@@ -1,0 +1,22 @@
+"""heat_tpu — a TPU-native distributed array and data-analytics framework.
+
+A from-scratch JAX/XLA implementation of the capabilities of Heat
+(``neosunhan/heat``, mounted read-only at /root/reference): a NumPy-like
+distributed ``DNDarray`` whose ``split`` axis is a ``NamedSharding`` over a
+TPU mesh, with distributed linalg, statistics, parallel RNG, parallel I/O,
+and an sklearn-style ML layer — MPI collectives replaced by XLA GSPMD over
+ICI/DCN throughout.
+"""
+from .core import *
+from .core import linalg
+from . import cluster
+from . import classification
+from . import graph
+from . import naive_bayes
+from . import parallel
+from . import regression
+from . import spatial
+from . import utils
+from .core import random
+from .core import version
+from .core.version import __version__

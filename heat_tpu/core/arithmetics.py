@@ -1,0 +1,233 @@
+"""Arithmetic operations (reference ``heat/core/arithmetics.py``).
+
+All binary ops ride :func:`_operations._binary_op` (promotion + broadcast +
+split propagation); reductions and cumops compile to partial+collective
+schedules by XLA. The reference's hand-rolled ``diff`` neighbor exchange
+(``arithmetics.py:293``) is a single global ``jnp.diff``.
+"""
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import types
+from ._operations import _binary_op, _cum_op, _local_op, _reduce_op
+from .dndarray import DNDarray
+
+__all__ = [
+    "add",
+    "bitwise_and",
+    "bitwise_not",
+    "bitwise_or",
+    "bitwise_xor",
+    "cumprod",
+    "cumproduct",
+    "cumsum",
+    "diff",
+    "div",
+    "divide",
+    "floordiv",
+    "floor_divide",
+    "fmod",
+    "invert",
+    "left_shift",
+    "mod",
+    "mul",
+    "multiply",
+    "nanprod",
+    "nansum",
+    "neg",
+    "negative",
+    "pos",
+    "positive",
+    "pow",
+    "power",
+    "prod",
+    "remainder",
+    "right_shift",
+    "sub",
+    "subtract",
+    "sum",
+]
+
+
+def add(t1, t2, out=None, where=True) -> DNDarray:
+    """Elementwise addition (reference ``arithmetics.py:63``)."""
+    return _binary_op(jnp.add, t1, t2, out=out, where=where)
+
+
+def sub(t1, t2, out=None, where=True) -> DNDarray:
+    """Elementwise subtraction (reference ``arithmetics.py``)."""
+    return _binary_op(jnp.subtract, t1, t2, out=out, where=where)
+
+
+subtract = sub
+
+
+def mul(t1, t2, out=None, where=True) -> DNDarray:
+    """Elementwise multiplication."""
+    return _binary_op(jnp.multiply, t1, t2, out=out, where=where)
+
+
+multiply = mul
+
+
+def div(t1, t2, out=None, where=True) -> DNDarray:
+    """Elementwise true division."""
+    res = _binary_op(jnp.true_divide, t1, t2, out=out, where=where)
+    return res
+
+
+divide = div
+
+
+def floordiv(t1, t2) -> DNDarray:
+    """Elementwise floor division."""
+    return _binary_op(jnp.floor_divide, t1, t2)
+
+
+floor_divide = floordiv
+
+
+def fmod(t1, t2) -> DNDarray:
+    """Elementwise C-style remainder (sign of the dividend)."""
+    return _binary_op(jnp.fmod, t1, t2)
+
+
+def mod(t1, t2) -> DNDarray:
+    """Elementwise python-style modulo (sign of the divisor)."""
+    return _binary_op(jnp.mod, t1, t2)
+
+
+remainder = mod
+
+
+def pow(t1, t2, out=None, where=True) -> DNDarray:
+    """Elementwise exponentiation."""
+    return _binary_op(jnp.power, t1, t2, out=out, where=where)
+
+
+power = pow
+
+
+def neg(a, out=None) -> DNDarray:
+    """Elementwise negation."""
+    return _local_op(jnp.negative, a, out=out, no_cast=True)
+
+
+negative = neg
+
+
+def pos(a, out=None) -> DNDarray:
+    """Elementwise unary plus."""
+    return _local_op(jnp.positive, a, out=out, no_cast=True)
+
+
+positive = pos
+
+
+def _check_int_or_bool(*tensors):
+    for t in tensors:
+        if isinstance(t, DNDarray) and not types.heat_type_is_exact(t.dtype):
+            raise TypeError(f"Operation not supported for float types, got {t.dtype}")
+        if isinstance(t, (float, complex)) and not isinstance(t, bool):
+            raise TypeError("Operation not supported for float scalars")
+
+
+def bitwise_and(t1, t2) -> DNDarray:
+    """Elementwise AND of integer/boolean arrays."""
+    _check_int_or_bool(t1, t2)
+    return _binary_op(jnp.bitwise_and, t1, t2)
+
+
+def bitwise_or(t1, t2) -> DNDarray:
+    _check_int_or_bool(t1, t2)
+    return _binary_op(jnp.bitwise_or, t1, t2)
+
+
+def bitwise_xor(t1, t2) -> DNDarray:
+    _check_int_or_bool(t1, t2)
+    return _binary_op(jnp.bitwise_xor, t1, t2)
+
+
+def invert(a, out=None) -> DNDarray:
+    """Elementwise bitwise NOT (reference ``arithmetics.py``)."""
+    _check_int_or_bool(a)
+    return _local_op(jnp.invert, a, out=out, no_cast=True)
+
+
+bitwise_not = invert
+
+
+def left_shift(t1, t2) -> DNDarray:
+    _check_int_or_bool(t1, t2)
+    return _binary_op(jnp.left_shift, t1, t2)
+
+
+def right_shift(t1, t2) -> DNDarray:
+    _check_int_or_bool(t1, t2)
+    return _binary_op(jnp.right_shift, t1, t2)
+
+
+def cumsum(a, axis: int, dtype=None, out=None) -> DNDarray:
+    """Cumulative sum (reference ``arithmetics.py:261`` — local cumsum +
+    Exscan; on TPU one jnp.cumsum, XLA inserts the scan collective)."""
+    return _cum_op(jnp.cumsum, a, axis, out=out, dtype=dtype)
+
+
+def cumprod(a, axis: int, dtype=None, out=None) -> DNDarray:
+    """Cumulative product (reference ``arithmetics.py:224``)."""
+    return _cum_op(jnp.cumprod, a, axis, out=out, dtype=dtype)
+
+
+cumproduct = cumprod
+
+
+def diff(a: DNDarray, n: int = 1, axis: int = -1) -> DNDarray:
+    """n-th discrete difference along an axis (reference
+    ``arithmetics.py:293`` hand-rolled the split-axis neighbor send; the
+    global jnp.diff compiles to a halo exchange automatically)."""
+    if n == 0:
+        return a
+    if n < 0:
+        raise ValueError(f"diff requires that n be a positive number, got {n}")
+    from .stride_tricks import sanitize_axis
+
+    axis = sanitize_axis(a.shape, axis)
+    result = jnp.diff(a.larray, n=n, axis=axis)
+    return DNDarray(
+        result,
+        dtype=types.canonical_heat_type(result.dtype),
+        split=a.split,
+        device=a.device,
+        comm=a.comm,
+    )
+
+
+def _int_to_int64(x: DNDarray):
+    # reference sum/prod accumulate small ints in int64 (torch semantics)
+    if types.heat_type_is_exact(x.dtype) and x.dtype not in (types.int64,):
+        return types.int64
+    return None
+
+
+def sum(a: DNDarray, axis=None, out=None, keepdims: bool = False) -> DNDarray:
+    """Sum over axis (reference ``arithmetics.py:960``)."""
+    return _reduce_op(jnp.sum, a, axis=axis, out=out, keepdims=keepdims, out_dtype=_int_to_int64(a))
+
+
+def prod(a: DNDarray, axis=None, out=None, keepdims: bool = False) -> DNDarray:
+    """Product over axis (reference ``arithmetics.py:870``)."""
+    return _reduce_op(jnp.prod, a, axis=axis, out=out, keepdims=keepdims, out_dtype=_int_to_int64(a))
+
+
+def nansum(a: DNDarray, axis=None, out=None, keepdims: bool = False) -> DNDarray:
+    """Sum ignoring NaNs."""
+    return _reduce_op(jnp.nansum, a, axis=axis, out=out, keepdims=keepdims)
+
+
+def nanprod(a: DNDarray, axis=None, out=None, keepdims: bool = False) -> DNDarray:
+    """Product ignoring NaNs."""
+    return _reduce_op(jnp.nanprod, a, axis=axis, out=out, keepdims=keepdims)

@@ -1,0 +1,253 @@
+"""Device-mesh "communication" layer — the TPU-native replacement for MPI.
+
+The reference implements a 1941-line MPI wrapper
+(``heat/core/communication.py``): tensor-aware buffers, derived datatypes,
+GPU staging, axis-permuting collectives. On TPU none of that machinery is
+needed — a ``jax.sharding.Mesh`` plus ``NamedSharding`` annotations *is* the
+communication backend: XLA GSPMD inserts all-reduce / all-gather /
+all-to-all / collective-permute on ICI automatically, and explicit
+collectives are expressed with ``jax.lax`` primitives inside ``shard_map``.
+
+What survives here is the *bookkeeping* interface the rest of the library
+speaks (reference ``communication.py:120,161-239,1886-1937``):
+
+- ``MPICommunication`` -> :class:`MeshCommunication`: holds the device mesh,
+  knows the world ``size``/``rank``, computes ``chunk()`` partitions and
+  ``counts_displs_shape()``.
+- ``MPI_WORLD``/``MPI_SELF`` singletons and ``get_comm``/``use_comm``/
+  ``sanitize_comm``.
+
+Partitioning note: the reference balances remainders across the first ranks
+(``communication.py:161-209``); XLA shards an axis in ceil-div blocks (the
+last shard may be short or empty). ``chunk()`` follows the XLA convention so
+that ``lshape_map`` always reflects the true on-device layout.
+"""
+from __future__ import annotations
+
+import math
+from contextlib import contextmanager
+from typing import List, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = [
+    "Communication",
+    "MeshCommunication",
+    "MPI_WORLD",
+    "MPI_SELF",
+    "WORLD",
+    "SELF",
+    "get_comm",
+    "use_comm",
+    "sanitize_comm",
+    "SPLIT_AXIS",
+]
+
+# canonical mesh-axis name carrying the DNDarray ``split`` dimension
+SPLIT_AXIS = "split"
+
+
+class Communication:
+    """Base class for communication backends (reference ``communication.py:88``)."""
+
+    @staticmethod
+    def is_distributed() -> bool:
+        raise NotImplementedError()
+
+    def chunk(self, shape, split, rank=None) -> Tuple[int, Tuple[int, ...], Tuple[slice, ...]]:
+        raise NotImplementedError()
+
+
+class MeshCommunication(Communication):
+    """A communicator backed by a JAX device mesh.
+
+    Parameters
+    ----------
+    devices : list of jax.Device, optional
+        Devices forming the mesh. Defaults to all devices of the default
+        backend.
+    mesh : jax.sharding.Mesh, optional
+        Pre-built mesh. Must contain the axis ``split``; additional axes
+        (e.g. a slow DCN axis for hierarchical data-parallelism) are allowed
+        and are used by :mod:`heat_tpu.optim`.
+    """
+
+    def __init__(self, devices: Optional[List] = None, mesh: Optional[Mesh] = None):
+        if mesh is not None:
+            if SPLIT_AXIS not in mesh.axis_names:
+                raise ValueError(f"mesh must contain axis {SPLIT_AXIS!r}, got {mesh.axis_names}")
+            self._mesh = mesh
+        else:
+            if devices is None:
+                devices = jax.devices()
+            self._mesh = Mesh(np.array(devices), axis_names=(SPLIT_AXIS,))
+        self._devices = list(self._mesh.devices.flat)
+
+    # -- world-style properties ------------------------------------------------
+    @property
+    def mesh(self) -> Mesh:
+        return self._mesh
+
+    @property
+    def size(self) -> int:
+        """Number of shards along the split axis (MPI world-size analogue)."""
+        return self._mesh.shape[SPLIT_AXIS]
+
+    @property
+    def rank(self) -> int:
+        """Index of the controlling process (multi-host: ``jax.process_index``).
+
+        Under single-controller JAX every process sees the *global* array, so
+        unlike MPI code the library almost never branches on ``rank``.
+        """
+        return jax.process_index()
+
+    def is_distributed(self) -> bool:
+        return self.size > 1
+
+    # -- sharding construction -------------------------------------------------
+    def spec(self, ndim: int, split: Optional[int]) -> PartitionSpec:
+        """PartitionSpec placing the mesh split-axis at dimension ``split``."""
+        if split is None:
+            return PartitionSpec()
+        if not 0 <= split < max(ndim, 1):
+            raise ValueError(f"split {split} out of range for ndim {ndim}")
+        parts = [None] * ndim
+        parts[split] = SPLIT_AXIS
+        return PartitionSpec(*parts)
+
+    def sharding(self, ndim: int, split: Optional[int]) -> NamedSharding:
+        """NamedSharding for an ``ndim``-dim array split along ``split``."""
+        return NamedSharding(self._mesh, self.spec(ndim, split))
+
+    def phys_split(self, shape, split: Optional[int]) -> Optional[int]:
+        """The physically realizable split: XLA requires the sharded dim to
+        divide the mesh size; non-divisible dims are replicated (the
+        DNDarray keeps the logical ``split`` as metadata)."""
+        if split is None:
+            return None
+        if shape[split] % self.size != 0:
+            return None
+        return split
+
+    def array_sharding(self, shape, split: Optional[int]) -> NamedSharding:
+        """Sharding actually applied to an array of ``shape`` (divisibility
+        rule included)."""
+        return self.sharding(len(shape), self.phys_split(shape, split))
+
+    # -- partition bookkeeping (reference communication.py:161-239) -----------
+    def chunk(
+        self, shape, split: Optional[int], rank: Optional[int] = None
+    ) -> Tuple[int, Tuple[int, ...], Tuple[slice, ...]]:
+        """Compute the shard of ``shape`` owned by ``rank`` along ``split``.
+
+        Returns ``(offset, local_shape, slices)`` like the reference
+        (``communication.py:161-209``), but using XLA's ceil-div layout.
+        """
+        shape = tuple(int(s) for s in shape)
+        if split is None:
+            return 0, shape, tuple(slice(0, s) for s in shape)
+        rank = self.rank if rank is None else rank
+        n = shape[split]
+        block = -(-n // self.size) if n else 0  # ceil div
+        start = min(rank * block, n)
+        end = min(start + block, n)
+        lshape = list(shape)
+        lshape[split] = end - start
+        slices = tuple(
+            slice(start, end) if i == split else slice(0, s) for i, s in enumerate(shape)
+        )
+        return start, tuple(lshape), slices
+
+    def counts_displs_shape(self, shape, split: int):
+        """Per-rank counts/displacements along ``split`` (ref ``:211-239``)."""
+        shape = tuple(int(s) for s in shape)
+        n = shape[split]
+        block = -(-n // self.size) if n else 0
+        counts, displs = [], []
+        for r in range(self.size):
+            start = min(r * block, n)
+            end = min(start + block, n)
+            counts.append(end - start)
+            displs.append(start)
+        output_shape = list(shape)
+        output_shape[split] = block
+        return tuple(counts), tuple(displs), tuple(output_shape)
+
+    def lshape_map(self, shape, split: Optional[int]) -> np.ndarray:
+        """(size, ndim) array of every shard's local shape (ref ``dndarray.py:569``).
+
+        Pure metadata on TPU — no Allreduce needed.
+        """
+        shape = tuple(int(s) for s in shape)
+        ndim = max(len(shape), 1)
+        out = np.empty((self.size, len(shape)), dtype=np.int64)
+        for r in range(self.size):
+            _, lshape, _ = self.chunk(shape, split, rank=r)
+            out[r] = lshape if len(shape) else ()
+        return out
+
+    # -- misc -----------------------------------------------------------------
+    def __repr__(self) -> str:
+        return f"MeshCommunication(size={self.size}, mesh={self._mesh!r})"
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, MeshCommunication) and self._mesh == other._mesh
+
+    def __hash__(self):
+        return hash(self._mesh)
+
+
+class _SelfCommunication(MeshCommunication):
+    """Single-device communicator (MPI_SELF analogue)."""
+
+    def __init__(self):
+        super().__init__(devices=[jax.devices()[0]])
+
+
+# module-level singletons (reference communication.py:1886-1937)
+WORLD = MeshCommunication()
+SELF = _SelfCommunication()
+# Names kept for reference-API familiarity; there is no MPI underneath.
+MPI_WORLD = WORLD
+MPI_SELF = SELF
+
+_default_comm = WORLD
+
+
+def get_comm() -> MeshCommunication:
+    """The current default communicator (reference ``communication.py:1907``)."""
+    return _default_comm
+
+
+def use_comm(comm: Optional[MeshCommunication] = None) -> None:
+    """Set the default communicator (reference ``communication.py:1927``)."""
+    global _default_comm
+    if comm is None:
+        comm = WORLD
+    if not isinstance(comm, Communication):
+        raise TypeError(f"expected a Communication object, got {type(comm)}")
+    _default_comm = comm
+
+
+def sanitize_comm(comm) -> MeshCommunication:
+    """Default-or-validate a communicator (reference ``communication.py:1917``)."""
+    if comm is None:
+        return get_comm()
+    if not isinstance(comm, Communication):
+        raise TypeError(f"expected a Communication object, got {type(comm)}")
+    return comm
+
+
+@contextmanager
+def comm_context(comm: MeshCommunication):
+    """Temporarily swap the default communicator."""
+    global _default_comm
+    prev = _default_comm
+    _default_comm = comm
+    try:
+        yield comm
+    finally:
+        _default_comm = prev

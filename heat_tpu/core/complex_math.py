@@ -1,0 +1,35 @@
+"""Complex number operations (reference ``heat/core/complex_math.py``)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import types
+from ._operations import _local_op
+from .dndarray import DNDarray
+
+__all__ = ["angle", "conj", "conjugate", "imag", "real"]
+
+
+def angle(x, deg: bool = False, out=None) -> DNDarray:
+    """Phase angle of a complex array (reference ``complex_math.py``)."""
+    return _local_op(lambda t: jnp.angle(t, deg=deg), x, out=out, no_cast=True)
+
+
+def conjugate(x, out=None) -> DNDarray:
+    """Complex conjugate."""
+    return _local_op(jnp.conjugate, x, out=out, no_cast=True)
+
+
+conj = conjugate
+
+
+def imag(x, out=None) -> DNDarray:
+    """Imaginary part; zeros for real input."""
+    return _local_op(jnp.imag, x, out=out, no_cast=True)
+
+
+def real(x, out=None) -> DNDarray:
+    """Real part."""
+    if isinstance(x, DNDarray) and not types.heat_type_is_complexfloating(x.dtype):
+        return x
+    return _local_op(jnp.real, x, out=out, no_cast=True)

@@ -1,0 +1,16 @@
+"""Mathematical constants (reference ``heat/core/constants.py``)."""
+import numpy as np
+
+__all__ = ["e", "Euler", "inf", "Inf", "Infty", "Infinity", "nan", "NaN", "pi"]
+
+e = float(np.e)
+pi = float(np.pi)
+inf = float("inf")
+nan = float("nan")
+
+# aliases (reference ``constants.py``)
+Euler = e
+Inf = inf
+Infty = inf
+Infinity = inf
+NaN = nan

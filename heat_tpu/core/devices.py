@@ -1,0 +1,105 @@
+"""Device abstraction (reference ``heat/core/devices.py``).
+
+The reference pins one CUDA device per MPI rank round-robin
+(``devices.py:98-102``). Under single-controller JAX the mesh owns device
+placement, so :class:`Device` is a light label selecting the JAX platform
+("cpu" or "tpu"); all arrays on a given platform are sharded across that
+platform's devices via the mesh.
+"""
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax
+
+__all__ = ["Device", "cpu", "get_device", "use_device", "sanitize_device"]
+
+
+class Device:
+    """A compute platform label (reference ``devices.py:17``).
+
+    Parameters
+    ----------
+    device_type : str
+        "cpu", "tpu" (or "gpu" where available).
+    device_id : int
+        Kept for reference-API parity; under a mesh, placement is collective
+        so this is informational only.
+    """
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        self.__device_type = str(device_type)
+        self.__device_id = int(device_id)
+
+    @property
+    def device_type(self) -> str:
+        return self.__device_type
+
+    @property
+    def device_id(self) -> int:
+        return self.__device_id
+
+    @property
+    def jax_platform(self) -> str:
+        return self.__device_type
+
+    def __repr__(self) -> str:
+        return f"device({self.__str__()!r})"
+
+    def __str__(self) -> str:
+        return f"{self.device_type}:{self.device_id}"
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, Device):
+            return self.device_type == other.device_type and self.device_id == other.device_id
+        if isinstance(other, str):
+            return str(self) == other or self.device_type == other
+        return NotImplemented
+
+    def __hash__(self):
+        return hash(str(self))
+
+
+cpu = Device("cpu")
+"""The CPU device singleton (reference ``devices.py:79``)."""
+
+# Expose an accelerator singleton when one is present (tpu preferred).
+_accel: Optional[Device] = None
+try:  # pragma: no cover - depends on runtime platform
+    _platform = jax.default_backend()
+    if _platform not in ("cpu",):
+        _accel = Device(_platform)
+        globals()[_platform] = _accel
+        __all__.append(_platform)
+except Exception:  # noqa: BLE001
+    pass
+
+__default_device = _accel if _accel is not None else cpu
+
+
+def get_device() -> Device:
+    """The currently globally-set default device (reference ``devices.py:121``)."""
+    return __default_device
+
+
+def use_device(device: Optional[Union[str, Device]] = None) -> None:
+    """Set the global default device (reference ``devices.py:135``)."""
+    global __default_device
+    __default_device = sanitize_device(device)
+
+
+def sanitize_device(device: Optional[Union[str, Device]]) -> Device:
+    """Default-or-validate a device argument (reference ``devices.py:157``)."""
+    if device is None:
+        return get_device()
+    if isinstance(device, Device):
+        return device
+    if isinstance(device, str):
+        name = device.lower().split(":")[0]
+        if name == "cpu":
+            return cpu
+        if _accel is not None and name == _accel.device_type:
+            return _accel
+        if name in ("gpu", "tpu", "axon") and _accel is not None:
+            return _accel
+    raise ValueError(f"Unknown device, must be 'cpu' or an available accelerator, got {device}")

@@ -1,0 +1,81 @@
+"""Exponential and logarithmic functions (reference ``heat/core/exponential.py``)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ._operations import _local_op
+from .dndarray import DNDarray
+
+__all__ = [
+    "exp",
+    "expm1",
+    "exp2",
+    "log",
+    "log2",
+    "log10",
+    "log1p",
+    "logaddexp",
+    "logaddexp2",
+    "sqrt",
+    "rsqrt",
+    "square",
+    "cbrt",
+]
+
+
+def exp(x, out=None) -> DNDarray:
+    """Elementwise e**x."""
+    return _local_op(jnp.exp, x, out=out)
+
+
+def expm1(x, out=None) -> DNDarray:
+    return _local_op(jnp.expm1, x, out=out)
+
+
+def exp2(x, out=None) -> DNDarray:
+    return _local_op(jnp.exp2, x, out=out)
+
+
+def log(x, out=None) -> DNDarray:
+    return _local_op(jnp.log, x, out=out)
+
+
+def log2(x, out=None) -> DNDarray:
+    return _local_op(jnp.log2, x, out=out)
+
+
+def log10(x, out=None) -> DNDarray:
+    return _local_op(jnp.log10, x, out=out)
+
+
+def log1p(x, out=None) -> DNDarray:
+    return _local_op(jnp.log1p, x, out=out)
+
+
+def logaddexp(t1, t2) -> DNDarray:
+    from ._operations import _binary_op
+
+    return _binary_op(jnp.logaddexp, t1, t2)
+
+
+def logaddexp2(t1, t2) -> DNDarray:
+    from ._operations import _binary_op
+
+    return _binary_op(jnp.logaddexp2, t1, t2)
+
+
+def sqrt(x, out=None) -> DNDarray:
+    return _local_op(jnp.sqrt, x, out=out)
+
+
+def rsqrt(x, out=None) -> DNDarray:
+    """Reciprocal square root (rsqrt is a single TPU VPU op)."""
+    return _local_op(lambda t: jnp.reciprocal(jnp.sqrt(t)), x, out=out)
+
+
+def square(x, out=None) -> DNDarray:
+    return _local_op(jnp.square, x, out=out, no_cast=True)
+
+
+def cbrt(x, out=None) -> DNDarray:
+    return _local_op(jnp.cbrt, x, out=out)

@@ -1,0 +1,299 @@
+"""Array factories (reference ``heat/core/factories.py``).
+
+Every factory builds the array *directly in its target sharding* via
+``jax.jit(..., out_shardings=...)`` where possible, so large distributed
+arrays never materialize on one device. The reference's ``is_split=``
+global-shape inference (neighbor Isend/Probe/Recv, ``factories.py:383-426``)
+is only meaningful multi-host; under multi-process JAX it maps onto
+``jax.make_array_from_process_local_data``.
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import devices, types
+from .communication import MeshCommunication, sanitize_comm
+from .devices import Device
+from .dndarray import DNDarray
+from .stride_tricks import sanitize_axis, sanitize_shape
+
+__all__ = [
+    "arange",
+    "array",
+    "asarray",
+    "empty",
+    "empty_like",
+    "eye",
+    "full",
+    "full_like",
+    "linspace",
+    "logspace",
+    "meshgrid",
+    "ones",
+    "ones_like",
+    "zeros",
+    "zeros_like",
+]
+
+
+def array(
+    obj,
+    dtype=None,
+    copy: bool = True,
+    ndmin: int = 0,
+    order: str = "C",
+    split: Optional[int] = None,
+    is_split: Optional[int] = None,
+    device: Optional[Union[str, Device]] = None,
+    comm: Optional[MeshCommunication] = None,
+) -> DNDarray:
+    """The main constructor (reference ``factories.py:150-431``).
+
+    ``split=k`` shards the global input along axis ``k`` over the mesh.
+    ``is_split=k`` declares the input to be this *process's* local shard;
+    with one controlling process the local data is the global data, and
+    multi-host processes are assembled with
+    ``jax.make_array_from_process_local_data``.
+    """
+    if split is not None and is_split is not None:
+        raise ValueError(f"split and is_split are mutually exclusive, got {split}, {is_split}")
+    comm = sanitize_comm(comm)
+    device = devices.sanitize_device(device)
+
+    if isinstance(obj, DNDarray):
+        if dtype is None:
+            dtype = obj.dtype
+        data = obj.larray
+    else:
+        data = obj
+
+    if dtype is not None:
+        dtype = types.canonical_heat_type(dtype)
+        jt = dtype.jax_type()
+    else:
+        jt = None
+
+    if not isinstance(data, jax.Array):
+        np_data = np.asarray(data)
+        if np_data.dtype == np.float64 and jt is None and not isinstance(data, np.ndarray):
+            # python floats default to float32, matching the reference/torch
+            np_data = np_data.astype(np.float32)
+        data = jnp.asarray(np_data, dtype=jt)
+    elif jt is not None and data.dtype != np.dtype(jt):
+        data = data.astype(jt)
+
+    while data.ndim < ndmin:
+        data = data[jnp.newaxis]
+
+    if is_split is not None:
+        is_split = sanitize_axis(data.shape, is_split)
+        if jax.process_count() > 1:  # pragma: no cover - multi-host only
+            sharding = comm.sharding(data.ndim, is_split)
+            gshape = list(data.shape)
+            gshape[is_split] = data.shape[is_split] * jax.process_count()
+            data = jax.make_array_from_process_local_data(sharding, np.asarray(data), tuple(gshape))
+        split = is_split
+
+    return DNDarray(data, dtype=dtype, split=split, device=device, comm=comm)
+
+
+def asarray(obj, dtype=None, copy=None, order="C", device=None) -> DNDarray:
+    """Convert to DNDarray without copy when possible (reference
+    ``factories.py``)."""
+    if isinstance(obj, DNDarray) and (dtype is None or obj.dtype == types.canonical_heat_type(dtype)):
+        return obj
+    return array(obj, dtype=dtype, device=device)
+
+
+def _sharded_factory(shape, split, comm, fill) -> jax.Array:
+    """jit a fill function straight into the target sharding (no host pass).
+
+    jit output shardings require the split dim to divide the mesh; uneven
+    shapes fall back to compute-then-reshard (device-to-device on ICI).
+    """
+    sharding = comm.array_sharding(shape, split)
+    return jax.jit(fill, out_shardings=sharding)()
+
+
+def __factory(shape, dtype, split, device, comm, fill_name) -> DNDarray:
+    shape = sanitize_shape(shape)
+    dtype = types.canonical_heat_type(dtype)
+    split = sanitize_axis(shape, split)
+    device = devices.sanitize_device(device)
+    comm = sanitize_comm(comm)
+    jt = dtype.jax_type()
+    if fill_name == "zeros":
+        data = _sharded_factory(shape, split, comm, lambda: jnp.zeros(shape, dtype=jt))
+    elif fill_name == "ones":
+        data = _sharded_factory(shape, split, comm, lambda: jnp.ones(shape, dtype=jt))
+    else:
+        raise ValueError(fill_name)
+    return DNDarray(data, dtype=dtype, split=split, device=device, comm=comm)
+
+
+def zeros(shape, dtype=types.float32, split=None, device=None, comm=None, order="C") -> DNDarray:
+    """reference ``factories.py:1225``"""
+    return __factory(shape, dtype, split, device, comm, "zeros")
+
+
+def ones(shape, dtype=types.float32, split=None, device=None, comm=None, order="C") -> DNDarray:
+    """reference ``factories.py:1128``"""
+    return __factory(shape, dtype, split, device, comm, "ones")
+
+
+def empty(shape, dtype=types.float32, split=None, device=None, comm=None, order="C") -> DNDarray:
+    """reference ``factories.py:488``. XLA has no uninitialized alloc; zeros."""
+    return __factory(shape, dtype, split, device, comm, "zeros")
+
+
+def full(shape, fill_value, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
+    """reference ``factories.py:789``"""
+    shape = sanitize_shape(shape)
+    if dtype is None:
+        dtype = types.heat_type_of(fill_value)
+        if dtype == types.int64 and isinstance(fill_value, int):
+            dtype = types.float32 if isinstance(fill_value, bool) else dtype
+    dtype = types.canonical_heat_type(dtype)
+    comm = sanitize_comm(comm)
+    split = sanitize_axis(shape, split)
+    jt = dtype.jax_type()
+    data = _sharded_factory(shape, split, comm, lambda: jnp.full(shape, fill_value, dtype=jt))
+    return DNDarray(data, dtype=dtype, split=split, device=devices.sanitize_device(device), comm=comm)
+
+
+def _like_meta(a: DNDarray, dtype, split, device, comm):
+    return (
+        a.shape,
+        dtype if dtype is not None else a.dtype,
+        split if split is not None else a.split,
+        device if device is not None else a.device,
+        comm if comm is not None else a.comm,
+    )
+
+
+def zeros_like(a, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
+    return zeros(*_like_meta(a, dtype, split, device, comm))
+
+
+def ones_like(a, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
+    return ones(*_like_meta(a, dtype, split, device, comm))
+
+
+def empty_like(a, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
+    return empty(*_like_meta(a, dtype, split, device, comm))
+
+
+def full_like(a, fill_value, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
+    shape, dtype_, split_, device_, comm_ = _like_meta(a, dtype, split, device, comm)
+    return full(shape, fill_value, dtype=dtype if dtype is not None else None, split=split_, device=device_, comm=comm_)
+
+
+def arange(*args, dtype=None, split=None, device=None, comm=None) -> DNDarray:
+    """reference ``factories.py:40``"""
+    if len(args) == 1:
+        start, stop, step = 0, args[0], 1
+    elif len(args) == 2:
+        start, stop, step = args[0], args[1], 1
+    elif len(args) == 3:
+        start, stop, step = args
+    else:
+        raise TypeError(f"function takes 1 to 3 positional arguments but {len(args)} were given")
+    if dtype is None:
+        if all(isinstance(a, (int, np.integer)) for a in (start, stop, step)):
+            dtype = types.int32
+        else:
+            dtype = types.float32
+    dtype = types.canonical_heat_type(dtype)
+    comm = sanitize_comm(comm)
+    n = int(max(0, -(-(stop - start) // step))) if step != 0 else 0
+    split = sanitize_axis((n,), split)
+    jt = dtype.jax_type()
+    data = _sharded_factory(
+        (n,), split, comm, lambda: jnp.arange(start, stop, step, dtype=jt)
+    )
+    return DNDarray(data, dtype=dtype, split=split, device=devices.sanitize_device(device), comm=comm)
+
+
+def linspace(
+    start,
+    stop,
+    num: int = 50,
+    endpoint: bool = True,
+    retstep: bool = False,
+    dtype=None,
+    split=None,
+    device=None,
+    comm=None,
+):
+    """reference ``factories.py:896``"""
+    num = int(num)
+    comm = sanitize_comm(comm)
+    split = sanitize_axis((num,), split)
+    dtype = types.canonical_heat_type(dtype) if dtype is not None else types.float32
+    jt = dtype.jax_type()
+    data = _sharded_factory(
+        (num,), split, comm, lambda: jnp.linspace(start, stop, num, endpoint=endpoint).astype(jt)
+    )
+    res = DNDarray(data, dtype=dtype, split=split, device=devices.sanitize_device(device), comm=comm)
+    if retstep:
+        step = (stop - start) / max(1, (num - 1 if endpoint else num))
+        return res, step
+    return res
+
+
+def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None, split=None, device=None, comm=None) -> DNDarray:
+    """reference ``factories.py:982``"""
+    from . import exponential, arithmetics
+
+    y = linspace(start, stop, num=num, endpoint=endpoint, split=split, device=device, comm=comm)
+    res = arithmetics.pow(float(base), y)
+    if dtype is not None:
+        return res.astype(dtype)
+    return res
+
+
+def eye(shape, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """reference ``factories.py:586``"""
+    if isinstance(shape, (int, np.integer)):
+        n, m = int(shape), int(shape)
+    else:
+        shape = tuple(shape)
+        n, m = (int(shape[0]), int(shape[0])) if len(shape) == 1 else (int(shape[0]), int(shape[1]))
+    dtype = types.canonical_heat_type(dtype)
+    comm = sanitize_comm(comm)
+    split = sanitize_axis((n, m), split)
+    jt = dtype.jax_type()
+    data = _sharded_factory((n, m), split, comm, lambda: jnp.eye(n, m, dtype=jt))
+    return DNDarray(data, dtype=dtype, split=split, device=devices.sanitize_device(device), comm=comm)
+
+
+def meshgrid(*arrays, indexing: str = "xy") -> List[DNDarray]:
+    """reference ``factories.py:1045``. Outputs inherit the split of the
+    corresponding input dimension where possible."""
+    if indexing not in ("xy", "ij"):
+        raise ValueError(f"indexing must be 'xy' or 'ij', got {indexing}")
+    dnd = [a if isinstance(a, DNDarray) else array(a) for a in arrays]
+    if len(dnd) == 0:
+        return []
+    comm = dnd[0].comm
+    device = dnd[0].device
+    splits = [a.split for a in dnd]
+    grids = jnp.meshgrid(*[a.larray for a in dnd], indexing=indexing)
+    # determine output split: if any input was split, shard outputs along the
+    # dimension that input occupies in the grid
+    out_split = None
+    for i, s in enumerate(splits):
+        if s is not None:
+            dim = i
+            if indexing == "xy" and i < 2 and len(dnd) >= 2:
+                dim = 1 - i
+            out_split = dim
+            break
+    return [
+        DNDarray(g, dtype=types.canonical_heat_type(g.dtype), split=out_split, device=device, comm=comm)
+        for g in grids
+    ]

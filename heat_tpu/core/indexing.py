@@ -1,0 +1,41 @@
+"""Indexing operations (reference ``heat/core/indexing.py``)."""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from . import types
+from ._operations import _binary_op
+from .dndarray import DNDarray
+
+__all__ = ["nonzero", "where"]
+
+
+def nonzero(x: DNDarray) -> Tuple[DNDarray, ...]:
+    """Indices of nonzero elements, one 1-D array per dimension (reference
+    ``indexing.py:16`` — local nonzero + global offset; a global jnp call
+    here). Result is split=0 when the input was distributed."""
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"expected x to be a DNDarray, but was {type(x)}")
+    result = jnp.nonzero(x.larray)
+    split = 0 if x.split is not None else None
+    return tuple(
+        DNDarray(r.astype(jnp.int64), dtype=types.int64, split=split, device=x.device, comm=x.comm)
+        for r in result
+    )
+
+
+def where(cond: DNDarray, x=None, y=None) -> DNDarray:
+    """Ternary where / nonzero dispatch (reference ``indexing.py:91``)."""
+    if x is None and y is None:
+        return nonzero(cond)
+    if x is None or y is None:
+        raise TypeError("either both or neither of x and y should be given")
+    xs = x.larray if isinstance(x, DNDarray) else x
+    ys = y.larray if isinstance(y, DNDarray) else y
+    result = jnp.where(cond.larray.astype(jnp.bool_), xs, ys)
+    split = cond.split
+    if isinstance(x, DNDarray) and x.split is not None:
+        split = x.split if split is None else split
+    return DNDarray(result, split=split if result.ndim == cond.ndim else None, device=cond.device, comm=cond.comm)

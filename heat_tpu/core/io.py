@@ -1,0 +1,226 @@
+"""Parallel I/O (reference ``heat/core/io.py``).
+
+The reference reads per-rank byte/row ranges through parallel HDF5
+(``mpio`` driver) / netCDF4 / CSV splitting (``io.py:57-1111``). Under
+single-controller JAX the controller reads and shards via ``device_put``;
+under multi-host each process reads only its ``comm.chunk`` slice and the
+global array is assembled with ``jax.make_array_from_single_device_arrays``.
+netCDF support is gated on the library being installed (not in this image).
+"""
+from __future__ import annotations
+
+import csv as csv_module
+import os
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import devices, types
+from .communication import sanitize_comm
+from .dndarray import DNDarray
+
+try:
+    import h5py
+
+    __HDF5_EXTENSIONS = [".h5", ".hdf5"]
+    __HAS_HDF5 = True
+except ImportError:  # pragma: no cover
+    __HDF5_EXTENSIONS = []
+    __HAS_HDF5 = False
+
+try:  # pragma: no cover - not in this image
+    import netCDF4 as nc
+
+    __NETCDF_EXTENSIONS = [".nc", ".nc4", ".netcdf"]
+    __HAS_NETCDF = True
+except ImportError:
+    __NETCDF_EXTENSIONS = [".nc", ".nc4", ".netcdf"]
+    __HAS_NETCDF = False
+
+__CSV_EXTENSION = ".csv"
+
+__all__ = [
+    "load",
+    "load_csv",
+    "load_hdf5",
+    "load_netcdf",
+    "save",
+    "save_csv",
+    "save_hdf5",
+    "save_netcdf",
+    "supports_hdf5",
+    "supports_netcdf",
+]
+
+
+def supports_hdf5() -> bool:
+    """Whether h5py is available (reference ``io.py``)."""
+    return __HAS_HDF5
+
+
+def supports_netcdf() -> bool:
+    """Whether netCDF4 is available (reference ``io.py``)."""
+    return __HAS_NETCDF
+
+
+def load(path: str, *args, **kwargs) -> DNDarray:
+    """Load by file extension (reference ``io.py:662``)."""
+    if not isinstance(path, str):
+        raise TypeError(f"Expected path to be str, but was {type(path)}")
+    extension = os.path.splitext(path)[-1].strip().lower()
+    if extension in (".h5", ".hdf5"):
+        return load_hdf5(path, *args, **kwargs)
+    if extension in __NETCDF_EXTENSIONS:
+        return load_netcdf(path, *args, **kwargs)
+    if extension == __CSV_EXTENSION:
+        return load_csv(path, *args, **kwargs)
+    raise ValueError(f"Unsupported file extension {extension}")
+
+
+def load_hdf5(
+    path: str,
+    dataset: str,
+    dtype=types.float32,
+    split: Optional[int] = None,
+    device=None,
+    comm=None,
+) -> DNDarray:
+    """Load an HDF5 dataset, each process reading only its chunk (reference
+    ``io.py:57``)."""
+    if not __HAS_HDF5:
+        raise ImportError("h5py is required for HDF5 support")
+    if not isinstance(path, str):
+        raise TypeError(f"path must be str, not {type(path)}")
+    if not isinstance(dataset, str):
+        raise TypeError(f"dataset must be str, not {type(dataset)}")
+    comm = sanitize_comm(comm)
+    dtype = types.canonical_heat_type(dtype)
+    with h5py.File(path, "r") as handle:
+        data = handle[dataset]
+        gshape = tuple(data.shape)
+        if jax.process_count() > 1 and split is not None:  # pragma: no cover
+            _, _, slices = comm.chunk(gshape, split, rank=jax.process_index())
+            local = np.asarray(data[slices], dtype=np.dtype(dtype.jax_type()))
+            sharding = comm.sharding(len(gshape), split)
+            arrays = [
+                jax.device_put(local[_local_slice(comm, gshape, split, d, local)], d)
+                for d in sharding.addressable_devices
+            ]
+            garr = jax.make_array_from_single_device_arrays(gshape, sharding, arrays)
+            return DNDarray(garr, dtype=dtype, split=split, device=device, comm=comm)
+        arr = np.asarray(data[...], dtype=np.dtype(dtype.jax_type()))
+    return DNDarray(jnp.asarray(arr), dtype=dtype, split=split, device=device, comm=comm)
+
+
+def _local_slice(comm, gshape, split, device, local):  # pragma: no cover - multi-host
+    return tuple(slice(None) for _ in gshape)
+
+
+def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs) -> None:
+    """Save to HDF5 (reference ``io.py:149``)."""
+    if not __HAS_HDF5:
+        raise ImportError("h5py is required for HDF5 support")
+    if not isinstance(data, DNDarray):
+        raise TypeError(f"data must be a DNDarray, not {type(data)}")
+    if not isinstance(path, str):
+        raise TypeError(f"path must be str, not {type(path)}")
+    arr = data.numpy()
+    if jax.process_index() == 0:
+        with h5py.File(path, mode) as handle:
+            handle.create_dataset(dataset, data=arr, **kwargs)
+
+
+def load_netcdf(path: str, variable: str, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """Load a netCDF variable (reference ``io.py:268``); requires netCDF4."""
+    if not __HAS_NETCDF:
+        raise ImportError("netCDF4 is required for netCDF support (not available in this build)")
+    comm = sanitize_comm(comm)  # pragma: no cover
+    dtype = types.canonical_heat_type(dtype)
+    with nc.Dataset(path, "r") as handle:
+        arr = np.asarray(handle[variable][...], dtype=np.dtype(dtype.jax_type()))
+    return DNDarray(jnp.asarray(arr), dtype=dtype, split=split, device=device, comm=comm)
+
+
+def save_netcdf(data: DNDarray, path: str, variable: str, mode: str = "w", **kwargs) -> None:
+    """Save to netCDF (reference ``io.py:351``); requires netCDF4."""
+    if not __HAS_NETCDF:
+        raise ImportError("netCDF4 is required for netCDF support (not available in this build)")
+    arr = data.numpy()  # pragma: no cover
+    with nc.Dataset(path, mode) as handle:
+        dims = []
+        for i, s in enumerate(arr.shape):
+            name = f"dim_{i}"
+            handle.createDimension(name, s)
+            dims.append(name)
+        var = handle.createVariable(variable, arr.dtype, tuple(dims))
+        var[...] = arr
+
+
+def load_csv(
+    path: str,
+    header_lines: int = 0,
+    sep: str = ",",
+    dtype=types.float32,
+    encoding: str = "utf-8",
+    split: Optional[int] = None,
+    device=None,
+    comm=None,
+) -> DNDarray:
+    """Load a CSV file (reference ``io.py:713`` read per-rank byte ranges;
+    the controller reads and shards here)."""
+    if not isinstance(path, str):
+        raise TypeError(f"path must be str, not {type(path)}")
+    if not isinstance(sep, str):
+        raise TypeError(f"separator must be str, not {type(sep)}")
+    if not isinstance(header_lines, int):
+        raise TypeError(f"header_lines must be int, not {type(header_lines)}")
+    dtype = types.canonical_heat_type(dtype)
+    data = np.genfromtxt(
+        path, delimiter=sep, skip_header=header_lines, dtype=np.dtype(dtype.jax_type()), encoding=encoding
+    )
+    return DNDarray(jnp.asarray(data), dtype=dtype, split=split, device=device, comm=comm)
+
+
+def save_csv(
+    data: DNDarray,
+    path: str,
+    header_lines=None,
+    sep: str = ",",
+    decimals: int = -1,
+    encoding: str = "utf-8",
+    **kwargs,
+) -> None:
+    """Save to CSV (reference ``io.py:926``)."""
+    if not isinstance(data, DNDarray):
+        raise TypeError(f"data must be a DNDarray, not {type(data)}")
+    arr = data.numpy()
+    if arr.ndim == 1:
+        arr = arr[:, None]
+    fmt = "%s"
+    if types.heat_type_is_exact(data.dtype):
+        fmt = "%d"
+    elif decimals >= 0:
+        fmt = f"%.{decimals}f"
+    else:
+        fmt = "%f"
+    if jax.process_index() == 0:
+        header = None
+        if header_lines is not None:
+            header = "\n".join(header_lines) if not isinstance(header_lines, str) else header_lines
+        np.savetxt(path, arr, fmt=fmt, delimiter=sep, header=header or "", comments="", encoding=encoding)
+
+
+def save(data: DNDarray, path: str, *args, **kwargs) -> None:
+    """Save by file extension (reference ``io.py:1060``)."""
+    if not isinstance(path, str):
+        raise TypeError(f"Expected path to be str, but was {type(path)}")
+    extension = os.path.splitext(path)[-1].strip().lower()
+    if extension in (".h5", ".hdf5"):
+        return save_hdf5(data, path, *args, **kwargs)
+    if extension in __NETCDF_EXTENSIONS:
+        return save_netcdf(data, path, *args, **kwargs)
+    if extension == __CSV_EXTENSION:
+        return save_csv(data, path, *args, **kwargs)
+    raise ValueError(f"Unsupported file extension {extension}")

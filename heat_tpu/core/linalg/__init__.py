@@ -1,0 +1,6 @@
+"""Distributed linear algebra (reference ``heat/core/linalg/``)."""
+from . import basics, solver, svd
+from .basics import *
+from .qr import qr
+from .solver import *
+from .svd import svd

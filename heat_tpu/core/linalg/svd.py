@@ -1,0 +1,61 @@
+"""Singular value decomposition.
+
+The reference ships only an empty stub (``heat/core/linalg/svd.py:1-5``,
+"Future file for SVD functions") — this module goes beyond parity. The
+TPU-native algorithm for tall-skinny matrices is **TSQR + SVD-of-R**: a
+communication-avoiding QR (one all-gather of k×k factors over ICI) followed
+by a replicated small SVD, with U recovered by a sharded matmul on the MXU.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+from ..dndarray import DNDarray
+from .qr import qr
+
+__all__ = ["svd"]
+
+SVD_out = collections.namedtuple("SVD", "U, S, Vh")
+
+
+def svd(a: DNDarray, full_matrices: bool = False, compute_uv: bool = True):
+    """SVD of a 2-D DNDarray.
+
+    For split=0 (tall-skinny) inputs uses distributed TSQR + local SVD of R;
+    otherwise a global ``jnp.linalg.svd`` (GSPMD chooses the schedule).
+    Only ``full_matrices=False`` (reduced) is supported distributed.
+    """
+    if not isinstance(a, DNDarray):
+        raise TypeError(f"expected a DNDarray, got {type(a)}")
+    if a.ndim != 2:
+        raise ValueError(f"svd requires a 2-D array, got {a.ndim}-D")
+    if full_matrices and a.split is not None:
+        raise NotImplementedError("full_matrices=True is not supported for split arrays")
+    m, n = a.shape
+
+    if a.split == 0 and m >= n and a.comm.size > 1:
+        Q, R = qr(a, calc_q=compute_uv)
+        if not compute_uv:
+            s = jnp.linalg.svd(R.larray, compute_uv=False)
+            return DNDarray(s, split=None, device=a.device, comm=a.comm)
+        u_r, s, vh = jnp.linalg.svd(R.larray, full_matrices=False)
+        U = Q @ DNDarray(u_r, split=None, device=a.device, comm=a.comm)
+        return SVD_out(
+            U,
+            DNDarray(s, split=None, device=a.device, comm=a.comm),
+            DNDarray(vh, split=None, device=a.device, comm=a.comm),
+        )
+
+    ftype = jnp.promote_types(a.larray.dtype, jnp.float32)
+    if not compute_uv:
+        s = jnp.linalg.svd(a.larray.astype(ftype), compute_uv=False)
+        return DNDarray(s, split=None, device=a.device, comm=a.comm)
+    u, s, vh = jnp.linalg.svd(a.larray.astype(ftype), full_matrices=full_matrices)
+    return SVD_out(
+        DNDarray(u, split=a.split if a.split == 0 else None, device=a.device, comm=a.comm),
+        DNDarray(s, split=None, device=a.device, comm=a.comm),
+        DNDarray(vh, split=1 if a.split == 1 else None, device=a.device, comm=a.comm),
+    )

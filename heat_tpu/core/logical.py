@@ -1,0 +1,98 @@
+"""Logical operations (reference ``heat/core/logical.py``)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from . import types
+from ._operations import _binary_op, _local_op, _reduce_op
+from .dndarray import DNDarray
+
+__all__ = [
+    "all",
+    "allclose",
+    "any",
+    "isclose",
+    "isfinite",
+    "isinf",
+    "isnan",
+    "isneginf",
+    "isposinf",
+    "logical_and",
+    "logical_not",
+    "logical_or",
+    "logical_xor",
+    "signbit",
+]
+
+
+def all(x, axis=None, out=None, keepdims=False) -> DNDarray:
+    """Whether all elements are truthy (reference ``logical.py:38`` —
+    MPI.LAND reduce; XLA emits the equivalent all-reduce)."""
+    return _reduce_op(jnp.all, x, axis=axis, out=out, keepdims=keepdims, out_dtype=types.bool)
+
+
+def any(x, axis=None, out=None, keepdims=False) -> DNDarray:
+    """Whether any element is truthy (reference ``logical.py:157``)."""
+    return _reduce_op(jnp.any, x, axis=axis, out=out, keepdims=keepdims, out_dtype=types.bool)
+
+
+def allclose(x, y, rtol: float = 1e-05, atol: float = 1e-08, equal_nan: bool = False) -> bool:
+    """Global closeness check to one python bool (reference ``logical.py:105``)."""
+    close = isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+    return bool(jnp.all(close.larray))
+
+
+def isclose(x, y, rtol: float = 1e-05, atol: float = 1e-08, equal_nan: bool = False) -> DNDarray:
+    """Elementwise closeness (reference ``logical.py:210``)."""
+    res = _binary_op(
+        lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), x, y
+    )
+    return res.astype(types.bool) if res.dtype != types.bool else res
+
+
+def isfinite(x) -> DNDarray:
+    return _local_op(jnp.isfinite, x, no_cast=True, out_dtype=types.bool)
+
+
+def isinf(x) -> DNDarray:
+    return _local_op(jnp.isinf, x, no_cast=True, out_dtype=types.bool)
+
+
+def isnan(x) -> DNDarray:
+    return _local_op(jnp.isnan, x, no_cast=True, out_dtype=types.bool)
+
+
+def isneginf(x, out=None) -> DNDarray:
+    return _local_op(jnp.isneginf, x, out=out, no_cast=True, out_dtype=types.bool)
+
+
+def isposinf(x, out=None) -> DNDarray:
+    return _local_op(jnp.isposinf, x, out=out, no_cast=True, out_dtype=types.bool)
+
+
+def logical_and(t1, t2) -> DNDarray:
+    return _binary_op(jnp.logical_and, _as_bool(t1), _as_bool(t2))
+
+
+def logical_not(t, out=None) -> DNDarray:
+    return _local_op(jnp.logical_not, t, out=out, no_cast=True, out_dtype=types.bool)
+
+
+def logical_or(t1, t2) -> DNDarray:
+    return _binary_op(jnp.logical_or, _as_bool(t1), _as_bool(t2))
+
+
+def logical_xor(t1, t2) -> DNDarray:
+    return _binary_op(jnp.logical_xor, t1, t2)
+
+
+def signbit(x, out=None) -> DNDarray:
+    return _local_op(jnp.signbit, x, out=out, no_cast=True, out_dtype=types.bool)
+
+
+def _as_bool(t):
+    if isinstance(t, DNDarray) and t.dtype != types.bool:
+        return t.astype(types.bool)
+    return t

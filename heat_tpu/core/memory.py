@@ -1,0 +1,26 @@
+"""Memory helpers (reference ``heat/core/memory.py``)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .dndarray import DNDarray
+
+__all__ = ["copy", "sanitize_memory_layout"]
+
+
+def copy(x: DNDarray) -> DNDarray:
+    """Deep copy (reference ``memory.py:13``)."""
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"input needs to be a DNDarray, but was {type(x)}")
+    return DNDarray(
+        jnp.copy(x.larray), dtype=x.dtype, split=x.split, device=x.device, comm=x.comm
+    )
+
+
+def sanitize_memory_layout(x, order: str = "C"):
+    """Reference ``memory.py:42`` permuted strides for C/F order. XLA owns
+    physical layout (tiled HBM), so logical order is always C; 'F' requests
+    are accepted and ignored."""
+    if order not in ("C", "F"):
+        raise ValueError(f"order must be 'C' or 'F', got {order}")
+    return x

@@ -1,0 +1,79 @@
+"""Printing (reference ``heat/core/printing.py``).
+
+The reference gathers shards to rank 0 and formats torch-style
+(``printing.py:62-100,184-295``). Under single-controller JAX the global
+array is directly addressable; formatting uses numpy with torch-like
+thresholds.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "get_printoptions",
+    "global_printing",
+    "local_printing",
+    "print0",
+    "set_printoptions",
+]
+
+# torch-like defaults (reference ``printing.py:14-28``)
+__PRINT_OPTIONS = dict(precision=4, threshold=1000, edgeitems=3, linewidth=120, sci_mode=None)
+__LOCAL_PRINTING = False
+
+
+def get_printoptions() -> dict:
+    """Current print options (reference ``printing.py:42``)."""
+    return dict(__PRINT_OPTIONS)
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None, linewidth=None, profile=None, sci_mode=None):
+    """Configure printing (reference ``printing.py:150``)."""
+    if profile == "default":
+        __PRINT_OPTIONS.update(precision=4, threshold=1000, edgeitems=3, linewidth=120)
+    elif profile == "short":
+        __PRINT_OPTIONS.update(precision=2, threshold=1000, edgeitems=2, linewidth=120)
+    elif profile == "full":
+        __PRINT_OPTIONS.update(precision=4, threshold=float("inf"), edgeitems=3, linewidth=120)
+    for key, value in dict(
+        precision=precision, threshold=threshold, edgeitems=edgeitems, linewidth=linewidth, sci_mode=sci_mode
+    ).items():
+        if value is not None:
+            __PRINT_OPTIONS[key] = value
+
+
+def local_printing() -> None:
+    """Print only process-local data (reference ``printing.py:30``)."""
+    global __LOCAL_PRINTING
+    __LOCAL_PRINTING = True
+
+
+def global_printing() -> None:
+    """Print the full global array (default; reference ``printing.py:62``)."""
+    global __LOCAL_PRINTING
+    __LOCAL_PRINTING = False
+
+
+def print0(*args, **kwargs) -> None:
+    """Print once (on the controller) — reference ``printing.py:100``."""
+    import jax
+
+    if jax.process_index() == 0:
+        print(*args, **kwargs)
+
+
+def __str__(dndarray) -> str:
+    """Format a DNDarray (reference ``printing.py:184``)."""
+    opts = __PRINT_OPTIONS
+    data = np.asarray(dndarray.numpy())
+    with np.printoptions(
+        precision=opts["precision"],
+        threshold=opts["threshold"] if np.isfinite(opts["threshold"]) else data.size + 1,
+        edgeitems=opts["edgeitems"],
+        linewidth=opts["linewidth"],
+    ):
+        body = np.array2string(data, separator=", ", prefix="DNDarray(")
+    return (
+        f"DNDarray({body}, dtype=ht.{dndarray.dtype.__name__}, "
+        f"device={dndarray.device}, split={dndarray.split})"
+    )

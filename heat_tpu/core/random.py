@@ -1,0 +1,234 @@
+"""Parallel random number generation (reference ``heat/core/random.py``).
+
+The reference implements a counter-based Threefry-2x32/2x64 generator *in
+torch ops* (``random.py:876-1057``) and maps each rank's global element
+offsets onto counter values so that any split produces the same global
+stream (``__counter_sequence``, ``random.py:55-201``).
+
+JAX's native PRNG **is** counter-based Threefry, and with partitionable
+keys (``jax_threefry_partitionable``, enabled here) a draw of a given
+global shape produces the *same global stream for every sharding* — the
+reference's core guarantee, for free, generated shard-locally on device.
+State is (seed, counter); each draw folds the counter into the key and
+advances it, so call sequences are reproducible after ``seed()``.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import devices, types
+from .communication import sanitize_comm
+from .dndarray import DNDarray
+from .stride_tricks import sanitize_shape
+
+jax.config.update("jax_threefry_partitionable", True)
+
+__all__ = [
+    "get_state",
+    "normal",
+    "permutation",
+    "rand",
+    "randint",
+    "randn",
+    "random_integer",
+    "random_sample",
+    "randperm",
+    "ranf",
+    "sample",
+    "seed",
+    "set_state",
+    "standard_normal",
+    "uniform",
+]
+
+# global (seed, counter) state, reference ``random.py:40-43``
+__seed: int = 0
+__counter: int = 0
+
+
+def seed(seed: Optional[int] = None) -> None:
+    """Reset the generator (reference ``random.py:772``)."""
+    global __seed, __counter
+    if seed is None:
+        seed = int(np.random.SeedSequence().entropy % (2**63))
+    __seed = int(seed)
+    __counter = 0
+
+
+def get_state() -> Tuple[str, int, int, int, float]:
+    """Generator state tuple (reference ``random.py:203``)."""
+    return ("Threefry", __seed, __counter, 0, 0.0)
+
+
+def set_state(state: Tuple) -> None:
+    """Restore generator state (reference ``random.py:790``)."""
+    global __seed, __counter
+    if not isinstance(state, tuple) or len(state) not in (3, 5):
+        raise TypeError("state needs to be a 3- or 5-tuple")
+    if state[0] != "Threefry":
+        raise ValueError("algorithm must be 'Threefry'")
+    __seed = int(state[1])
+    __counter = int(state[2])
+
+
+def _next_key(nelem: int) -> jax.Array:
+    """Derive the key for the next draw and advance the counter."""
+    global __counter
+    key = jax.random.fold_in(jax.random.PRNGKey(__seed), __counter & 0x7FFFFFFF)
+    __counter += max(int(nelem), 1)
+    return key
+
+
+def _finalize(data: jax.Array, dtype, split, device, comm) -> DNDarray:
+    comm = sanitize_comm(comm)
+    return DNDarray(
+        data,
+        dtype=dtype,
+        split=split,
+        device=devices.sanitize_device(device),
+        comm=comm,
+    )
+
+
+def _float_jt(dtype):
+    dtype = types.canonical_heat_type(dtype) if dtype is not None else types.float32
+    if dtype not in (types.float16, types.bfloat16, types.float32, types.float64):
+        raise ValueError(f"Unsupported dtype {dtype} for random floats")
+    return dtype, dtype.jax_type()
+
+
+def rand(*d, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """Uniform [0, 1) samples (reference ``random.py:404``)."""
+    shape = sanitize_shape(d if len(d) else (1,))
+    if len(d) == 0:
+        shape = ()
+    dtype, jt = _float_jt(dtype)
+    comm_ = sanitize_comm(comm)
+    key = _next_key(int(np.prod(shape)) if shape else 1)
+    sharding = comm_.array_sharding(shape, split if shape else None)
+    data = jax.jit(
+        lambda k: jax.random.uniform(k, shape, dtype=jt), out_shardings=sharding
+    )(key)
+    return _finalize(data, dtype, split if shape else None, device, comm_)
+
+
+def randn(*d, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """Standard-normal samples (reference ``random.py:592``; the reference
+    used the Kundu transform ``random.py:248-266``, JAX uses inverse-erf —
+    moments match, bitstreams differ by construction)."""
+    shape = sanitize_shape(d if len(d) else (1,))
+    if len(d) == 0:
+        shape = ()
+    dtype, jt = _float_jt(dtype)
+    comm_ = sanitize_comm(comm)
+    key = _next_key(int(np.prod(shape)) if shape else 1)
+    sharding = comm_.array_sharding(shape, split if shape else None)
+    data = jax.jit(
+        lambda k: jax.random.normal(k, shape, dtype=jt), out_shardings=sharding
+    )(key)
+    return _finalize(data, dtype, split if shape else None, device, comm_)
+
+
+def randint(
+    low: int,
+    high: Optional[int] = None,
+    size=None,
+    dtype=types.int32,
+    split=None,
+    device=None,
+    comm=None,
+) -> DNDarray:
+    """Uniform integers in [low, high) (reference ``random.py:481``)."""
+    if high is None:
+        low, high = 0, low
+    if size is None:
+        size = ()
+    shape = sanitize_shape(size) if size != () else ()
+    if high <= low:
+        raise ValueError("low >= high")
+    dtype = types.canonical_heat_type(dtype)
+    comm_ = sanitize_comm(comm)
+    key = _next_key(int(np.prod(shape)) if shape else 1)
+    split_ = split if shape else None
+    sharding = comm_.array_sharding(shape, split_)
+    data = jax.jit(
+        lambda k: jax.random.randint(k, shape, low, high, dtype=jnp.int64).astype(dtype.jax_type()),
+        out_shardings=sharding,
+    )(key)
+    return _finalize(data, dtype, split_, device, comm_)
+
+
+random_integer = randint
+
+
+def random_sample(shape=None, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """Uniform [0,1) with a shape tuple argument (reference ``random.py``)."""
+    if shape is None:
+        shape = ()
+    shape = sanitize_shape(shape) if shape != () else ()
+    return rand(*shape, dtype=dtype, split=split, device=device, comm=comm) if shape else rand(dtype=dtype)
+
+
+random = random_sample
+ranf = random_sample
+sample = random_sample
+
+
+def normal(mean=0.0, std=1.0, shape=None, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """Normal with given mean/std (reference ``random.py:268``)."""
+    if shape is None:
+        shape = ()
+    shape = sanitize_shape(shape) if shape != () else ()
+    base = randn(*shape, dtype=dtype, split=split, device=device, comm=comm)
+    if isinstance(mean, DNDarray):
+        mean = mean.larray
+    if isinstance(std, DNDarray):
+        std = std.larray
+    return DNDarray(
+        base.larray * std + mean, dtype=base.dtype, split=base.split, device=base.device, comm=base.comm
+    )
+
+
+def standard_normal(shape=None, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """reference ``random.py``"""
+    if shape is None:
+        shape = ()
+    shape = sanitize_shape(shape) if shape != () else ()
+    return randn(*shape, dtype=dtype, split=split, device=device, comm=comm)
+
+
+def uniform(low=0.0, high=1.0, size=None, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """Uniform [low, high) (reference ``random.py``)."""
+    if size is None:
+        size = ()
+    shape = sanitize_shape(size) if size != () else ()
+    base = rand(*shape, dtype=dtype, split=split, device=device, comm=comm)
+    return DNDarray(
+        base.larray * (high - low) + low, dtype=base.dtype, split=base.split, device=base.device, comm=base.comm
+    )
+
+
+def randperm(n: int, dtype=types.int64, split=None, device=None, comm=None) -> DNDarray:
+    """Random permutation of arange(n) (reference ``random.py:649``)."""
+    dtype = types.canonical_heat_type(dtype)
+    comm_ = sanitize_comm(comm)
+    key = _next_key(int(n))
+    data = jax.random.permutation(key, int(n)).astype(dtype.jax_type())
+    return _finalize(data, dtype, split, device, comm_)
+
+
+def permutation(x, split=None, device=None, comm=None) -> DNDarray:
+    """Random permutation / shuffle of the first axis (reference
+    ``random.py:326``)."""
+    if isinstance(x, (int, np.integer)):
+        return randperm(int(x), split=split, device=device, comm=comm)
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"x must be int or DNDarray, got {type(x)}")
+    key = _next_key(x.shape[0])
+    perm = jax.random.permutation(key, x.shape[0])
+    result = jnp.take(x.larray, perm, axis=0)
+    return DNDarray(result, dtype=x.dtype, split=x.split, device=x.device, comm=x.comm)

@@ -1,0 +1,68 @@
+"""Relational operations (reference ``heat/core/relational.py``)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import types
+from ._operations import _binary_op
+from .dndarray import DNDarray
+
+__all__ = ["eq", "equal", "ge", "greater_equal", "gt", "greater", "le", "less_equal", "lt", "less", "ne", "not_equal"]
+
+
+def eq(t1, t2) -> DNDarray:
+    """Elementwise ==, bool result (reference ``relational.py``)."""
+    return _bool_op(jnp.equal, t1, t2)
+
+
+def _bool_op(op, t1, t2) -> DNDarray:
+    res = _binary_op(op, t1, t2)
+    if res.dtype != types.bool:
+        res = res.astype(types.bool)
+    return res
+
+
+def equal(t1, t2) -> bool:
+    """Global equality to a single python bool (reference
+    ``relational.py:80`` — Allreduce(LAND); here one jnp.all on the sharded
+    comparison, psum'd by XLA)."""
+    try:
+        res = _binary_op(jnp.equal, t1, t2)
+    except ValueError:
+        return False
+    return bool(jnp.all(res.larray))
+
+
+def ge(t1, t2) -> DNDarray:
+    return _bool_op(jnp.greater_equal, t1, t2)
+
+
+greater_equal = ge
+
+
+def gt(t1, t2) -> DNDarray:
+    return _bool_op(jnp.greater, t1, t2)
+
+
+greater = gt
+
+
+def le(t1, t2) -> DNDarray:
+    return _bool_op(jnp.less_equal, t1, t2)
+
+
+less_equal = le
+
+
+def lt(t1, t2) -> DNDarray:
+    return _bool_op(jnp.less, t1, t2)
+
+
+less = lt
+
+
+def ne(t1, t2) -> DNDarray:
+    return _bool_op(jnp.not_equal, t1, t2)
+
+
+not_equal = ne

@@ -1,0 +1,107 @@
+"""Rounding and sign operations (reference ``heat/core/rounding.py``)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from . import types
+from ._operations import _local_op
+from .dndarray import DNDarray
+
+__all__ = [
+    "abs",
+    "absolute",
+    "ceil",
+    "clip",
+    "fabs",
+    "floor",
+    "modf",
+    "round",
+    "sgn",
+    "sign",
+    "trunc",
+]
+
+
+def abs(x, out=None, dtype=None) -> DNDarray:
+    """Elementwise absolute value (reference ``rounding.py``)."""
+    if dtype is not None:
+        dtype = types.canonical_heat_type(dtype)
+    res = _local_op(jnp.abs, x, out=None if dtype else out, no_cast=True)
+    if dtype is not None:
+        res = res.astype(dtype)
+        if out is not None:
+            from ._operations import _write_out
+
+            return _write_out(out, res)
+    return res
+
+
+absolute = abs
+
+
+def fabs(x, out=None) -> DNDarray:
+    """Float absolute value."""
+    return _local_op(jnp.fabs, x, out=out)
+
+
+def ceil(x, out=None) -> DNDarray:
+    return _local_op(jnp.ceil, x, out=out)
+
+
+def floor(x, out=None) -> DNDarray:
+    return _local_op(jnp.floor, x, out=out)
+
+
+def clip(x, a_min, a_max, out=None) -> DNDarray:
+    """Clamp values to [a_min, a_max] (reference ``rounding.py``)."""
+    if a_min is None and a_max is None:
+        raise ValueError("either a_min or a_max must be set")
+    if isinstance(a_min, DNDarray):
+        a_min = a_min.larray
+    if isinstance(a_max, DNDarray):
+        a_max = a_max.larray
+    return _local_op(lambda t: jnp.clip(t, a_min, a_max), x, out=out, no_cast=True)
+
+
+def modf(x, out=None):
+    """Fractional and integral parts (reference ``rounding.py``)."""
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"expected x to be a DNDarray, but was {type(x)}")
+    frac = _local_op(lambda t: jnp.modf(t)[0], x)
+    integ = _local_op(lambda t: jnp.modf(t)[1], x)
+    if out is not None:
+        if not isinstance(out, tuple) or len(out) != 2:
+            raise TypeError("out must be a 2-tuple of DNDarrays")
+        from ._operations import _write_out
+
+        return _write_out(out[0], frac), _write_out(out[1], integ)
+    return frac, integ
+
+
+def round(x, decimals: int = 0, out=None, dtype=None) -> DNDarray:
+    """Round to the given number of decimals (reference ``rounding.py``)."""
+    if dtype is not None:
+        dtype = types.canonical_heat_type(dtype)
+    res = _local_op(lambda t: jnp.round(t, decimals=decimals), x, out=out)
+    if dtype is not None:
+        res = res.astype(dtype)
+    return res
+
+
+def sgn(x, out=None) -> DNDarray:
+    """Sign (complex: x/|x|) — reference ``rounding.py``."""
+    return _local_op(jnp.sign, x, out=out, no_cast=True)
+
+
+def sign(x, out=None) -> DNDarray:
+    """Sign; for complex input, the sign of the real part (torch semantics
+    in the reference)."""
+    if isinstance(x, DNDarray) and types.heat_type_is_complexfloating(x.dtype):
+        return _local_op(lambda t: jnp.sign(jnp.real(t)).astype(t.dtype), x, out=out, no_cast=True)
+    return _local_op(jnp.sign, x, out=out, no_cast=True)
+
+
+def trunc(x, out=None) -> DNDarray:
+    return _local_op(jnp.trunc, x, out=out)

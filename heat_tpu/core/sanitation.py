@@ -1,0 +1,70 @@
+"""Input/output/distribution checks (reference ``heat/core/sanitation.py``)."""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import types
+from .dndarray import DNDarray
+from .stride_tricks import sanitize_axis
+
+__all__ = ["sanitize_in", "sanitize_infinity", "sanitize_out", "sanitize_distribution", "sanitize_sequence", "sanitize_lshape"]
+
+
+def sanitize_in(x) -> None:
+    """Require a DNDarray (reference ``sanitation.py:159``)."""
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"input needs to be a DNDarray, but was {type(x)}")
+
+
+def sanitize_infinity(x: DNDarray):
+    """Largest representable value for x's dtype (reference helper)."""
+    dtype = x.dtype
+    if types.heat_type_is_exact(dtype):
+        return types.iinfo(dtype).max
+    return float("inf")
+
+
+def sanitize_out(out, output_shape, output_split, output_device, output_comm=None) -> None:
+    """Validate an out= argument (reference ``sanitation.py:259``)."""
+    if not isinstance(out, DNDarray):
+        raise TypeError(f"expected out to be None or a DNDarray, but was {type(out)}")
+    if tuple(out.shape) != tuple(output_shape):
+        raise ValueError(f"Expecting output buffer of shape {tuple(output_shape)}, got {out.shape}")
+    if out.split != output_split:
+        raise ValueError(f"Expecting output buffer with split {output_split}, got {out.split}")
+
+
+def sanitize_distribution(*args: DNDarray, target: DNDarray, diff_map=None):
+    """Bring operands onto the target's distribution (reference
+    ``sanitation.py:31``). On TPU this is a resplit (device_put), never a
+    point-to-point exchange."""
+    out = []
+    for arg in args:
+        if not isinstance(arg, DNDarray):
+            raise TypeError(f"expected DNDarray, got {type(arg)}")
+        if arg.split != target.split and arg.ndim == target.ndim:
+            out.append(arg.resplit(target.split))
+        else:
+            out.append(arg)
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def sanitize_sequence(seq) -> list:
+    """Normalize a sequence argument to a list (reference ``sanitation.py``)."""
+    if isinstance(seq, list):
+        return seq
+    if isinstance(seq, tuple):
+        return list(seq)
+    if isinstance(seq, DNDarray):
+        return seq.tolist()
+    raise TypeError(f"seq must be a list, tuple or DNDarray, got {type(seq)}")
+
+
+def sanitize_lshape(array: DNDarray, tensor) -> None:
+    """Verify a local tensor fits the array's shard layout (reference
+    ``sanitation.py:213``)."""
+    if tuple(tensor.shape) != tuple(array.lshape):
+        raise ValueError(f"local tensor shape {tensor.shape} does not match lshape {array.lshape}")

@@ -1,0 +1,44 @@
+"""Signal processing (reference ``heat/core/signal.py``).
+
+The reference's ``convolve`` is the canonical halo-exchange stencil: pad ->
+``get_halo(M//2)`` -> local conv1d on the halo-extended shard -> trim
+(``signal.py:16-148``). A global convolution under XLA generates the same
+neighbor exchange on ICI automatically; the explicit ``ppermute`` halo
+helper lives in :mod:`heat_tpu.parallel.halo` for custom stencils.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import types
+from .dndarray import DNDarray
+
+__all__ = ["convolve"]
+
+
+def convolve(a: DNDarray, v: DNDarray, mode: str = "full") -> DNDarray:
+    """1-D discrete convolution (reference ``signal.py:16``)."""
+    from . import factories
+
+    if not isinstance(a, DNDarray):
+        a = factories.array(a)
+    if not isinstance(v, DNDarray):
+        v = factories.array(v)
+    if a.ndim != 1 or v.ndim != 1:
+        raise ValueError(f"convolve requires 1-D inputs, got {a.ndim}-D and {v.ndim}-D")
+    if mode not in ("full", "same", "valid"):
+        raise ValueError(f"unsupported mode {mode!r}")
+    if v.shape[0] > a.shape[0]:
+        a, v = v, a
+    if mode == "same" and v.shape[0] % 2 == 0:
+        raise ValueError("mode 'same' cannot be used with even-sized kernel")
+    promoted = types.promote_types(a.dtype, v.dtype)
+    jt = promoted.jax_type()
+    result = jnp.convolve(a.larray.astype(jt), v.larray.astype(jt), mode=mode)
+    return DNDarray(
+        result,
+        dtype=types.canonical_heat_type(result.dtype),
+        split=a.split,
+        device=a.device,
+        comm=a.comm,
+    )

@@ -1,0 +1,273 @@
+"""Statistical operations (reference ``heat/core/statistics.py``, 1997 LoC).
+
+The reference implements parallel Welford moment-merging
+(``__merge_moments``, ``statistics.py:1043``) and custom MPI argmax/argmin
+ops over stacked (value, index) buffers (``statistics.py:1335-1404``).
+Under XLA a single global ``jnp`` reduction over a sharded array compiles to
+the identical local-partial + all-reduce schedule, so all of that machinery
+disappears; what remains is axis/ddof bookkeeping and the unbiased
+skew/kurtosis corrections.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import types
+from ._operations import _binary_op, _local_op, _reduce_op, _reduced_split
+from .dndarray import DNDarray
+from .stride_tricks import sanitize_axis
+
+__all__ = [
+    "argmax",
+    "argmin",
+    "average",
+    "bincount",
+    "bucketize",
+    "cov",
+    "digitize",
+    "histc",
+    "histogram",
+    "kurtosis",
+    "max",
+    "maximum",
+    "mean",
+    "median",
+    "min",
+    "minimum",
+    "percentile",
+    "skew",
+    "std",
+    "var",
+]
+
+
+def argmax(x: DNDarray, axis=None, out=None, **kwargs) -> DNDarray:
+    """Index of the maximum (reference ``statistics.py`` via MPI_ARGMAX)."""
+    return _arg_reduce(jnp.argmax, x, axis, out)
+
+
+def argmin(x: DNDarray, axis=None, out=None, **kwargs) -> DNDarray:
+    """Index of the minimum (reference via MPI_ARGMIN)."""
+    return _arg_reduce(jnp.argmin, x, axis, out)
+
+
+def _arg_reduce(op, x, axis, out):
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"expected x to be a DNDarray, but was {type(x)}")
+    axis = sanitize_axis(x.shape, axis)
+    result = op(x.larray, axis=axis)
+    split = _reduced_split(x.split, axis if axis is not None else None, x.ndim, False)
+    res = DNDarray(
+        result.astype(jnp.int64),
+        dtype=types.int64,
+        split=split,
+        device=x.device,
+        comm=x.comm,
+    )
+    if out is not None:
+        from ._operations import _write_out
+
+        return _write_out(out, res)
+    return res
+
+
+def average(x: DNDarray, axis=None, weights: Optional[DNDarray] = None, returned: bool = False):
+    """Weighted average (reference ``statistics.py:189``)."""
+    if weights is None:
+        result = mean(x, axis)
+        if returned:
+            n = x.size if axis is None else np.prod([x.shape[a] for a in _axes(x, axis)])
+            from . import factories
+
+            return result, factories.full_like(result, float(n))
+        return result
+    axis_s = sanitize_axis(x.shape, axis)
+    w = weights.larray if isinstance(weights, DNDarray) else jnp.asarray(weights)
+    xa = x.larray
+    if w.ndim != xa.ndim:
+        if axis_s is None or isinstance(axis_s, tuple):
+            raise TypeError("Axis must be specified when shapes of x and weights differ.")
+        shape = [1] * xa.ndim
+        shape[axis_s] = -1
+        w = w.reshape(shape)
+    wsum = jnp.sum(jnp.broadcast_to(w, xa.shape), axis=axis_s)
+    result = jnp.sum(xa * w, axis=axis_s) / wsum
+    split = _reduced_split(x.split, axis_s, x.ndim, False)
+    res = DNDarray(result, dtype=types.canonical_heat_type(result.dtype), split=split, device=x.device, comm=x.comm)
+    if returned:
+        wres = DNDarray(jnp.broadcast_to(wsum, result.shape), split=split, device=x.device, comm=x.comm)
+        return res, wres
+    return res
+
+
+def _axes(x, axis):
+    if axis is None:
+        return tuple(range(x.ndim))
+    axis = sanitize_axis(x.shape, axis)
+    return (axis,) if isinstance(axis, int) else axis
+
+
+def bincount(x: DNDarray, weights=None, minlength: int = 0) -> DNDarray:
+    """Count occurrences of each value (reference ``statistics.py:322``)."""
+    w = weights.larray if isinstance(weights, DNDarray) else weights
+    result = jnp.bincount(x.larray, weights=w, minlength=minlength)
+    return DNDarray(result, dtype=types.canonical_heat_type(result.dtype), split=None, device=x.device, comm=x.comm)
+
+
+def bucketize(input: DNDarray, boundaries, right: bool = False, out=None) -> DNDarray:
+    """Index of the bucket each value falls into (reference
+    ``statistics.py:393``)."""
+    b = boundaries.larray if isinstance(boundaries, DNDarray) else jnp.asarray(boundaries)
+    side = "left" if right else "right"
+    return _local_op(lambda t: jnp.searchsorted(b, t, side=side).astype(jnp.int64), input, out=out, no_cast=True, out_dtype=types.int64)
+
+
+def digitize(x: DNDarray, bins, right: bool = False) -> DNDarray:
+    """Index of the bin each value belongs to (reference
+    ``statistics.py:541``)."""
+    b = bins.larray if isinstance(bins, DNDarray) else jnp.asarray(bins)
+    return _local_op(lambda t: jnp.digitize(t, b, right=right).astype(jnp.int64), x, no_cast=True, out_dtype=types.int64)
+
+
+def cov(m: DNDarray, y: Optional[DNDarray] = None, rowvar: bool = True, bias: bool = False, ddof: Optional[int] = None) -> DNDarray:
+    """Covariance matrix estimate (reference ``statistics.py:466``)."""
+    if ddof is None:
+        ddof = 0 if bias else 1
+    x = m.larray
+    if x.ndim == 1:
+        x = x[None, :]
+    elif not rowvar and x.shape[0] != 1:
+        x = x.T
+    if y is not None:
+        ya = y.larray
+        if ya.ndim == 1:
+            ya = ya[None, :]
+        elif not rowvar:
+            ya = ya.T
+        x = jnp.concatenate([x, ya], axis=0)
+    avg = jnp.mean(x, axis=1, keepdims=True)
+    fact = x.shape[1] - ddof
+    xc = x - avg
+    result = (xc @ xc.conj().T) / fact
+    split = 0 if m.split is not None else None
+    return DNDarray(jnp.squeeze(result), dtype=types.canonical_heat_type(result.dtype), split=split if result.ndim > 1 else None, device=m.device, comm=m.comm)
+
+
+def histc(input: DNDarray, bins: int = 100, min: float = 0.0, max: float = 0.0, out=None) -> DNDarray:
+    """Histogram with equal-width bins (torch-style; reference
+    ``statistics.py:616``)."""
+    arr = input.larray
+    lo, hi = float(min), float(max)
+    if lo == 0.0 and hi == 0.0:
+        lo, hi = float(jnp.min(arr)), float(jnp.max(arr))
+    hist, _ = jnp.histogram(arr, bins=bins, range=(lo, hi))
+    res = DNDarray(hist.astype(input.dtype.jax_type()), dtype=input.dtype, split=None, device=input.device, comm=input.comm)
+    if out is not None:
+        from ._operations import _write_out
+
+        return _write_out(out, res)
+    return res
+
+
+def histogram(a: DNDarray, bins: int = 10, range=None, normed=None, weights=None, density=None):
+    """numpy-style histogram (reference exposes torch histc; numpy parity
+    added for convenience)."""
+    hist, edges = jnp.histogram(a.larray, bins=bins, range=range, density=density)
+    return (
+        DNDarray(hist, split=None, device=a.device, comm=a.comm),
+        DNDarray(edges, split=None, device=a.device, comm=a.comm),
+    )
+
+
+def kurtosis(x: DNDarray, axis=None, fisher: bool = True, bias: bool = True) -> DNDarray:
+    """Kurtosis (reference ``statistics.py:727``; moment merging is XLA's
+    problem now)."""
+    axis_s = sanitize_axis(x.shape, axis)
+    arr = x.larray.astype(jnp.promote_types(x.larray.dtype, jnp.float32))
+    n = arr.size if axis_s is None else arr.shape[axis_s]
+    mu = jnp.mean(arr, axis=axis_s, keepdims=True)
+    m2 = jnp.mean((arr - mu) ** 2, axis=axis_s)
+    m4 = jnp.mean((arr - mu) ** 4, axis=axis_s)
+    g2 = m4 / (m2**2)
+    if not bias and n > 3:
+        g2 = ((n - 1) / ((n - 2) * (n - 3))) * ((n + 1) * g2 - 3 * (n - 1)) + 3
+    if fisher:
+        g2 = g2 - 3
+    split = _reduced_split(x.split, axis_s, x.ndim, False)
+    return DNDarray(g2, dtype=types.canonical_heat_type(g2.dtype), split=split, device=x.device, comm=x.comm)
+
+
+def skew(x: DNDarray, axis=None, bias: bool = True) -> DNDarray:
+    """Skewness (reference ``statistics.py:1676``)."""
+    axis_s = sanitize_axis(x.shape, axis)
+    arr = x.larray.astype(jnp.promote_types(x.larray.dtype, jnp.float32))
+    n = arr.size if axis_s is None else arr.shape[axis_s]
+    mu = jnp.mean(arr, axis=axis_s, keepdims=True)
+    m2 = jnp.mean((arr - mu) ** 2, axis=axis_s)
+    m3 = jnp.mean((arr - mu) ** 3, axis=axis_s)
+    g1 = m3 / (m2**1.5)
+    if not bias and n > 2:
+        g1 = g1 * np.sqrt(n * (n - 1)) / (n - 2)
+    split = _reduced_split(x.split, axis_s, x.ndim, False)
+    return DNDarray(g1, dtype=types.canonical_heat_type(g1.dtype), split=split, device=x.device, comm=x.comm)
+
+
+def max(x: DNDarray, axis=None, out=None, keepdims=None) -> DNDarray:
+    """Maximum along axis (reference ``statistics.py:781``)."""
+    return _reduce_op(jnp.max, x, axis=axis, out=out, keepdims=bool(keepdims))
+
+
+def maximum(x1, x2, out=None) -> DNDarray:
+    """Elementwise maximum (reference ``statistics.py``)."""
+    return _binary_op(jnp.maximum, x1, x2, out=out)
+
+
+def mean(x: DNDarray, axis=None) -> DNDarray:
+    """Arithmetic mean (reference ``statistics.py:891`` — local moments +
+    Allreduce + pairwise merging; one jnp.mean here)."""
+    return _reduce_op(jnp.mean, x, axis=axis)
+
+
+def median(x: DNDarray, axis=None, keepdims: bool = False) -> DNDarray:
+    """Median (reference ``statistics.py:1017``, gather-based)."""
+    axis_s = sanitize_axis(x.shape, axis)
+    result = jnp.median(x.larray, axis=axis_s, keepdims=keepdims)
+    split = _reduced_split(x.split, axis_s, x.ndim, keepdims)
+    return DNDarray(result, dtype=types.canonical_heat_type(result.dtype), split=split, device=x.device, comm=x.comm)
+
+
+def min(x: DNDarray, axis=None, out=None, keepdims=None) -> DNDarray:
+    """Minimum along axis (reference ``statistics.py:1114``)."""
+    return _reduce_op(jnp.min, x, axis=axis, out=out, keepdims=bool(keepdims))
+
+
+def minimum(x1, x2, out=None) -> DNDarray:
+    return _binary_op(jnp.minimum, x1, x2, out=out)
+
+
+def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear", keepdims: bool = False) -> DNDarray:
+    """q-th percentile (reference ``statistics.py:1406``, gather-based;
+    global jnp.percentile here — XLA handles the sharded sort)."""
+    axis_s = sanitize_axis(x.shape, axis)
+    q_arr = q.larray if isinstance(q, DNDarray) else jnp.asarray(q)
+    method = {"lower": "lower", "higher": "higher", "midpoint": "midpoint", "nearest": "nearest", "linear": "linear"}[interpolation]
+    result = jnp.percentile(x.larray.astype(jnp.float64 if x.larray.dtype == jnp.float64 else jnp.float32), q_arr, axis=axis_s, method=method, keepdims=keepdims)
+    res = DNDarray(result, dtype=types.canonical_heat_type(result.dtype), split=None, device=x.device, comm=x.comm)
+    if out is not None:
+        from ._operations import _write_out
+
+        return _write_out(out, res)
+    return res
+
+
+def std(x: DNDarray, axis=None, ddof: int = 0, **kwargs) -> DNDarray:
+    """Standard deviation (reference ``statistics.py:1784``)."""
+    return _reduce_op(jnp.std, x, axis=axis, ddof=ddof)
+
+
+def var(x: DNDarray, axis=None, ddof: int = 0, **kwargs) -> DNDarray:
+    """Variance (reference ``statistics.py:1854``)."""
+    return _reduce_op(jnp.var, x, axis=axis, ddof=ddof)

@@ -1,0 +1,137 @@
+"""Trigonometric functions (reference ``heat/core/trigonometrics.py``).
+
+Pure ``_local_op`` wrappers: elementwise, split-preserving, fused by XLA
+into surrounding computations.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ._operations import _binary_op, _local_op
+from .dndarray import DNDarray
+
+__all__ = [
+    "acos",
+    "arccos",
+    "acosh",
+    "arccosh",
+    "asin",
+    "arcsin",
+    "asinh",
+    "arcsinh",
+    "atan",
+    "arctan",
+    "atan2",
+    "arctan2",
+    "atanh",
+    "arctanh",
+    "cos",
+    "cosh",
+    "deg2rad",
+    "degrees",
+    "rad2deg",
+    "radians",
+    "sin",
+    "sinc",
+    "sinh",
+    "tan",
+    "tanh",
+]
+
+
+def acos(x, out=None) -> DNDarray:
+    """Elementwise arccos."""
+    return _local_op(jnp.arccos, x, out=out)
+
+
+arccos = acos
+
+
+def acosh(x, out=None) -> DNDarray:
+    return _local_op(jnp.arccosh, x, out=out)
+
+
+arccosh = acosh
+
+
+def asin(x, out=None) -> DNDarray:
+    return _local_op(jnp.arcsin, x, out=out)
+
+
+arcsin = asin
+
+
+def asinh(x, out=None) -> DNDarray:
+    return _local_op(jnp.arcsinh, x, out=out)
+
+
+arcsinh = asinh
+
+
+def atan(x, out=None) -> DNDarray:
+    return _local_op(jnp.arctan, x, out=out)
+
+
+arctan = atan
+
+
+def atanh(x, out=None) -> DNDarray:
+    return _local_op(jnp.arctanh, x, out=out)
+
+
+arctanh = atanh
+
+
+def atan2(t1, t2) -> DNDarray:
+    """Elementwise two-argument arctangent."""
+    from . import types
+
+    res = _binary_op(jnp.arctan2, t1, t2)
+    if types.heat_type_is_exact(res.dtype):
+        res = res.astype(types.float32)
+    return res
+
+
+arctan2 = atan2
+
+
+def cos(x, out=None) -> DNDarray:
+    return _local_op(jnp.cos, x, out=out)
+
+
+def cosh(x, out=None) -> DNDarray:
+    return _local_op(jnp.cosh, x, out=out)
+
+
+def deg2rad(x, out=None) -> DNDarray:
+    return _local_op(jnp.deg2rad, x, out=out)
+
+
+radians = deg2rad
+
+
+def rad2deg(x, out=None) -> DNDarray:
+    return _local_op(jnp.rad2deg, x, out=out)
+
+
+degrees = rad2deg
+
+
+def sin(x, out=None) -> DNDarray:
+    return _local_op(jnp.sin, x, out=out)
+
+
+def sinc(x, out=None) -> DNDarray:
+    return _local_op(jnp.sinc, x, out=out)
+
+
+def sinh(x, out=None) -> DNDarray:
+    return _local_op(jnp.sinh, x, out=out)
+
+
+def tan(x, out=None) -> DNDarray:
+    return _local_op(jnp.tan, x, out=out)
+
+
+def tanh(x, out=None) -> DNDarray:
+    return _local_op(jnp.tanh, x, out=out)

@@ -1,0 +1,7 @@
+"""Version information (reference ``heat/core/version.py``)."""
+major: int = 1
+minor: int = 1
+micro: int = 1
+extension: str = "tpu"
+
+__version__ = f"{major}.{minor}.{micro}-{extension}"

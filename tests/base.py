@@ -1,0 +1,68 @@
+"""Shared test helpers (reference ``heat/core/tests/test_suites/basic_test.py``).
+
+The core oracle (reference ``basic_test.py:142-306``): a distributed result
+must equal the single-process numpy result **for every possible split
+axis**.
+"""
+from __future__ import annotations
+
+import unittest
+
+import numpy as np
+
+import heat_tpu as ht
+
+
+class TestCase(unittest.TestCase):
+    @classmethod
+    def setUpClass(cls):
+        cls.comm = ht.get_comm()
+        cls.device = ht.get_device()
+
+    def assert_array_equal(self, heat_array, expected, rtol=1e-5, atol=1e-8):
+        """Check gshape, dtype kind and gathered values against numpy
+        (reference ``basic_test.py:68``)."""
+        self.assertIsInstance(heat_array, ht.DNDarray, f"expected DNDarray, got {type(heat_array)}")
+        expected = np.asarray(expected)
+        self.assertEqual(
+            tuple(heat_array.shape), tuple(expected.shape),
+            f"global shape mismatch: {heat_array.shape} != {expected.shape}",
+        )
+        got = heat_array.numpy()
+        if np.issubdtype(expected.dtype, np.floating) or np.issubdtype(expected.dtype, np.complexfloating):
+            np.testing.assert_allclose(got.astype(expected.dtype), expected, rtol=rtol, atol=atol)
+        else:
+            np.testing.assert_array_equal(got.astype(expected.dtype), expected)
+
+    def assert_func_equal(
+        self,
+        shape,
+        heat_func,
+        numpy_func,
+        heat_args=None,
+        numpy_args=None,
+        distributed_result=True,
+        dtypes=("float32",),
+        low=-10,
+        high=10,
+        rtol=1e-5,
+        atol=1e-6,
+    ):
+        """Sweep every split axis and compare against numpy (reference
+        ``basic_test.py:142``)."""
+        heat_args = heat_args or {}
+        numpy_args = numpy_args or {}
+        rng = np.random.default_rng(42)
+        for dtype in dtypes:
+            if dtype.startswith("int"):
+                np_arr = rng.integers(low, high, size=shape).astype(dtype)
+            else:
+                np_arr = (rng.random(shape) * (high - low) + low).astype(dtype)
+            expected = numpy_func(np_arr.copy(), **numpy_args)
+            for split in [None] + list(range(len(shape))):
+                ht_arr = ht.array(np_arr, split=split)
+                result = heat_func(ht_arr, **heat_args)
+                if isinstance(result, ht.DNDarray):
+                    self.assert_array_equal(result, expected, rtol=rtol, atol=atol)
+                else:
+                    np.testing.assert_allclose(np.asarray(result), expected, rtol=rtol, atol=atol)

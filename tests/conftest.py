@@ -1,0 +1,15 @@
+"""Test configuration: run the whole suite on a virtual 8-device CPU mesh.
+
+Mirrors the reference's strategy of executing the entire suite under
+multiple MPI world sizes (``Jenkinsfile:24-27``): here a single process
+hosts 8 XLA CPU devices and every sharded op runs a real GSPMD program.
+"""
+import os
+
+_flag = "--xla_force_host_platform_device_count=8"
+if _flag not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _flag).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,241 @@
+"""Core DNDarray / factories / types tests (reference
+``test_dndarray.py``, ``test_factories.py``, ``test_types.py``)."""
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from .base import TestCase
+
+
+class TestFactories(TestCase):
+    def test_zeros_ones_full(self):
+        for split in (None, 0, 1):
+            z = ht.zeros((8, 5), split=split)
+            self.assert_array_equal(z, np.zeros((8, 5), dtype=np.float32))
+            o = ht.ones((8, 5), split=split, dtype=ht.int32)
+            self.assert_array_equal(o, np.ones((8, 5), dtype=np.int32))
+            f = ht.full((8, 5), 3.5, split=split)
+            self.assert_array_equal(f, np.full((8, 5), 3.5, dtype=np.float32))
+
+    def test_arange(self):
+        self.assert_array_equal(ht.arange(10), np.arange(10))
+        self.assert_array_equal(ht.arange(2, 20, 3, split=0), np.arange(2, 20, 3))
+        self.assert_array_equal(ht.arange(0, 1, 0.1), np.arange(0, 1, 0.1).astype(np.float32))
+
+    def test_linspace_logspace(self):
+        self.assert_array_equal(ht.linspace(0, 10, 17, split=0), np.linspace(0, 10, 17).astype(np.float32))
+        res, step = ht.linspace(0, 1, 11, retstep=True)
+        assert abs(step - 0.1) < 1e-6
+        self.assert_array_equal(
+            ht.logspace(0, 2, 10, split=0), np.logspace(0, 2, 10).astype(np.float32), rtol=1e-4
+        )
+
+    def test_eye(self):
+        for split in (None, 0, 1):
+            self.assert_array_equal(ht.eye(7, split=split), np.eye(7, dtype=np.float32))
+        self.assert_array_equal(ht.eye((4, 6), split=0), np.eye(4, 6, dtype=np.float32))
+
+    def test_array_splits(self):
+        x = np.arange(24).reshape(4, 6).astype(np.float32)
+        for split in (None, 0, 1):
+            a = ht.array(x, split=split)
+            assert a.split == split
+            self.assert_array_equal(a, x)
+
+    def test_array_like(self):
+        a = ht.array([[1, 2], [3, 4]], split=0)
+        self.assert_array_equal(ht.zeros_like(a), np.zeros((2, 2), dtype=np.int64))
+        self.assert_array_equal(ht.ones_like(a), np.ones((2, 2), dtype=np.int64))
+        self.assert_array_equal(ht.full_like(a, 9), np.full((2, 2), 9))
+
+    def test_meshgrid(self):
+        x, y = ht.meshgrid(ht.arange(4), ht.arange(3, split=0))
+        nx, ny = np.meshgrid(np.arange(4), np.arange(3))
+        self.assert_array_equal(x, nx)
+        self.assert_array_equal(y, ny)
+
+
+class TestDNDarray(TestCase):
+    def test_metadata(self):
+        a = ht.zeros((16, 3), split=0)
+        assert a.shape == (16, 3)
+        assert a.gshape == (16, 3)
+        assert a.ndim == 2
+        assert a.size == 48
+        assert a.split == 0
+        assert a.balanced
+        assert a.is_balanced()
+        lmap = a.lshape_map
+        assert lmap.sum(axis=0)[0] == 16
+
+    def test_resplit(self):
+        x = np.arange(40).reshape(8, 5).astype(np.float32)
+        a = ht.array(x, split=0)
+        b = a.resplit(1)
+        assert b.split == 1
+        self.assert_array_equal(b, x)
+        a.resplit_(None)
+        assert a.split is None
+        self.assert_array_equal(a, x)
+        a.resplit_(1)
+        assert a.split == 1
+        self.assert_array_equal(a, x)
+
+    def test_astype(self):
+        a = ht.arange(10, split=0)
+        b = a.astype(ht.float32)
+        assert b.dtype == ht.float32
+        self.assert_array_equal(b, np.arange(10, dtype=np.float32))
+
+    def test_item_and_casts(self):
+        a = ht.array([5])
+        assert int(a) == 5
+        assert float(ht.array([2.5])) == 2.5
+        assert bool(ht.array([True]))
+        assert ht.array(7).item() == 7
+
+    def test_getitem_scalar_on_split(self):
+        x = np.arange(30).reshape(10, 3)
+        a = ht.array(x, split=0)
+        row = a[3]
+        assert row.split is None
+        self.assert_array_equal(row, x[3])
+
+    def test_getitem_slice_keeps_split(self):
+        x = np.arange(64).reshape(16, 4)
+        a = ht.array(x, split=0)
+        sl = a[2:10]
+        assert sl.split == 0
+        self.assert_array_equal(sl, x[2:10])
+        b = ht.array(x, split=1)
+        sl2 = b[2:10]
+        assert sl2.split == 1
+        self.assert_array_equal(sl2, x[2:10])
+
+    def test_getitem_advanced(self):
+        x = np.arange(50).reshape(10, 5)
+        a = ht.array(x, split=0)
+        idx = [1, 3, 5]
+        self.assert_array_equal(a[idx], x[idx])
+        mask = x[:, 0] > 20
+        self.assert_array_equal(a[ht.array(mask)], x[mask])
+
+    def test_setitem(self):
+        x = np.arange(24).reshape(6, 4).astype(np.float32)
+        a = ht.array(x, split=0)
+        a[0] = 99.0
+        x[0] = 99.0
+        self.assert_array_equal(a, x)
+        a[2:4, 1] = -1.0
+        x[2:4, 1] = -1.0
+        self.assert_array_equal(a, x)
+
+    def test_iter_len(self):
+        a = ht.arange(5, split=0)
+        assert len(a) == 5
+        assert [int(v) for v in a] == [0, 1, 2, 3, 4]
+
+    def test_fill_diagonal(self):
+        a = ht.zeros((5, 5), split=0)
+        a.fill_diagonal(2.0)
+        self.assert_array_equal(a, np.eye(5, dtype=np.float32) * 2)
+
+    def test_local_shards(self):
+        a = ht.zeros((16, 3), split=0)
+        shards = a.local_shards
+        assert sum(s.shape[0] for s in shards) == 16
+
+
+class TestTypes(TestCase):
+    def test_canonical(self):
+        assert ht.canonical_heat_type(np.float32) == ht.float32
+        assert ht.canonical_heat_type("int64") == ht.int64
+        assert ht.canonical_heat_type(float) == ht.float32
+        assert ht.canonical_heat_type(bool) == ht.bool
+        with pytest.raises(TypeError):
+            ht.canonical_heat_type("notatype")
+
+    def test_promote(self):
+        assert ht.promote_types(ht.int32, ht.float32) == ht.float64
+        assert ht.promote_types(ht.int8, ht.uint8) == ht.int16
+        assert ht.promote_types(ht.float32, ht.float64) == ht.float64
+
+    def test_heat_type_of(self):
+        assert ht.heat_type_of(ht.zeros(3)) == ht.float32
+        assert ht.heat_type_of(True) == ht.bool
+        assert ht.heat_type_of(3.5) == ht.float32
+
+    def test_issubdtype(self):
+        assert ht.issubdtype(ht.float32, ht.floating)
+        assert ht.issubdtype(ht.int16, ht.integer)
+        assert not ht.issubdtype(ht.float64, ht.integer)
+
+    def test_finfo_iinfo(self):
+        assert ht.finfo(ht.float32).bits == 32
+        assert ht.iinfo(ht.int8).max == 127
+        with pytest.raises(TypeError):
+            ht.finfo(ht.int32)
+
+    def test_type_call_casts(self):
+        a = ht.float32(5)
+        assert a.dtype == ht.float32
+
+    def test_can_cast(self):
+        assert ht.can_cast(ht.int32, ht.float64)
+        assert ht.can_cast(ht.uint8, ht.int16, casting="safe")
+
+
+class TestPrinting(TestCase):
+    def test_repr(self):
+        a = ht.arange(5, split=0)
+        s = repr(a)
+        assert "DNDarray" in s and "split=0" in s
+
+    def test_printoptions(self):
+        ht.set_printoptions(precision=2)
+        assert ht.get_printoptions()["precision"] == 2
+        ht.set_printoptions(profile="default")
+
+
+class TestMemory(TestCase):
+    def test_copy(self):
+        a = ht.arange(6, split=0)
+        b = ht.copy(a)
+        b[0] = 99
+        assert int(a[0]) == 0
+        assert int(b[0]) == 99
+
+    def test_sanitize_memory_layout(self):
+        a = ht.zeros((3, 3))
+        assert ht.sanitize_memory_layout(a, "C") is a
+        with pytest.raises(ValueError):
+            ht.sanitize_memory_layout(a, "X")
+
+
+class TestCommunication(TestCase):
+    def test_world(self):
+        comm = ht.get_comm()
+        assert comm.size >= 1
+        assert comm.rank == 0
+
+    def test_chunk(self):
+        comm = ht.get_comm()
+        off, lshape, slices = comm.chunk((16, 4), 0, rank=0)
+        assert off == 0
+        assert lshape[1] == 4
+        counts, displs, _ = comm.counts_displs_shape((16, 4), 0)
+        assert sum(counts) == 16
+
+    def test_sanitize_comm(self):
+        assert ht.sanitize_comm(None) is ht.get_comm()
+        with pytest.raises(TypeError):
+            ht.sanitize_comm(42)
+
+    def test_use_comm(self):
+        prev = ht.get_comm()
+        ht.use_comm(ht.MPI_SELF)
+        assert ht.get_comm().size == 1
+        ht.use_comm(None)
+        assert ht.get_comm() is ht.MPI_WORLD
+        ht.use_comm(prev)

@@ -1,0 +1,202 @@
+"""Arithmetics / trig / exp / rounding / relational / logical tests
+(reference ``test_arithmetics.py`` etc.), using the split-sweep oracle."""
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from .base import TestCase
+
+
+class TestArithmetics(TestCase):
+    def test_binary_ops(self):
+        x = np.arange(1, 25).reshape(4, 6).astype(np.float32)
+        y = (np.arange(24).reshape(4, 6) + 0.5).astype(np.float32)
+        for split in (None, 0, 1):
+            a, b = ht.array(x, split=split), ht.array(y, split=split)
+            self.assert_array_equal(a + b, x + y)
+            self.assert_array_equal(a - b, x - y)
+            self.assert_array_equal(a * b, x * y)
+            self.assert_array_equal(a / b, x / y)
+            self.assert_array_equal(a // b, x // y)
+            self.assert_array_equal(a % b, x % y)
+            self.assert_array_equal(a**2, x**2)
+
+    def test_scalar_ops(self):
+        x = np.arange(12).reshape(3, 4).astype(np.float32)
+        a = ht.array(x, split=0)
+        self.assert_array_equal(a + 2, x + 2)
+        self.assert_array_equal(2 + a, 2 + x)
+        self.assert_array_equal(2 - a, 2 - x)
+        self.assert_array_equal(a * 3.0, x * 3.0)
+        self.assert_array_equal(1 / (a + 1), 1 / (x + 1))
+
+    def test_broadcast_split(self):
+        x = np.arange(24).reshape(4, 6).astype(np.float32)
+        v = np.arange(6).astype(np.float32)
+        a = ht.array(x, split=0)
+        b = ht.array(v)  # replicated
+        self.assert_array_equal(a + b, x + v)
+        res = a + b
+        assert res.split == 0
+
+    def test_mismatched_split_raises(self):
+        a = ht.zeros((4, 4), split=0)
+        b = ht.zeros((4, 4), split=1)
+        with pytest.raises(ValueError):
+            a + b
+
+    def test_type_promotion(self):
+        a = ht.arange(5, dtype=ht.int32)
+        b = ht.ones(5, dtype=ht.float32)
+        assert (a + b).dtype == ht.float64  # numpy promotion int32+float32
+        c = ht.ones(5, dtype=ht.int64)
+        assert (a + c).dtype == ht.int64
+
+    def test_reductions(self):
+        x = np.arange(24).reshape(4, 6).astype(np.float32)
+        for split in (None, 0, 1):
+            a = ht.array(x, split=split)
+            self.assert_array_equal(ht.sum(a, axis=0), x.sum(axis=0))
+            self.assert_array_equal(ht.sum(a, axis=1), x.sum(axis=1))
+            assert abs(ht.sum(a).item() - x.sum()) < 1e-3
+            self.assert_array_equal(ht.prod(a[:2, :2], axis=0), x[:2, :2].prod(axis=0))
+            self.assert_array_equal(a.sum(axis=0, keepdims=True), x.sum(axis=0, keepdims=True))
+
+    def test_reduction_split_semantics(self):
+        a = ht.zeros((8, 4), split=0)
+        assert ht.sum(a, axis=0).split is None  # reduced over split axis
+        assert ht.sum(a, axis=1).split == 0  # split axis survives
+        b = ht.zeros((8, 4), split=1)
+        assert ht.sum(b, axis=0).split == 0  # split shifts down
+
+    def test_cumops(self):
+        x = np.arange(1, 13).reshape(3, 4).astype(np.float32)
+        for split in (None, 0, 1):
+            a = ht.array(x, split=split)
+            self.assert_array_equal(ht.cumsum(a, 0), x.cumsum(axis=0))
+            self.assert_array_equal(ht.cumsum(a, 1), x.cumsum(axis=1))
+            self.assert_array_equal(ht.cumprod(a, 0), x.cumprod(axis=0))
+
+    def test_diff(self):
+        x = np.cumsum(np.arange(20)).astype(np.float32)
+        for split in (None, 0):
+            a = ht.array(x, split=split)
+            self.assert_array_equal(ht.diff(a), np.diff(x))
+            self.assert_array_equal(ht.diff(a, n=2), np.diff(x, n=2))
+
+    def test_bitwise(self):
+        x = np.array([0b1100, 0b1010], dtype=np.int32)
+        y = np.array([0b1010, 0b0110], dtype=np.int32)
+        a, b = ht.array(x), ht.array(y)
+        self.assert_array_equal(ht.bitwise_and(a, b), x & y)
+        self.assert_array_equal(ht.bitwise_or(a, b), x | y)
+        self.assert_array_equal(ht.bitwise_xor(a, b), x ^ y)
+        self.assert_array_equal(ht.invert(a), ~x)
+        self.assert_array_equal(a << 1, x << 1)
+        self.assert_array_equal(a >> 1, x >> 1)
+        with pytest.raises(TypeError):
+            ht.bitwise_and(ht.ones(3), ht.ones(3))
+
+    def test_neg_pos_abs(self):
+        x = np.array([-3.0, 2.0, -1.0], dtype=np.float32)
+        a = ht.array(x, split=0)
+        self.assert_array_equal(-a, -x)
+        self.assert_array_equal(+a, x)
+        self.assert_array_equal(abs(a), np.abs(x))
+
+    def test_nan_reductions(self):
+        x = np.array([1.0, np.nan, 3.0], dtype=np.float32)
+        a = ht.array(x)
+        assert ht.nansum(a).item() == 4.0
+        assert ht.nanprod(a).item() == 3.0
+
+    def test_out_kwarg(self):
+        x = np.arange(6).astype(np.float32)
+        a = ht.array(x, split=0)
+        out = ht.zeros(6, split=0)
+        ht.add(a, a, out=out)
+        self.assert_array_equal(out, x * 2)
+
+
+class TestElementwise(TestCase):
+    def test_trig(self):
+        self.assert_func_equal((4, 5), ht.sin, np.sin)
+        self.assert_func_equal((4, 5), ht.cos, np.cos)
+        self.assert_func_equal((4, 5), ht.tan, np.tan, rtol=1e-3)
+        self.assert_func_equal((4, 5), ht.tanh, np.tanh)
+        self.assert_func_equal((4, 5), ht.sinh, np.sinh, rtol=1e-4)
+        self.assert_func_equal((4, 5), ht.arctan, np.arctan)
+
+    def test_trig_int_promotes(self):
+        a = ht.arange(5)
+        assert ht.sin(a).dtype == ht.float32 or ht.sin(a).dtype == ht.float64
+
+    def test_exp_log(self):
+        self.assert_func_equal((3, 4), ht.exp, np.exp, low=-2, high=2, rtol=1e-4)
+        self.assert_func_equal((3, 4), ht.log, np.log, low=1, high=100, rtol=1e-5)
+        self.assert_func_equal((3, 4), ht.sqrt, np.sqrt, low=0, high=100)
+        self.assert_func_equal((3, 4), ht.log1p, np.log1p, low=0, high=10)
+        self.assert_func_equal((3, 4), ht.exp2, np.exp2, low=-3, high=3, rtol=1e-4)
+
+    def test_rounding(self):
+        x = np.array([-1.7, -0.2, 0.2, 1.5, 2.5], dtype=np.float32)
+        a = ht.array(x, split=0)
+        self.assert_array_equal(ht.floor(a), np.floor(x))
+        self.assert_array_equal(ht.ceil(a), np.ceil(x))
+        self.assert_array_equal(ht.trunc(a), np.trunc(x))
+        self.assert_array_equal(ht.round(a), np.round(x))
+        self.assert_array_equal(ht.sign(a), np.sign(x))
+        self.assert_array_equal(ht.clip(a, -1, 1), np.clip(x, -1, 1))
+        frac, integ = ht.modf(a)
+        nfrac, ninteg = np.modf(x)
+        self.assert_array_equal(frac, nfrac)
+        self.assert_array_equal(integ, ninteg)
+
+    def test_relational(self):
+        x = np.array([1, 2, 3, 4], dtype=np.float32)
+        y = np.array([2, 2, 2, 2], dtype=np.float32)
+        a, b = ht.array(x, split=0), ht.array(y, split=0)
+        self.assert_array_equal(a == b, x == y)
+        self.assert_array_equal(a != b, x != y)
+        self.assert_array_equal(a < b, x < y)
+        self.assert_array_equal(a <= b, x <= y)
+        self.assert_array_equal(a > b, x > y)
+        self.assert_array_equal(a >= b, x >= y)
+        assert ht.equal(a, a)
+        assert not ht.equal(a, b)
+
+    def test_logical(self):
+        x = np.array([[True, False], [True, True]])
+        a = ht.array(x, split=0)
+        assert bool(ht.all(a)) == x.all()
+        assert bool(ht.any(a)) == x.any()
+        self.assert_array_equal(ht.all(a, axis=0), x.all(axis=0))
+        self.assert_array_equal(ht.any(a, axis=1), x.any(axis=1))
+        self.assert_array_equal(ht.logical_not(a), ~x)
+        self.assert_array_equal(ht.logical_and(a, a), x & x)
+        self.assert_array_equal(ht.logical_or(a, ~a), np.ones_like(x))
+        self.assert_array_equal(ht.logical_xor(a, a), np.zeros_like(x, dtype=bool))
+
+    def test_isclose_allclose(self):
+        a = ht.ones((4, 4), split=0)
+        b = a + 1e-9
+        assert ht.allclose(a, b)
+        c = a + 1.0
+        assert not ht.allclose(a, c)
+        self.assert_array_equal(ht.isclose(a, b), np.ones((4, 4), dtype=bool))
+
+    def test_isnan_isinf(self):
+        x = np.array([1.0, np.nan, np.inf, -np.inf], dtype=np.float32)
+        a = ht.array(x, split=0)
+        self.assert_array_equal(ht.isnan(a), np.isnan(x))
+        self.assert_array_equal(ht.isinf(a), np.isinf(x))
+        self.assert_array_equal(ht.isfinite(a), np.isfinite(x))
+
+    def test_complex(self):
+        x = np.array([1 + 2j, 3 - 4j], dtype=np.complex64)
+        a = ht.array(x)
+        self.assert_array_equal(ht.real(a), x.real)
+        self.assert_array_equal(ht.imag(a), x.imag)
+        self.assert_array_equal(ht.conj(a), np.conj(x))
+        self.assert_array_equal(ht.angle(a), np.angle(x))
