@@ -1,0 +1,83 @@
+"""Benchmark driver: KMeans throughput on the north-star workload.
+
+Mirrors the reference protocol (``/root/reference/benchmarks/kmeans/
+heat-cpu.py:20-26``: k=8, 30 iterations, wall-clock) on synthetic blobs,
+split=0 over all available devices. ``vs_baseline`` is the speedup over a
+single-CPU-process NumPy implementation of the identical Lloyd iteration
+(the BASELINE.json target is >=8x that throughput).
+
+Prints exactly one JSON line.
+"""
+import json
+import time
+
+import numpy as np
+
+N = 1 << 19  # 524288 samples
+F = 32
+K = 8
+ITERS = 30
+
+
+def numpy_lloyd(x, c, iters):
+    for _ in range(iters):
+        d2 = (x * x).sum(1)[:, None] + (c * c).sum(1)[None, :] - 2.0 * (x @ c.T)
+        labels = d2.argmin(1)
+        onehot = np.eye(K, dtype=x.dtype)[labels]
+        counts = onehot.sum(0)
+        c = np.where(counts[:, None] > 0, (onehot.T @ x) / np.maximum(counts, 1)[:, None], c)
+    return c
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import heat_tpu as ht
+    from heat_tpu.cluster.kmeans import _lloyd_step
+
+    rng = np.random.default_rng(7)
+    true_centers = rng.normal(size=(K, F)).astype(np.float32) * 8
+    data = np.concatenate(
+        [tc + rng.normal(size=(N // K, F)).astype(np.float32) for tc in true_centers]
+    )
+    rng.shuffle(data)
+    init = data[rng.choice(N, K, replace=False)].copy()
+
+    # --- heat_tpu on all devices ---
+    x = ht.array(data, split=0)
+    xa = x.larray
+    c = jnp.asarray(init)
+    # warmup / compile
+    c_w, _, _ = _lloyd_step(xa, c, K)
+    c_w.block_until_ready()
+
+    c_run = jnp.asarray(init)
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        c_run, _, _ = _lloyd_step(xa, c_run, K)
+    c_run.block_until_ready()
+    t1 = time.perf_counter()
+    iters_per_sec = ITERS / (t1 - t0)
+
+    # --- single-process numpy baseline (3 iters is enough to time) ---
+    nb_iters = 3
+    t0 = time.perf_counter()
+    numpy_lloyd(data, init.copy(), nb_iters)
+    t1 = time.perf_counter()
+    baseline_ips = nb_iters / (t1 - t0)
+
+    print(
+        json.dumps(
+            {
+                "metric": "kmeans_iters_per_sec",
+                "value": round(iters_per_sec, 3),
+                "unit": f"iters/s (n={N}, f={F}, k={K})",
+                "vs_baseline": round(iters_per_sec / baseline_ips, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

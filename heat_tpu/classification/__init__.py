@@ -1,0 +1,2 @@
+"""Classification (reference ``heat/classification/``)."""
+from .kneighborsclassifier import KNeighborsClassifier

@@ -1,0 +1,62 @@
+"""k-nearest-neighbors classifier (reference
+``heat/classification/kneighborsclassifier.py``).
+
+fit stores the training set; predict is a fused sharded program: distance
+matrix on the MXU -> ``lax.top_k`` of the negated distances -> one-hot
+vote (reference ``kneighborsclassifier.py:10-136``).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import types
+from ..core.base import BaseEstimator, ClassificationMixin
+from ..core.dndarray import DNDarray
+from ..spatial.distance import _quadratic_expand
+
+__all__ = ["KNeighborsClassifier"]
+
+
+def one_hot_encoding(y: jnp.ndarray, classes: jnp.ndarray) -> jnp.ndarray:
+    """One-hot over an arbitrary class alphabet (reference
+    ``kneighborsclassifier.py:45``)."""
+    return (y[:, None] == classes[None, :]).astype(jnp.float32)
+
+
+class KNeighborsClassifier(BaseEstimator, ClassificationMixin):
+    """reference ``kneighborsclassifier.py:10``"""
+
+    def __init__(self, n_neighbors: int = 5):
+        self.n_neighbors = n_neighbors
+        self.x = None
+        self.y = None
+        self.classes_ = None
+
+    def fit(self, x: DNDarray, y: DNDarray) -> "KNeighborsClassifier":
+        """Store the training set (reference ``kneighborsclassifier.py``)."""
+        if not isinstance(x, DNDarray) or not isinstance(y, DNDarray):
+            raise TypeError(f"input needs to be DNDarrays, but were {type(x)}, {type(y)}")
+        self.x = x
+        self.y = y
+        self.classes_ = jnp.unique(y.larray.ravel())
+        return self
+
+    def predict(self, x: DNDarray) -> DNDarray:
+        """reference ``kneighborsclassifier.py:predict``"""
+        if self.x is None:
+            raise RuntimeError("fit needs to be called before predict")
+        Xq = x.larray.astype(jnp.float32)
+        Xt = self.x.larray.astype(jnp.float32)
+        yt = self.y.larray.ravel()
+        d2 = _quadratic_expand(Xq, Xt)  # (nq, nt)
+        _, idx = jax.lax.top_k(-d2, self.n_neighbors)  # (nq, k) nearest
+        neigh_labels = jnp.take(yt, idx)  # (nq, k)
+        votes = jnp.sum(
+            one_hot_encoding(neigh_labels.ravel(), self.classes_).reshape(
+                idx.shape[0], self.n_neighbors, -1
+            ),
+            axis=1,
+        )  # (nq, n_classes)
+        pred = jnp.take(self.classes_, jnp.argmax(votes, axis=1))
+        return DNDarray(pred, split=x.split, device=x.device, comm=x.comm)

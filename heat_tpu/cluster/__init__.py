@@ -1,0 +1,5 @@
+"""Clustering algorithms (reference ``heat/cluster/``)."""
+from .kmeans import KMeans
+from .kmedians import KMedians
+from .kmedoids import KMedoids
+from .spectral import Spectral

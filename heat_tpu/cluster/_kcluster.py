@@ -1,0 +1,115 @@
+"""Shared k-clustering machinery (reference ``heat/cluster/_kcluster.py``).
+
+The reference's per-centroid Bcast initialization and cdist/argmin
+assignment (``_kcluster.py:101-196``) become jitted global programs: one
+``jax.random.choice`` for random init, an iterative D²-sampling loop for
+kmeans++ (``probability_based``), and a fused distance+argmin kernel for
+assignment — all sharded over the data axis, reductions psum'd on ICI.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as ht_random
+from ..core import types
+from ..core.base import BaseEstimator, ClusteringMixin
+from ..core.dndarray import DNDarray
+from ..spatial.distance import _quadratic_expand
+
+__all__ = ["_KCluster"]
+
+
+class _KCluster(BaseEstimator, ClusteringMixin):
+    """Base class for KMeans/KMedians/KMedoids (reference ``_kcluster.py:10``).
+
+    Parameters
+    ----------
+    metric : callable
+        Tile metric used for assignment, (n, f) x (k, f) -> (n, k).
+    n_clusters, init, max_iter, tol, random_state : see reference.
+    """
+
+    def __init__(self, metric: Callable, n_clusters: int, init, max_iter: int, tol: float, random_state: Optional[int]):
+        self.n_clusters = n_clusters
+        self.init = init
+        self.max_iter = max_iter
+        self.tol = tol
+        self.random_state = random_state
+
+        self._metric = metric
+        self._cluster_centers = None
+        self._labels = None
+        self._inertia = None
+        self._n_iter = None
+
+    @property
+    def cluster_centers_(self) -> DNDarray:
+        return self._cluster_centers
+
+    @property
+    def labels_(self) -> DNDarray:
+        return self._labels
+
+    @property
+    def inertia_(self) -> float:
+        return self._inertia
+
+    @property
+    def n_iter_(self) -> int:
+        return self._n_iter
+
+    def _initialize_cluster_centers(self, x: DNDarray) -> jnp.ndarray:
+        """Pick initial centroids (reference ``_kcluster.py:87-187``).
+
+        'random' samples k rows; 'probability_based'/'kmeans++' performs
+        D²-weighted sampling. Either way the centroids end replicated, the
+        analogue of the reference's Bcast.
+        """
+        k = self.n_clusters
+        xa = x.larray
+        n = xa.shape[0]
+        if k > n:
+            raise ValueError(f"n_clusters ({k}) cannot exceed the number of samples ({n})")
+        if isinstance(self.init, DNDarray):
+            if self.init.shape != (k, x.shape[1]):
+                raise ValueError(f"passed centroids have wrong shape {self.init.shape}")
+            return self.init.larray.astype(xa.dtype)
+        if self.random_state is not None:
+            ht_random.seed(self.random_state)
+        if self.init == "random":
+            key = ht_random._next_key(k)
+            idx = jax.random.choice(key, n, shape=(k,), replace=False)
+            return jnp.take(xa, idx, axis=0)
+        if self.init in ("probability_based", "kmeans++", "k-means++"):
+            key = ht_random._next_key(k * n)
+
+            first = jax.random.randint(jax.random.fold_in(key, 0), (), 0, n)
+            centers = jnp.zeros((k, xa.shape[1]), dtype=xa.dtype)
+            centers = centers.at[0].set(xa[first])
+            d2 = _quadratic_expand(xa, centers[:1]).ravel()
+            for i in range(1, k):
+                probs = d2 / jnp.sum(d2)
+                nxt = jax.random.choice(jax.random.fold_in(key, i), n, p=probs)
+                centers = centers.at[i].set(xa[nxt])
+                d2 = jnp.minimum(d2, _quadratic_expand(xa, centers[i : i + 1]).ravel())
+            return centers
+        raise ValueError(f"Initialization method {self.init!r} not supported")
+
+    def _assign_to_cluster(self, x: DNDarray) -> DNDarray:
+        """Cluster index of every sample (reference ``_kcluster.py:196``)."""
+        if self._cluster_centers is None:
+            raise RuntimeError("fit needs to be called before predict")
+        labels = jnp.argmin(self._metric(x.larray, self._cluster_centers.larray), axis=1)
+        return DNDarray(
+            labels.astype(jnp.int64), dtype=types.int64, split=x.split, device=x.device, comm=x.comm
+        )
+
+    def predict(self, x: DNDarray) -> DNDarray:
+        """Labels for new data (reference ``_kcluster.py``)."""
+        if not isinstance(x, DNDarray):
+            raise TypeError(f"input needs to be a DNDarray, but was {type(x)}")
+        return self._assign_to_cluster(x)

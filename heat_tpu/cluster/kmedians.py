@@ -1,0 +1,77 @@
+"""K-Medians clustering (reference ``heat/cluster/kmedians.py``).
+
+Same fused-iteration structure as :class:`KMeans`; the centroid update is a
+masked per-cluster median (non-members NaN'd out, ``nanmedian`` reduced
+over the sharded data axis).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..core import types
+from ..core.dndarray import DNDarray
+from ..spatial.distance import _manhattan as _l1_distance
+from ._kcluster import _KCluster
+
+__all__ = ["KMedians"]
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _median_step(xa: jnp.ndarray, centers: jnp.ndarray, k: int):
+    # reference kmedians assigns by Manhattan distance (kmedians.py:49),
+    # matching the L1-optimal median update
+    d = _l1_distance(xa, centers)
+    labels = jnp.argmin(d, axis=1)
+    member = labels[:, None] == jnp.arange(k)[None, :]  # (n, k)
+    masked = jnp.where(member[:, :, None], xa[:, None, :], jnp.nan)  # (n, k, f)
+    new_centers = jnp.nanmedian(masked, axis=0)  # (k, f)
+    new_centers = jnp.where(jnp.isnan(new_centers), centers, new_centers)
+    shift = jnp.sum((new_centers - centers) ** 2)
+    return new_centers, labels, shift
+
+
+class KMedians(_KCluster):
+    """K-Medians (reference ``kmedians.py:12``)."""
+
+    def __init__(
+        self,
+        n_clusters: int = 8,
+        init: Union[str, DNDarray] = "random",
+        max_iter: int = 300,
+        tol: float = 1e-4,
+        random_state: Optional[int] = None,
+    ):
+        super().__init__(
+            metric=_l1_distance,
+            n_clusters=n_clusters,
+            init=init,
+            max_iter=max_iter,
+            tol=tol,
+            random_state=random_state,
+        )
+
+    def fit(self, x: DNDarray) -> "KMedians":
+        """reference ``kmedians.py``"""
+        if not isinstance(x, DNDarray):
+            raise TypeError(f"input needs to be a DNDarray, but was {type(x)}")
+        k = self.n_clusters
+        xa = x.larray.astype(jnp.promote_types(x.larray.dtype, jnp.float32))
+        centers = self._initialize_cluster_centers(x).astype(xa.dtype)
+
+        labels = None
+        n_iter = 0
+        for n_iter in range(1, self.max_iter + 1):
+            centers, labels, shift = _median_step(xa, centers, k)
+            if self.tol is not None and float(shift) <= self.tol:
+                break
+
+        self._cluster_centers = DNDarray(centers, split=None, device=x.device, comm=x.comm)
+        self._labels = DNDarray(
+            labels.astype(jnp.int64), dtype=types.int64, split=x.split, device=x.device, comm=x.comm
+        )
+        self._n_iter = n_iter
+        return self

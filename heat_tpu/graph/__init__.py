@@ -1,0 +1,2 @@
+"""Graph algorithms (reference ``heat/graph/``)."""
+from .laplacian import Laplacian

@@ -1,0 +1,2 @@
+"""Naive Bayes (reference ``heat/naive_bayes/``)."""
+from .gaussianNB import GaussianNB

@@ -1,0 +1,152 @@
+"""Gaussian Naive Bayes (reference ``heat/naive_bayes/gaussianNB.py``).
+
+Per-class moments are masked reductions over the sharded sample axis (the
+reference's incremental ``__update_mean_variance``, ``gaussianNB.py:131``,
+merged by psum); prediction is a fused joint-log-likelihood + argmax.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import types
+from ..core.base import BaseEstimator, ClassificationMixin
+from ..core.dndarray import DNDarray
+
+__all__ = ["GaussianNB"]
+
+
+class GaussianNB(BaseEstimator, ClassificationMixin):
+    """reference ``gaussianNB.py:12``
+
+    Parameters: ``priors`` (class priors, optional), ``var_smoothing``.
+    Attributes after fit: ``classes_``, ``theta_`` (means), ``sigma_``
+    (variances), ``class_prior_``, ``class_count_``, ``epsilon_``.
+    """
+
+    def __init__(self, priors=None, var_smoothing: float = 1e-9):
+        self.priors = priors
+        self.var_smoothing = var_smoothing
+
+    def fit(self, x: DNDarray, y: DNDarray, sample_weight=None) -> "GaussianNB":
+        """reference ``gaussianNB.py:fit``"""
+        self.classes_ = None
+        self.theta_ = None
+        self.sigma_ = None
+        self.class_count_ = None
+        self.class_prior_ = None
+        return self.partial_fit(x, y, classes=None, sample_weight=sample_weight, _refit=True)
+
+    def partial_fit(self, x: DNDarray, y: DNDarray, classes=None, sample_weight=None, _refit: bool = False) -> "GaussianNB":
+        """Incremental fit (reference ``gaussianNB.py:200``)."""
+        if not isinstance(x, DNDarray) or not isinstance(y, DNDarray):
+            raise TypeError(f"input needs to be DNDarrays, but were {type(x)}, {type(y)}")
+        X = x.larray.astype(jnp.promote_types(x.larray.dtype, jnp.float32))
+        Y = y.larray.ravel()
+        if classes is not None:
+            class_vals = jnp.asarray(classes if not isinstance(classes, DNDarray) else classes.larray)
+        elif not _refit and getattr(self, "classes_", None) is not None:
+            class_vals = self.classes_.larray
+        elif _refit:
+            class_vals = jnp.unique(Y)
+        else:
+            # reference ``gaussianNB.py:113``
+            raise ValueError("classes must be passed on the first call to partial_fit.")
+        unseen = ~jnp.isin(jnp.unique(Y), class_vals)
+        if bool(jnp.any(unseen)):
+            bad = np.asarray(jnp.unique(Y))[np.asarray(unseen)]
+            raise ValueError(
+                f"The target label(s) {bad} in y do not exist in the initial classes {np.asarray(class_vals)}"
+            )
+        k = class_vals.shape[0]
+        f = X.shape[1]
+
+        member = (Y[:, None] == class_vals[None, :]).astype(X.dtype)  # (n, k)
+        if sample_weight is not None:
+            w = sample_weight.larray if isinstance(sample_weight, DNDarray) else jnp.asarray(sample_weight)
+            member = member * w[:, None]
+        counts = jnp.sum(member, axis=0)  # (k,)
+        sums = member.T @ X  # (k, f)
+        means = sums / jnp.maximum(counts, 1.0)[:, None]
+        sq = member.T @ (X * X)
+        varis = sq / jnp.maximum(counts, 1.0)[:, None] - means**2
+
+        eps = self.var_smoothing * float(jnp.max(jnp.var(X, axis=0)))
+        if _refit or getattr(self, "theta_", None) is None:
+            new_counts, new_means, new_vars = counts, means, varis
+        else:
+            # merge with previous moments (parallel Welford, reference
+            # ``__update_mean_variance`` gaussianNB.py:131)
+            old_counts = self.class_count_.larray
+            old_means = self.theta_.larray
+            old_vars = self.sigma_.larray - self.epsilon_
+            tot = old_counts + counts
+            delta = means - old_means
+            new_means = old_means + delta * (counts / jnp.maximum(tot, 1.0))[:, None]
+            m_a = old_vars * old_counts[:, None]
+            m_b = varis * counts[:, None]
+            m2 = m_a + m_b + (delta**2) * ((old_counts * counts) / jnp.maximum(tot, 1.0))[:, None]
+            new_vars = m2 / jnp.maximum(tot, 1.0)[:, None]
+            new_counts = tot
+
+        self.epsilon_ = eps
+        self.classes_ = DNDarray(class_vals, split=None, device=x.device, comm=x.comm)
+        self.class_count_ = DNDarray(new_counts, split=None, device=x.device, comm=x.comm)
+        self.theta_ = DNDarray(new_means, split=None, device=x.device, comm=x.comm)
+        self.sigma_ = DNDarray(new_vars + eps, split=None, device=x.device, comm=x.comm)
+        if self.priors is not None:
+            pr = self.priors.larray if isinstance(self.priors, DNDarray) else jnp.asarray(self.priors)
+            self.class_prior_ = DNDarray(pr, split=None, device=x.device, comm=x.comm)
+        else:
+            self.class_prior_ = DNDarray(
+                new_counts / jnp.sum(new_counts), split=None, device=x.device, comm=x.comm
+            )
+        return self
+
+    def __joint_log_likelihood(self, X: jnp.ndarray) -> jnp.ndarray:
+        """reference ``gaussianNB.py:391``"""
+        theta = self.theta_.larray  # (k, f)
+        sigma = self.sigma_.larray
+        prior = self.class_prior_.larray
+        log_prior = jnp.log(jnp.maximum(prior, 1e-300))
+        # (n, k): -0.5 * sum(log(2 pi sigma)) - 0.5 * sum((x-mu)^2/sigma)
+        n_ij = -0.5 * jnp.sum(jnp.log(2.0 * jnp.pi * sigma), axis=1)  # (k,)
+        quad = -0.5 * jnp.sum(
+            ((X[:, None, :] - theta[None, :, :]) ** 2) / sigma[None, :, :], axis=2
+        )  # (n, k)
+        return log_prior[None, :] + n_ij[None, :] + quad
+
+    def logsumexp(self, a: DNDarray, axis=None) -> DNDarray:
+        """reference ``gaussianNB.py:407``"""
+        from jax.scipy.special import logsumexp as lse
+
+        out = lse(a.larray, axis=axis)
+        return DNDarray(out, split=None, device=a.device, comm=a.comm)
+
+    def predict(self, x: DNDarray) -> DNDarray:
+        """reference ``gaussianNB.py:480``"""
+        if getattr(self, "theta_", None) is None:
+            raise RuntimeError("fit needs to be called before predict")
+        X = x.larray.astype(self.theta_.larray.dtype)
+        jll = self.__joint_log_likelihood(X)
+        idx = jnp.argmax(jll, axis=1)
+        pred = jnp.take(self.classes_.larray, idx)
+        return DNDarray(pred, split=x.split, device=x.device, comm=x.comm)
+
+    def predict_proba(self, x: DNDarray) -> DNDarray:
+        """Posterior probabilities (reference ``gaussianNB.py``)."""
+        from jax.scipy.special import logsumexp as lse
+
+        X = x.larray.astype(self.theta_.larray.dtype)
+        jll = self.__joint_log_likelihood(X)
+        log_prob = jll - lse(jll, axis=1, keepdims=True)
+        return DNDarray(jnp.exp(log_prob), split=x.split, device=x.device, comm=x.comm)
+
+    def predict_log_proba(self, x: DNDarray) -> DNDarray:
+        from jax.scipy.special import logsumexp as lse
+
+        X = x.larray.astype(self.theta_.larray.dtype)
+        jll = self.__joint_log_likelihood(X)
+        return DNDarray(jll - lse(jll, axis=1, keepdims=True), split=x.split, device=x.device, comm=x.comm)

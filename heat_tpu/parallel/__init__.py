@@ -1,0 +1,17 @@
+"""TPU-native parallelism primitives.
+
+The reference expresses every distributed pattern as hand-written MPI; this
+package exposes the reusable TPU equivalents as first-class helpers:
+
+- :mod:`heat_tpu.parallel.ring` — the rotate-shard pipeline over
+  ``lax.ppermute`` (the skeleton of the reference's ring cdist,
+  ``heat/spatial/distance.py:209``, and of ring attention).
+- :mod:`heat_tpu.parallel.halo` — split-axis neighbor halo exchange inside
+  ``shard_map`` (reference ``heat/core/dndarray.py:333-441``).
+- :mod:`heat_tpu.parallel.mesh` — mesh construction, including 2-D
+  ICI×DCN meshes for hierarchical data parallelism (DASO-style).
+"""
+from . import halo, mesh, ring
+from .halo import halo_exchange
+from .mesh import make_mesh, make_hierarchical_mesh
+from .ring import ring_map, ring_reduce

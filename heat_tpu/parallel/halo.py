@@ -1,0 +1,62 @@
+"""Halo exchange over ``lax.ppermute`` (reference ``heat/core/dndarray.py:333-441``).
+
+The reference posts Isend/Irecv to split-axis neighbors; on TPU the same
+pattern is a pair of collective-permutes on the ICI ring, usable inside any
+``shard_map``-ped stencil kernel.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..core.communication import SPLIT_AXIS, MeshCommunication
+
+__all__ = ["halo_exchange", "exchange"]
+
+
+def exchange(block: jnp.ndarray, halo_size: int, axis_name: str = SPLIT_AXIS):
+    """Inside ``shard_map``: return (halo_prev, halo_next) for this shard.
+
+    ``halo_prev`` is the last ``halo_size`` rows of the left neighbor,
+    ``halo_next`` the first ``halo_size`` rows of the right neighbor;
+    boundary shards receive zero-size halos semantically (here: wrapped
+    values the caller masks, since ppermute is cyclic).
+    """
+    p = lax.axis_size(axis_name)
+    tail = block[-halo_size:]
+    head = block[:halo_size]
+    # send my tail to the right neighbor -> arrives as their halo_prev
+    halo_prev = lax.ppermute(tail, axis_name, [(j, (j + 1) % p) for j in range(p)])
+    # send my head to the left neighbor -> arrives as their halo_next
+    halo_next = lax.ppermute(head, axis_name, [(j, (j - 1) % p) for j in range(p)])
+    return halo_prev, halo_next
+
+
+def halo_exchange(x, halo_size: int, comm: MeshCommunication, axis_name: str = SPLIT_AXIS):
+    """Return the global array of per-shard halo-extended blocks.
+
+    For an (N, ...) array sharded on axis 0 over P devices, returns a
+    (P, N/P + 2*halo, ...) array whose i-th slice is shard i with its
+    neighbor halos attached (cyclic at the boundary, like the reference's
+    ``get_halo`` before boundary trimming).
+    """
+    mesh = comm.mesh
+    p = mesh.shape[axis_name]
+    if x.shape[0] % p:
+        raise ValueError(f"halo_exchange requires axis-0 divisible by mesh size {p}")
+
+    def local(block):
+        prev, nxt = exchange(block, halo_size, axis_name)
+        return jnp.concatenate([prev, block, nxt], axis=0)[None]
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=P(axis_name),
+        out_specs=P(axis_name),
+        check_vma=False,
+    )(x)

@@ -1,0 +1,49 @@
+"""Mesh construction helpers.
+
+The reference's DASO optimizer builds a two-level communicator hierarchy by
+hand (node-local DDP + staggered global MPI sub-communicators,
+``heat/optim/dp_optimizer.py:181-198``). On TPU the same structure is a 2-D
+``Mesh`` whose fast axis rides ICI and slow axis rides DCN; XLA routes
+collectives per axis automatically.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..core.communication import SPLIT_AXIS, MeshCommunication
+
+__all__ = ["make_mesh", "make_hierarchical_mesh"]
+
+
+def make_mesh(devices: Optional[Sequence] = None, axis_name: str = SPLIT_AXIS) -> Mesh:
+    """1-D mesh over the given (default: all) devices."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.array(devices), axis_names=(axis_name,))
+
+
+def make_hierarchical_mesh(
+    n_slow: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+    slow_axis: str = "nodes",
+    fast_axis: str = SPLIT_AXIS,
+) -> Mesh:
+    """2-D (slow × fast) mesh for DASO-style hierarchical data parallelism.
+
+    ``n_slow`` defaults to the number of processes (hosts), so the fast axis
+    maps onto intra-host ICI and the slow axis onto inter-host DCN — the
+    TPU-native version of the reference's node-local/global split.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if n_slow is None:
+        n_slow = max(jax.process_count(), 1)
+    if len(devices) % n_slow:
+        raise ValueError(f"{len(devices)} devices not divisible into {n_slow} groups")
+    arr = np.array(devices).reshape(n_slow, len(devices) // n_slow)
+    return Mesh(arr, axis_names=(slow_axis, fast_axis))

@@ -1,0 +1,3 @@
+"""Regression (reference ``heat/regression/``)."""
+from . import lasso
+from .lasso import Lasso

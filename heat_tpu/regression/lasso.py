@@ -1,0 +1,120 @@
+"""Lasso regression (reference ``heat/regression/lasso.py``).
+
+Coordinate descent with soft thresholding. The reference's per-feature loop
+issues a distributed matvec per coordinate (``lasso.py:10-186``); here one
+full sweep over features is a single jitted ``lax.fori_loop`` whose matvecs
+are sharded over the data axis (psum on ICI), so a sweep is one XLA program
+regardless of feature count.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import types
+from ..core.base import BaseEstimator, RegressionMixin
+from ..core.dndarray import DNDarray
+
+__all__ = ["Lasso"]
+
+
+@partial(jax.jit, static_argnames=())
+def _cd_sweep(X: jnp.ndarray, y: jnp.ndarray, theta: jnp.ndarray, lam: jnp.ndarray):
+    """One full coordinate-descent sweep (all features), jitted.
+
+    Maintains the running residual so a sweep costs one matvec total
+    instead of one per coordinate. Coordinate 0 (the intercept column) is
+    not regularized, matching the reference (``lasso.py:160-164``).
+    """
+    n, m = X.shape
+    col_sq = jnp.sum(X * X, axis=0)  # (m,)
+
+    def body(j, carry):
+        th, r = carry
+        # rho_j over the residual with feature j added back
+        rho = X[:, j] @ (r + X[:, j] * th[j])
+        soft = jnp.sign(rho) * jnp.maximum(jnp.abs(rho) - lam * n, 0.0)
+        numer = jnp.where(j == 0, rho, soft)  # intercept unregularized
+        new_tj = jnp.where(col_sq[j] > 0, numer / jnp.maximum(col_sq[j], 1e-30), 0.0)
+        r = r - X[:, j] * (new_tj - th[j])
+        return (th.at[j].set(new_tj), r)
+
+    r0 = y - X @ theta
+    th, _ = jax.lax.fori_loop(0, m, body, (theta, r0))
+    return th
+
+
+class Lasso(BaseEstimator, RegressionMixin):
+    """L1-regularized linear regression via coordinate descent (reference
+    ``lasso.py:10``).
+
+    Parameters: ``lam`` (L1 weight), ``max_iter``, ``tol``. An intercept
+    column of ones is expected in x, matching the reference's usage.
+    """
+
+    def __init__(self, lam: float = 0.1, max_iter: int = 100, tol: float = 1e-6):
+        self.lam = lam
+        self.max_iter = max_iter
+        self.tol = tol
+        self.__theta = None
+        self.n_iter = None
+
+    @property
+    def coef_(self) -> Optional[DNDarray]:
+        return None if self.__theta is None else self.__theta[1:]
+
+    @property
+    def intercept_(self) -> Optional[DNDarray]:
+        return None if self.__theta is None else self.__theta[0]
+
+    @property
+    def theta(self) -> Optional[DNDarray]:
+        return self.__theta
+
+    def soft_threshold(self, rho):
+        """Soft thresholding operator (reference ``lasso.py``)."""
+        lam = self.lam
+        if isinstance(rho, DNDarray):
+            import jax.numpy as jnp
+
+            r = rho.larray
+            out = jnp.sign(r) * jnp.maximum(jnp.abs(r) - lam, 0.0)
+            return DNDarray(out, split=rho.split, device=rho.device, comm=rho.comm)
+        return jnp.sign(rho) * jnp.maximum(jnp.abs(rho) - lam, 0.0)
+
+    def rmse(self, gt: DNDarray, yest: DNDarray) -> float:
+        """Root mean squared error (reference ``lasso.py``)."""
+        diff = gt.larray.ravel() - yest.larray.ravel()
+        return float(jnp.sqrt(jnp.mean(diff * diff)))
+
+    def fit(self, x: DNDarray, y: DNDarray) -> "Lasso":
+        """reference ``lasso.py:fit``"""
+        if not isinstance(x, DNDarray) or not isinstance(y, DNDarray):
+            raise TypeError(f"input needs to be DNDarrays, but were {type(x)}, {type(y)}")
+        if x.ndim != 2:
+            raise ValueError(f"x needs to be 2D, but was {x.ndim}D")
+        X = x.larray.astype(jnp.promote_types(x.larray.dtype, jnp.float32))
+        Y = y.larray.astype(X.dtype).ravel()
+        m = X.shape[1]
+        theta = jnp.zeros(m, dtype=X.dtype)
+        lam = jnp.asarray(self.lam, dtype=X.dtype)
+
+        for it in range(1, self.max_iter + 1):
+            new_theta = _cd_sweep(X, Y, theta, lam)
+            diff = float(jnp.max(jnp.abs(new_theta - theta)))
+            theta = new_theta
+            if diff < self.tol:
+                break
+        self.n_iter = it
+        self.__theta = DNDarray(theta.reshape(-1, 1), split=None, device=x.device, comm=x.comm)
+        return self
+
+    def predict(self, x: DNDarray) -> DNDarray:
+        """reference ``lasso.py:predict``"""
+        if self.__theta is None:
+            raise RuntimeError("fit needs to be called before predict")
+        out = x.larray @ self.__theta.larray
+        return DNDarray(out, split=x.split, device=x.device, comm=x.comm)

@@ -1,0 +1,3 @@
+"""Spatial distance functions (reference ``heat/spatial/``)."""
+from . import distance
+from .distance import cdist, manhattan, rbf

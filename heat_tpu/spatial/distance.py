@@ -1,0 +1,124 @@
+"""Pairwise distances (reference ``heat/spatial/distance.py``).
+
+The reference's ``_dist`` (``distance.py:209-486``) hand-implements a ring
+pipeline: the moving shard rotates with Send/Probe/Recv and symmetric tiles
+are mailed back. On TPU there are two native schedules:
+
+- **GSPMD path** (default): the quadratic expansion
+  ``|x|^2 + |y|^2 - 2 x y^T`` is one sharded matmul on the MXU; XLA
+  all-gathers the smaller operand over ICI. Fastest when a y-shard fits
+  in HBM alongside x.
+- **Ring path** (``heat_tpu.parallel.ring.ring_map``): rotates y-shards
+  with ``ppermute`` computing one output tile per step — the reference's
+  schedule, for when M·N tiles must not be materialized against a
+  replicated y.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import types
+from ..core.dndarray import DNDarray
+
+__all__ = ["cdist", "manhattan", "rbf"]
+
+
+def _quadratic_expand(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """||x_i - y_j||^2 via the MXU-friendly expansion (reference
+    ``_quadratic_expand``, ``distance.py:16-133``)."""
+    x_norm = jnp.sum(x * x, axis=1, keepdims=True)
+    y_norm = jnp.sum(y * y, axis=1)
+    d2 = x_norm + y_norm[None, :] - 2.0 * (x @ y.T)
+    return jnp.maximum(d2, 0.0)
+
+
+def _euclidian(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    diff = x[:, None, :] - y[None, :, :]
+    return jnp.sqrt(jnp.sum(diff * diff, axis=-1))
+
+
+def _manhattan(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    diff = jnp.abs(x[:, None, :] - y[None, :, :])
+    return jnp.sum(diff, axis=-1)
+
+
+def _gaussian(x: jnp.ndarray, y: jnp.ndarray, sigma: float) -> jnp.ndarray:
+    d2 = _quadratic_expand(x, y)
+    return jnp.exp(-d2 / (2.0 * sigma * sigma))
+
+
+def _dist(x: DNDarray, y: Optional[DNDarray], metric: Callable, use_ring: bool = False) -> DNDarray:
+    """Dispatch over distributions (reference ``distance.py:209``)."""
+    if x.ndim != 2:
+        raise NotImplementedError(f"Input x must be a 2D DNDarray, got {x.ndim}-D")
+    self_dist = y is None
+    if self_dist:
+        y = x
+    if y.ndim != 2:
+        raise NotImplementedError(f"Input y must be a 2D DNDarray, got {y.ndim}-D")
+    if x.shape[1] != y.shape[1]:
+        raise ValueError(f"feature dimensions differ: {x.shape[1]} != {y.shape[1]}")
+    if x.split == 1 or y.split == 1:
+        raise NotImplementedError("cdist with split=1 operands: resplit to 0 or None first")
+
+    promoted = types.promote_types(x.dtype, types.float32)
+    jt = promoted.jax_type()
+    xa = x.larray.astype(jt)
+    ya = y.larray.astype(jt)
+
+    if use_ring and x.split == 0 and y.split == 0:
+        from ..parallel.ring import ring_map
+
+        p = x.comm.size
+        if xa.shape[0] % p == 0 and ya.shape[0] % p == 0 and p > 1:
+            result = ring_map(metric, xa, ya, x.comm)
+            out_split = 0
+            return DNDarray(result, dtype=promoted, split=out_split, device=x.device, comm=x.comm)
+
+    # GSPMD path: one global expression; XLA inserts the collectives
+    result = metric(xa, ya)
+    out_split = 0 if x.split is not None else (1 if y.split is not None else None)
+    return DNDarray(result, dtype=promoted, split=out_split, device=x.device, comm=x.comm)
+
+
+def cdist(
+    x: DNDarray,
+    y: Optional[DNDarray] = None,
+    quadratic_expansion: bool = False,
+    use_ring: bool = False,
+) -> DNDarray:
+    """Euclidean distance matrix (reference ``distance.py:136``).
+
+    ``quadratic_expansion=True`` uses the matmul form (one MXU op); the
+    default exact form is used otherwise. ``use_ring=True`` selects the
+    ``ppermute`` ring schedule when both operands are split.
+    """
+    if quadratic_expansion:
+        metric = lambda a, b: jnp.sqrt(_quadratic_expand(a, b))
+    else:
+        metric = _euclidian
+    return _dist(x, y, metric, use_ring=use_ring)
+
+
+def manhattan(x: DNDarray, y: Optional[DNDarray] = None, expand: bool = False, use_ring: bool = False) -> DNDarray:
+    """Manhattan (L1) distance matrix (reference ``distance.py:186``).
+
+    ``expand`` selected a broadcast-vs-loop implementation in the reference
+    with identical results; XLA fuses the broadcast form either way, so the
+    flag is accepted for API parity and has no effect here.
+    """
+    return _dist(x, y, _manhattan, use_ring=use_ring)
+
+
+def rbf(
+    x: DNDarray,
+    y: Optional[DNDarray] = None,
+    sigma: float = 1.0,
+    quadratic_expansion: bool = False,
+    use_ring: bool = False,
+) -> DNDarray:
+    """Gaussian RBF kernel matrix (reference ``distance.py:159``)."""
+    return _dist(x, y, lambda a, b: _gaussian(a, b, sigma), use_ring=use_ring)

@@ -1,0 +1,2 @@
+"""Utilities (reference ``heat/utils/``)."""
+from . import data

@@ -1,0 +1,2 @@
+"""Data tooling (reference ``heat/utils/data/``)."""
+from . import matrixgallery

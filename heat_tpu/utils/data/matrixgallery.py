@@ -1,0 +1,37 @@
+"""Test-matrix gallery (reference ``heat/utils/data/matrixgallery.py``)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ...core import types
+from ...core.communication import sanitize_comm
+from ...core.dndarray import DNDarray
+
+__all__ = ["parter", "hermitian"]
+
+
+def parter(n: int, split: Optional[int] = None, device=None, comm=None, dtype=types.float32) -> DNDarray:
+    """Parter matrix A[i,j] = 1 / (i - j + 0.5) (reference
+    ``matrixgallery.py:15``)."""
+    dtype = types.canonical_heat_type(dtype)
+    i = jnp.arange(n, dtype=dtype.jax_type())
+    a = 1.0 / (i[:, None] - i[None, :] + 0.5)
+    return DNDarray(a, dtype=dtype, split=split, device=device, comm=sanitize_comm(comm))
+
+
+def hermitian(n: int, split: Optional[int] = None, device=None, comm=None, dtype=types.complex64) -> DNDarray:
+    """Random Hermitian matrix (reference ``matrixgallery.py``)."""
+    from ...core import random as ht_random
+
+    dtype = types.canonical_heat_type(dtype)
+    if types.heat_type_is_complexfloating(dtype):
+        re = ht_random.rand(n, n).larray
+        im = ht_random.rand(n, n).larray
+        a = re + 1j * im
+        h = (a + a.conj().T) / 2
+    else:
+        a = ht_random.rand(n, n).larray
+        h = (a + a.T) / 2
+    return DNDarray(h.astype(dtype.jax_type()), dtype=dtype, split=split, device=device, comm=sanitize_comm(comm))

@@ -1,0 +1,344 @@
+"""Cluster / spatial / graph tests (reference ``heat/cluster/tests``,
+``heat/spatial/tests``)."""
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from .base import TestCase
+
+
+def make_blobs(n_per=64, k=4, f=8, seed=0, spread=10.0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(k, f)).astype(np.float32) * spread
+    pts = np.concatenate([c + rng.normal(size=(n_per, f)).astype(np.float32) for c in centers])
+    perm = rng.permutation(len(pts))
+    return pts[perm].astype(np.float32), centers
+
+
+class TestCdist(TestCase):
+    def setUp(self):
+        rng = np.random.default_rng(3)
+        self.x = rng.random((64, 8)).astype(np.float32)
+        self.y = rng.random((32, 8)).astype(np.float32)
+        from scipy.spatial.distance import cdist
+
+        self.expected = cdist(self.x, self.y).astype(np.float32)
+
+    def test_cdist_splits(self):
+        for sx in (None, 0):
+            for sy in (None, 0):
+                d = ht.spatial.cdist(ht.array(self.x, split=sx), ht.array(self.y, split=sy))
+                self.assert_array_equal(d, self.expected, rtol=1e-4, atol=1e-4)
+
+    def test_cdist_quadratic(self):
+        d = ht.spatial.cdist(
+            ht.array(self.x, split=0), ht.array(self.y), quadratic_expansion=True
+        )
+        self.assert_array_equal(d, self.expected, rtol=1e-3, atol=1e-3)
+
+    def test_cdist_self(self):
+        from scipy.spatial.distance import cdist
+
+        d = ht.spatial.cdist(ht.array(self.x, split=0))
+        self.assert_array_equal(d, cdist(self.x, self.x), rtol=1e-4, atol=1e-4)
+
+    def test_cdist_ring(self):
+        d = ht.spatial.cdist(
+            ht.array(self.x, split=0), ht.array(self.y, split=0), use_ring=True
+        )
+        assert d.split == 0
+        self.assert_array_equal(d, self.expected, rtol=1e-4, atol=1e-4)
+
+    def test_rbf(self):
+        sigma = 2.0
+        expected = np.exp(-(self.expected**2) / (2 * sigma * sigma))
+        r = ht.spatial.rbf(ht.array(self.x, split=0), ht.array(self.y), sigma=sigma)
+        self.assert_array_equal(r, expected, rtol=1e-4, atol=1e-5)
+
+    def test_manhattan(self):
+        from scipy.spatial.distance import cdist
+
+        expected = cdist(self.x, self.y, metric="cityblock").astype(np.float32)
+        m = ht.spatial.manhattan(ht.array(self.x, split=0), ht.array(self.y))
+        self.assert_array_equal(m, expected, rtol=1e-4, atol=1e-4)
+
+    def test_feature_mismatch(self):
+        with pytest.raises(ValueError):
+            ht.spatial.cdist(ht.zeros((4, 3)), ht.zeros((4, 5)))
+
+
+class TestKMeans(TestCase):
+    def test_fit_recovers_blobs(self):
+        pts, true_centers = make_blobs()
+        x = ht.array(pts, split=0)
+        # init near the truth: Lloyd must converge onto the blob means
+        init = ht.array(true_centers + 0.5)
+        km = ht.cluster.KMeans(n_clusters=4, init=init, max_iter=100)
+        km.fit(x)
+        got = km.cluster_centers_.numpy()
+        # match each true center to its nearest found centroid
+        d = np.linalg.norm(got[:, None, :] - true_centers[None, :, :], axis=2)
+        assert d.min(axis=0).max() < 1.0
+        assert km.labels_.shape == (len(pts),)
+        assert km.inertia_ > 0
+        assert km.n_iter_ >= 1
+
+    def test_kmeanspp_quality(self):
+        pts, true_centers = make_blobs(seed=21)
+        x = ht.array(pts, split=0)
+        km = ht.cluster.KMeans(n_clusters=4, init="kmeans++", max_iter=100, random_state=17)
+        km.fit(x)
+        # inertia must be within 3x of the inertia at the true centers
+        from heat_tpu.cluster.kmeans import _inertia
+
+        ref = float(_inertia(x.larray, true_centers, 4))
+        assert km.inertia_ < 3 * ref
+
+    def test_deterministic(self):
+        pts, _ = make_blobs(seed=5)
+        x = ht.array(pts, split=0)
+        c1 = ht.cluster.KMeans(n_clusters=4, random_state=9).fit(x).cluster_centers_.numpy()
+        c2 = ht.cluster.KMeans(n_clusters=4, random_state=9).fit(x).cluster_centers_.numpy()
+        assert np.array_equal(c1, c2)
+
+    def test_split_invariant(self):
+        pts, _ = make_blobs(seed=6)
+        c0 = ht.cluster.KMeans(n_clusters=4, random_state=3).fit(ht.array(pts, split=0))
+        cn = ht.cluster.KMeans(n_clusters=4, random_state=3).fit(ht.array(pts, split=None))
+        np.testing.assert_allclose(
+            c0.cluster_centers_.numpy(), cn.cluster_centers_.numpy(), rtol=1e-5, atol=1e-5
+        )
+
+    def test_predict(self):
+        pts, _ = make_blobs(seed=2)
+        x = ht.array(pts, split=0)
+        km = ht.cluster.KMeans(n_clusters=4, random_state=1).fit(x)
+        labels = km.predict(x)
+        assert np.array_equal(labels.numpy(), km.labels_.numpy())
+
+    def test_init_dndarray(self):
+        pts, true_centers = make_blobs(seed=8)
+        km = ht.cluster.KMeans(n_clusters=4, init=ht.array(true_centers), max_iter=10)
+        km.fit(ht.array(pts, split=0))
+        assert km.n_iter_ <= 5  # should converge nearly immediately
+
+    def test_get_set_params(self):
+        km = ht.cluster.KMeans(n_clusters=3)
+        params = km.get_params()
+        assert params["n_clusters"] == 3
+        km.set_params(n_clusters=5)
+        assert km.n_clusters == 5
+
+
+class TestKMediansMedoids(TestCase):
+    def test_kmedians(self):
+        pts, true_centers = make_blobs(seed=11)
+        init = ht.array((true_centers + 0.5).astype(np.float32))
+        km = ht.cluster.KMedians(n_clusters=4, init=init).fit(ht.array(pts, split=0))
+        got = km.cluster_centers_.numpy()
+        d = np.linalg.norm(got[:, None, :] - true_centers[None, :, :], axis=2)
+        assert d.min(axis=0).max() < 1.5
+
+    def test_kmedoids_centers_are_points(self):
+        pts, _ = make_blobs(seed=12)
+        km = ht.cluster.KMedoids(n_clusters=4, random_state=4).fit(ht.array(pts, split=0))
+        centers = km.cluster_centers_.numpy()
+        for c in centers:
+            assert (np.abs(pts - c).sum(axis=1) < 1e-5).any()
+
+
+class TestSpectralAndGraph(TestCase):
+    def test_laplacian(self):
+        pts, _ = make_blobs(n_per=16, k=2, f=4, seed=13)
+        lap = ht.graph.Laplacian(
+            similarity=lambda z: ht.spatial.rbf(z, sigma=5.0), definition="norm_sym"
+        )
+        L = lap.construct(ht.array(pts, split=0))
+        Lnp = L.numpy()
+        assert Lnp.shape == (32, 32)
+        np.testing.assert_allclose(Lnp, Lnp.T, atol=1e-5)  # symmetric
+        evals = np.linalg.eigvalsh(Lnp)
+        assert evals.min() > -1e-4  # PSD
+
+    def test_laplacian_simple(self):
+        pts, _ = make_blobs(n_per=8, k=2, f=4, seed=14)
+        lap = ht.graph.Laplacian(
+            similarity=lambda z: ht.spatial.rbf(z, sigma=5.0), definition="simple"
+        )
+        L = lap.construct(ht.array(pts)).numpy()
+        np.testing.assert_allclose(L.sum(axis=1), 0.0, atol=1e-4)  # rows sum to 0
+
+    def test_spectral(self):
+        # two well-separated blobs
+        rng = np.random.default_rng(20)
+        a = rng.normal(size=(32, 2)).astype(np.float32)
+        b = rng.normal(size=(32, 2)).astype(np.float32) + 40.0
+        pts = np.concatenate([a, b])
+        x = ht.array(pts.astype(np.float32), split=0)
+        sp = ht.cluster.Spectral(n_clusters=2, gamma=0.05, n_lanczos=20, random_state=2)
+        sp.fit(x)
+        labels = sp.labels_.numpy()
+        # the two blobs must be separated
+        assert len(set(labels[:32])) == 1
+        assert len(set(labels[32:])) == 1
+        assert labels[0] != labels[-1]
+
+
+class TestMLEstimators(TestCase):
+    def test_lasso(self):
+        rng = np.random.default_rng(30)
+        n, f = 256, 8
+        X = rng.normal(size=(n, f)).astype(np.float32)
+        w_true = np.array([2.0, -3.0, 0, 0, 1.5, 0, 0, 0], dtype=np.float32)
+        y = X @ w_true + 0.01 * rng.normal(size=n).astype(np.float32)
+        Xb = np.concatenate([np.ones((n, 1), dtype=np.float32), X], axis=1)
+        lasso = ht.regression.Lasso(lam=0.01, max_iter=200)
+        lasso.fit(ht.array(Xb, split=0), ht.array(y, split=0))
+        coef = lasso.theta.numpy().ravel()[1:]
+        np.testing.assert_allclose(coef, w_true, atol=0.15)
+        pred = lasso.predict(ht.array(Xb, split=0))
+        assert lasso.rmse(ht.array(y), pred) < 0.5
+
+    def test_gaussian_nb(self):
+        pts, _ = make_blobs(n_per=64, k=3, f=4, seed=31)
+        labels = np.concatenate([np.full(64, i) for i in range(3)])
+        rng = np.random.default_rng(0)
+        perm = rng.permutation(len(pts))
+        # regenerate unshuffled blobs for clean labels
+        centers = np.random.default_rng(31).normal(size=(3, 4)).astype(np.float32) * 10
+        pts = np.concatenate([c + np.random.default_rng(i).normal(size=(64, 4)).astype(np.float32) for i, c in enumerate(centers)])
+        gnb = ht.naive_bayes.GaussianNB()
+        gnb.fit(ht.array(pts, split=0), ht.array(labels.astype(np.float32)))
+        pred = gnb.predict(ht.array(pts, split=0)).numpy()
+        assert (pred == labels).mean() > 0.95
+        proba = gnb.predict_proba(ht.array(pts[:8], split=0)).numpy()
+        np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-4)
+
+    def test_gaussian_nb_partial_fit(self):
+        centers = np.array([[0.0, 0.0], [10.0, 10.0]], dtype=np.float32)
+        rng = np.random.default_rng(5)
+        a = centers[0] + rng.normal(size=(64, 2)).astype(np.float32)
+        b = centers[1] + rng.normal(size=(64, 2)).astype(np.float32)
+        gnb = ht.naive_bayes.GaussianNB()
+        gnb.partial_fit(ht.array(a), ht.array(np.zeros(64, dtype=np.float32)), classes=[0.0, 1.0])
+        gnb.partial_fit(ht.array(b), ht.array(np.ones(64, dtype=np.float32)))
+        pred = gnb.predict(ht.array(np.array([[0.5, 0.5], [9.5, 9.5]], dtype=np.float32)))
+        assert pred.numpy().tolist() == [0.0, 1.0]
+
+    def test_knn(self):
+        pts, _ = make_blobs(n_per=64, k=3, f=4, seed=33)
+        centers = np.random.default_rng(33).normal(size=(3, 4)).astype(np.float32) * 10
+        pts = np.concatenate([c + np.random.default_rng(i).normal(size=(64, 4)).astype(np.float32) for i, c in enumerate(centers)])
+        labels = np.concatenate([np.full(64, i) for i in range(3)]).astype(np.float32)
+        knn = ht.classification.KNeighborsClassifier(n_neighbors=5)
+        knn.fit(ht.array(pts, split=0), ht.array(labels))
+        pred = knn.predict(ht.array(pts, split=0)).numpy()
+        assert (pred == labels).mean() > 0.95
+
+    def test_base_estimator(self):
+        km = ht.cluster.KMeans(n_clusters=2)
+        assert ht.is_estimator(km)
+        assert ht.is_clusterer(km)
+        assert not ht.is_classifier(km)
+        knn = ht.classification.KNeighborsClassifier()
+        assert ht.is_classifier(knn)
+        lasso = ht.regression.Lasso()
+        assert ht.is_regressor(lasso)
+
+
+class TestParallelPrimitives(TestCase):
+    def test_ring_map_matches_direct(self):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(40)
+        x = rng.random((32, 4)).astype(np.float32)
+        y = rng.random((16, 4)).astype(np.float32)
+        comm = ht.get_comm()
+        if comm.size == 1:
+            pytest.skip("needs multi-device mesh")
+        from heat_tpu.parallel import ring_map
+
+        xj = ht.array(x, split=0).larray
+        yj = ht.array(y, split=0).larray
+        out = ring_map(lambda a, b: a @ b.T, xj, yj, comm)
+        np.testing.assert_allclose(np.asarray(out), x @ y.T, rtol=1e-5, atol=1e-5)
+
+    def test_halo_exchange(self):
+        comm = ht.get_comm()
+        if comm.size == 1:
+            pytest.skip("needs multi-device mesh")
+        from heat_tpu.parallel import halo_exchange
+
+        x = ht.arange(32, dtype=ht.float32, split=0).reshape((32, 1))
+        h = np.asarray(halo_exchange(x.larray, 1, comm))
+        p = comm.size
+        block = 32 // p
+        assert h.shape == (p, block + 2, 1)
+        # interior shard i: first element is last element of shard i-1
+        for i in range(1, p - 1):
+            assert h[i, 0, 0] == i * block - 1
+            assert h[i, -1, 0] == (i + 1) * block
+
+    def test_hierarchical_mesh(self):
+        import jax
+
+        from heat_tpu.parallel import make_hierarchical_mesh
+
+        if len(jax.devices()) < 4:
+            pytest.skip("needs >=4 devices")
+        mesh = make_hierarchical_mesh(n_slow=2)
+        assert mesh.axis_names == ("nodes", "split")
+        assert mesh.shape["nodes"] == 2
+
+
+class TestReviewRegressions(TestCase):
+    """Regression tests for reference-parity fixes found in review."""
+
+    def test_kmedians_l1_assignment(self):
+        # point (3,3): L1 picks center (4,0) (d=4) over (0,0) (d=6);
+        # L2 would pick (0,0) (18 < 10 is false: L2^2 to (4,0) is 10) —
+        # actually L2 picks (4,0) too; use the classic counterexample:
+        pts = np.array([[3.0, 3.0]], dtype=np.float32)
+        centers = np.array([[0.0, 0.0], [4.0, 5.0]], dtype=np.float32)
+        km = ht.cluster.KMedians(n_clusters=2, init=ht.array(centers), max_iter=1)
+        km.fit(ht.array(np.concatenate([centers, pts]), split=None))
+        # L1: d((3,3),(0,0))=6, d((3,3),(4,5))=3 -> cluster 1
+        assert int(km.labels_.numpy()[-1]) == 1
+
+    def test_lasso_intercept_unregularized(self):
+        rng = np.random.default_rng(50)
+        n = 128
+        X = rng.normal(size=(n, 2)).astype(np.float32)
+        y = 10.0 + X @ np.array([1.0, -1.0], dtype=np.float32)
+        Xb = np.concatenate([np.ones((n, 1), dtype=np.float32), X], axis=1)
+        la = ht.regression.Lasso(lam=0.1, max_iter=100)
+        la.fit(ht.array(Xb, split=0), ht.array(y.astype(np.float32), split=0))
+        intercept = float(la.theta.numpy().ravel()[0])
+        assert abs(intercept - 10.0) < 0.05  # no lam bias on the intercept
+
+    def test_gnb_requires_classes_first_call(self):
+        x = ht.array(np.zeros((4, 2), dtype=np.float32))
+        y = ht.array(np.zeros(4, dtype=np.float32))
+        gnb = ht.naive_bayes.GaussianNB()
+        with pytest.raises(ValueError, match="classes must be passed"):
+            gnb.partial_fit(x, y)
+
+    def test_gnb_rejects_unseen_labels(self):
+        gnb = ht.naive_bayes.GaussianNB()
+        x = ht.array(np.random.default_rng(0).normal(size=(8, 2)).astype(np.float32))
+        y0 = ht.array(np.zeros(8, dtype=np.float32))
+        gnb.partial_fit(x, y0, classes=[0.0, 1.0])
+        y2 = ht.array(np.full(8, 2.0, dtype=np.float32))
+        with pytest.raises(ValueError, match="do not exist in the initial"):
+            gnb.partial_fit(x, y2)
+
+    def test_spectral_predict_new_data_length(self):
+        rng = np.random.default_rng(51)
+        a = rng.normal(size=(24, 2)).astype(np.float32)
+        b = rng.normal(size=(24, 2)).astype(np.float32) + 30
+        sp = ht.cluster.Spectral(n_clusters=2, gamma=0.05, n_lanczos=16, random_state=1)
+        sp.fit(ht.array(np.concatenate([a, b]), split=0))
+        new = np.concatenate([a[:8], b[:8]]).astype(np.float32)
+        pred = sp.predict(ht.array(new, split=0))
+        assert pred.shape == (16,)  # length of the NEW data, not training

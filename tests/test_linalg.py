@@ -1,0 +1,167 @@
+"""Linalg tests (reference ``heat/core/linalg/tests``)."""
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from .base import TestCase
+
+
+class TestMatmul(TestCase):
+    def setUp(self):
+        rng = np.random.default_rng(1)
+        self.a = rng.random((16, 24)).astype(np.float32)
+        self.b = rng.random((24, 8)).astype(np.float32)
+
+    def test_all_split_combos(self):
+        expected = self.a @ self.b
+        for sa in (None, 0, 1):
+            for sb in (None, 0, 1):
+                c = ht.matmul(ht.array(self.a, split=sa), ht.array(self.b, split=sb))
+                self.assert_array_equal(c, expected, rtol=1e-4, atol=1e-4)
+
+    def test_split_metadata(self):
+        c = ht.matmul(ht.array(self.a, split=0), ht.array(self.b))
+        assert c.split == 0
+        c = ht.matmul(ht.array(self.a), ht.array(self.b, split=1))
+        assert c.split == 1
+        c = ht.matmul(ht.array(self.a, split=1), ht.array(self.b, split=0))
+        assert c.split is None  # contracted split -> psum, replicated
+
+    def test_matmul_operator(self):
+        c = ht.array(self.a, split=0) @ ht.array(self.b)
+        self.assert_array_equal(c, self.a @ self.b, rtol=1e-4, atol=1e-4)
+
+    def test_dot_vectors(self):
+        v = np.arange(16, dtype=np.float32)
+        w = np.arange(16, dtype=np.float32)[::-1].copy()
+        d = ht.dot(ht.array(v, split=0), ht.array(w, split=0))
+        assert abs(d.item() - v @ w) < 1e-2
+
+    def test_vecdot_outer(self):
+        v = np.arange(8, dtype=np.float32)
+        w = np.arange(8, dtype=np.float32) + 1
+        self.assert_array_equal(ht.outer(ht.array(v, split=0), ht.array(w)), np.outer(v, w))
+        res = ht.vecdot(ht.array(v), ht.array(w))
+        assert abs(res.item() - (v * w).sum()) < 1e-3
+
+    def test_transpose(self):
+        a = ht.array(self.a, split=0)
+        at = a.T
+        assert at.split == 1
+        self.assert_array_equal(at, self.a.T)
+        a3 = ht.zeros((4, 6, 8), split=1)
+        t3 = ht.transpose(a3, (2, 0, 1))
+        assert t3.split == 2
+        assert t3.shape == (8, 4, 6)
+
+    def test_tril_triu(self):
+        x = np.arange(16, dtype=np.float32).reshape(4, 4)
+        for split in (None, 0, 1):
+            self.assert_array_equal(ht.tril(ht.array(x, split=split)), np.tril(x))
+            self.assert_array_equal(ht.triu(ht.array(x, split=split), k=1), np.triu(x, k=1))
+
+    def test_trace_norm(self):
+        x = np.arange(16, dtype=np.float32).reshape(4, 4)
+        t = ht.linalg.trace(ht.array(x, split=0))
+        assert abs(float(t.item()) - np.trace(x)) < 1e-4
+        n = ht.norm(ht.array(x, split=0))
+        assert abs(float(n.item()) - np.linalg.norm(x)) < 1e-3
+
+    def test_det_inv(self):
+        rng = np.random.default_rng(4)
+        x = (rng.random((5, 5)) + np.eye(5) * 5).astype(np.float32)
+        d = ht.linalg.det(ht.array(x))
+        assert abs(float(d.item()) - np.linalg.det(x)) / abs(np.linalg.det(x)) < 1e-3
+        inv = ht.linalg.inv(ht.array(x))
+        np.testing.assert_allclose(inv.numpy() @ x, np.eye(5), atol=1e-3)
+
+    def test_cross(self):
+        a = np.array([[1.0, 0, 0], [0, 1, 0]], dtype=np.float32)
+        b = np.array([[0.0, 1, 0], [0, 0, 1]], dtype=np.float32)
+        self.assert_array_equal(ht.cross(ht.array(a), ht.array(b)), np.cross(a, b))
+
+
+class TestQR(TestCase):
+    def _check_qr(self, x, split):
+        a = ht.array(x, split=split)
+        q, r = ht.linalg.qr(a)
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), x, atol=1e-4)
+        k = r.shape[0]
+        np.testing.assert_allclose(q.numpy().T @ q.numpy(), np.eye(k), atol=1e-4)
+        np.testing.assert_allclose(np.tril(r.numpy(), -1), 0.0, atol=1e-5)
+
+    def test_tall_skinny_split0(self):
+        rng = np.random.default_rng(7)
+        self._check_qr(rng.random((64, 8)).astype(np.float32), 0)
+
+    def test_uneven_rows(self):
+        rng = np.random.default_rng(8)
+        self._check_qr(rng.random((50, 6)).astype(np.float32), 0)
+
+    def test_replicated(self):
+        rng = np.random.default_rng(9)
+        self._check_qr(rng.random((16, 16)).astype(np.float32), None)
+
+    def test_split1(self):
+        rng = np.random.default_rng(10)
+        self._check_qr(rng.random((16, 8)).astype(np.float32), 1)
+
+    def test_calc_q_false(self):
+        rng = np.random.default_rng(11)
+        x = rng.random((64, 4)).astype(np.float32)
+        q, r = ht.linalg.qr(ht.array(x, split=0), calc_q=False)
+        assert q is None
+        # R must match the R of a reference QR up to sign
+        _, r_ref = np.linalg.qr(x)
+        np.testing.assert_allclose(np.abs(r.numpy()), np.abs(r_ref), atol=1e-4)
+
+
+class TestSVD(TestCase):
+    def test_tall_skinny(self):
+        rng = np.random.default_rng(12)
+        x = rng.random((64, 6)).astype(np.float32)
+        u, s, vh = ht.linalg.svd(ht.array(x, split=0))
+        np.testing.assert_allclose((u.numpy() * s.numpy()) @ vh.numpy(), x, atol=1e-4)
+        np.testing.assert_allclose(u.numpy().T @ u.numpy(), np.eye(6), atol=1e-4)
+        s_ref = np.linalg.svd(x, compute_uv=False)
+        np.testing.assert_allclose(s.numpy(), s_ref, atol=1e-4)
+
+    def test_values_only(self):
+        rng = np.random.default_rng(13)
+        x = rng.random((32, 4)).astype(np.float32)
+        s = ht.linalg.svd(ht.array(x, split=0), compute_uv=False)
+        np.testing.assert_allclose(s.numpy(), np.linalg.svd(x, compute_uv=False), atol=1e-4)
+
+    def test_replicated(self):
+        rng = np.random.default_rng(14)
+        x = rng.random((8, 8)).astype(np.float32)
+        u, s, vh = ht.linalg.svd(ht.array(x))
+        np.testing.assert_allclose((u.numpy() * s.numpy()) @ vh.numpy(), x, atol=1e-4)
+
+
+class TestSolvers(TestCase):
+    def test_cg(self):
+        rng = np.random.default_rng(15)
+        n = 12
+        m = rng.random((n, n)).astype(np.float32)
+        A = m @ m.T + n * np.eye(n, dtype=np.float32)
+        b = rng.random(n).astype(np.float32)
+        x0 = np.zeros(n, dtype=np.float32)
+        sol = ht.linalg.cg(ht.array(A, split=0), ht.array(b), ht.array(x0))
+        np.testing.assert_allclose(A @ sol.numpy(), b, atol=1e-2)
+
+    def test_lanczos(self):
+        rng = np.random.default_rng(16)
+        n = 16
+        m = rng.random((n, n)).astype(np.float32)
+        A = (m + m.T) / 2
+        V, T = ht.linalg.lanczos(ht.array(A), n)
+        Vn, Tn = V.numpy(), T.numpy()
+        # V orthonormal, A ≈ V T V^T for full iteration count
+        np.testing.assert_allclose(Vn.T @ Vn, np.eye(n), atol=1e-3)
+        np.testing.assert_allclose(Vn @ Tn @ Vn.T, A, atol=1e-2)
+
+    def test_cg_validates(self):
+        with pytest.raises(TypeError):
+            ht.linalg.cg(np.eye(3), ht.zeros(3), ht.zeros(3))

@@ -1,0 +1,177 @@
+"""Manipulations / indexing tests (reference ``test_manipulations.py``)."""
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from .base import TestCase
+
+
+class TestManipulations(TestCase):
+    def test_concatenate(self):
+        x = np.arange(24, dtype=np.float32).reshape(4, 6)
+        y = np.arange(12, dtype=np.float32).reshape(2, 6)
+        for split in (None, 0):
+            res = ht.concatenate([ht.array(x, split=split), ht.array(y, split=split)], axis=0)
+            self.assert_array_equal(res, np.concatenate([x, y], axis=0))
+            assert res.split == split
+        z = np.arange(8, dtype=np.float32).reshape(4, 2)
+        res = ht.concatenate([ht.array(x, split=1), ht.array(z, split=1)], axis=1)
+        self.assert_array_equal(res, np.concatenate([x, z], axis=1))
+
+    def test_concat_mismatch(self):
+        with pytest.raises(RuntimeError):
+            ht.concatenate([ht.zeros((4, 4), split=0), ht.zeros((4, 4), split=1)], axis=0)
+
+    def test_stack_vstack_hstack(self):
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        y = x + 10
+        self.assert_array_equal(ht.stack([ht.array(x, split=0), ht.array(y, split=0)]), np.stack([x, y]))
+        self.assert_array_equal(ht.vstack([ht.array(x), ht.array(y)]), np.vstack([x, y]))
+        self.assert_array_equal(ht.hstack([ht.array(x), ht.array(y)]), np.hstack([x, y]))
+        self.assert_array_equal(ht.column_stack([ht.arange(3), ht.arange(3)]), np.column_stack([np.arange(3), np.arange(3)]))
+
+    def test_reshape(self):
+        x = np.arange(24, dtype=np.float32)
+        for split in (None, 0):
+            a = ht.array(x, split=split)
+            self.assert_array_equal(ht.reshape(a, (4, 6)), x.reshape(4, 6))
+            self.assert_array_equal(ht.reshape(a, (2, -1)), x.reshape(2, 12))
+        b = ht.array(x.reshape(4, 6), split=0)
+        r = ht.reshape(b, (6, 4), new_split=1)
+        assert r.split == 1
+        self.assert_array_equal(r, x.reshape(6, 4))
+
+    def test_flatten_ravel(self):
+        x = np.arange(24, dtype=np.float32).reshape(4, 6)
+        a = ht.array(x, split=1)
+        f = ht.flatten(a)
+        assert f.split == 0
+        self.assert_array_equal(f, x.ravel())
+
+    def test_sort(self):
+        x = np.random.default_rng(0).random((8, 6)).astype(np.float32)
+        for split in (None, 0, 1):
+            a = ht.array(x, split=split)
+            v, i = ht.sort(a, axis=0)
+            self.assert_array_equal(v, np.sort(x, axis=0))
+            np.testing.assert_array_equal(i.numpy(), np.argsort(x, axis=0, kind="stable"))
+            v, i = ht.sort(a, axis=1, descending=True)
+            self.assert_array_equal(v, -np.sort(-x, axis=1))
+
+    def test_unique(self):
+        x = np.array([3, 1, 2, 3, 1, 2, 9], dtype=np.int64)
+        for split in (None, 0):
+            u = ht.unique(ht.array(x, split=split), sorted=True)
+            self.assert_array_equal(u, np.unique(x))
+        u, inv = ht.unique(ht.array(x), return_inverse=True)
+        nu, ninv = np.unique(x, return_inverse=True)
+        self.assert_array_equal(u, nu)
+        np.testing.assert_array_equal(inv.numpy(), ninv)
+
+    def test_topk(self):
+        x = np.random.default_rng(1).random((6, 10)).astype(np.float32)
+        a = ht.array(x, split=0)
+        v, i = ht.topk(a, 3)
+        np.testing.assert_allclose(v.numpy(), -np.sort(-x, axis=1)[:, :3], rtol=1e-6)
+        v, i = ht.topk(a, 2, largest=False)
+        np.testing.assert_allclose(v.numpy(), np.sort(x, axis=1)[:, :2], rtol=1e-6)
+
+    def test_pad(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        a = ht.array(x, split=0)
+        self.assert_array_equal(ht.pad(a, 1), np.pad(x, 1))
+        self.assert_array_equal(ht.pad(a, [(1, 2), (0, 1)]), np.pad(x, [(1, 2), (0, 1)]))
+        self.assert_array_equal(ht.pad(a, (1, 1), constant_values=0), np.pad(x, [(0, 0), (1, 1)]))
+
+    def test_roll_flip_rot90(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        for split in (None, 0, 1):
+            a = ht.array(x, split=split)
+            self.assert_array_equal(ht.roll(a, 1, axis=0), np.roll(x, 1, axis=0))
+            self.assert_array_equal(ht.flip(a, 0), np.flip(x, 0))
+            self.assert_array_equal(ht.fliplr(a), np.fliplr(x))
+            self.assert_array_equal(ht.flipud(a), np.flipud(x))
+        self.assert_array_equal(ht.rot90(ht.array(x)), np.rot90(x))
+
+    def test_squeeze_expand(self):
+        x = np.arange(6, dtype=np.float32).reshape(1, 6, 1)
+        a = ht.array(x)
+        self.assert_array_equal(ht.squeeze(a), x.squeeze())
+        self.assert_array_equal(ht.squeeze(a, 0), x.squeeze(0))
+        b = ht.arange(6, split=0)
+        e = ht.expand_dims(b, 0)
+        assert e.split == 1
+        self.assert_array_equal(e, np.arange(6)[None])
+
+    def test_split_functions(self):
+        x = np.arange(24, dtype=np.float32).reshape(6, 4)
+        a = ht.array(x, split=0)
+        parts = ht.split(a, 3)
+        assert len(parts) == 3
+        self.assert_array_equal(parts[0], x[:2])
+        v = ht.vsplit(a, 2)
+        self.assert_array_equal(v[1], x[3:])
+        h = ht.hsplit(a, 2)
+        self.assert_array_equal(h[0], x[:, :2])
+
+    def test_repeat_tile(self):
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        a = ht.array(x, split=0)
+        self.assert_array_equal(ht.repeat(a, 2, axis=0), np.repeat(x, 2, axis=0))
+        self.assert_array_equal(ht.tile(a, (2, 1)), np.tile(x, (2, 1)))
+
+    def test_diag(self):
+        v = np.arange(4, dtype=np.float32)
+        self.assert_array_equal(ht.diag(ht.array(v)), np.diag(v))
+        x = np.arange(16, dtype=np.float32).reshape(4, 4)
+        self.assert_array_equal(ht.diag(ht.array(x, split=0)), np.diag(x))
+
+    def test_broadcast_to(self):
+        v = np.arange(4, dtype=np.float32)
+        self.assert_array_equal(ht.broadcast_to(ht.array(v), (3, 4)), np.broadcast_to(v, (3, 4)))
+
+    def test_swapaxes_moveaxis(self):
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        a = ht.array(x, split=2)
+        s = ht.swapaxes(a, 0, 2)
+        assert s.split == 0
+        self.assert_array_equal(s, np.swapaxes(x, 0, 2))
+        m = ht.moveaxis(a, 0, -1)
+        self.assert_array_equal(m, np.moveaxis(x, 0, -1))
+
+    def test_resplit_function(self):
+        x = np.arange(24, dtype=np.float32).reshape(4, 6)
+        a = ht.array(x, split=0)
+        b = ht.resplit(a, 1)
+        assert b.split == 1 and a.split == 0
+        self.assert_array_equal(b, x)
+
+
+class TestIndexing(TestCase):
+    def test_nonzero(self):
+        x = np.array([[0, 1, 0], [2, 0, 3]], dtype=np.float32)
+        for split in (None, 0, 1):
+            res = ht.nonzero(ht.array(x, split=split))
+            expected = np.nonzero(x)
+            assert len(res) == 2
+            for r, e in zip(res, expected):
+                np.testing.assert_array_equal(r.numpy(), e)
+
+    def test_where(self):
+        x = np.array([[1.0, -1.0], [-2.0, 2.0]], dtype=np.float32)
+        a = ht.array(x, split=0)
+        res = ht.where(a > 0, a, ht.zeros_like(a))
+        self.assert_array_equal(res, np.where(x > 0, x, 0))
+        res2 = ht.where(a > 0, 1.0, -1.0)
+        self.assert_array_equal(res2, np.where(x > 0, 1.0, -1.0))
+
+    def test_signal_convolve(self):
+        sig = np.random.default_rng(2).random(32).astype(np.float32)
+        ker = np.array([0.25, 0.5, 0.25], dtype=np.float32)
+        for mode in ("full", "same", "valid"):
+            for split in (None, 0):
+                res = ht.convolve(ht.array(sig, split=split), ht.array(ker), mode=mode)
+                self.assert_array_equal(res, np.convolve(sig, ker, mode=mode), rtol=1e-5)
+        with pytest.raises(ValueError):
+            ht.convolve(ht.array(sig), ht.array(np.ones(4, dtype=np.float32)), mode="same")

@@ -1,0 +1,136 @@
+"""Random and I/O tests (reference ``test_random.py``, ``test_io.py``)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from .base import TestCase
+
+
+class TestRandom(TestCase):
+    def test_reproducible_after_seed(self):
+        ht.random.seed(42)
+        a = ht.random.rand(16, split=0).numpy()
+        ht.random.seed(42)
+        b = ht.random.rand(16, split=0).numpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_split_invariant_stream(self):
+        """The reference's core guarantee (``random.py:55-201``): same
+        global stream for every split."""
+        for shape in [(16,), (8, 8), (13,)]:
+            ht.random.seed(7)
+            ref = ht.random.rand(*shape, split=None).numpy()
+            for split in range(len(shape)):
+                ht.random.seed(7)
+                got = ht.random.rand(*shape, split=split).numpy()
+                np.testing.assert_array_equal(ref, got)
+
+    def test_state_roundtrip(self):
+        ht.random.seed(1)
+        ht.random.rand(4)
+        state = ht.random.get_state()
+        a = ht.random.rand(8).numpy()
+        ht.random.set_state(state)
+        b = ht.random.rand(8).numpy()
+        np.testing.assert_array_equal(a, b)
+        assert state[0] == "Threefry"
+
+    def test_distributions(self):
+        ht.random.seed(0)
+        u = ht.random.rand(10000, split=0)
+        assert 0.0 <= float(u.min().item()) and float(u.max().item()) < 1.0
+        assert abs(float(u.mean().item()) - 0.5) < 0.02
+        n = ht.random.randn(10000, split=0)
+        assert abs(float(n.mean().item())) < 0.05
+        assert abs(float(n.std().item()) - 1.0) < 0.05
+
+    def test_randint(self):
+        ht.random.seed(3)
+        r = ht.random.randint(0, 10, size=(100,), split=0)
+        vals = r.numpy()
+        assert vals.min() >= 0 and vals.max() < 10
+        assert r.dtype == ht.int32
+        with pytest.raises(ValueError):
+            ht.random.randint(5, 5)
+
+    def test_normal_uniform(self):
+        ht.random.seed(4)
+        n = ht.random.normal(5.0, 2.0, (5000,), split=0)
+        assert abs(float(n.mean().item()) - 5.0) < 0.15
+        u = ht.random.uniform(-2.0, 2.0, (5000,))
+        assert -2.0 <= float(u.min().item()) and float(u.max().item()) < 2.0
+
+    def test_randperm_permutation(self):
+        ht.random.seed(5)
+        p = ht.random.randperm(20)
+        np.testing.assert_array_equal(np.sort(p.numpy()), np.arange(20))
+        x = ht.arange(10, split=0)
+        shuffled = ht.random.permutation(x)
+        np.testing.assert_array_equal(np.sort(shuffled.numpy()), np.arange(10))
+
+    def test_dtype_checks(self):
+        with pytest.raises(ValueError):
+            ht.random.rand(4, dtype=ht.int32)
+
+
+class TestIO(TestCase):
+    def test_hdf5_roundtrip(self):
+        x = ht.random.randn(32, 4, split=0)
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "data.h5")
+            ht.save_hdf5(x, path, "data")
+            for split in (None, 0, 1):
+                back = ht.load_hdf5(path, "data", split=split)
+                assert back.split == split
+                np.testing.assert_allclose(back.numpy(), x.numpy(), rtol=1e-6)
+            via_load = ht.load(path, dataset="data", split=0)
+            np.testing.assert_allclose(via_load.numpy(), x.numpy(), rtol=1e-6)
+
+    def test_csv_roundtrip(self):
+        x = ht.arange(24, dtype=ht.float32).reshape((6, 4))
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "data.csv")
+            ht.save(x, path)
+            back = ht.load_csv(path, split=0)
+            np.testing.assert_allclose(back.numpy(), x.numpy(), rtol=1e-5)
+
+    def test_csv_header(self):
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "h.csv")
+            with open(path, "w") as f:
+                f.write("a,b\n1.0,2.0\n3.0,4.0\n")
+            back = ht.load_csv(path, header_lines=1)
+            np.testing.assert_allclose(back.numpy(), [[1, 2], [3, 4]])
+
+    def test_netcdf_gated(self):
+        if not ht.io.supports_netcdf():
+            with pytest.raises(ImportError):
+                ht.load_netcdf("/tmp/x.nc", "var")
+
+    def test_unsupported_extension(self):
+        with pytest.raises(ValueError):
+            ht.load("/tmp/file.xyz")
+        with pytest.raises(ValueError):
+            ht.save(ht.zeros(3), "/tmp/file.xyz")
+
+    def test_save_load_validation(self):
+        with pytest.raises(TypeError):
+            ht.load(123)
+        with pytest.raises(TypeError):
+            ht.save_hdf5(np.zeros(3), "/tmp/x.h5", "data")
+
+
+class TestMatrixGallery(TestCase):
+    def test_parter(self):
+        p = ht.utils.data.matrixgallery.parter(8)
+        expected = 1.0 / (np.arange(8)[:, None] - np.arange(8)[None, :] + 0.5)
+        np.testing.assert_allclose(p.numpy(), expected, rtol=1e-6)
+
+    def test_hermitian(self):
+        h = ht.utils.data.matrixgallery.hermitian(6)
+        hn = h.numpy()
+        np.testing.assert_allclose(hn, hn.conj().T, rtol=1e-6)

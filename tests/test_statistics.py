@@ -1,0 +1,90 @@
+"""Statistics tests (reference ``heat/core/tests/test_statistics.py``)."""
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from .base import TestCase
+
+
+class TestStatistics(TestCase):
+    def test_mean_var_std(self):
+        self.assert_func_equal((8, 6), ht.mean, np.mean)
+        self.assert_func_equal((8, 6), ht.mean, np.mean, heat_args={"axis": 0}, numpy_args={"axis": 0})
+        self.assert_func_equal((8, 6), ht.mean, np.mean, heat_args={"axis": 1}, numpy_args={"axis": 1})
+        self.assert_func_equal((8, 6), ht.var, np.var, rtol=1e-4)
+        self.assert_func_equal((8, 6), ht.std, np.std, rtol=1e-4)
+        self.assert_func_equal(
+            (8, 6), ht.var, np.var, heat_args={"axis": 0, "ddof": 1}, numpy_args={"axis": 0, "ddof": 1}, rtol=1e-4
+        )
+
+    def test_min_max(self):
+        self.assert_func_equal((7, 5), ht.max, np.max)
+        self.assert_func_equal((7, 5), ht.min, np.min)
+        self.assert_func_equal((7, 5), ht.max, np.max, heat_args={"axis": 0}, numpy_args={"axis": 0})
+        self.assert_func_equal((7, 5), ht.min, np.min, heat_args={"axis": 1}, numpy_args={"axis": 1})
+
+    def test_argmin_argmax(self):
+        x = np.random.default_rng(0).random((9, 7)).astype(np.float32)
+        for split in (None, 0, 1):
+            a = ht.array(x, split=split)
+            self.assert_array_equal(ht.argmax(a), np.array(x.argmax()))
+            self.assert_array_equal(ht.argmin(a, axis=0), x.argmin(axis=0))
+            self.assert_array_equal(ht.argmax(a, axis=1), x.argmax(axis=1))
+
+    def test_maximum_minimum(self):
+        x = np.random.default_rng(1).random((6, 4)).astype(np.float32)
+        y = np.random.default_rng(2).random((6, 4)).astype(np.float32)
+        self.assert_array_equal(ht.maximum(ht.array(x, split=0), ht.array(y, split=0)), np.maximum(x, y))
+        self.assert_array_equal(ht.minimum(ht.array(x, split=0), ht.array(y, split=0)), np.minimum(x, y))
+
+    def test_average(self):
+        x = np.arange(24, dtype=np.float32).reshape(4, 6)
+        w = np.arange(1, 7, dtype=np.float32)
+        a = ht.array(x, split=0)
+        self.assert_array_equal(ht.average(a), np.average(x))
+        self.assert_array_equal(
+            ht.average(a, axis=1, weights=ht.array(w)), np.average(x, axis=1, weights=w), rtol=1e-5
+        )
+
+    def test_median_percentile(self):
+        x = np.random.default_rng(3).random((8, 6)).astype(np.float32)
+        for split in (None, 0, 1):
+            a = ht.array(x, split=split)
+            self.assert_array_equal(ht.median(a), np.median(x), rtol=1e-5)
+            self.assert_array_equal(ht.median(a, axis=0), np.median(x, axis=0), rtol=1e-5)
+            self.assert_array_equal(
+                ht.percentile(a, 30.0), np.percentile(x, 30.0).astype(np.float32), rtol=1e-4
+            )
+
+    def test_skew_kurtosis(self):
+        from scipy import stats
+
+        x = np.random.default_rng(4).random(500).astype(np.float32)
+        a = ht.array(x, split=0)
+        assert abs(float(ht.skew(a).item()) - stats.skew(x)) < 1e-2
+        assert abs(float(ht.kurtosis(a).item()) - stats.kurtosis(x)) < 1e-2
+
+    def test_cov(self):
+        x = np.random.default_rng(5).random((4, 50)).astype(np.float32)
+        a = ht.array(x, split=1)
+        self.assert_array_equal(ht.cov(a), np.cov(x), rtol=1e-3)
+
+    def test_bincount_digitize(self):
+        x = np.array([0, 1, 1, 3, 2, 1], dtype=np.int64)
+        self.assert_array_equal(ht.bincount(ht.array(x)), np.bincount(x))
+        vals = np.array([0.2, 6.4, 3.0, 1.6], dtype=np.float32)
+        bins = np.array([0.0, 1.0, 2.5, 4.0, 10.0], dtype=np.float32)
+        self.assert_array_equal(ht.digitize(ht.array(vals), ht.array(bins)), np.digitize(vals, bins))
+
+    def test_histc(self):
+        x = np.random.default_rng(6).random(100).astype(np.float32)
+        h = ht.histc(ht.array(x, split=0), bins=10, min=0.0, max=1.0)
+        expected, _ = np.histogram(x, bins=10, range=(0, 1))
+        self.assert_array_equal(h, expected.astype(np.float32))
+
+    def test_bucketize(self):
+        boundaries = np.array([1.0, 3.0, 5.0], dtype=np.float32)
+        v = np.array([0.5, 2.0, 4.0, 6.0], dtype=np.float32)
+        res = ht.bucketize(ht.array(v), ht.array(boundaries))
+        np.testing.assert_array_equal(res.numpy(), np.searchsorted(boundaries, v, side="right"))
