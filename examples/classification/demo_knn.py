@@ -1,0 +1,29 @@
+"""kNN classification demo on iris (reference ``examples/knn``)."""
+import os
+
+import numpy as np
+
+import heat_tpu as ht
+
+
+def main():
+    path = os.path.join(os.path.dirname(ht.__file__), "datasets", "iris.csv")
+    iris = ht.load_csv(path, sep=";", split=0)
+    labels = ht.array(np.repeat(np.arange(3), 50).astype(np.float32), split=0)
+
+    # leave-some-out evaluation
+    keep = np.ones(150, dtype=bool)
+    keep[::5] = False  # hold out every 5th sample
+    train_x = ht.array(iris.numpy()[keep], split=0)
+    train_y = ht.array(labels.numpy()[keep], split=0)
+    test_x = ht.array(iris.numpy()[~keep], split=0)
+    test_y = labels.numpy()[~keep]
+
+    knn = ht.classification.KNeighborsClassifier(n_neighbors=5)
+    knn.fit(train_x, train_y)
+    pred = knn.predict(test_x).numpy()
+    print(f"kNN accuracy on held-out iris: {(pred == test_y).mean():.3f}")
+
+
+if __name__ == "__main__":
+    main()

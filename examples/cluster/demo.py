@@ -1,0 +1,19 @@
+"""Clustering demo on the iris dataset (reference ``examples/kClustering``)."""
+import os
+
+import heat_tpu as ht
+
+
+def main():
+    path = os.path.join(os.path.dirname(ht.__file__), "datasets", "iris.csv")
+    iris = ht.load_csv(path, sep=";", split=0)
+    print(f"iris: {iris.shape} split={iris.split} on {iris.comm.size} devices")
+    for cls in (ht.cluster.KMeans, ht.cluster.KMedians, ht.cluster.KMedoids):
+        model = cls(n_clusters=3, init="kmeans++", random_state=42)
+        model.fit(iris)
+        print(f"{cls.__name__}: {model.n_iter_} iterations")
+        print(model.cluster_centers_.numpy().round(2))
+
+
+if __name__ == "__main__":
+    main()

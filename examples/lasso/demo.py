@@ -1,0 +1,24 @@
+"""Lasso demo (reference ``examples/lasso/demo.py``)."""
+import numpy as np
+
+import heat_tpu as ht
+
+
+def main():
+    rng = np.random.default_rng(0)
+    n, f = 10000, 32
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    w = np.zeros(f, dtype=np.float32)
+    w[[2, 7, 20]] = [3.0, -2.0, 1.5]  # sparse ground truth
+    y = X @ w + 0.1 * rng.normal(size=n).astype(np.float32)
+
+    Xb = np.concatenate([np.ones((n, 1), dtype=np.float32), X], axis=1)
+    lasso = ht.regression.Lasso(lam=0.01, max_iter=100)
+    lasso.fit(ht.array(Xb, split=0), ht.array(y, split=0))
+    coef = lasso.theta.numpy().ravel()[1:]
+    print("nonzero coefficients found:", np.flatnonzero(np.abs(coef) > 0.1))
+    print("rmse:", lasso.rmse(ht.array(y), lasso.predict(ht.array(Xb, split=0))))
+
+
+if __name__ == "__main__":
+    main()

@@ -13,6 +13,8 @@ from . import cluster
 from . import classification
 from . import graph
 from . import naive_bayes
+from . import nn
+from . import optim
 from . import parallel
 from . import regression
 from . import spatial

@@ -17,6 +17,8 @@ from .memory import *
 from .printing import *
 from .stride_tricks import *
 from .sanitation import *
+from . import tiling
+from .tiling import *
 from ._operations import *
 from .arithmetics import *
 from .complex_math import *

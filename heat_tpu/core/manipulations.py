@@ -57,6 +57,7 @@ __all__ = [
     "swapaxes",
     "tile",
     "topk",
+    "unfold",
     "unique",
     "vsplit",
     "vstack",
@@ -427,6 +428,26 @@ def topk(a: DNDarray, k: int, dim: int = -1, largest: bool = True, sorted: bool 
         _write(out[1], res_i)
         return out
     return res_v, res_i
+
+
+def unfold(a: DNDarray, axis: int, size: int, step: int = 1) -> DNDarray:
+    """Sliding windows along an axis (reference ``manipulations.py`` unfold;
+    torch.Tensor.unfold semantics: window dim appended last)."""
+    axis = sanitize_axis(a.shape, axis)
+    if size < 1 or step < 1:
+        raise ValueError(f"size and step must be >= 1, got {size}, {step}")
+    length = a.shape[axis]
+    if size > length:
+        raise ValueError(f"size {size} exceeds dimension {length}")
+    n_windows = (length - size) // step + 1
+    starts = jnp.arange(n_windows) * step
+    moved = jnp.moveaxis(a.larray, axis, 0)
+    windows = jax.vmap(lambda s: jax.lax.dynamic_slice_in_dim(moved, s, size, axis=0))(starts)
+    # windows: (n_windows, size, ...) -> restore axis order, window dim last
+    windows = jnp.moveaxis(windows, 1, -1)  # (n_windows, ..., size)
+    result = jnp.moveaxis(windows, 0, axis)
+    # windows stay distributed along the unfolded axis
+    return _wrap(result, a, a.split)
 
 
 def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False, axis: Optional[int] = None):

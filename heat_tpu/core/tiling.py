@@ -1,0 +1,168 @@
+"""Tiling metadata (reference ``heat/core/tiling.py``, 1257 LoC).
+
+The reference's tile classes *drive communication*: ``SplitTiles`` indexes
+the Isend/Irecv mesh of ``resplit_`` and ``SquareDiagTiles`` the CAQR tile
+loops. On TPU resplit is one ``device_put`` and QR is TSQR, so no code
+path needs tiles to move data — but the classes remain useful (and
+API-required) as *metadata views*: global tile boundaries, per-process
+ownership, and tile indexing over the canonical XLA layout.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .dndarray import DNDarray
+
+__all__ = ["SplitTiles", "SquareDiagTiles"]
+
+
+class SplitTiles:
+    """World-size tiles in every dimension (reference ``tiling.py:14``).
+
+    ``tile_ends_g[d]`` holds the global end index of each tile along dim
+    ``d``; ``tile_locations`` maps each tile to the process owning it
+    (ownership follows the split dimension).
+    """
+
+    def __init__(self, arr: DNDarray):
+        if not isinstance(arr, DNDarray):
+            raise TypeError(f"arr must be a DNDarray, got {type(arr)}")
+        self.__arr = arr
+        comm = arr.comm
+        size = comm.size
+        ends = []
+        for dim, length in enumerate(arr.gshape):
+            block = -(-length // size) if length else 0
+            e = np.minimum((np.arange(size) + 1) * block, length)
+            ends.append(e)
+        self.__tile_ends_g = np.stack(ends) if ends else np.zeros((0, size), dtype=np.int64)
+        # ownership: tiles along the split dim belong to that process;
+        # replicated arrays are owned by process 0
+        shape = tuple(size for _ in arr.gshape)
+        locs = np.zeros(shape, dtype=np.int64)
+        if arr.split is not None:
+            idx = [None] * len(shape)
+            reshape = [1] * len(shape)
+            reshape[arr.split] = size
+            locs = locs + np.arange(size).reshape(reshape)
+        self.__tile_locations = locs
+
+    @property
+    def arr(self) -> DNDarray:
+        return self.__arr
+
+    @property
+    def tile_ends_g(self) -> np.ndarray:
+        """(ndim, size) global end indices (reference ``tiling.py``)."""
+        return self.__tile_ends_g
+
+    @property
+    def tile_locations(self) -> np.ndarray:
+        """size^ndim ownership map (reference ``tiling.py``)."""
+        return self.__tile_locations
+
+    @property
+    def tile_dimensions(self) -> np.ndarray:
+        """(ndim, size) tile extents."""
+        starts = np.zeros_like(self.__tile_ends_g)
+        starts[:, 1:] = self.__tile_ends_g[:, :-1]
+        return self.__tile_ends_g - starts
+
+    def __getitem__(self, key) -> Optional[np.ndarray]:
+        """The global slab of tile ``key`` (returns host data; the
+        reference returned the local torch view)."""
+        if not isinstance(key, tuple):
+            key = (key,)
+        slices = []
+        for dim, k in enumerate(key):
+            ends = self.__tile_ends_g[dim]
+            start = 0 if k == 0 else int(ends[k - 1])
+            slices.append(slice(start, int(ends[k])))
+        return self.__arr.numpy()[tuple(slices)]
+
+
+class SquareDiagTiles:
+    """Square tiles along the diagonal (reference ``tiling.py:331``).
+
+    Computes the CAQR tile decomposition metadata: per-process row/column
+    tile counts and global tile boundary indices. Data movement never uses
+    these on TPU (QR is TSQR), but the indexing scheme is preserved for
+    API parity and inspection.
+    """
+
+    def __init__(self, arr: DNDarray, tiles_per_proc: int = 1):
+        if not isinstance(arr, DNDarray):
+            raise TypeError(f"arr must be a DNDarray, got {type(arr)}")
+        if arr.ndim != 2:
+            raise ValueError("arr must be 2D")
+        if tiles_per_proc < 1:
+            raise ValueError("tiles_per_proc must be >= 1")
+        self.__arr = arr
+        size = arr.comm.size
+        m, n = arr.gshape
+        # square tile edge from the split-axis block size
+        split = arr.split if arr.split is not None else 0
+        block = -(-arr.gshape[split] // size)
+        tile = max(1, -(-block // tiles_per_proc))
+        row_starts = list(range(0, m, tile))
+        col_starts = list(range(0, n, tile))
+        self.__row_inds = row_starts
+        self.__col_inds = col_starts
+        self.__tile_rows = len(row_starts)
+        self.__tile_cols = len(col_starts)
+        self.__tiles_per_proc = tiles_per_proc
+        # reference semantics: tiles are partitioned across processes along
+        # the split dimension only; the other dimension is fully visible to
+        # every process
+        if split == 0:
+            per = -(-self.__tile_rows // size)
+            self.__tile_rows_per_process = [
+                max(0, min(per, self.__tile_rows - r * per)) for r in range(size)
+            ]
+            self.__tile_columns_per_process = [self.__tile_cols] * size
+        else:
+            per = -(-self.__tile_cols // size)
+            self.__tile_columns_per_process = [
+                max(0, min(per, self.__tile_cols - r * per)) for r in range(size)
+            ]
+            self.__tile_rows_per_process = [self.__tile_rows] * size
+
+    @property
+    def arr(self) -> DNDarray:
+        return self.__arr
+
+    @property
+    def row_indices(self) -> List[int]:
+        return self.__row_inds
+
+    @property
+    def col_indices(self) -> List[int]:
+        return self.__col_inds
+
+    @property
+    def tile_columns(self) -> int:
+        return self.__tile_cols
+
+    @property
+    def tile_rows(self) -> int:
+        return self.__tile_rows
+
+    @property
+    def tile_columns_per_process(self) -> List[int]:
+        return self.__tile_columns_per_process
+
+    @property
+    def tile_rows_per_process(self) -> List[int]:
+        return self.__tile_rows_per_process
+
+    def __getitem__(self, key) -> Optional[np.ndarray]:
+        if not isinstance(key, tuple):
+            key = (key,)
+        row, col = (key + (slice(None),))[:2] if len(key) < 2 else key
+        rs = self.__row_inds + [self.__arr.gshape[0]]
+        cs = self.__col_inds + [self.__arr.gshape[1]]
+        r_slice = slice(rs[row], rs[row + 1]) if isinstance(row, int) else slice(None)
+        c_slice = slice(cs[col], cs[col + 1]) if isinstance(col, int) else slice(None)
+        return self.__arr.numpy()[r_slice, c_slice]

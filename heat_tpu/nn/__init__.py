@@ -1,0 +1,21 @@
+"""Neural-network layer (reference ``heat/nn/``).
+
+The reference exposes ``torch.nn.*`` via passthrough plus the
+``DataParallel`` wrapper. The TPU-native equivalent forwards unknown
+attributes to ``flax.linen`` (so ``ht.nn.Dense``, ``ht.nn.Conv``, ... are
+flax modules) and provides :class:`DataParallel` for mesh data
+parallelism.
+"""
+from . import functional, lr_scheduler, vision_transforms
+from .data_parallel import DataParallel
+
+import flax.linen as _linen
+
+__all__ = ["DataParallel", "functional", "lr_scheduler", "vision_transforms"]
+
+
+def __getattr__(name):
+    try:
+        return getattr(_linen, name)
+    except AttributeError:
+        raise AttributeError(f"module {__name__} has no attribute {name}")

@@ -1,0 +1,16 @@
+"""Functional NN ops (reference ``heat/nn/functional.py``).
+
+The reference exposes ``torch.nn.functional`` via ``__getattr__``
+passthrough (``functional.py:9``); the TPU-native equivalent forwards to
+``jax.nn`` (activations, softmax, one_hot, ...).
+"""
+import jax.nn as _jnn
+
+__all__ = []
+
+
+def __getattr__(name):
+    try:
+        return getattr(_jnn, name)
+    except AttributeError:
+        raise AttributeError(f"module {__name__} has no attribute {name}")

@@ -1,0 +1,27 @@
+"""Learning-rate schedules (reference ``heat/nn/lr_scheduler.py``).
+
+The reference passes ``torch.optim.lr_scheduler.*`` through
+(``lr_scheduler.py:10``); the TPU-native equivalent forwards to optax's
+schedule library (``exponential_decay``, ``cosine_decay_schedule``,
+``piecewise_constant_schedule``, ...).
+"""
+import optax as _optax
+
+__all__ = []
+
+_SCHEDULES = {
+    "StepLR": "exponential_decay",
+    "ExponentialLR": "exponential_decay",
+    "CosineAnnealingLR": "cosine_decay_schedule",
+    "MultiStepLR": "piecewise_constant_schedule",
+    "LinearLR": "linear_schedule",
+}
+
+
+def __getattr__(name):
+    if name in _SCHEDULES:
+        return getattr(_optax, _SCHEDULES[name])
+    try:
+        return getattr(_optax, name)
+    except AttributeError:
+        raise AttributeError(f"module {__name__} has no attribute {name}")

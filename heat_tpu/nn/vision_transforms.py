@@ -1,0 +1,48 @@
+"""Vision transforms (reference ``heat/nn/vision_transforms.py``).
+
+The reference passes ``torchvision.transforms`` through
+(``vision_transforms.py:12``); torchvision is not in this image, so the
+transforms actually used by the examples (Normalize, ToTensor, Compose)
+are implemented natively over jnp.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["Compose", "Normalize", "ToTensor"]
+
+
+class Compose:
+    """Chain transforms (torchvision-compatible)."""
+
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+class ToTensor:
+    """uint8 HWC image -> float CHW in [0, 1]."""
+
+    def __call__(self, x):
+        arr = jnp.asarray(np.asarray(x), dtype=jnp.float32) / 255.0
+        if arr.ndim == 3:
+            arr = jnp.transpose(arr, (2, 0, 1))
+        return arr
+
+
+class Normalize:
+    """Channel-wise standardization."""
+
+    def __init__(self, mean, std):
+        self.mean = jnp.asarray(mean, dtype=jnp.float32)
+        self.std = jnp.asarray(std, dtype=jnp.float32)
+
+    def __call__(self, x):
+        mean = self.mean.reshape(-1, *([1] * (x.ndim - 1)))
+        std = self.std.reshape(-1, *([1] * (x.ndim - 1)))
+        return (x - mean) / std

@@ -1,0 +1,24 @@
+"""Optimizers (reference ``heat/optim/``).
+
+Unknown attributes forward to optax (``ht.optim.sgd``, ``ht.optim.adam``,
+...), mirroring the reference's ``torch.optim`` passthrough; DASO and
+DataParallelOptimizer are the distributed wrappers.
+"""
+from . import utils
+from .dp_optimizer import DASO, DataParallelOptimizer
+from .utils import DetectMetricPlateau
+
+import optax as _optax
+
+__all__ = ["DASO", "DataParallelOptimizer", "DetectMetricPlateau", "utils"]
+
+_ALIASES = {"SGD": "sgd", "Adam": "adam", "AdamW": "adamw", "Adagrad": "adagrad", "RMSprop": "rmsprop"}
+
+
+def __getattr__(name):
+    if name in _ALIASES:
+        return getattr(_optax, _ALIASES[name])
+    try:
+        return getattr(_optax, name)
+    except AttributeError:
+        raise AttributeError(f"module {__name__} has no attribute {name}")

@@ -1,2 +1,5 @@
 """Data tooling (reference ``heat/utils/data/``)."""
-from . import matrixgallery
+from . import datatools, matrixgallery, mnist, partial_dataset
+from .datatools import DataLoader, Dataset, dataset_ishuffle, dataset_shuffle
+from .mnist import MNISTDataset
+from .partial_dataset import PartialH5Dataset

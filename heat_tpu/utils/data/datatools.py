@@ -1,0 +1,134 @@
+"""Dataset / DataLoader tooling (reference ``heat/utils/data/datatools.py``).
+
+The reference wraps a split DNDarray's *local* torch shard as a torch
+dataset and implements an epoch-end cross-rank shuffle with Isend blocks
+(``dataset_shuffle:246``, ``dataset_ishuffle:301``). On TPU the dataset
+holds the global sharded array; batching slices the global batch (each
+device reads only its shard — no host loop), and the global shuffle is a
+single sharded ``take`` with a permutation — one all-to-all on ICI instead
+of point-to-point block mailing.
+"""
+from __future__ import annotations
+
+import math
+from typing import Iterator, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import random as ht_random
+from ...core.dndarray import DNDarray
+
+__all__ = ["DataLoader", "Dataset", "dataset_shuffle", "dataset_ishuffle"]
+
+
+class Dataset:
+    """Dataset over one or more (sharded) DNDarrays (reference
+    ``datatools.py:143``).
+
+    Parameters
+    ----------
+    array : DNDarray or sequence of DNDarrays
+        Sample axis is axis 0.
+    transform : callable, optional
+        Applied per batch at load time.
+    shuffle : bool
+        Whether :func:`dataset_shuffle` reshuffles at epoch end.
+    """
+
+    def __init__(self, array, transforms=None, shuffle: bool = True, test_set: bool = False):
+        if isinstance(array, DNDarray):
+            arrays = [array]
+        else:
+            arrays = list(array)
+        n = arrays[0].shape[0]
+        for a in arrays:
+            if a.shape[0] != n:
+                raise ValueError("all arrays must share the sample axis length")
+        self.arrays = arrays
+        self.transforms = transforms if isinstance(transforms, (list, tuple)) else [transforms] * len(arrays)
+        self.shuffle_flag = shuffle
+        self.test_set = test_set
+
+    def __len__(self) -> int:
+        return self.arrays[0].shape[0]
+
+    def __getitem__(self, index):
+        out = []
+        for a, t in zip(self.arrays, self.transforms):
+            item = a.larray[index]
+            if t is not None:
+                item = t(item)
+            out.append(item)
+        return out[0] if len(out) == 1 else tuple(out)
+
+    def shuffle(self) -> None:
+        """Epoch-end global shuffle (reference ``dataset_shuffle:246``)."""
+        dataset_shuffle(self)
+
+    def ishuffle(self) -> None:
+        """Async shuffle; on TPU the collective is already non-blocking
+        (XLA schedules it), so this is the same one-program shuffle
+        (reference ``dataset_ishuffle:301``)."""
+        dataset_ishuffle(self)
+
+
+class DataLoader:
+    """Batch iterator over a Dataset (reference ``datatools.py:16``).
+
+    Yields per-batch jnp arrays (sharded like the source); batches are
+    global slices so every device reads its own shard.
+    """
+
+    def __init__(
+        self,
+        dataset: Union[Dataset, DNDarray],
+        batch_size: int = 1,
+        drop_last: bool = True,
+        shuffle: bool = True,
+    ):
+        if isinstance(dataset, DNDarray):
+            dataset = Dataset(dataset, shuffle=shuffle)
+        if not isinstance(dataset, Dataset):
+            raise TypeError(f"dataset must be a Dataset or DNDarray, got {type(dataset)}")
+        self.dataset = dataset
+        self.batch_size = int(batch_size)
+        self.drop_last = drop_last
+        self.shuffle = shuffle
+        self._first_epoch = True
+
+    def __len__(self) -> int:
+        n = len(self.dataset)
+        return n // self.batch_size if self.drop_last else math.ceil(n / self.batch_size)
+
+    def __iter__(self) -> Iterator:
+        do_shuffle = self.shuffle and self.dataset.shuffle_flag
+        if do_shuffle and not self.dataset.test_set and not self._first_epoch:
+            self.dataset.shuffle()
+        self._first_epoch = False
+        n = len(self.dataset)
+        nb = len(self)
+        for b in range(nb):
+            start = b * self.batch_size
+            stop = min(start + self.batch_size, n)
+            yield self.dataset[slice(start, stop)]
+
+
+def dataset_shuffle(dataset: Dataset, attrs: Optional[List] = None) -> None:
+    """Globally shuffle the dataset's arrays in place (reference
+    ``dataset_shuffle:246`` — Isend blocks of samples between ranks; one
+    permuted sharded gather here)."""
+    n = len(dataset)
+    key = ht_random._next_key(n)
+    perm = jax.random.permutation(key, n)
+    for i, a in enumerate(dataset.arrays):
+        shuffled = jnp.take(a.larray, perm, axis=0)
+        a.larray = shuffled
+
+
+def dataset_ishuffle(dataset: Dataset, attrs: Optional[List] = None) -> None:
+    """Non-blocking variant (reference ``dataset_ishuffle:301``): the XLA
+    collective is asynchronous by construction, so identical to
+    :func:`dataset_shuffle`."""
+    dataset_shuffle(dataset, attrs)

@@ -1,0 +1,87 @@
+"""MNIST dataset (reference ``heat/utils/data/mnist.py``).
+
+The reference subclasses ``torchvision.datasets.MNIST`` and slices the
+images across ranks (``mnist.py:16``). torchvision is not in this image,
+so the raw IDX files are parsed directly; samples end sharded over the
+mesh like any split=0 DNDarray.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Optional
+
+import numpy as np
+
+from ...core import factories, types
+from ...core.dndarray import DNDarray
+
+__all__ = ["MNISTDataset"]
+
+
+def _read_idx(path: str) -> np.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        zero, dtype_code, ndim = struct.unpack(">HBB", f.read(4))
+        shape = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        return np.frombuffer(f.read(), dtype=np.uint8).reshape(shape)
+
+
+class MNISTDataset:
+    """MNIST over DNDarrays (reference ``mnist.py:16``).
+
+    Parameters
+    ----------
+    root : str
+        Directory containing the raw IDX files
+        (train-images-idx3-ubyte[.gz] etc.).
+    train : bool
+    transform : callable, optional
+        Per-image transform.
+    split : int or None
+        DNDarray split of the sample axis (the reference always splits 0).
+    """
+
+    _FILES = {
+        True: ("train-images-idx3-ubyte", "train-labels-idx1-ubyte"),
+        False: ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"),
+    }
+
+    def __init__(self, root: str, train: bool = True, transform=None, target_transform=None, split: Optional[int] = 0):
+        img_name, lbl_name = self._FILES[train]
+        images = labels = None
+        for suffix in ("", ".gz"):
+            ipath = os.path.join(root, img_name + suffix)
+            lpath = os.path.join(root, lbl_name + suffix)
+            if os.path.exists(ipath) and os.path.exists(lpath):
+                images = _read_idx(ipath)
+                labels = _read_idx(lpath)
+                break
+        if images is None:
+            raise FileNotFoundError(f"MNIST idx files not found under {root}")
+        self.transform = transform
+        self.target_transform = target_transform
+        imgs = images.astype(np.float32) / 255.0
+        self.htdata = factories.array(imgs, split=split)
+        self.httargets = factories.array(labels.astype(np.int64), split=split)
+
+    @property
+    def data(self) -> DNDarray:
+        return self.htdata
+
+    @property
+    def targets(self) -> DNDarray:
+        return self.httargets
+
+    def __len__(self) -> int:
+        return self.htdata.shape[0]
+
+    def __getitem__(self, index):
+        img = self.htdata.larray[index]
+        target = self.httargets.larray[index]
+        if self.transform is not None:
+            img = self.transform(img)
+        if self.target_transform is not None:
+            target = self.target_transform(target)
+        return img, target

@@ -1,0 +1,123 @@
+"""Streaming dataset for larger-than-memory HDF5 files (reference
+``heat/utils/data/partial_dataset.py``).
+
+The reference streams slabs of an H5 file with background convert/load
+threads (``PartialH5Dataset:32``, ``queue_thread:20``,
+``PartialH5DataLoaderIter:224``). Same structure here: a producer thread
+reads the next slab from disk while the device consumes the current one;
+slabs are device_put asynchronously so host reads overlap device compute.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.communication import sanitize_comm
+
+__all__ = ["PartialH5Dataset", "PartialH5DataLoaderIter", "queue_thread"]
+
+
+def queue_thread(q: "queue.Queue", fn, *args) -> threading.Thread:
+    """Run ``fn(*args)`` pushing results into ``q`` on a daemon thread
+    (reference ``partial_dataset.py:20``)."""
+    t = threading.Thread(target=fn, args=args, daemon=True)
+    t.start()
+    return t
+
+
+class PartialH5Dataset:
+    """Iterate an HDF5 dataset in slabs without loading it fully (reference
+    ``partial_dataset.py:32``).
+
+    Parameters
+    ----------
+    file : str
+        Path to the HDF5 file.
+    dataset_names : list of str
+        Datasets to read in lock-step (e.g. ["data", "labels"]).
+    initial_load : int
+        Rows per slab held in memory at once.
+    transforms : callable(s), optional
+    use_gpu : bool
+        Kept for reference parity; slabs are placed on the default devices.
+    """
+
+    def __init__(
+        self,
+        file: str,
+        comm=None,
+        dataset_names="data",
+        transforms=None,
+        use_gpu: bool = True,
+        validate_set: bool = False,
+        initial_load: int = 7000,
+        load_length: Optional[int] = None,
+    ):
+        import h5py
+
+        self.file = file
+        self.comm = sanitize_comm(comm)
+        self.dataset_names = [dataset_names] if isinstance(dataset_names, str) else list(dataset_names)
+        self.transforms = transforms if isinstance(transforms, (list, tuple)) else [transforms] * len(
+            self.dataset_names
+        )
+        self.load_len = int(load_length or initial_load)
+        self.validate_set = validate_set
+        with h5py.File(file, "r") as handle:
+            self.total_size = handle[self.dataset_names[0]].shape[0]
+
+    def __len__(self) -> int:
+        return self.total_size
+
+    def _read_slab(self, start: int, stop: int) -> List[np.ndarray]:
+        import h5py
+
+        with h5py.File(self.file, "r") as handle:
+            return [np.asarray(handle[name][start:stop]) for name in self.dataset_names]
+
+    def __iter__(self) -> "PartialH5DataLoaderIter":
+        return PartialH5DataLoaderIter(self)
+
+
+class PartialH5DataLoaderIter:
+    """Background-prefetching slab iterator (reference
+    ``partial_dataset.py:224``)."""
+
+    def __init__(self, dataset: PartialH5Dataset):
+        self.dataset = dataset
+        self._q: "queue.Queue" = queue.Queue(maxsize=2)
+        self._offsets = list(range(0, dataset.total_size, dataset.load_len))
+        self._thread = queue_thread(self._q, self._producer)
+
+    def _producer(self) -> None:
+        try:
+            for start in self._offsets:
+                stop = min(start + self.dataset.load_len, self.dataset.total_size)
+                slab = self.dataset._read_slab(start, stop)
+                out = []
+                for arr, t in zip(slab, self.dataset.transforms):
+                    j = jnp.asarray(arr)
+                    if t is not None:
+                        j = t(j)
+                    out.append(jax.device_put(j))  # async H2D, overlaps next read
+                self._q.put(out[0] if len(out) == 1 else tuple(out))
+        except BaseException as exc:  # noqa: BLE001 - surfaced to the consumer
+            self._q.put(exc)
+        finally:
+            self._q.put(None)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is None:
+            raise StopIteration
+        if isinstance(item, BaseException):
+            raise item
+        return item

@@ -1,0 +1,314 @@
+"""NN / optimizer / data-tooling tests (reference ``heat/nn/tests``,
+``heat/optim``, ``heat/utils/data``)."""
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from .base import TestCase
+
+
+def _make_regression(n=256, f=8, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    w = rng.normal(size=(f, 1)).astype(np.float32)
+    y = X @ w + 0.01 * rng.normal(size=(n, 1)).astype(np.float32)
+    return X, y, w
+
+
+class TestDataParallel(TestCase):
+    def test_training_reduces_loss(self):
+        import flax.linen as fnn
+        import jax.numpy as jnp
+        import optax
+
+        X, y, _ = _make_regression()
+
+        class Model(fnn.Module):
+            @fnn.compact
+            def __call__(self, x):
+                return fnn.Dense(1)(x)
+
+        dp = ht.nn.DataParallel(Model(), optimizer=optax.sgd(0.05))
+        xb = ht.array(X, split=0)
+        yb = ht.array(y, split=0)
+        dp.init(xb.larray[:1])
+
+        def mse(pred, target):
+            return jnp.mean((pred - target) ** 2)
+
+        losses = [dp.train_step(mse, xb, yb) for _ in range(50)]
+        assert losses[-1] < losses[0] * 0.1
+
+    def test_forward_keeps_split(self):
+        import flax.linen as fnn
+        import optax
+
+        class Model(fnn.Module):
+            @fnn.compact
+            def __call__(self, x):
+                return fnn.Dense(4)(x)
+
+        dp = ht.nn.DataParallel(Model())
+        x = ht.random.randn(32, 8, split=0)
+        dp.init(x.larray[:1])
+        out = dp(x)
+        assert isinstance(out, ht.DNDarray)
+        assert out.split == 0
+        assert out.shape == (32, 4)
+
+    def test_dp_optimizer_wrapper(self):
+        import flax.linen as fnn
+        import jax.numpy as jnp
+        import optax
+
+        class Model(fnn.Module):
+            @fnn.compact
+            def __call__(self, x):
+                return fnn.Dense(1)(x)
+
+        opt = ht.optim.DataParallelOptimizer(optax.sgd(0.05))
+        dp = ht.nn.DataParallel(Model(), optimizer=opt)
+        X, y, _ = _make_regression(seed=1)
+        xb, yb = ht.array(X, split=0), ht.array(y, split=0)
+        dp.init(xb.larray[:1])
+        loss0 = opt.step(lambda p, t: jnp.mean((p - t) ** 2), xb, yb)
+        for _ in range(30):
+            loss = opt.step(lambda p, t: jnp.mean((p - t) ** 2), xb, yb)
+        assert loss < loss0
+        assert opt.batches_completed == 31
+        with pytest.raises(TypeError):
+            ht.optim.DataParallelOptimizer(42)
+
+    def test_nn_passthrough(self):
+        import flax.linen as fnn
+
+        assert ht.nn.Dense is fnn.Dense
+        assert callable(ht.nn.functional.relu)
+        with pytest.raises(AttributeError):
+            ht.nn.DoesNotExist
+
+
+class TestDASO(TestCase):
+    def test_daso_step_and_phases(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from heat_tpu.parallel import make_hierarchical_mesh
+
+        if len(jax.devices()) < 4:
+            pytest.skip("needs >=4 devices")
+        mesh = make_hierarchical_mesh(n_slow=2)
+        X, y, _ = _make_regression(n=64, f=4, seed=2)
+        params = {"w": jnp.zeros((4, 1)), "b": jnp.zeros(1)}
+
+        def loss_and_grad(p, xb, yb):
+            def obj(p):
+                return jnp.mean((xb @ p["w"] + p["b"] - yb) ** 2)
+
+            return jax.value_and_grad(obj)(p)
+
+        daso = ht.optim.DASO(optax.sgd(0.05), total_epochs=4, warmup_epochs=1, cooldown_epochs=1)
+        params = daso.init(params, mesh)
+        assert params["w"].shape == (2, 4, 1)  # one replica per slow group
+        xj, yj = jnp.asarray(X), jnp.asarray(y)
+        losses = []
+        for epoch in range(4):
+            for _ in range(10):
+                params, loss = daso.step(loss_and_grad, params, xj, yj)
+            daso.epoch_loss_logic(float(loss))
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.5
+        assert daso.epoch == 4
+        final = daso.consolidated_params(params)
+        assert final["w"].shape == (4, 1)
+
+    def test_daso_replicas_diverge_then_sync(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from heat_tpu.parallel import make_hierarchical_mesh
+
+        if len(jax.devices()) < 4:
+            pytest.skip("needs >=4 devices")
+        mesh = make_hierarchical_mesh(n_slow=2)
+        rng = np.random.default_rng(9)
+        X = rng.normal(size=(32, 4)).astype(np.float32)
+        y = rng.normal(size=(32, 1)).astype(np.float32)
+
+        def loss_and_grad(p, xb, yb):
+            return jax.value_and_grad(lambda p: jnp.mean((xb @ p["w"] - yb) ** 2))(p)
+
+        daso = ht.optim.DASO(optax.sgd(0.1), total_epochs=10, warmup_epochs=0, cooldown_epochs=0)
+        daso.global_skip = 100  # effectively never sync
+        daso.batches_to_wait = 0
+        params = daso.init({"w": jnp.zeros((4, 1))}, mesh)
+        for _ in range(1, 5):  # steps 1..4, no sync (step 0 syncs)
+            params, _ = daso.step(loss_and_grad, params, jnp.asarray(X), jnp.asarray(y))
+        reps = np.asarray(params["w"])
+        assert not np.allclose(reps[0], reps[1])  # groups genuinely diverged
+        synced = daso._avg_fn(params)
+        s = np.asarray(synced["w"])
+        np.testing.assert_allclose(s[0], s[1], rtol=1e-5)
+
+    def test_detect_metric_plateau(self):
+        det = ht.optim.DetectMetricPlateau(patience=2, threshold=0.01)
+        assert not det.test_if_improving(1.0)
+        assert not det.test_if_improving(0.5)  # improving
+        assert not det.test_if_improving(0.5)  # bad 1
+        assert not det.test_if_improving(0.5)  # bad 2
+        assert det.test_if_improving(0.5)  # bad 3 > patience -> plateau
+        state = det.get_state()
+        det2 = ht.optim.DetectMetricPlateau()
+        det2.set_state(state)
+        assert det2.best == det.best
+
+    def test_optim_passthrough(self):
+        import optax
+
+        assert ht.optim.SGD is optax.sgd
+        assert ht.optim.Adam is optax.adam
+
+
+class TestDataTools(TestCase):
+    def test_dataset_dataloader(self):
+        X = np.arange(64, dtype=np.float32).reshape(16, 4)
+        y = np.arange(16, dtype=np.float32)
+        ds = ht.utils.data.Dataset([ht.array(X, split=0), ht.array(y, split=0)], shuffle=False)
+        assert len(ds) == 16
+        dl = ht.utils.data.DataLoader(ds, batch_size=4, shuffle=False)
+        batches = list(dl)
+        assert len(batches) == 4
+        xb, yb = batches[0]
+        assert xb.shape == (4, 4)
+        np.testing.assert_array_equal(np.asarray(yb), y[:4])
+
+    def test_dataset_shuffle_preserves_pairs(self):
+        X = np.arange(32, dtype=np.float32).reshape(16, 2)
+        y = X[:, 0].copy()
+        ds = ht.utils.data.Dataset([ht.array(X, split=0), ht.array(y, split=0)])
+        ht.utils.data.dataset_shuffle(ds)
+        Xs = np.asarray(ds.arrays[0].larray)
+        ys = np.asarray(ds.arrays[1].larray)
+        np.testing.assert_array_equal(Xs[:, 0], ys)  # rows stayed paired
+        assert not np.array_equal(Xs, X)  # actually shuffled
+
+    def test_partial_h5_dataset(self):
+        import os
+        import tempfile
+
+        import h5py
+
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "big.h5")
+            data = np.arange(100, dtype=np.float32).reshape(50, 2)
+            labels = np.arange(50, dtype=np.int64)
+            with h5py.File(path, "w") as f:
+                f.create_dataset("data", data=data)
+                f.create_dataset("labels", data=labels)
+            ds = ht.utils.data.PartialH5Dataset(
+                path, dataset_names=["data", "labels"], initial_load=16
+            )
+            assert len(ds) == 50
+            seen = []
+            for xb, yb in ds:
+                assert xb.shape[0] == yb.shape[0]
+                seen.append(np.asarray(yb))
+            np.testing.assert_array_equal(np.concatenate(seen), labels)
+
+    def test_mnist_idx_parsing(self):
+        import os
+        import struct
+        import tempfile
+
+        rng = np.random.default_rng(0)
+        imgs = rng.integers(0, 255, size=(10, 4, 4), dtype=np.uint8)
+        lbls = rng.integers(0, 10, size=(10,), dtype=np.uint8)
+        with tempfile.TemporaryDirectory() as d:
+            with open(os.path.join(d, "train-images-idx3-ubyte"), "wb") as f:
+                f.write(struct.pack(">HBB", 0, 8, 3))
+                f.write(struct.pack(">III", 10, 4, 4))
+                f.write(imgs.tobytes())
+            with open(os.path.join(d, "train-labels-idx1-ubyte"), "wb") as f:
+                f.write(struct.pack(">HBB", 0, 8, 1))
+                f.write(struct.pack(">I", 10))
+                f.write(lbls.tobytes())
+            ds = ht.utils.data.MNISTDataset(d, train=True, split=0)
+            assert len(ds) == 10
+            np.testing.assert_allclose(
+                np.asarray(ds.htdata.larray), imgs.astype(np.float32) / 255.0
+            )
+            img, target = ds[3]
+            assert int(target) == int(lbls[3])
+
+
+class TestTiling(TestCase):
+    def test_split_tiles(self):
+        a = ht.zeros((16, 8), split=0)
+        tiles = ht.SplitTiles(a)
+        ends = tiles.tile_ends_g
+        assert ends.shape[0] == 2
+        assert ends[0][-1] == 16 and ends[1][-1] == 8
+        dims = tiles.tile_dimensions
+        assert dims[0].sum() == 16
+        locs = tiles.tile_locations
+        assert locs.shape == tuple([a.comm.size] * 2)
+
+    def test_square_diag_tiles(self):
+        a = ht.zeros((32, 16), split=0)
+        tiles = ht.SquareDiagTiles(a, tiles_per_proc=2)
+        assert tiles.tile_rows >= 1
+        assert tiles.tile_columns >= 1
+        assert sum(tiles.tile_rows_per_process) >= tiles.tile_rows
+        t00 = tiles[0, 0]
+        assert t00.ndim == 2
+
+    def test_unfold(self):
+        x = np.arange(8, dtype=np.float32)
+        a = ht.array(x, split=0)
+        u = ht.unfold(a, 0, 3, 1)
+        expected = np.stack([x[i : i + 3] for i in range(6)])
+        np.testing.assert_array_equal(u.numpy(), expected)
+        u2 = ht.unfold(ht.array(np.arange(24, dtype=np.float32).reshape(4, 6)), 1, 2, 2)
+        assert u2.shape == (4, 3, 2)
+
+
+class TestDataToolRegressions(TestCase):
+    def test_dataset_shuffle_false_respected(self):
+        X = np.arange(32, dtype=np.float32).reshape(16, 2)
+        ds = ht.utils.data.Dataset(ht.array(X, split=0), shuffle=False)
+        dl = ht.utils.data.DataLoader(ds, batch_size=4)
+        list(dl)
+        list(dl)  # second epoch would shuffle if the flag were ignored
+        np.testing.assert_array_equal(np.asarray(ds.arrays[0].larray), X)
+
+    def test_partial_dataset_producer_error_propagates(self):
+        import os
+        import tempfile
+
+        import h5py
+
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "x.h5")
+            with h5py.File(path, "w") as f:
+                f.create_dataset("data", data=np.zeros((10, 2), dtype=np.float32))
+
+            def bad_transform(x):
+                raise RuntimeError("boom")
+
+            ds = ht.utils.data.PartialH5Dataset(
+                path, dataset_names=["data"], transforms=bad_transform, initial_load=4
+            )
+            with pytest.raises(RuntimeError, match="boom"):
+                for _ in ds:
+                    pass
+
+    def test_square_diag_tiles_column_counts(self):
+        a = ht.zeros((32, 16), split=0)
+        tiles = ht.SquareDiagTiles(a, tiles_per_proc=2)
+        size = a.comm.size
+        # split=0: every process sees all column tiles
+        assert tiles.tile_columns_per_process == [tiles.tile_columns] * size
+        assert sum(tiles.tile_rows_per_process) == tiles.tile_rows
