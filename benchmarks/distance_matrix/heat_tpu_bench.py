@@ -1,0 +1,29 @@
+"""cdist benchmark (reference protocol:
+``benchmarks/distance_matrix/heat-cpu.py:20-34`` — both expansions, 10
+trials, SUSY-like 40k x 18)."""
+import numpy as np
+
+import sys, pathlib
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[2]))
+import heat_tpu as ht
+from heat_tpu.utils.profiling import Timer
+
+
+def main(n=40000, f=18, trials=10):
+    rng = np.random.default_rng(1)
+    data = rng.normal(size=(n, f)).astype(np.float32)
+    x = ht.array(data, split=0)
+    for quadratic in (False, True):
+        times = []
+        for _ in range(trials):
+            with Timer() as t:
+                d = ht.spatial.cdist(x, quadratic_expansion=quadratic)
+                d.larray.block_until_ready()
+            times.append(t.elapsed)
+        med = float(np.median(times))
+        gb = (n * n * 4) / 1e9  # output bytes
+        print(f"cdist quadratic={quadratic}: median {med:.4f}s ({gb/med:.1f} GB/s output)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+"""KMeans benchmark (reference protocol: ``benchmarks/kmeans/heat-cpu.py``
+— k=8, 30 iterations, 10 trials, wall-clock fit)."""
+import time
+
+import numpy as np
+
+import sys, pathlib
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[2]))
+import heat_tpu as ht
+from heat_tpu.utils.profiling import Timer
+
+
+def main(n=1 << 19, f=32, k=8, iters=30, trials=10):
+    rng = np.random.default_rng(7)
+    centers = rng.normal(size=(k, f)).astype(np.float32) * 8
+    data = np.concatenate([c + rng.normal(size=(n // k, f)).astype(np.float32) for c in centers])
+    x = ht.array(data, split=0)
+    times = []
+    for t in range(trials):
+        km = ht.cluster.KMeans(n_clusters=k, init="random", max_iter=iters, tol=None, random_state=t)
+        with Timer() as timer:
+            km.fit(x)
+        times.append(timer.elapsed)
+    print(f"kmeans fit ({iters} iters, n={n}, f={f}): median {np.median(times):.4f}s "
+          f"({iters/np.median(times):.1f} iters/s)")
+
+
+if __name__ == "__main__":
+    main()

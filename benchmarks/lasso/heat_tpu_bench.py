@@ -1,0 +1,27 @@
+"""Lasso benchmark (reference protocol: ``benchmarks/lasso/heat-cpu.py`` —
+1 iteration x 10 trials on ~1e7-row data)."""
+import numpy as np
+
+import sys, pathlib
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[2]))
+import heat_tpu as ht
+from heat_tpu.utils.profiling import Timer
+
+
+def main(n=1 << 20, f=64, trials=10):
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = X @ rng.normal(size=f).astype(np.float32)
+    Xb = np.concatenate([np.ones((n, 1), dtype=np.float32), X], axis=1)
+    xd, yd = ht.array(Xb, split=0), ht.array(y, split=0)
+    times = []
+    for _ in range(trials):
+        lasso = ht.regression.Lasso(lam=0.01, max_iter=1)
+        with Timer() as t:
+            lasso.fit(xd, yd)
+        times.append(t.elapsed)
+    print(f"lasso 1-iter fit (n={n}, f={f}): median {np.median(times):.4f}s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,25 @@
+"""mean/std benchmark (reference protocol:
+``benchmarks/statistical_moments/heat-cpu.py:20-27`` — axis None/0/1)."""
+import numpy as np
+
+import sys, pathlib
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[2]))
+import heat_tpu as ht
+from heat_tpu.utils.profiling import Timer
+
+
+def main(shape=(1 << 22, 32), trials=10):
+    x = ht.random.randn(*shape, split=0)
+    for fn in (ht.mean, ht.std):
+        for axis in (None, 0, 1):
+            times = []
+            for _ in range(trials):
+                with Timer() as t:
+                    r = fn(x, axis)
+                    r.larray.block_until_ready()
+                times.append(t.elapsed)
+            print(f"{fn.__name__} axis={axis}: median {np.median(times)*1e3:.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

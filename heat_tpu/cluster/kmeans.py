@@ -25,11 +25,14 @@ from ._kcluster import _KCluster
 __all__ = ["KMeans"]
 
 
-@partial(jax.jit, static_argnames=("k",), donate_argnums=())
-def _lloyd_step(xa: jnp.ndarray, centers: jnp.ndarray, k: int):
-    """One Lloyd iteration: (assign, update, shift) fused into one program."""
+def _lloyd_body(xa: jnp.ndarray, centers: jnp.ndarray, k: int):
+    """One Lloyd iteration: (assign, update, shift) fused into one program.
+
+    The distance+argmin runs on the sharded data; the one-hot update is an
+    MXU matmul whose reduction XLA psums over ICI.
+    """
     d2 = _quadratic_expand(xa, centers)  # (n, k), sharded on n
-    labels = jnp.argmin(d2, axis=1)
+    labels = jnp.argmin(d2, axis=1).astype(jnp.int32)
     onehot = jax.nn.one_hot(labels, k, dtype=xa.dtype)  # (n, k)
     counts = jnp.sum(onehot, axis=0)  # (k,)
     sums = onehot.T @ xa  # (k, f) — MXU matmul + psum
@@ -40,10 +43,36 @@ def _lloyd_step(xa: jnp.ndarray, centers: jnp.ndarray, k: int):
     return new_centers, labels, shift
 
 
+_lloyd_step = partial(jax.jit, static_argnames=("k",))(_lloyd_body)
+
+
 @partial(jax.jit, static_argnames=("k",))
 def _inertia(xa: jnp.ndarray, centers: jnp.ndarray, k: int) -> jnp.ndarray:
     d2 = _quadratic_expand(xa, centers)
     return jnp.sum(jnp.min(d2, axis=1))
+
+
+@partial(jax.jit, static_argnames=("k", "max_iter"))
+def _lloyd_fit(xa: jnp.ndarray, centers: jnp.ndarray, k: int, max_iter: int, tol: float):
+    """The whole fit as ONE device program: a ``lax.while_loop`` over fused
+    Lloyd iterations with the tol check on device. A full fit is a single
+    dispatch — essential when the host drives the TPU over a network
+    (per-step RPC latency would otherwise dominate)."""
+
+    def cond(state):
+        i, _, _, shift = state
+        return jnp.logical_and(i < max_iter, shift > tol)
+
+    def body(state):
+        i, c, _, _ = state
+        new_c, labels, shift = _lloyd_body(xa, c, k)
+        return (i + 1, new_c, labels, shift)
+
+    n = xa.shape[0]
+    state0 = (0, centers, jnp.zeros((n,), dtype=jnp.int32), jnp.asarray(jnp.inf, xa.dtype))
+    i, c, labels, _ = jax.lax.while_loop(cond, body, state0)
+    return c, labels, i
+
 
 
 class KMeans(_KCluster):
@@ -78,21 +107,19 @@ class KMeans(_KCluster):
             raise TypeError(f"input needs to be a DNDarray, but was {type(x)}")
         if x.ndim != 2:
             raise ValueError(f"input needs to be 2D, but was {x.ndim}D")
+        if self.max_iter < 1:
+            raise ValueError(f"max_iter must be >= 1, got {self.max_iter}")
         k = self.n_clusters
         xa = x.larray.astype(jnp.promote_types(x.larray.dtype, jnp.float32))
         centers = self._initialize_cluster_centers(x).astype(xa.dtype)
 
-        labels = None
-        n_iter = 0
-        for n_iter in range(1, self.max_iter + 1):
-            centers, labels, shift = _lloyd_step(xa, centers, k)
-            if self.tol is not None and float(shift) <= self.tol:
-                break
+        tol = -1.0 if self.tol is None else float(self.tol)
+        centers, labels, n_iter = _lloyd_fit(xa, centers, k, self.max_iter, tol)
 
         self._cluster_centers = DNDarray(centers, split=None, device=x.device, comm=x.comm)
         self._labels = DNDarray(
             labels.astype(jnp.int64), dtype=types.int64, split=x.split, device=x.device, comm=x.comm
         )
         self._inertia = float(_inertia(xa, centers, k))
-        self._n_iter = n_iter
+        self._n_iter = int(n_iter)
         return self

@@ -10,7 +10,7 @@ from . import types
 from .dndarray import DNDarray
 from .stride_tricks import sanitize_axis
 
-__all__ = ["sanitize_in", "sanitize_infinity", "sanitize_out", "sanitize_distribution", "sanitize_sequence", "sanitize_lshape"]
+__all__ = ["sanitize_in", "sanitize_in_tensor", "sanitize_infinity", "sanitize_out", "sanitize_distribution", "sanitize_sequence", "sanitize_lshape", "scalar_to_1d"]
 
 
 def sanitize_in(x) -> None:
@@ -68,3 +68,22 @@ def sanitize_lshape(array: DNDarray, tensor) -> None:
     ``sanitation.py:213``)."""
     if tuple(tensor.shape) != tuple(array.lshape):
         raise ValueError(f"local tensor shape {tensor.shape} does not match lshape {array.lshape}")
+
+
+def sanitize_in_tensor(x) -> None:
+    """Require a raw jax array (reference ``sanitation.py`` required a
+    torch.Tensor)."""
+    import jax
+
+    if not isinstance(x, jax.Array):
+        raise TypeError(f"input needs to be a jax.Array, but was {type(x)}")
+
+
+def scalar_to_1d(x: DNDarray) -> DNDarray:
+    """Turn a scalar DNDarray into a 1-element 1-D DNDarray (reference
+    ``sanitation.py``)."""
+    if x.ndim != 0:
+        return x
+    return DNDarray(
+        x.larray.reshape(1), dtype=x.dtype, split=None, device=x.device, comm=x.comm
+    )

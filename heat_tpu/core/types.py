@@ -45,6 +45,8 @@ __all__ = [
     "complexfloating",
     "complex64",
     "cfloat",
+    "csingle",
+    "float_",
     "complex128",
     "cdouble",
     "canonical_heat_type",
@@ -202,7 +204,9 @@ half = float16
 float = float32
 double = float64
 cfloat = complex64
+csingle = complex64
 cdouble = complex128
+float_ = float32
 
 _HEAT_TYPES = [
     bool,

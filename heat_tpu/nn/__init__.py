@@ -7,11 +7,11 @@ flax modules) and provides :class:`DataParallel` for mesh data
 parallelism.
 """
 from . import functional, lr_scheduler, vision_transforms
-from .data_parallel import DataParallel
+from .data_parallel import DataParallel, DataParallelMultiGPU
 
 import flax.linen as _linen
 
-__all__ = ["DataParallel", "functional", "lr_scheduler", "vision_transforms"]
+__all__ = ["DataParallel", "DataParallelMultiGPU", "functional", "lr_scheduler", "vision_transforms"]
 
 
 def __getattr__(name):

@@ -24,7 +24,7 @@ from ..core import random as ht_random
 from ..core.communication import MeshCommunication, sanitize_comm
 from ..core.dndarray import DNDarray
 
-__all__ = ["DataParallel"]
+__all__ = ["DataParallel", "DataParallelMultiGPU"]
 
 
 class DataParallel:
@@ -36,10 +36,11 @@ class DataParallel:
     module : flax.linen.Module or callable
         The model. A flax module is initialized internally; a plain callable
         is treated as ``apply_fn(params, inputs)``.
+    optimizer : optax.GradientTransformation or DataParallelOptimizer, optional
+        If given, ``train_step`` also applies the update (positional order
+        matches reference ``data_parallel.py:335``: module, optimizer, comm).
     comm : MeshCommunication, optional
         Mesh to shard batches over (reference passed ``MPI_WORLD``).
-    optimizer : optax.GradientTransformation or DataParallelOptimizer, optional
-        If given, ``train_step`` also applies the update.
     blocking_parameter_updates : bool
         Accepted for reference-API parity. Both values compile to the same
         overlapped schedule (XLA fuses the psum into backward).
@@ -54,8 +55,8 @@ class DataParallel:
     def __init__(
         self,
         module,
-        comm: Optional[MeshCommunication] = None,
         optimizer=None,
+        comm: Optional[MeshCommunication] = None,
         blocking_parameter_updates: bool = False,
         seed: int = 0,
     ):
@@ -172,3 +173,10 @@ class DataParallel:
 
     def train(self):
         return self
+
+
+class DataParallelMultiGPU(DataParallel):
+    """Reference ``data_parallel.py:314``: node-local torch-DDP + DASO
+    global sync. On TPU there is no node-local/global split at this layer —
+    the mesh covers all chips and DASO owns the hierarchy — so this is
+    :class:`DataParallel` under the reference's name."""

@@ -15,3 +15,6 @@ from . import halo, mesh, ring
 from .halo import halo_exchange
 from .mesh import make_mesh, make_hierarchical_mesh
 from .ring import ring_map, ring_reduce
+# note: the ring_attention *function* is the public name; the dense oracle
+# is exposed as `attention` (the submodule is shadowed by design)
+from .ring_attention import attention, ring_attention

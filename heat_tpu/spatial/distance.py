@@ -35,14 +35,56 @@ def _quadratic_expand(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
     return jnp.maximum(d2, 0.0)
 
 
-def _euclidian(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+# cap on the (n, chunk, f) broadcast temporary for exact metrics, in elements
+_EXACT_TEMP_ELEMS = 1 << 26
+
+
+def _chunked_pairwise(x: jnp.ndarray, y: jnp.ndarray, tile_fn) -> jnp.ndarray:
+    """Exact pairwise metric without materializing (n, m, f): loop over
+    y-chunks on device, writing (n, chunk) tiles into the output. The
+    reference's non-expanded path got the same memory bound from its ring
+    (``distance.py:209``); here the x axis stays sharded and the chunk loop
+    is a ``fori_loop`` inside the program."""
+    import math
+
+    n, f = x.shape
+    m = y.shape[0]
+    # memory bound applies to the PER-DEVICE shard of the broadcast temp
+    sharding = getattr(x, "sharding", None)
+    n_local = sharding.shard_shape(x.shape)[0] if sharding is not None else n
+    if n_local * m * f <= _EXACT_TEMP_ELEMS:
+        return tile_fn(x, y)
+    chunk = max(16, min(m, _EXACT_TEMP_ELEMS // max(1, n_local * f)))
+    pad = (-m) % chunk
+    yp = jnp.pad(y, ((0, pad), (0, 0))) if pad else y
+    nb = yp.shape[0] // chunk
+
+    def body(i, out):
+        yc = jax.lax.dynamic_slice_in_dim(yp, i * chunk, chunk, axis=0)
+        tile = tile_fn(x, yc)
+        return jax.lax.dynamic_update_slice_in_dim(out, tile, i * chunk, axis=1)
+
+    out = jnp.zeros((n, nb * chunk), dtype=x.dtype)
+    out = jax.lax.fori_loop(0, nb, body, out)
+    return out[:, :m]
+
+
+def _euclid_tile(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
     diff = x[:, None, :] - y[None, :, :]
     return jnp.sqrt(jnp.sum(diff * diff, axis=-1))
 
 
-def _manhattan(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+def _euclidian(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    return _chunked_pairwise(x, y, _euclid_tile)
+
+
+def _manhattan_tile(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
     diff = jnp.abs(x[:, None, :] - y[None, :, :])
     return jnp.sum(diff, axis=-1)
+
+
+def _manhattan(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    return _chunked_pairwise(x, y, _manhattan_tile)
 
 
 def _gaussian(x: jnp.ndarray, y: jnp.ndarray, sigma: float) -> jnp.ndarray:

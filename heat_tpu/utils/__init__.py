@@ -1,2 +1,3 @@
 """Utilities (reference ``heat/utils/``)."""
-from . import data
+from . import checkpointing, data, profiling
+from .checkpointing import load_checkpoint, save_checkpoint

@@ -1,0 +1,117 @@
+"""Checkpoint / resume.
+
+The reference has no training checkpoint system (SURVEY §5): persistence is
+``ht.save``/``ht.load`` plus RNG ``get_state``/``set_state``. This module
+goes beyond parity with a consolidated checkpoint for training state:
+parameter pytrees (DNDarrays, jax arrays, optax states), the global RNG
+state, and user metadata — written once by the controller, restorable with
+shardings reapplied.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..core import random as ht_random
+from ..core.dndarray import DNDarray
+
+__all__ = ["save_checkpoint", "load_checkpoint"]
+
+_META = "meta.json"
+_ARRAYS = "arrays.npz"
+_TREEDEF = "treedef.pkl"
+
+
+def _flatten(tree) -> Tuple[list, Any]:
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return leaves, treedef
+
+
+def save_checkpoint(path: str, state: Any, step: Optional[int] = None, metadata: Optional[Dict] = None) -> None:
+    """Write a checkpoint directory.
+
+    ``state`` is any pytree of jax arrays / DNDarrays / numpy arrays /
+    scalars. DNDarray leaves are recorded with their split so restore can
+    reapply the sharding. Includes the heat RNG state (reference
+    ``random.get_state:203``).
+    """
+    os.makedirs(path, exist_ok=True)
+    splits = {}
+
+    def to_host(leaf, idx):
+        if isinstance(leaf, DNDarray):
+            splits[str(idx)] = leaf.split
+            return leaf.numpy()
+        return np.asarray(jax.device_get(leaf))
+
+    leaves, treedef = _flatten(state)
+    arrays = {str(i): to_host(leaf, i) for i, leaf in enumerate(leaves)}
+    if jax.process_index() == 0:
+        np.savez(os.path.join(path, _ARRAYS), **arrays)
+        with open(os.path.join(path, _TREEDEF), "wb") as f:
+            pickle.dump(treedef, f)
+        meta = {
+            "step": step,
+            "metadata": metadata or {},
+            "treedef": str(treedef),
+            "n_leaves": len(leaves),
+            "splits": splits,
+            "rng_state": list(ht_random.get_state()),
+        }
+        with open(os.path.join(path, _META), "w") as f:
+            json.dump(meta, f)
+
+
+def load_checkpoint(path: str, like: Any = None, restore_rng: bool = True):
+    """Restore a checkpoint.
+
+    ``like`` is a pytree with the same structure as the saved state (e.g.
+    freshly-initialized params); leaves are replaced with the stored
+    values, DNDarray leaves with their recorded splits reapplied. Returns
+    ``(state, step, metadata)``.
+    """
+    with open(os.path.join(path, _META)) as f:
+        meta = json.load(f)
+    data = np.load(os.path.join(path, _ARRAYS))
+    n = meta["n_leaves"]
+    stored = [data[str(i)] for i in range(n)]
+    if restore_rng and meta.get("rng_state"):
+        s = meta["rng_state"]
+        ht_random.set_state((s[0], int(s[1]), int(s[2]), int(s[3]), float(s[4])))
+
+    if like is None:
+        # rebuild the saved structure from the pickled treedef
+        tpath = os.path.join(path, _TREEDEF)
+        if os.path.exists(tpath):
+            with open(tpath, "rb") as f:
+                treedef = pickle.load(f)
+            state = jax.tree_util.tree_unflatten(treedef, stored)
+        else:
+            state = stored if n != 1 else stored[0]
+    else:
+        leaves, treedef = _flatten(like)
+        if len(leaves) != n:
+            raise ValueError(f"checkpoint has {n} leaves, 'like' tree has {len(leaves)}")
+        new_leaves = []
+        for i, (old, new) in enumerate(zip(leaves, stored)):
+            if isinstance(old, DNDarray):
+                new_leaves.append(
+                    DNDarray(
+                        new,
+                        dtype=old.dtype,
+                        split=meta["splits"].get(str(i), old.split),
+                        device=old.device,
+                        comm=old.comm,
+                    )
+                )
+            else:
+                import jax.numpy as jnp
+
+                new_leaves.append(jnp.asarray(new, dtype=getattr(old, "dtype", None)))
+        state = jax.tree_util.tree_unflatten(treedef, new_leaves)
+    return state, meta.get("step"), meta.get("metadata", {})

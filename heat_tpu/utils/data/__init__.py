@@ -2,4 +2,4 @@
 from . import datatools, matrixgallery, mnist, partial_dataset
 from .datatools import DataLoader, Dataset, dataset_ishuffle, dataset_shuffle
 from .mnist import MNISTDataset
-from .partial_dataset import PartialH5Dataset
+from .partial_dataset import PartialH5DataLoaderIter, PartialH5Dataset

@@ -1,0 +1,65 @@
+"""Profiling hooks.
+
+The reference has none (SURVEY §5: benchmarks use bare
+``time.perf_counter``). On TPU the XLA profiler is nearly free to wire in:
+``trace`` captures a TensorBoard-viewable device trace, ``annotate`` names
+regions inside it, and ``Timer`` reproduces the reference's benchmark
+timing pattern with proper device synchronization.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional
+
+import jax
+
+__all__ = ["trace", "annotate", "Timer"]
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, create_perfetto_link: bool = False):
+    """Capture an XLA device trace viewable in TensorBoard/Perfetto."""
+    jax.profiler.start_trace(log_dir, create_perfetto_link=create_perfetto_link)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region that shows up inside a :func:`trace` capture."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class Timer:
+    """Wall-clock timer that blocks on device completion.
+
+    The reference timed with bare ``perf_counter`` around eager torch+MPI
+    (``benchmarks/kmeans/heat-cpu.py:23-26``); under async JAX dispatch a
+    correct timer must synchronize, so ``stop(x)`` blocks on ``x`` (or on
+    all devices when given nothing).
+    """
+
+    def __init__(self):
+        self._t0: Optional[float] = None
+        self.elapsed: Optional[float] = None
+
+    def start(self) -> "Timer":
+        self._t0 = time.perf_counter()
+        return self
+
+    def stop(self, *block_on) -> float:
+        for x in block_on:
+            jax.block_until_ready(x)
+        if not block_on:
+            for d in jax.devices():
+                jax.device_put(0.0, d).block_until_ready()
+        self.elapsed = time.perf_counter() - self._t0
+        return self.elapsed
+
+    def __enter__(self) -> "Timer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

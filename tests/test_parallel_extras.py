@@ -1,0 +1,95 @@
+"""Ring attention / checkpoint / profiling tests."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from .base import TestCase
+
+
+class TestRingAttention(TestCase):
+    def _run(self, causal):
+        import jax.numpy as jnp
+
+        from heat_tpu.parallel import ring_attention
+        from heat_tpu.parallel.ring_attention import attention
+
+        comm = ht.get_comm()
+        if comm.size == 1:
+            pytest.skip("needs multi-device mesh")
+        rng = np.random.default_rng(0)
+        n, d = 64, 16
+        q = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+        k = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+        qs = ht.array(np.asarray(q), split=0).larray
+        ks = ht.array(np.asarray(k), split=0).larray
+        vs = ht.array(np.asarray(v), split=0).larray
+        out = ring_attention(qs, ks, vs, comm, causal=causal)
+        expected = attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected), rtol=2e-4, atol=2e-4)
+
+    def test_full(self):
+        self._run(causal=False)
+
+    def test_causal(self):
+        self._run(causal=True)
+
+    def test_validates(self):
+        import jax.numpy as jnp
+
+        from heat_tpu.parallel import ring_attention
+
+        with pytest.raises(ValueError):
+            ring_attention(jnp.zeros((4, 2, 2)), jnp.zeros((4, 2, 2)), jnp.zeros((4, 2, 2)), ht.get_comm())
+
+
+class TestCheckpointing(TestCase):
+    def test_roundtrip_tree(self):
+        import jax.numpy as jnp
+
+        ht.random.seed(123)
+        ht.random.rand(4)
+        state = {
+            "params": {"w": jnp.arange(6, dtype=jnp.float32).reshape(2, 3)},
+            "data": ht.arange(16, dtype=ht.float32, split=0),
+        }
+        with tempfile.TemporaryDirectory() as d:
+            ht.utils.save_checkpoint(d, state, step=7, metadata={"note": "test"})
+            rng_before = ht.random.get_state()
+            ht.random.seed(999)  # clobber
+            like = {
+                "params": {"w": jnp.zeros((2, 3), dtype=jnp.float32)},
+                "data": ht.zeros(16, split=0),
+            }
+            restored, step, meta = ht.utils.load_checkpoint(d, like=like)
+            assert step == 7
+            assert meta["note"] == "test"
+            np.testing.assert_array_equal(np.asarray(restored["params"]["w"]), np.arange(6).reshape(2, 3))
+            assert isinstance(restored["data"], ht.DNDarray)
+            assert restored["data"].split == 0
+            np.testing.assert_array_equal(restored["data"].numpy(), np.arange(16))
+            assert ht.random.get_state()[1] == rng_before[1]  # rng restored
+
+    def test_leaf_mismatch(self):
+        import jax.numpy as jnp
+
+        with tempfile.TemporaryDirectory() as d:
+            ht.utils.save_checkpoint(d, {"a": jnp.zeros(3)})
+            with pytest.raises(ValueError):
+                ht.utils.load_checkpoint(d, like={"a": jnp.zeros(3), "b": jnp.zeros(2)})
+
+
+class TestProfiling(TestCase):
+    def test_timer(self):
+        x = ht.random.randn(64, 64, split=0)
+        with ht.utils.profiling.Timer() as t:
+            y = ht.matmul(x, x.T)
+        assert t.elapsed is not None and t.elapsed >= 0
+
+    def test_annotate(self):
+        with ht.utils.profiling.annotate("region"):
+            pass
