@@ -185,7 +185,7 @@ def cumprod(a, axis: int, dtype=None, out=None) -> DNDarray:
 cumproduct = cumprod
 
 
-def diff(a: DNDarray, n: int = 1, axis: int = -1) -> DNDarray:
+def diff(a: DNDarray, n: int = 1, axis: int = -1, prepend=None, append=None) -> DNDarray:
     """n-th discrete difference along an axis (reference
     ``arithmetics.py:293`` hand-rolled the split-axis neighbor send; the
     global jnp.diff compiles to a halo exchange automatically)."""
@@ -196,7 +196,18 @@ def diff(a: DNDarray, n: int = 1, axis: int = -1) -> DNDarray:
     from .stride_tricks import sanitize_axis
 
     axis = sanitize_axis(a.shape, axis)
-    result = jnp.diff(a.larray, n=n, axis=axis)
+
+    def _edge(v):
+        if v is None:
+            return None
+        arr = v.larray if isinstance(v, DNDarray) else jnp.asarray(v)
+        if arr.ndim == 0:
+            shape = list(a.shape)
+            shape[axis] = 1
+            arr = jnp.broadcast_to(arr, shape)
+        return arr
+
+    result = jnp.diff(a.larray, n=n, axis=axis, prepend=_edge(prepend), append=_edge(append))
     return DNDarray(
         result,
         dtype=types.canonical_heat_type(result.dtype),
@@ -213,21 +224,31 @@ def _int_to_int64(x: DNDarray):
     return None
 
 
-def sum(a: DNDarray, axis=None, out=None, keepdims: bool = False) -> DNDarray:
+def _merge_keepdim(keepdim, keepdims) -> bool:
+    """The reference spells this kwarg ``keepdim`` (torch-style,
+    ``arithmetics.py:960``); numpy users expect ``keepdims``. Accept both."""
+    if keepdim is not None:
+        return bool(keepdim)
+    return bool(keepdims)
+
+
+def sum(a: DNDarray, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
     """Sum over axis (reference ``arithmetics.py:960``)."""
-    return _reduce_op(jnp.sum, a, axis=axis, out=out, keepdims=keepdims, out_dtype=_int_to_int64(a))
+    kd = _merge_keepdim(keepdim, keepdims)
+    return _reduce_op(jnp.sum, a, axis=axis, out=out, keepdims=kd, out_dtype=_int_to_int64(a))
 
 
-def prod(a: DNDarray, axis=None, out=None, keepdims: bool = False) -> DNDarray:
+def prod(a: DNDarray, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
     """Product over axis (reference ``arithmetics.py:870``)."""
-    return _reduce_op(jnp.prod, a, axis=axis, out=out, keepdims=keepdims, out_dtype=_int_to_int64(a))
+    kd = _merge_keepdim(keepdim, keepdims)
+    return _reduce_op(jnp.prod, a, axis=axis, out=out, keepdims=kd, out_dtype=_int_to_int64(a))
 
 
-def nansum(a: DNDarray, axis=None, out=None, keepdims: bool = False) -> DNDarray:
+def nansum(a: DNDarray, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
     """Sum ignoring NaNs."""
-    return _reduce_op(jnp.nansum, a, axis=axis, out=out, keepdims=keepdims)
+    return _reduce_op(jnp.nansum, a, axis=axis, out=out, keepdims=_merge_keepdim(keepdim, keepdims))
 
 
-def nanprod(a: DNDarray, axis=None, out=None, keepdims: bool = False) -> DNDarray:
+def nanprod(a: DNDarray, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
     """Product ignoring NaNs."""
-    return _reduce_op(jnp.nanprod, a, axis=axis, out=out, keepdims=keepdims)
+    return _reduce_op(jnp.nanprod, a, axis=axis, out=out, keepdims=_merge_keepdim(keepdim, keepdims))

@@ -123,22 +123,22 @@ class RegressionMixin:
         raise NotImplementedError()
 
 
-def is_estimator(obj) -> bool:
+def is_estimator(estimator) -> bool:
     """reference ``base.py:233``"""
-    return isinstance(obj, BaseEstimator)
+    return isinstance(estimator, BaseEstimator)
 
 
-def is_classifier(obj) -> bool:
-    return getattr(obj, "_estimator_type", None) == "classifier"
+def is_classifier(estimator) -> bool:
+    return getattr(estimator, "_estimator_type", None) == "classifier"
 
 
-def is_clusterer(obj) -> bool:
-    return getattr(obj, "_estimator_type", None) == "clusterer"
+def is_clusterer(estimator) -> bool:
+    return getattr(estimator, "_estimator_type", None) == "clusterer"
 
 
-def is_regressor(obj) -> bool:
-    return getattr(obj, "_estimator_type", None) == "regressor"
+def is_regressor(estimator) -> bool:
+    return getattr(estimator, "_estimator_type", None) == "regressor"
 
 
-def is_transformer(obj) -> bool:
-    return hasattr(obj, "transform") and hasattr(obj, "fit")
+def is_transformer(estimator) -> bool:
+    return hasattr(estimator, "transform") and hasattr(estimator, "fit")

@@ -227,6 +227,99 @@ class DNDarray:
     def loc(self) -> LocalIndex:
         return LocalIndex(self.__array)
 
+    @property
+    def lloc(self) -> LocalIndex:
+        """Local-shard indexing view (reference ``dndarray.py:239``)."""
+        return LocalIndex(self.__array)
+
+    @property
+    def stride(self) -> Tuple[int, ...]:
+        """Element strides of the (C-contiguous) global array (reference
+        ``dndarray.py:308``)."""
+        strides = []
+        acc = 1
+        for dim in reversed(self.gshape):
+            strides.append(acc)
+            acc *= dim
+        return tuple(reversed(strides))
+
+    @property
+    def strides(self) -> Tuple[int, ...]:
+        """Byte strides, numpy-style (reference ``dndarray.py:315``)."""
+        item = np.dtype(self.__dtype.jax_type()).itemsize
+        return tuple(s * item for s in self.stride)
+
+    @property
+    def halo_next(self):
+        """Halos received from the *next* shard, for every inter-shard
+        boundary (reference ``dndarray.py:124`` stored the per-rank received
+        buffer; single-controller JAX exposes all boundaries at once).
+
+        Shape ``(num_shards - 1, ..., halo_size, ...)`` with ``halo_size``
+        replacing the split dimension: entry ``i`` is the halo shard ``i``
+        receives from shard ``i + 1``.
+        """
+        hs = self.halo_size
+        if hs == 0 or self.__split is None:
+            return None
+        counts, displs = self.counts_displs()
+        slabs = []
+        for i in range(1, len(counts)):
+            # a halo crosses boundary i only when both neighbors hold >= hs
+            if counts[i - 1] < hs or counts[i] < hs:
+                continue
+            sl = [slice(None)] * self.ndim
+            sl[self.__split] = slice(displs[i], displs[i] + hs)
+            slabs.append(self.__array[tuple(sl)])
+        return jnp.stack(slabs) if slabs else None
+
+    @property
+    def halo_prev(self):
+        """Halos received from the *previous* shard, for every inter-shard
+        boundary (reference ``dndarray.py:131``): entry ``i`` is the halo
+        shard ``i + 1`` receives from shard ``i``."""
+        hs = self.halo_size
+        if hs == 0 or self.__split is None:
+            return None
+        counts, displs = self.counts_displs()
+        slabs = []
+        for i in range(1, len(counts)):
+            if counts[i - 1] < hs or counts[i] < hs:
+                continue
+            sl = [slice(None)] * self.ndim
+            sl[self.__split] = slice(max(displs[i] - hs, 0), displs[i])
+            slabs.append(self.__array[tuple(sl)])
+        return jnp.stack(slabs) if slabs else None
+
+    def counts_displs(self) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
+        """Per-device item counts and offsets along the split axis
+        (reference ``dndarray.py:543``)."""
+        if self.__split is None:
+            raise ValueError(
+                "Non-distributed DNDarray. Cannot calculate counts and displacements."
+            )
+        counts = self.lshape_map[:, self.__split]
+        displs = np.concatenate([[0], np.cumsum(counts)[:-1]])
+        return tuple(int(c) for c in counts), tuple(int(d) for d in displs)
+
+    def is_distributed(self) -> bool:
+        """Whether data lives on more than one device (reference
+        ``dndarray.py:952``)."""
+        return self.__split is not None and self.__comm.is_distributed()
+
+    def cpu(self) -> "DNDarray":
+        """Return a host-memory copy (reference ``dndarray.py:560`` moved
+        torch storage to CPU). The returned DNDarray's buffer lives on the
+        JAX CPU backend — it does not occupy accelerator HBM."""
+        host = jax.device_put(self.__array, jax.local_devices(backend="cpu")[0])
+        out = DNDarray.__new__(DNDarray)
+        out._DNDarray__comm = self.__comm
+        out._DNDarray__device = devices.cpu
+        out._DNDarray__dtype = self.__dtype
+        out._DNDarray__split = None
+        out._DNDarray__array = host
+        return out
+
     # ------------------------------------------------------------- placement
     def resplit_(self, axis: Optional[int] = None) -> "DNDarray":
         """In-place redistribution to a new split axis (reference
@@ -396,6 +489,17 @@ class DNDarray:
         """Normalize an index key and compute the resulting split axis."""
         split = self.__split
         if isinstance(key, DNDarray):
+            # coordinate-list indexing: x[nonzero(x)] with an (n, ndim) int
+            # key selects per-row coordinates (reference torch-style
+            # ``dndarray.py:700-707`` handling of nonzero results)
+            if (
+                key.ndim == 2
+                and self.ndim > 1
+                and key.gshape[1] == self.ndim
+                and types.issubdtype(key.dtype, types.integer)
+            ):
+                cols = tuple(key.larray[:, d] for d in range(self.ndim))
+                return cols, (0 if split is not None else None)
             key = key.larray
         if not isinstance(key, tuple):
             key = (key,)

@@ -52,16 +52,18 @@ def log1p(x, out=None) -> DNDarray:
     return _local_op(jnp.log1p, x, out=out)
 
 
-def logaddexp(t1, t2) -> DNDarray:
+def logaddexp(x1, x2, out=None) -> DNDarray:
+    """log(exp(x1) + exp(x2)) (reference ``exponential.py:210``)."""
     from ._operations import _binary_op
 
-    return _binary_op(jnp.logaddexp, t1, t2)
+    return _binary_op(jnp.logaddexp, x1, x2, out=out)
 
 
-def logaddexp2(t1, t2) -> DNDarray:
+def logaddexp2(x1, x2, out=None) -> DNDarray:
+    """log2(2**x1 + 2**x2) (reference ``exponential.py``)."""
     from ._operations import _binary_op
 
-    return _binary_op(jnp.logaddexp2, t1, t2)
+    return _binary_op(jnp.logaddexp2, x1, x2, out=out)
 
 
 def sqrt(x, out=None) -> DNDarray:

@@ -101,12 +101,17 @@ def array(
     return DNDarray(data, dtype=dtype, split=split, device=device, comm=comm)
 
 
-def asarray(obj, dtype=None, copy=None, order="C", device=None) -> DNDarray:
+def asarray(obj, dtype=None, copy=None, order="C", is_split=None, device=None) -> DNDarray:
     """Convert to DNDarray without copy when possible (reference
-    ``factories.py``)."""
-    if isinstance(obj, DNDarray) and (dtype is None or obj.dtype == types.canonical_heat_type(dtype)):
+    ``factories.py:434``). ``is_split`` marks ``obj`` as this process's
+    local shard of a larger array (reference is_split semantics)."""
+    if order is not None and order not in ("C", "K", "A"):
+        raise NotImplementedError("only C-order memory layout is supported on TPU")
+    if isinstance(obj, DNDarray) and is_split is None and (
+        dtype is None or obj.dtype == types.canonical_heat_type(dtype)
+    ):
         return obj
-    return array(obj, dtype=dtype, device=device)
+    return array(obj, dtype=dtype, is_split=is_split, device=device)
 
 
 def _sharded_factory(shape, split, comm, fill) -> jax.Array:
@@ -256,8 +261,10 @@ def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None, split=No
     return res
 
 
-def eye(shape, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+def eye(shape, dtype=types.float32, split=None, device=None, comm=None, order="C") -> DNDarray:
     """reference ``factories.py:586``"""
+    if order != "C":
+        raise NotImplementedError("only C-order memory layout is supported on TPU")
     if isinstance(shape, (int, np.integer)):
         n, m = int(shape), int(shape)
     else:

@@ -1,28 +1,31 @@
 """Indexing operations (reference ``heat/core/indexing.py``)."""
 from __future__ import annotations
 
-from typing import Tuple
-
 import jax.numpy as jnp
 
 from . import types
-from ._operations import _binary_op
 from .dndarray import DNDarray
 
 __all__ = ["nonzero", "where"]
 
 
-def nonzero(x: DNDarray) -> Tuple[DNDarray, ...]:
-    """Indices of nonzero elements, one 1-D array per dimension (reference
-    ``indexing.py:16`` — local nonzero + global offset; a global jnp call
-    here). Result is split=0 when the input was distributed."""
+def nonzero(x: DNDarray) -> DNDarray:
+    """Indices of nonzero elements as one (n, ndim) coordinate array
+    (reference ``indexing.py:16`` — torch-style, *not* the numpy tuple).
+
+    For 1-D input the result is 1-D (reference squeezes the trailing dim).
+    The result is split=0 when the input was distributed; ``x[nonzero(x)]``
+    recovers the nonzero values (coordinate-list indexing, handled by
+    ``DNDarray.__getitem__``).
+    """
     if not isinstance(x, DNDarray):
         raise TypeError(f"expected x to be a DNDarray, but was {type(x)}")
-    result = jnp.nonzero(x.larray)
+    result = jnp.stack(jnp.nonzero(x.larray), axis=1)
+    if x.ndim == 1:
+        result = result.reshape(-1)
     split = 0 if x.split is not None else None
-    return tuple(
-        DNDarray(r.astype(jnp.int64), dtype=types.int64, split=split, device=x.device, comm=x.comm)
-        for r in result
+    return DNDarray(
+        result.astype(jnp.int64), dtype=types.int64, split=split, device=x.device, comm=x.comm
     )
 
 
@@ -38,4 +41,9 @@ def where(cond: DNDarray, x=None, y=None) -> DNDarray:
     split = cond.split
     if isinstance(x, DNDarray) and x.split is not None:
         split = x.split if split is None else split
-    return DNDarray(result, split=split if result.ndim == cond.ndim else None, device=cond.device, comm=cond.comm)
+    return DNDarray(
+        result,
+        split=split if result.ndim == cond.ndim else None,
+        device=cond.device,
+        comm=cond.comm,
+    )

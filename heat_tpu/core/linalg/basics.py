@@ -94,8 +94,9 @@ def vdot(x1: DNDarray, x2: DNDarray) -> DNDarray:
     return DNDarray(result, split=None, device=x1.device, comm=x1.comm)
 
 
-def vecdot(x1: DNDarray, x2: DNDarray, axis: Optional[int] = None, keepdims: bool = False) -> DNDarray:
+def vecdot(x1: DNDarray, x2: DNDarray, axis: Optional[int] = None, keepdim=None, keepdims: bool = False) -> DNDarray:
     """Vector dot along an axis (reference ``basics.py:2272``)."""
+    keepdims = bool(keepdim or keepdims)
     if axis is None:
         axis = -1
     axis = sanitize_axis(tuple(np.broadcast_shapes(x1.shape, x2.shape)), axis)

@@ -27,15 +27,16 @@ __all__ = [
 ]
 
 
-def all(x, axis=None, out=None, keepdims=False) -> DNDarray:
+def all(x, axis=None, out=None, keepdim=False, keepdims=None) -> DNDarray:
     """Whether all elements are truthy (reference ``logical.py:38`` —
-    MPI.LAND reduce; XLA emits the equivalent all-reduce)."""
-    return _reduce_op(jnp.all, x, axis=axis, out=out, keepdims=keepdims, out_dtype=types.bool)
+    MPI.LAND reduce; XLA emits the equivalent all-reduce). ``keepdim`` is
+    the reference spelling; ``keepdims`` accepted for numpy users."""
+    return _reduce_op(jnp.all, x, axis=axis, out=out, keepdims=bool(keepdim or keepdims), out_dtype=types.bool)
 
 
-def any(x, axis=None, out=None, keepdims=False) -> DNDarray:
+def any(x, axis=None, out=None, keepdim=False, keepdims=None) -> DNDarray:
     """Whether any element is truthy (reference ``logical.py:157``)."""
-    return _reduce_op(jnp.any, x, axis=axis, out=out, keepdims=keepdims, out_dtype=types.bool)
+    return _reduce_op(jnp.any, x, axis=axis, out=out, keepdims=bool(keepdim or keepdims), out_dtype=types.bool)
 
 
 def allclose(x, y, rtol: float = 1e-05, atol: float = 1e-08, equal_nan: bool = False) -> bool:
@@ -72,20 +73,20 @@ def isposinf(x, out=None) -> DNDarray:
     return _local_op(jnp.isposinf, x, out=out, no_cast=True, out_dtype=types.bool)
 
 
-def logical_and(t1, t2) -> DNDarray:
-    return _binary_op(jnp.logical_and, _as_bool(t1), _as_bool(t2))
+def logical_and(x, y) -> DNDarray:
+    return _binary_op(jnp.logical_and, _as_bool(x), _as_bool(y))
 
 
-def logical_not(t, out=None) -> DNDarray:
-    return _local_op(jnp.logical_not, t, out=out, no_cast=True, out_dtype=types.bool)
+def logical_not(x, out=None) -> DNDarray:
+    return _local_op(jnp.logical_not, x, out=out, no_cast=True, out_dtype=types.bool)
 
 
-def logical_or(t1, t2) -> DNDarray:
-    return _binary_op(jnp.logical_or, _as_bool(t1), _as_bool(t2))
+def logical_or(x, y) -> DNDarray:
+    return _binary_op(jnp.logical_or, _as_bool(x), _as_bool(y))
 
 
-def logical_xor(t1, t2) -> DNDarray:
-    return _binary_op(jnp.logical_xor, t1, t2)
+def logical_xor(x, y) -> DNDarray:
+    return _binary_op(jnp.logical_xor, x, y)
 
 
 def signbit(x, out=None) -> DNDarray:

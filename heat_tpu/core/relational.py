@@ -10,9 +10,9 @@ from .dndarray import DNDarray
 __all__ = ["eq", "equal", "ge", "greater_equal", "gt", "greater", "le", "less_equal", "lt", "less", "ne", "not_equal"]
 
 
-def eq(t1, t2) -> DNDarray:
+def eq(x, y) -> DNDarray:
     """Elementwise ==, bool result (reference ``relational.py``)."""
-    return _bool_op(jnp.equal, t1, t2)
+    return _bool_op(jnp.equal, x, y)
 
 
 def _bool_op(op, t1, t2) -> DNDarray:
@@ -22,47 +22,47 @@ def _bool_op(op, t1, t2) -> DNDarray:
     return res
 
 
-def equal(t1, t2) -> bool:
+def equal(x, y) -> bool:
     """Global equality to a single python bool (reference
     ``relational.py:80`` — Allreduce(LAND); here one jnp.all on the sharded
     comparison, psum'd by XLA)."""
     try:
-        res = _binary_op(jnp.equal, t1, t2)
+        res = _binary_op(jnp.equal, x, y)
     except ValueError:
         return False
     return bool(jnp.all(res.larray))
 
 
-def ge(t1, t2) -> DNDarray:
-    return _bool_op(jnp.greater_equal, t1, t2)
+def ge(x, y) -> DNDarray:
+    return _bool_op(jnp.greater_equal, x, y)
 
 
 greater_equal = ge
 
 
-def gt(t1, t2) -> DNDarray:
-    return _bool_op(jnp.greater, t1, t2)
+def gt(x, y) -> DNDarray:
+    return _bool_op(jnp.greater, x, y)
 
 
 greater = gt
 
 
-def le(t1, t2) -> DNDarray:
-    return _bool_op(jnp.less_equal, t1, t2)
+def le(x, y) -> DNDarray:
+    return _bool_op(jnp.less_equal, x, y)
 
 
 less_equal = le
 
 
-def lt(t1, t2) -> DNDarray:
-    return _bool_op(jnp.less, t1, t2)
+def lt(x, y) -> DNDarray:
+    return _bool_op(jnp.less, x, y)
 
 
 less = lt
 
 
-def ne(t1, t2) -> DNDarray:
-    return _bool_op(jnp.not_equal, t1, t2)
+def ne(x, y) -> DNDarray:
+    return _bool_op(jnp.not_equal, x, y)
 
 
 not_equal = ne

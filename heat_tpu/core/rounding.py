@@ -54,15 +54,18 @@ def floor(x, out=None) -> DNDarray:
     return _local_op(jnp.floor, x, out=out)
 
 
-def clip(x, a_min, a_max, out=None) -> DNDarray:
-    """Clamp values to [a_min, a_max] (reference ``rounding.py``)."""
-    if a_min is None and a_max is None:
-        raise ValueError("either a_min or a_max must be set")
-    if isinstance(a_min, DNDarray):
-        a_min = a_min.larray
-    if isinstance(a_max, DNDarray):
-        a_max = a_max.larray
-    return _local_op(lambda t: jnp.clip(t, a_min, a_max), x, out=out, no_cast=True)
+def clip(x, min=None, max=None, out=None, *, a_min=None, a_max=None) -> DNDarray:
+    """Clamp values to [min, max] (reference ``rounding.py:126`` spells the
+    bounds ``min``/``max``; numpy's ``a_min``/``a_max`` also accepted)."""
+    lo = a_min if a_min is not None else min
+    hi = a_max if a_max is not None else max
+    if lo is None and hi is None:
+        raise ValueError("either min or max must be set")
+    if isinstance(lo, DNDarray):
+        lo = lo.larray
+    if isinstance(hi, DNDarray):
+        hi = hi.larray
+    return _local_op(lambda t: jnp.clip(t, lo, hi), x, out=out, no_cast=True)
 
 
 def modf(x, out=None):

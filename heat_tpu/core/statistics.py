@@ -117,12 +117,14 @@ def bincount(x: DNDarray, weights=None, minlength: int = 0) -> DNDarray:
     return DNDarray(result, dtype=types.canonical_heat_type(result.dtype), split=None, device=x.device, comm=x.comm)
 
 
-def bucketize(input: DNDarray, boundaries, right: bool = False, out=None) -> DNDarray:
+def bucketize(input: DNDarray, boundaries, out_int32: bool = False, right: bool = False, out=None) -> DNDarray:
     """Index of the bucket each value falls into (reference
     ``statistics.py:393``)."""
     b = boundaries.larray if isinstance(boundaries, DNDarray) else jnp.asarray(boundaries)
     side = "left" if right else "right"
-    return _local_op(lambda t: jnp.searchsorted(b, t, side=side).astype(jnp.int64), input, out=out, no_cast=True, out_dtype=types.int64)
+    idx_type = types.int32 if out_int32 else types.int64
+    jt = idx_type.jax_type()
+    return _local_op(lambda t: jnp.searchsorted(b, t, side=side).astype(jt), input, out=out, no_cast=True, out_dtype=idx_type)
 
 
 def digitize(x: DNDarray, bins, right: bool = False) -> DNDarray:
@@ -182,9 +184,10 @@ def histogram(a: DNDarray, bins: int = 10, range=None, normed=None, weights=None
     )
 
 
-def kurtosis(x: DNDarray, axis=None, fisher: bool = True, bias: bool = True) -> DNDarray:
-    """Kurtosis (reference ``statistics.py:727``; moment merging is XLA's
-    problem now)."""
+def kurtosis(x: DNDarray, axis=None, unbiased: bool = True, Fischer: bool = True) -> DNDarray:
+    """Kurtosis (reference ``statistics.py:727``; ``unbiased`` applies the
+    sample-size correction, ``Fischer`` subtracts 3 — reference arg names).
+    Moment merging is XLA's problem now."""
     axis_s = sanitize_axis(x.shape, axis)
     arr = x.larray.astype(jnp.promote_types(x.larray.dtype, jnp.float32))
     n = arr.size if axis_s is None else arr.shape[axis_s]
@@ -192,16 +195,17 @@ def kurtosis(x: DNDarray, axis=None, fisher: bool = True, bias: bool = True) -> 
     m2 = jnp.mean((arr - mu) ** 2, axis=axis_s)
     m4 = jnp.mean((arr - mu) ** 4, axis=axis_s)
     g2 = m4 / (m2**2)
-    if not bias and n > 3:
+    if unbiased and n > 3:
         g2 = ((n - 1) / ((n - 2) * (n - 3))) * ((n + 1) * g2 - 3 * (n - 1)) + 3
-    if fisher:
+    if Fischer:
         g2 = g2 - 3
     split = _reduced_split(x.split, axis_s, x.ndim, False)
     return DNDarray(g2, dtype=types.canonical_heat_type(g2.dtype), split=split, device=x.device, comm=x.comm)
 
 
-def skew(x: DNDarray, axis=None, bias: bool = True) -> DNDarray:
-    """Skewness (reference ``statistics.py:1676``)."""
+def skew(x: DNDarray, axis=None, unbiased: bool = True) -> DNDarray:
+    """Skewness (reference ``statistics.py:1676``; ``unbiased`` applies the
+    Fisher-Pearson sample correction)."""
     axis_s = sanitize_axis(x.shape, axis)
     arr = x.larray.astype(jnp.promote_types(x.larray.dtype, jnp.float32))
     n = arr.size if axis_s is None else arr.shape[axis_s]
@@ -209,15 +213,15 @@ def skew(x: DNDarray, axis=None, bias: bool = True) -> DNDarray:
     m2 = jnp.mean((arr - mu) ** 2, axis=axis_s)
     m3 = jnp.mean((arr - mu) ** 3, axis=axis_s)
     g1 = m3 / (m2**1.5)
-    if not bias and n > 2:
+    if unbiased and n > 2:
         g1 = g1 * np.sqrt(n * (n - 1)) / (n - 2)
     split = _reduced_split(x.split, axis_s, x.ndim, False)
     return DNDarray(g1, dtype=types.canonical_heat_type(g1.dtype), split=split, device=x.device, comm=x.comm)
 
 
-def max(x: DNDarray, axis=None, out=None, keepdims=None) -> DNDarray:
+def max(x: DNDarray, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
     """Maximum along axis (reference ``statistics.py:781``)."""
-    return _reduce_op(jnp.max, x, axis=axis, out=out, keepdims=bool(keepdims))
+    return _reduce_op(jnp.max, x, axis=axis, out=out, keepdims=bool(keepdim or keepdims))
 
 
 def maximum(x1, x2, out=None) -> DNDarray:
@@ -231,30 +235,32 @@ def mean(x: DNDarray, axis=None) -> DNDarray:
     return _reduce_op(jnp.mean, x, axis=axis)
 
 
-def median(x: DNDarray, axis=None, keepdims: bool = False) -> DNDarray:
+def median(x: DNDarray, axis=None, keepdim: bool = False, keepdims=None) -> DNDarray:
     """Median (reference ``statistics.py:1017``, gather-based)."""
+    kd = bool(keepdim or keepdims)
     axis_s = sanitize_axis(x.shape, axis)
-    result = jnp.median(x.larray, axis=axis_s, keepdims=keepdims)
-    split = _reduced_split(x.split, axis_s, x.ndim, keepdims)
+    result = jnp.median(x.larray, axis=axis_s, keepdims=kd)
+    split = _reduced_split(x.split, axis_s, x.ndim, kd)
     return DNDarray(result, dtype=types.canonical_heat_type(result.dtype), split=split, device=x.device, comm=x.comm)
 
 
-def min(x: DNDarray, axis=None, out=None, keepdims=None) -> DNDarray:
+def min(x: DNDarray, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
     """Minimum along axis (reference ``statistics.py:1114``)."""
-    return _reduce_op(jnp.min, x, axis=axis, out=out, keepdims=bool(keepdims))
+    return _reduce_op(jnp.min, x, axis=axis, out=out, keepdims=bool(keepdim or keepdims))
 
 
 def minimum(x1, x2, out=None) -> DNDarray:
     return _binary_op(jnp.minimum, x1, x2, out=out)
 
 
-def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear", keepdims: bool = False) -> DNDarray:
+def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear", keepdim: bool = False, keepdims=None) -> DNDarray:
     """q-th percentile (reference ``statistics.py:1406``, gather-based;
     global jnp.percentile here — XLA handles the sharded sort)."""
+    kd = bool(keepdim or keepdims)
     axis_s = sanitize_axis(x.shape, axis)
     q_arr = q.larray if isinstance(q, DNDarray) else jnp.asarray(q)
     method = {"lower": "lower", "higher": "higher", "midpoint": "midpoint", "nearest": "nearest", "linear": "linear"}[interpolation]
-    result = jnp.percentile(x.larray.astype(jnp.float64 if x.larray.dtype == jnp.float64 else jnp.float32), q_arr, axis=axis_s, method=method, keepdims=keepdims)
+    result = jnp.percentile(x.larray.astype(jnp.float64 if x.larray.dtype == jnp.float64 else jnp.float32), q_arr, axis=axis_s, method=method, keepdims=kd)
     res = DNDarray(result, dtype=types.canonical_heat_type(result.dtype), split=None, device=x.device, comm=x.comm)
     if out is not None:
         from ._operations import _write_out

@@ -82,11 +82,11 @@ def atanh(x, out=None) -> DNDarray:
 arctanh = atanh
 
 
-def atan2(t1, t2) -> DNDarray:
+def atan2(x1, x2) -> DNDarray:
     """Elementwise two-argument arctangent."""
     from . import types
 
-    res = _binary_op(jnp.arctan2, t1, t2)
+    res = _binary_op(jnp.arctan2, x1, x2)
     if types.heat_type_is_exact(res.dtype):
         res = res.astype(types.float32)
     return res

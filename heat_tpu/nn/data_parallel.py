@@ -36,11 +36,12 @@ class DataParallel:
     module : flax.linen.Module or callable
         The model. A flax module is initialized internally; a plain callable
         is treated as ``apply_fn(params, inputs)``.
-    optimizer : optax.GradientTransformation or DataParallelOptimizer, optional
-        If given, ``train_step`` also applies the update (positional order
-        matches reference ``data_parallel.py:335``: module, optimizer, comm).
     comm : MeshCommunication, optional
-        Mesh to shard batches over (reference passed ``MPI_WORLD``).
+        Mesh to shard batches over. Positional order matches the reference
+        signature (module, comm, optimizer) at ``data_parallel.py:52-57``,
+        where ``MPI_WORLD`` was passed here.
+    optimizer : optax.GradientTransformation or DataParallelOptimizer, optional
+        If given, ``train_step`` also applies the update.
     blocking_parameter_updates : bool
         Accepted for reference-API parity. Both values compile to the same
         overlapped schedule (XLA fuses the psum into backward).
@@ -55,11 +56,20 @@ class DataParallel:
     def __init__(
         self,
         module,
-        optimizer=None,
         comm: Optional[MeshCommunication] = None,
+        optimizer=None,
         blocking_parameter_updates: bool = False,
         seed: int = 0,
     ):
+        # tolerate the (module, optimizer, comm) order some callers use:
+        # a communicator is never a gradient transformation and vice versa
+        if comm is not None and not isinstance(comm, MeshCommunication) and (
+            hasattr(comm, "update") or hasattr(comm, "transformation")
+        ):
+            comm, optimizer = (
+                optimizer if isinstance(optimizer, MeshCommunication) else None,
+                comm,
+            )
         self.module = module
         self.comm = sanitize_comm(comm)
         self.blocking_parameter_updates = blocking_parameter_updates

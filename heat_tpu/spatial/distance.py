@@ -45,7 +45,6 @@ def _chunked_pairwise(x: jnp.ndarray, y: jnp.ndarray, tile_fn) -> jnp.ndarray:
     reference's non-expanded path got the same memory bound from its ring
     (``distance.py:209``); here the x axis stays sharded and the chunk loop
     is a ``fori_loop`` inside the program."""
-    import math
 
     n, f = x.shape
     m = y.shape[0]

@@ -1,0 +1,197 @@
+"""Reference-signature parity features (keepdim spelling, kwargs added for
+reference API compatibility, DNDarray parity methods).
+
+Mirrors reference call patterns: heat spells the reduction kwarg ``keepdim``
+(``arithmetics.py:960``, ``logical.py:38``), ``clip`` uses ``min``/``max``
+(``rounding.py:126``), ``kurtosis``/``skew`` use ``unbiased``/``Fischer``
+(``statistics.py:727,1676``), ``diff`` takes ``prepend``/``append``
+(``arithmetics.py:293``).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import heat_tpu as ht
+
+from .base import TestCase
+
+
+class TestSignatureParity(TestCase):
+    def setUp(self):
+        self.rng = np.random.default_rng(7)
+        self.x = self.rng.standard_normal((8, 6)).astype(np.float32)
+
+    def test_keepdim_spelling(self):
+        a = ht.array(self.x, split=0)
+        for fn, npfn in [
+            (ht.sum, np.sum),
+            (ht.prod, np.prod),
+            (ht.max, np.max),
+            (ht.min, np.min),
+        ]:
+            res = fn(a, axis=0, keepdim=True)
+            np.testing.assert_allclose(
+                res.numpy(), npfn(self.x, axis=0, keepdims=True), rtol=1e-4
+            )
+        res = ht.all(a > -100, axis=1, keepdim=True)
+        np.testing.assert_array_equal(res.numpy(), np.all(self.x > -100, axis=1, keepdims=True))
+        res = ht.any(a > 0, axis=1, keepdim=True)
+        np.testing.assert_array_equal(res.numpy(), np.any(self.x > 0, axis=1, keepdims=True))
+        res = ht.median(a, axis=0, keepdim=True)
+        np.testing.assert_allclose(res.numpy(), np.median(self.x, axis=0, keepdims=True), rtol=1e-5)
+
+    def test_clip_min_max_kwargs(self):
+        a = ht.array(self.x, split=0)
+        np.testing.assert_allclose(
+            ht.clip(a, min=-0.5, max=0.5).numpy(), np.clip(self.x, -0.5, 0.5)
+        )
+        np.testing.assert_allclose(ht.clip(a, min=0.0).numpy(), np.clip(self.x, 0.0, None))
+        np.testing.assert_allclose(ht.clip(a, a_min=-1.0, a_max=1.0).numpy(), np.clip(self.x, -1, 1))
+
+    def test_diff_prepend_append(self):
+        a = ht.array(self.x, split=0)
+        np.testing.assert_allclose(
+            ht.diff(a, axis=0, prepend=0.0).numpy(), np.diff(self.x, axis=0, prepend=0.0), rtol=1e-5
+        )
+        app = self.rng.standard_normal((1, 6)).astype(np.float32)
+        np.testing.assert_allclose(
+            ht.diff(a, axis=0, append=ht.array(app)).numpy(),
+            np.diff(self.x, axis=0, append=app),
+            rtol=1e-5,
+        )
+
+    def test_skew_kurtosis_reference_args(self):
+        a = ht.array(self.x, split=0)
+        n = self.x.shape[0]
+        mu = self.x.mean(0)
+        m2 = ((self.x - mu) ** 2).mean(0)
+        m3 = ((self.x - mu) ** 3).mean(0)
+        m4 = ((self.x - mu) ** 4).mean(0)
+        g1 = m3 / m2**1.5
+        g2 = m4 / m2**2
+        np.testing.assert_allclose(ht.skew(a, axis=0, unbiased=False).numpy(), g1, rtol=1e-3)
+        np.testing.assert_allclose(
+            ht.skew(a, axis=0, unbiased=True).numpy(),
+            g1 * np.sqrt(n * (n - 1)) / (n - 2),
+            rtol=1e-3,
+        )
+        np.testing.assert_allclose(
+            ht.kurtosis(a, axis=0, unbiased=False, Fischer=True).numpy(), g2 - 3, rtol=1e-3
+        )
+        np.testing.assert_allclose(
+            ht.kurtosis(a, axis=0, unbiased=False, Fischer=False).numpy(), g2, rtol=1e-3
+        )
+
+    def test_bucketize_out_int32(self):
+        a = ht.array(np.array([0.1, 0.4, 0.9], dtype=np.float32))
+        res = ht.bucketize(a, ht.array(np.array([0.25, 0.5, 0.75])), out_int32=True)
+        assert res.dtype == ht.int32
+        np.testing.assert_array_equal(res.numpy(), [0, 1, 3])
+
+    def test_logaddexp_out(self):
+        a = ht.array(self.x)
+        b = ht.array(self.x * 0.5)
+        out = ht.zeros_like(a)
+        res = ht.logaddexp(a, b, out=out)
+        np.testing.assert_allclose(out.numpy(), np.logaddexp(self.x, self.x * 0.5), rtol=1e-5)
+        assert res is out
+
+    def test_relational_kwarg_names(self):
+        a = ht.array(self.x)
+        b = ht.array(self.x)
+        np.testing.assert_array_equal(ht.eq(x=a, y=b).numpy(), np.ones_like(self.x, dtype=bool))
+        assert ht.equal(x=a, y=b) is True
+
+    def test_asarray_is_split(self):
+        local = np.arange(12, dtype=np.float32).reshape(4, 3)
+        res = ht.asarray(local, is_split=0)
+        assert res.split == 0
+        np.testing.assert_array_equal(res.numpy(), local)
+
+    def test_estimator_introspection(self):
+        km = ht.cluster.KMeans(n_clusters=2)
+        assert ht.is_estimator(estimator=km)
+        assert ht.cluster.KMeans is not None
+
+
+class TestDNDarrayParityMethods(TestCase):
+    def setUp(self):
+        self.x = np.arange(24, dtype=np.float32).reshape(6, 4)
+
+    def test_counts_displs(self):
+        a = ht.array(self.x, split=0)
+        counts, displs = a.counts_displs()
+        assert sum(counts) == 6
+        assert displs[0] == 0
+        assert len(counts) == len(displs) == a.comm.size
+        with np.testing.assert_raises(ValueError):
+            ht.array(self.x).counts_displs()
+
+    def test_stride_strides(self):
+        a = ht.array(self.x, split=0)
+        assert a.stride == (4, 1)
+        assert a.strides == (16, 4)
+
+    def test_is_distributed(self):
+        a = ht.array(self.x, split=0)
+        b = ht.array(self.x)
+        assert a.is_distributed() == (a.comm.size > 1)
+        assert not b.is_distributed()
+
+    def test_cpu(self):
+        a = ht.array(self.x, split=0)
+        c = a.cpu()
+        np.testing.assert_array_equal(c.numpy(), self.x)
+        assert c.split is None
+
+    def test_lloc(self):
+        a = ht.array(self.x, split=0)
+        np.testing.assert_array_equal(np.asarray(a.lloc[0]), self.x[0])
+
+    def test_halo_views(self):
+        a = ht.array(self.x, split=0)
+        a.get_halo(1)
+        if a.comm.size > 1:
+            nxt = a.halo_next
+            prv = a.halo_prev
+            counts, displs = a.counts_displs()
+            # boundaries where both neighbor shards hold >= halo_size rows
+            bounds = [i for i in range(1, len(counts)) if counts[i - 1] >= 1 and counts[i] >= 1]
+            assert nxt.shape == (len(bounds), 1, 4) and prv.shape == (len(bounds), 1, 4)
+            for j, i in enumerate(bounds):
+                np.testing.assert_array_equal(
+                    np.asarray(nxt[j]), self.x[displs[i] : displs[i] + 1]
+                )
+                np.testing.assert_array_equal(
+                    np.asarray(prv[j]), self.x[displs[i] - 1 : displs[i]]
+                )
+
+    def test_cpu_host_resident(self):
+        a = ht.array(self.x, split=0)
+        c = a.cpu()
+        devs = {d.platform for d in c.larray.devices()}
+        assert devs == {"cpu"}
+        np.testing.assert_array_equal(c.numpy(), self.x)
+
+    def test_data_parallel_reference_arg_order(self):
+        import optax
+
+        try:
+            import flax.linen as fnn
+        except ImportError:
+            self.skipTest("flax unavailable")
+
+        class M(fnn.Module):
+            @fnn.compact
+            def __call__(self, x):
+                return fnn.Dense(2)(x)
+
+        # reference order: (module, comm, optimizer) — data_parallel.py:52-57
+        dp = ht.nn.DataParallel(M(), ht.get_comm(), optax.sgd(0.1))
+        dp.init(np.zeros((1, 3), dtype=np.float32))
+        loss = dp.train_step(
+            lambda logits, y: ((logits - y) ** 2).mean(),
+            np.zeros((4, 3), dtype=np.float32),
+            np.zeros((4, 2), dtype=np.float32),
+        )
+        assert np.isfinite(loss)

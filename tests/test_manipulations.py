@@ -150,13 +150,19 @@ class TestManipulations(TestCase):
 
 class TestIndexing(TestCase):
     def test_nonzero(self):
+        # reference heat returns torch-style (n, ndim) coordinates
         x = np.array([[0, 1, 0], [2, 0, 3]], dtype=np.float32)
+        expected = np.stack(np.nonzero(x), axis=1)
         for split in (None, 0, 1):
-            res = ht.nonzero(ht.array(x, split=split))
-            expected = np.nonzero(x)
-            assert len(res) == 2
-            for r, e in zip(res, expected):
-                np.testing.assert_array_equal(r.numpy(), e)
+            a = ht.array(x, split=split)
+            res = ht.nonzero(a)
+            np.testing.assert_array_equal(res.numpy(), expected)
+            assert res.split == (0 if split is not None else None)
+            # coordinate-list indexing roundtrip: x[nonzero(x)] == nonzero values
+            np.testing.assert_array_equal(a[res].numpy(), x[np.nonzero(x)])
+        # 1-D input -> 1-D result (reference squeezes)
+        v = ht.array(np.array([1.0, 0.0, 2.0, 0.0]), split=0)
+        np.testing.assert_array_equal(ht.nonzero(v).numpy(), np.nonzero(v.numpy())[0])
 
     def test_where(self):
         x = np.array([[1.0, -1.0], [-2.0, 2.0]], dtype=np.float32)
