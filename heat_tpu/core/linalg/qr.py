@@ -42,6 +42,13 @@ def qr(
         raise TypeError(f"expected a DNDarray, got {type(a)}")
     if a.ndim != 2:
         raise ValueError(f"qr requires a 2-D array, got {a.ndim}-D")
+    # full f32 accumulation on the MXU: the reference's torch QR is exact
+    # f32; bf16 matmul passes would break the Q@R residual at ~1e-2.
+    with jax.default_matmul_precision("highest"):
+        return _qr_impl(a, calc_q)
+
+
+def _qr_impl(a: DNDarray, calc_q: bool) -> QR_out:
     ftype = jnp.promote_types(a.larray.dtype, jnp.float32)
     arr = a.larray.astype(ftype)
     m, n = arr.shape
@@ -57,7 +64,7 @@ def qr(
         # column-split: the reduced factors are column-blocked; gather and
         # factor once (reference ``__split1_qr_loop`` did a per-block loop).
         q, r = jnp.linalg.qr(arr)
-        Q = DNDarray(q, split=1 if n > m else 1, device=a.device, comm=comm) if calc_q else None
+        Q = DNDarray(q, split=1, device=a.device, comm=comm) if calc_q else None
         return QR_out(Q, DNDarray(r, split=1, device=a.device, comm=comm))
 
     # split == 0: TSQR

@@ -8,6 +8,7 @@ from __future__ import annotations
 
 from typing import Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 
 from .. import factories
@@ -28,6 +29,11 @@ def cg(A: DNDarray, b: DNDarray, x0: DNDarray, out: Optional[DNDarray] = None) -
     if x0.ndim != 1:
         raise RuntimeError("x0 needs to be a 1D vector")
 
+    with jax.default_matmul_precision("highest"):
+        return _cg_impl(A, b, x0, out)
+
+
+def _cg_impl(A, b, x0, out):
     r = b - matmul(A, x0)
     p = r.copy()
     rsold = matmul(r, r)
@@ -68,9 +74,14 @@ def lanczos(
         raise TypeError(f"A needs to be a DNDarray, got {type(A)}")
     if A.ndim != 2 or A.shape[0] != A.shape[1]:
         raise RuntimeError("A needs to be a square matrix")
-    n = A.shape[0]
     m = int(m)
 
+    with jax.default_matmul_precision("highest"):
+        return _lanczos_impl(A, m, v0, V_out, T_out)
+
+
+def _lanczos_impl(A, m, v0, V_out, T_out):
+    n = A.shape[0]
     arr = A.larray.astype(jnp.promote_types(A.larray.dtype, jnp.float32))
     if v0 is None:
         v = jnp.ones(n, dtype=arr.dtype) / jnp.sqrt(float(n))

@@ -11,6 +11,7 @@ from __future__ import annotations
 import collections
 from typing import Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 
 from ..dndarray import DNDarray
@@ -34,6 +35,11 @@ def svd(a: DNDarray, full_matrices: bool = False, compute_uv: bool = True):
         raise ValueError(f"svd requires a 2-D array, got {a.ndim}-D")
     if full_matrices and a.split is not None:
         raise NotImplementedError("full_matrices=True is not supported for split arrays")
+    with jax.default_matmul_precision("highest"):
+        return _svd_impl(a, full_matrices, compute_uv)
+
+
+def _svd_impl(a: DNDarray, full_matrices: bool, compute_uv: bool):
     m, n = a.shape
 
     if a.split == 0 and m >= n and a.comm.size > 1:
