@@ -43,6 +43,8 @@ __all__ = [
     "use_comm",
     "sanitize_comm",
     "SPLIT_AXIS",
+    "MPICommunication",
+    "CUDA_AWARE_MPI",
 ]
 
 # canonical mesh-axis name carrying the DNDarray ``split`` dimension
@@ -251,3 +253,9 @@ def comm_context(comm: MeshCommunication):
         yield comm
     finally:
         _default_comm = prev
+
+
+# name-parity aliases: the reference's MPI backend class (``communication.py:120``)
+# maps onto the mesh-collective backend here; there is no CUDA staging on TPU.
+MPICommunication = MeshCommunication
+CUDA_AWARE_MPI = False

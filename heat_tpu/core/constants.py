@@ -14,3 +14,10 @@ Inf = inf
 Infty = inf
 Infinity = inf
 NaN = nan
+
+# uppercase source names (reference ``constants.py:10-18``)
+PI = pi
+E = e
+INF = inf
+NINF = -inf
+NAN = nan

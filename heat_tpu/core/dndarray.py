@@ -504,10 +504,10 @@ class DNDarray:
         if not isinstance(key, tuple):
             key = (key,)
         key = tuple(k.larray if isinstance(k, DNDarray) else k for k in key)
-        # expand ellipsis
+        # expand ellipsis ("in"/.index would trip elementwise == on array keys)
         n_specified = sum(1 for k in key if k is not None and k is not Ellipsis)
-        if Ellipsis in key:
-            e = key.index(Ellipsis)
+        e = next((i for i, k in enumerate(key) if k is Ellipsis), None)
+        if e is not None:
             fill = (slice(None),) * (self.ndim - n_specified)
             key = key[:e] + fill + key[e + 1 :]
         if split is None:

@@ -19,7 +19,9 @@ __all__ = [
 
 # torch-like defaults (reference ``printing.py:14-28``)
 __PRINT_OPTIONS = dict(precision=4, threshold=1000, edgeitems=3, linewidth=120, sci_mode=None)
-__LOCAL_PRINTING = False
+
+# mode flag (reference ``printing.py:16``): True prints process-local shards
+LOCAL_PRINT = False
 
 
 def get_printoptions() -> dict:
@@ -44,14 +46,14 @@ def set_printoptions(precision=None, threshold=None, edgeitems=None, linewidth=N
 
 def local_printing() -> None:
     """Print only process-local data (reference ``printing.py:30``)."""
-    global __LOCAL_PRINTING
-    __LOCAL_PRINTING = True
+    global LOCAL_PRINT
+    LOCAL_PRINT = True
 
 
 def global_printing() -> None:
     """Print the full global array (default; reference ``printing.py:62``)."""
-    global __LOCAL_PRINTING
-    __LOCAL_PRINTING = False
+    global LOCAL_PRINT
+    LOCAL_PRINT = False
 
 
 def print0(*args, **kwargs) -> None:
@@ -63,9 +65,18 @@ def print0(*args, **kwargs) -> None:
 
 
 def __str__(dndarray) -> str:
-    """Format a DNDarray (reference ``printing.py:184``)."""
+    """Format a DNDarray (reference ``printing.py:184``); in local-print
+    mode only the process-addressable shard data is shown."""
     opts = __PRINT_OPTIONS
-    data = np.asarray(dndarray.numpy())
+    if LOCAL_PRINT:
+        shards = dndarray.larray.addressable_shards
+        split = dndarray.split
+        if split is not None and len(shards) > 1:
+            data = np.concatenate([np.asarray(s.data) for s in shards], axis=split)
+        else:
+            data = np.asarray(shards[0].data)
+    else:
+        data = np.asarray(dndarray.numpy())
     with np.printoptions(
         precision=opts["precision"],
         threshold=opts["threshold"] if np.isfinite(opts["threshold"]) else data.size + 1,

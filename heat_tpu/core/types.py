@@ -207,6 +207,8 @@ cfloat = complex64
 csingle = complex64
 cdouble = complex128
 float_ = float32
+int_ = int32
+complex = complexfloating
 
 _HEAT_TYPES = [
     bool,
@@ -270,6 +272,11 @@ def canonical_heat_type(a_type) -> Type[datatype]:
     (reference ``types.py:495``).
     """
     if isinstance(a_type, type) and issubclass(a_type, datatype):
+        if getattr(a_type, "_jax_type", None) is None:
+            raise TypeError(
+                f"abstract heat type {a_type.__name__!r} cannot be used as a "
+                "concrete dtype (pick e.g. float32/complex64)"
+            )
         return a_type
     try:
         if a_type in _EXTRA_CANONICAL:

@@ -6,16 +6,21 @@ attributes to ``flax.linen`` (so ``ht.nn.Dense``, ``ht.nn.Conv``, ... are
 flax modules) and provides :class:`DataParallel` for mesh data
 parallelism.
 """
-from . import functional, lr_scheduler, vision_transforms
+from . import compat, functional, lr_scheduler, vision_transforms
 from .data_parallel import DataParallel, DataParallelMultiGPU
 
 import flax.linen as _linen
 
-__all__ = ["DataParallel", "DataParallelMultiGPU", "functional", "lr_scheduler", "vision_transforms"]
+__all__ = ["DataParallel", "DataParallelMultiGPU", "compat", "functional", "lr_scheduler", "vision_transforms"]
 
 
 def __getattr__(name):
+    # flax names win (this package is flax-first); compat fills in the
+    # torch-only layer names (Linear, Conv2d, ReLU, ...) for migrating users
     try:
         return getattr(_linen, name)
     except AttributeError:
-        raise AttributeError(f"module {__name__} has no attribute {name}")
+        pass
+    if name in compat.__all__:
+        return getattr(compat, name)
+    raise AttributeError(f"module {__name__} has no attribute {name}")

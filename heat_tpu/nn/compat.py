@@ -1,0 +1,208 @@
+"""Torch-style layer names over flax/jax (migration aid).
+
+The reference exposes ``torch.nn.*`` wholesale via ``__getattr__``
+passthrough (``heat/nn/functional.py:9``, ``heat/nn/__init__.py``). The
+TPU-native build is flax-first (``ht.nn.Dense``, ``ht.nn.Conv``...), but
+reference users arrive speaking torch names — this module provides the
+common ones as thin flax modules with torch-flavoured constructor
+signatures. Channel layout follows the JAX convention (NHWC), not torch's
+NCHW; data pipelines feeding these layers should produce channels-last.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple, Union
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "Linear",
+    "Conv1d",
+    "Conv2d",
+    "ReLU",
+    "GELU",
+    "Sigmoid",
+    "Tanh",
+    "Softmax",
+    "LogSoftmax",
+    "Flatten",
+    "Dropout",
+    "MaxPool2d",
+    "AvgPool2d",
+    "BatchNorm1d",
+    "BatchNorm2d",
+    "LayerNorm",
+    "Embedding",
+    "MSELoss",
+    "L1Loss",
+    "CrossEntropyLoss",
+    "NLLLoss",
+]
+
+
+def _pair(v) -> Tuple[int, int]:
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def Linear(in_features: Optional[int] = None, out_features: int = None, bias: bool = True) -> nn.Dense:
+    """torch.nn.Linear(in, out) -> flax Dense(features=out); the input width
+    is inferred at first call, so ``in_features`` is accepted and unused."""
+    if out_features is None:  # single-arg call Linear(out)
+        out_features, in_features = in_features, None
+    return nn.Dense(features=int(out_features), use_bias=bias)
+
+
+def Conv1d(in_channels=None, out_channels=None, kernel_size=3, stride=1, padding=0, bias=True) -> nn.Conv:
+    return nn.Conv(
+        features=int(out_channels),
+        kernel_size=(int(kernel_size),) if isinstance(kernel_size, int) else tuple(kernel_size),
+        strides=(int(stride),) if isinstance(stride, int) else tuple(stride),
+        padding=[(padding, padding)] if isinstance(padding, int) else padding,
+        use_bias=bias,
+    )
+
+
+def Conv2d(in_channels=None, out_channels=None, kernel_size=3, stride=1, padding=0, bias=True) -> nn.Conv:
+    return nn.Conv(
+        features=int(out_channels),
+        kernel_size=_pair(kernel_size),
+        strides=_pair(stride),
+        padding=[(p, p) for p in _pair(padding)] if isinstance(padding, (int, tuple, list)) else padding,
+        use_bias=bias,
+    )
+
+
+class _Activation(nn.Module):
+    """Stateless activation as a module (torch has class forms; jax.nn has
+    functions — flax ``Sequential`` accepts either, tests may want both)."""
+
+    fn: Callable = jax.nn.relu
+
+    @nn.compact
+    def __call__(self, x):
+        return self.fn(x)
+
+
+def ReLU(inplace: bool = False) -> _Activation:
+    return _Activation(fn=jax.nn.relu)
+
+
+def GELU() -> _Activation:
+    return _Activation(fn=jax.nn.gelu)
+
+
+def Sigmoid() -> _Activation:
+    return _Activation(fn=jax.nn.sigmoid)
+
+
+def Tanh() -> _Activation:
+    return _Activation(fn=jnp.tanh)
+
+
+def Softmax(dim: int = -1) -> _Activation:
+    return _Activation(fn=lambda x: jax.nn.softmax(x, axis=dim))
+
+
+def LogSoftmax(dim: int = -1) -> _Activation:
+    return _Activation(fn=lambda x: jax.nn.log_softmax(x, axis=dim))
+
+
+class Flatten(nn.Module):
+    """torch.nn.Flatten: collapse all but the leading (batch) dimension."""
+
+    @nn.compact
+    def __call__(self, x):
+        return x.reshape(x.shape[0], -1)
+
+
+def Dropout(p: float = 0.5) -> nn.Dropout:
+    # deterministic is left to apply-time (pass deterministic=... or an
+    # rngs={'dropout': key}), matching flax convention
+    return nn.Dropout(rate=p)
+
+
+class MaxPool2d(nn.Module):
+    window: Union[int, Tuple[int, int]] = 2
+    stride: Optional[Union[int, Tuple[int, int]]] = None
+
+    @nn.compact
+    def __call__(self, x):
+        w = _pair(self.window)
+        s = _pair(self.stride) if self.stride is not None else w
+        return nn.max_pool(x, window_shape=w, strides=s)
+
+
+class AvgPool2d(nn.Module):
+    window: Union[int, Tuple[int, int]] = 2
+    stride: Optional[Union[int, Tuple[int, int]]] = None
+
+    @nn.compact
+    def __call__(self, x):
+        w = _pair(self.window)
+        s = _pair(self.stride) if self.stride is not None else w
+        return nn.avg_pool(x, window_shape=w, strides=s)
+
+
+def BatchNorm1d(num_features=None, momentum: float = 0.1, eps: float = 1e-5) -> nn.BatchNorm:
+    # flax momentum is the decay of the running average: torch 0.1 -> 0.9;
+    # train/eval selection happens at apply-time via use_running_average
+    return nn.BatchNorm(use_running_average=None, momentum=1.0 - momentum, epsilon=eps)
+
+
+BatchNorm2d = BatchNorm1d
+
+
+def LayerNorm(normalized_shape=None, eps: float = 1e-5) -> nn.LayerNorm:
+    return nn.LayerNorm(epsilon=eps)
+
+
+def Embedding(num_embeddings: int, embedding_dim: int) -> nn.Embed:
+    return nn.Embed(num_embeddings=int(num_embeddings), features=int(embedding_dim))
+
+
+class _Loss:
+    """Callable loss with torch-style reduction."""
+
+    def __init__(self, reduction: str = "mean"):
+        self.reduction = reduction
+
+    def _reduce(self, v):
+        if self.reduction == "mean":
+            return jnp.mean(v)
+        if self.reduction == "sum":
+            return jnp.sum(v)
+        return v
+
+    def __call__(self, pred, target):
+        return self._reduce(self._elementwise(_as_jax(pred), _as_jax(target)))
+
+
+def _as_jax(x):
+    larray = getattr(x, "larray", None)
+    return larray if larray is not None else jnp.asarray(x)
+
+
+class MSELoss(_Loss):
+    def _elementwise(self, pred, target):
+        return (pred - target) ** 2
+
+
+class L1Loss(_Loss):
+    def _elementwise(self, pred, target):
+        return jnp.abs(pred - target)
+
+
+class CrossEntropyLoss(_Loss):
+    """Logits + integer class targets (torch semantics)."""
+
+    def _elementwise(self, logits, target):
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.take_along_axis(logp, target.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+
+
+class NLLLoss(_Loss):
+    """Log-probability inputs + integer class targets."""
+
+    def _elementwise(self, logp, target):
+        return -jnp.take_along_axis(logp, target.astype(jnp.int32)[..., None], axis=-1)[..., 0]

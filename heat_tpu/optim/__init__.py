@@ -5,12 +5,13 @@ Unknown attributes forward to optax (``ht.optim.sgd``, ``ht.optim.adam``,
 DataParallelOptimizer are the distributed wrappers.
 """
 from . import utils
+from ..nn import lr_scheduler
 from .dp_optimizer import DASO, DataParallelOptimizer
 from .utils import DetectMetricPlateau
 
 import optax as _optax
 
-__all__ = ["DASO", "DataParallelOptimizer", "DetectMetricPlateau", "utils"]
+__all__ = ["DASO", "DataParallelOptimizer", "DetectMetricPlateau", "lr_scheduler", "utils"]
 
 _ALIASES = {"SGD": "sgd", "Adam": "adam", "AdamW": "adamw", "Adagrad": "adagrad", "RMSprop": "rmsprop"}
 

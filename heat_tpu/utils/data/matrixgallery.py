@@ -13,11 +13,12 @@ __all__ = ["parter", "hermitian"]
 
 
 def parter(n: int, split: Optional[int] = None, device=None, comm=None, dtype=types.float32) -> DNDarray:
-    """Parter matrix A[i,j] = 1 / (i - j + 0.5) (reference
-    ``matrixgallery.py:15``)."""
+    """Parter matrix A[i,j] = 1 / (j - i + 0.5) (reference
+    ``matrixgallery.py:15`` builds ``1/(II - JJ + 0.5)`` with II varying
+    along columns)."""
     dtype = types.canonical_heat_type(dtype)
     i = jnp.arange(n, dtype=dtype.jax_type())
-    a = 1.0 / (i[:, None] - i[None, :] + 0.5)
+    a = 1.0 / (i[None, :] - i[:, None] + 0.5)
     return DNDarray(a, dtype=dtype, split=split, device=device, comm=sanitize_comm(comm))
 
 

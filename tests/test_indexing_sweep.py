@@ -1,0 +1,112 @@
+"""Indexing/manipulation split-sweep oracle tests.
+
+Every case runs for split in (None, 0, 1) and compares against the plain
+numpy result — the reference's `assert_func_equal` strategy
+(``basic_test.py:142-306``). Includes regressions for advanced-index keys
+(numpy arrays used to trip elementwise `Ellipsis in key` checks in
+``DNDarray.__translate_key``).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import heat_tpu as ht
+
+from .base import TestCase
+
+SPLITS = (None, 0, 1)
+
+
+class TestGetitemSweep(TestCase):
+    def setUp(self):
+        self.x = np.random.default_rng(3).integers(0, 50, (16, 12)).astype(np.float32)
+
+    def _each(self):
+        for split in SPLITS:
+            yield split, ht.array(self.x, split=split)
+
+    def test_basic_and_strided(self):
+        for split, a in self._each():
+            np.testing.assert_allclose(float(a[3, 4]), self.x[3, 4])
+            np.testing.assert_allclose(a[5].numpy(), self.x[5])
+            np.testing.assert_allclose(a[2:9].numpy(), self.x[2:9])
+            np.testing.assert_allclose(a[::3, 1:7:2].numpy(), self.x[::3, 1:7:2])
+            np.testing.assert_allclose(a[-1].numpy(), self.x[-1])
+
+    def test_advanced_array_key(self):
+        idx = np.array([0, 5, 2])
+        for split, a in self._each():
+            np.testing.assert_allclose(a[idx].numpy(), self.x[idx])
+            np.testing.assert_allclose(a[ht.array(idx)].numpy(), self.x[idx])
+            # array key with ellipsis elsewhere in the tuple
+            np.testing.assert_allclose(a[idx, ...].numpy(), self.x[idx, ...])
+
+    def test_boolean_mask(self):
+        for split, a in self._each():
+            np.testing.assert_allclose(a[a > 25].numpy(), self.x[self.x > 25])
+
+    def test_ellipsis(self):
+        for split, a in self._each():
+            np.testing.assert_allclose(a[..., 2].numpy(), self.x[..., 2])
+            np.testing.assert_allclose(a[1, ...].numpy(), self.x[1, ...])
+
+    def test_split_metadata(self):
+        a = ht.array(self.x, split=0)
+        self.assertEqual(a[2:9].split, 0)
+        self.assertIsNone(a[3].split)  # scalar on split axis -> replicated
+        b = ht.array(self.x, split=1)
+        self.assertEqual(b[3].split, 0)  # split shifts down past removed dim
+
+    def test_setitem_sweep(self):
+        for split in SPLITS:
+            b = ht.array(self.x.copy(), split=split)
+            y = self.x.copy()
+            b[3] = 0.0
+            y[3] = 0
+            b[1:5, 2] = 7.0
+            y[1:5, 2] = 7
+            b[:, -1] = ht.arange(16, dtype=ht.float32)
+            y[:, -1] = np.arange(16)
+            np.testing.assert_allclose(b.numpy(), y)
+            self.assertEqual(b.split, split)
+
+
+class TestManipulationSweep(TestCase):
+    def setUp(self):
+        self.x = np.random.default_rng(3).integers(0, 50, (16, 12)).astype(np.float32)
+
+    def test_sort_unique_topk(self):
+        x = self.x
+        for split in SPLITS:
+            a = ht.array(x, split=split)
+            v, i = ht.sort(a, axis=0)
+            np.testing.assert_allclose(v.numpy(), np.sort(x, axis=0))
+            v, i = ht.sort(a, axis=1, descending=True)
+            np.testing.assert_allclose(v.numpy(), -np.sort(-x, axis=1))
+            u, inv = ht.unique(a, return_inverse=True)
+            np.testing.assert_allclose(
+                u.numpy().ravel()[inv.numpy().ravel()].reshape(x.shape), x
+            )
+            tv, ti = ht.topk(a, 3, dim=1)
+            np.testing.assert_allclose(tv.numpy(), -np.sort(-x, axis=1)[:, :3])
+
+    def test_reshape_new_split(self):
+        x = self.x
+        for split in SPLITS:
+            a = ht.array(x, split=split)
+            r = ht.reshape(a, (12, 16), new_split=1)
+            np.testing.assert_allclose(r.numpy(), x.reshape(12, 16))
+            self.assertEqual(r.split, 1)
+
+    def test_roll_pad_flip_concat(self):
+        x = self.x
+        for split in SPLITS:
+            a = ht.array(x, split=split)
+            np.testing.assert_allclose(ht.roll(a, 5, axis=0).numpy(), np.roll(x, 5, axis=0))
+            np.testing.assert_allclose(
+                ht.pad(a, ((1, 2), (0, 3))).numpy(), np.pad(x, ((1, 2), (0, 3)))
+            )
+            np.testing.assert_allclose(ht.flip(a, 0).numpy(), np.flip(x, 0))
+            np.testing.assert_allclose(
+                ht.concatenate([a, a], axis=0).numpy(), np.concatenate([x, x], 0)
+            )

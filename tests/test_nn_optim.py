@@ -312,3 +312,42 @@ class TestDataToolRegressions(TestCase):
         # split=0: every process sees all column tiles
         assert tiles.tile_columns_per_process == [tiles.tile_columns] * size
         assert sum(tiles.tile_rows_per_process) == tiles.tile_rows
+
+
+class TestTorchCompatLayers(TestCase):
+    """Torch-name layer shims over flax (``heat_tpu/nn/compat.py``)."""
+
+    def test_mlp_forward_and_losses(self):
+        import jax
+        import jax.numpy as jnp
+
+        nn = ht.nn
+        model = nn.Sequential(
+            [nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 3), nn.LogSoftmax(dim=-1)]
+        )
+        x = jnp.ones((8, 4))
+        params = model.init(jax.random.PRNGKey(0), x)
+        out = model.apply(params, x)
+        self.assertEqual(out.shape, (8, 3))
+        tgt = jnp.zeros(8, dtype=jnp.int32)
+        ce = float(nn.CrossEntropyLoss()(out, tgt))
+        nll = float(nn.NLLLoss()(out, tgt))
+        self.assertGreater(ce, 0.0)
+        self.assertAlmostEqual(float(nn.MSELoss()(jnp.ones(4), jnp.zeros(4))), 1.0)
+        self.assertAlmostEqual(float(nn.L1Loss()(jnp.full(4, -2.0), jnp.zeros(4))), 2.0)
+
+    def test_conv_pool_pipeline(self):
+        import jax
+        import jax.numpy as jnp
+
+        nn = ht.nn
+        model = nn.Sequential(
+            [nn.Conv2d(1, 4, 3, padding=1), nn.ReLU(), nn.MaxPool2d(2), nn.Flatten(), nn.Linear(None, 10)]
+        )
+        x = jnp.ones((2, 8, 8, 1))
+        params = model.init(jax.random.PRNGKey(1), x)
+        self.assertEqual(model.apply(params, x).shape, (2, 10))
+
+    def test_optim_lr_scheduler_namespace(self):
+        sched = ht.optim.lr_scheduler.CosineAnnealingLR(init_value=0.1, decay_steps=10)
+        self.assertLess(float(sched(10)), float(sched(0)))

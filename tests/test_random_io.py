@@ -127,7 +127,9 @@ class TestIO(TestCase):
 class TestMatrixGallery(TestCase):
     def test_parter(self):
         p = ht.utils.data.matrixgallery.parter(8)
-        expected = 1.0 / (np.arange(8)[:, None] - np.arange(8)[None, :] + 0.5)
+        # reference orientation (matrixgallery.py:49-61): II varies along
+        # columns, so A[i, j] = 1 / (j - i + 0.5)
+        expected = 1.0 / (np.arange(8)[None, :] - np.arange(8)[:, None] + 0.5)
         np.testing.assert_allclose(p.numpy(), expected, rtol=1e-6)
 
     def test_hermitian(self):
