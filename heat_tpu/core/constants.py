@@ -1,7 +1,22 @@
 """Mathematical constants (reference ``heat/core/constants.py``)."""
 import numpy as np
 
-__all__ = ["e", "Euler", "inf", "Inf", "Infty", "Infinity", "nan", "NaN", "pi"]
+__all__ = [
+    "e",
+    "Euler",
+    "inf",
+    "Inf",
+    "Infty",
+    "Infinity",
+    "nan",
+    "NaN",
+    "pi",
+    "PI",
+    "E",
+    "INF",
+    "NINF",
+    "NAN",
+]
 
 e = float(np.e)
 pi = float(np.pi)

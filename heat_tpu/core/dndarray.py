@@ -504,8 +504,17 @@ class DNDarray:
         if not isinstance(key, tuple):
             key = (key,)
         key = tuple(k.larray if isinstance(k, DNDarray) else k for k in key)
-        # expand ellipsis ("in"/.index would trip elementwise == on array keys)
-        n_specified = sum(1 for k in key if k is not None and k is not Ellipsis)
+        # expand ellipsis ("in"/.index would trip elementwise == on array keys);
+        # a multi-dim boolean mask consumes mask.ndim input dims
+        def _consumed(k):
+            if k is None or k is Ellipsis:
+                return 0
+            a = np.asarray(k) if not isinstance(k, (jax.Array, np.ndarray, slice, int, np.integer)) else k
+            if isinstance(a, (jax.Array, np.ndarray)) and a.dtype == np.bool_:
+                return a.ndim
+            return 1
+
+        n_specified = sum(_consumed(k) for k in key)
         e = next((i for i, k in enumerate(key) if k is Ellipsis), None)
         if e is not None:
             fill = (slice(None),) * (self.ndim - n_specified)

@@ -71,10 +71,16 @@ def __str__(dndarray) -> str:
     if LOCAL_PRINT:
         shards = dndarray.larray.addressable_shards
         split = dndarray.split
-        if split is not None and len(shards) > 1:
-            data = np.concatenate([np.asarray(s.data) for s in shards], axis=split)
+        # on a multi-axis mesh each unique shard appears once per replica
+        # and device order need not follow index order: keep one shard per
+        # distinct index, ordered by position along the split axis
+        unique = {s.index: s for s in shards}
+        ordered = [unique[idx] for idx in sorted(unique, key=lambda i: tuple(
+            (sl.start or 0) if isinstance(sl, slice) else sl for sl in i))]
+        if split is not None and len(ordered) > 1:
+            data = np.concatenate([np.asarray(s.data) for s in ordered], axis=split)
         else:
-            data = np.asarray(shards[0].data)
+            data = np.asarray(ordered[0].data)
     else:
         data = np.asarray(dndarray.numpy())
     with np.printoptions(

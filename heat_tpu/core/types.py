@@ -47,6 +47,8 @@ __all__ = [
     "cfloat",
     "csingle",
     "float_",
+    "int_",
+    "complex",
     "complex128",
     "cdouble",
     "canonical_heat_type",
@@ -208,6 +210,8 @@ csingle = complex64
 cdouble = complex128
 float_ = float32
 int_ = int32
+# reference ``types.py:367``: ``complex`` is the abstract class; as a dtype
+# argument it canonicalizes to complex64, same as the python builtin
 complex = complexfloating
 
 _HEAT_TYPES = [
@@ -234,6 +238,7 @@ _EXTRA_CANONICAL = {
     builtins.int: int64,
     builtins.float: float32,
     builtins.complex: complex64,
+    complexfloating: complex64,
     "bool": bool,
     "b1": bool,
     "uint8": uint8,
@@ -273,6 +278,8 @@ def canonical_heat_type(a_type) -> Type[datatype]:
     """
     if isinstance(a_type, type) and issubclass(a_type, datatype):
         if getattr(a_type, "_jax_type", None) is None:
+            if a_type in _EXTRA_CANONICAL:
+                return _EXTRA_CANONICAL[a_type]
             raise TypeError(
                 f"abstract heat type {a_type.__name__!r} cannot be used as a "
                 "concrete dtype (pick e.g. float32/complex64)"

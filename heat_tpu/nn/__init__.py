@@ -15,12 +15,13 @@ __all__ = ["DataParallel", "DataParallelMultiGPU", "compat", "functional", "lr_s
 
 
 def __getattr__(name):
-    # flax names win (this package is flax-first); compat fills in the
-    # torch-only layer names (Linear, Conv2d, ReLU, ...) for migrating users
+    # compat wins for every name it defines: where both exist (LayerNorm,
+    # Dropout) the compat shim keeps torch calling conventions —
+    # flax.linen.LayerNorm(512) would silently read 512 as epsilon.
+    if name in compat.__all__:
+        return getattr(compat, name)
     try:
         return getattr(_linen, name)
     except AttributeError:
         pass
-    if name in compat.__all__:
-        return getattr(compat, name)
     raise AttributeError(f"module {__name__} has no attribute {name}")

@@ -116,10 +116,13 @@ class Flatten(nn.Module):
         return x.reshape(x.shape[0], -1)
 
 
-def Dropout(p: float = 0.5) -> nn.Dropout:
-    # deterministic is left to apply-time (pass deterministic=... or an
-    # rngs={'dropout': key}), matching flax convention
-    return nn.Dropout(rate=p)
+def Dropout(p: float = 0.5, inplace: bool = False, **flax_kwargs) -> nn.Dropout:
+    # accepts both conventions: torch Dropout(p=...) and flax
+    # Dropout(rate=..., deterministic=..., ...); deterministic is left to
+    # apply-time unless passed explicitly
+    if "rate" in flax_kwargs:
+        return nn.Dropout(**flax_kwargs)
+    return nn.Dropout(rate=p, **flax_kwargs)
 
 
 class MaxPool2d(nn.Module):
@@ -153,8 +156,15 @@ def BatchNorm1d(num_features=None, momentum: float = 0.1, eps: float = 1e-5) -> 
 BatchNorm2d = BatchNorm1d
 
 
-def LayerNorm(normalized_shape=None, eps: float = 1e-5) -> nn.LayerNorm:
-    return nn.LayerNorm(epsilon=eps)
+def LayerNorm(
+    normalized_shape=None, eps: float = 1e-5, elementwise_affine: bool = True, **flax_kwargs
+) -> nn.LayerNorm:
+    # accepts both conventions: torch LayerNorm(normalized_shape, eps=...)
+    # (flax infers the normalized axis, so the shape is unused) and flax
+    # LayerNorm(epsilon=..., use_scale=..., ...)
+    if flax_kwargs:
+        return nn.LayerNorm(**flax_kwargs)
+    return nn.LayerNorm(epsilon=eps, use_bias=elementwise_affine, use_scale=elementwise_affine)
 
 
 def Embedding(num_embeddings: int, embedding_dim: int) -> nn.Embed:
