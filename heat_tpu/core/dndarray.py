@@ -504,11 +504,15 @@ class DNDarray:
         if not isinstance(key, tuple):
             key = (key,)
         key = tuple(k.larray if isinstance(k, DNDarray) else k for k in key)
+        # jnp accepts builtin-bool scalar keys but asserts on np.bool_ ones
+        key = tuple(bool(k) if isinstance(k, np.bool_) else k for k in key)
         # expand ellipsis ("in"/.index would trip elementwise == on array keys);
         # a multi-dim boolean mask consumes mask.ndim input dims
         def _consumed(k):
             if k is None or k is Ellipsis:
                 return 0
+            if isinstance(k, (bool, np.bool_)):
+                return 0  # scalar bool adds an axis, consumes no input dim
             a = np.asarray(k) if not isinstance(k, (jax.Array, np.ndarray, slice, int, np.integer)) else k
             if isinstance(a, (jax.Array, np.ndarray)) and a.dtype == np.bool_:
                 return a.ndim
@@ -530,13 +534,16 @@ class DNDarray:
             if k is None:
                 out_dim += 1
                 continue
+            if isinstance(k, (bool, np.bool_)):
+                out_dim += 1  # scalar bool adds an axis, consumes none
+                continue
             if in_dim == split:
                 if isinstance(k, slice):
                     out_split = out_dim
                 elif isinstance(k, (int, np.integer)):
                     out_split = None  # scalar on split axis -> replicated bcast
                 else:
-                    out_split = 0 if not bool_or_adv_seen else 0  # advanced -> split 0
+                    out_split = 0  # advanced index on split axis -> split 0
                 in_dim += 1
                 out_dim += 1 if not isinstance(k, (int, np.integer)) else 0
                 continue

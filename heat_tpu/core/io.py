@@ -177,9 +177,19 @@ def load_csv(
     if not isinstance(header_lines, int):
         raise TypeError(f"header_lines must be int, not {type(header_lines)}")
     dtype = types.canonical_heat_type(dtype)
-    data = np.genfromtxt(
-        path, delimiter=sep, skip_header=header_lines, dtype=np.dtype(dtype.jax_type()), encoding=encoding
-    )
+    data = None
+    if encoding in ("utf-8", "ascii", "latin-1") and len(sep) == 1:
+        from .. import native
+
+        data = native.csv_parse(path, header_lines, sep, np.dtype(dtype.jax_type()))
+    if data is None:
+        # reference semantics (io.py:800-806): every field parsed with
+        # float(), rows of fields -> always 2-D, then cast to the requested
+        # dtype. loadtxt(ndmin=2) matches that exactly (genfromtxt would
+        # collapse single-column files to 1-D and parse ints directly).
+        data = np.loadtxt(
+            path, delimiter=sep, skiprows=header_lines, dtype=np.float64, encoding=encoding, ndmin=2
+        ).astype(np.dtype(dtype.jax_type()))
     return DNDarray(jnp.asarray(data), dtype=dtype, split=split, device=device, comm=comm)
 
 

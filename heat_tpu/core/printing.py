@@ -74,9 +74,15 @@ def __str__(dndarray) -> str:
         # on a multi-axis mesh each unique shard appears once per replica
         # and device order need not follow index order: keep one shard per
         # distinct index, ordered by position along the split axis
-        unique = {s.index: s for s in shards}
-        ordered = [unique[idx] for idx in sorted(unique, key=lambda i: tuple(
-            (sl.start or 0) if isinstance(sl, slice) else sl for sl in i))]
+        def _index_key(index):
+            # slices are unhashable before Python 3.12: normalize to tuples
+            return tuple(
+                (sl.start or 0, sl.stop) if isinstance(sl, slice) else (sl, sl)
+                for sl in index
+            )
+
+        unique = {_index_key(s.index): s for s in shards}
+        ordered = [unique[k] for k in sorted(unique)]
         if split is not None and len(ordered) > 1:
             data = np.concatenate([np.asarray(s.data) for s in ordered], axis=split)
         else:

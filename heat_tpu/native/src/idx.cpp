@@ -1,0 +1,113 @@
+// Native IDX (MNIST) binary reader for heat_tpu.
+//
+// The reference loads MNIST through torchvision's Python IDX reader
+// (reference heat/utils/data/mnist.py:16 builds on
+// torchvision.datasets.MNIST).  This native reader parses the IDX
+// header (magic: two zero bytes, a dtype code, and ndims, followed by
+// big-endian uint32 dims) and bulk-copies the payload, byte-swapping
+// multi-byte types to little-endian host order.
+//
+// dtype codes (IDX spec): 0x08 u8, 0x09 i8, 0x0B i16, 0x0C i32,
+// 0x0D f32, 0x0E f64.
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+namespace {
+
+int type_size(int32_t code) {
+  switch (code) {
+    case 0x08:
+    case 0x09:
+      return 1;
+    case 0x0B:
+      return 2;
+    case 0x0C:
+    case 0x0D:
+      return 4;
+    case 0x0E:
+      return 8;
+    default:
+      return 0;
+  }
+}
+
+uint32_t be32(const unsigned char *p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+
+void byteswap(void *buf, int64_t count, int width) {
+  unsigned char *p = static_cast<unsigned char *>(buf);
+  for (int64_t i = 0; i < count; ++i, p += width)
+    for (int j = 0; j < width / 2; ++j) {
+      unsigned char t = p[j];
+      p[j] = p[width - 1 - j];
+      p[width - 1 - j] = t;
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Fills dims[0..7], *ndims, *dtype_code. Returns 0 or negative error.
+int64_t ht_idx_header(const char *path, int64_t *dims, int64_t *ndims,
+                      int32_t *dtype_code) {
+  if (!path || !dims || !ndims || !dtype_code) return -4;
+  FILE *f = fopen(path, "rb");
+  if (!f) return -1;
+  unsigned char hdr[4];
+  if (fread(hdr, 1, 4, f) != 4 || hdr[0] != 0 || hdr[1] != 0) {
+    fclose(f);
+    return -2;
+  }
+  int32_t code = hdr[2];
+  int nd = hdr[3];
+  if (type_size(code) == 0 || nd <= 0 || nd > 8) {
+    fclose(f);
+    return -2;
+  }
+  for (int i = 0; i < nd; ++i) {
+    unsigned char d[4];
+    if (fread(d, 1, 4, f) != 4) {
+      fclose(f);
+      return -2;
+    }
+    dims[i] = be32(d);
+  }
+  *ndims = nd;
+  *dtype_code = code;
+  fclose(f);
+  return 0;
+}
+
+// Reads the payload into out (host little-endian order). out_bytes must
+// equal prod(dims) * type_size. Returns 0 or negative error.
+int64_t ht_idx_read(const char *path, void *out, int64_t out_bytes) {
+  if (!path || !out || out_bytes < 0) return -4;
+  int64_t dims[8];
+  int64_t nd;
+  int32_t code;
+  int64_t rc = ht_idx_header(path, dims, &nd, &code);
+  if (rc != 0) return rc;
+  int width = type_size(code);
+  int64_t count = 1;
+  for (int64_t i = 0; i < nd; ++i) count *= dims[i];
+  if (count * width != out_bytes) return -3;
+  FILE *f = fopen(path, "rb");
+  if (!f) return -1;
+  if (fseek(f, 4 + 4 * static_cast<long>(nd), SEEK_SET) != 0) {
+    fclose(f);
+    return -1;
+  }
+  if (static_cast<int64_t>(fread(out, 1, out_bytes, f)) != out_bytes) {
+    fclose(f);
+    return -2;
+  }
+  fclose(f);
+  if (width > 1) byteswap(out, count, width);
+  return 0;
+}
+
+}  // extern "C"

@@ -159,12 +159,16 @@ BatchNorm2d = BatchNorm1d
 def LayerNorm(
     normalized_shape=None, eps: float = 1e-5, elementwise_affine: bool = True, **flax_kwargs
 ) -> nn.LayerNorm:
-    # accepts both conventions: torch LayerNorm(normalized_shape, eps=...)
-    # (flax infers the normalized axis, so the shape is unused) and flax
-    # LayerNorm(epsilon=..., use_scale=..., ...)
-    if flax_kwargs:
-        return nn.LayerNorm(**flax_kwargs)
-    return nn.LayerNorm(epsilon=eps, use_bias=elementwise_affine, use_scale=elementwise_affine)
+    # accepts both conventions: torch LayerNorm(normalized_shape, eps=...,
+    # bias=...) — flax infers the normalized axis, so the shape is unused —
+    # and flax LayerNorm(epsilon=..., use_scale=..., ...). Explicit torch
+    # args are merged with (not discarded by) extra flax kwargs.
+    if "bias" in flax_kwargs:  # torch spelling
+        flax_kwargs["use_bias"] = bool(flax_kwargs.pop("bias"))
+    flax_kwargs.setdefault("epsilon", eps)
+    flax_kwargs.setdefault("use_bias", elementwise_affine)
+    flax_kwargs.setdefault("use_scale", elementwise_affine)
+    return nn.LayerNorm(**flax_kwargs)
 
 
 def Embedding(num_embeddings: int, embedding_dim: int) -> nn.Embed:

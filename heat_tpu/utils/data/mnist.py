@@ -21,6 +21,12 @@ __all__ = ["MNISTDataset"]
 
 
 def _read_idx(path: str) -> np.ndarray:
+    if not path.endswith(".gz"):
+        from ... import native
+
+        arr = native.idx_read(path)
+        if arr is not None:
+            return arr
     opener = gzip.open if path.endswith(".gz") else open
     with opener(path, "rb") as f:
         zero, dtype_code, ndim = struct.unpack(">HBB", f.read(4))

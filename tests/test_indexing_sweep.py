@@ -110,3 +110,12 @@ class TestManipulationSweep(TestCase):
             np.testing.assert_allclose(
                 ht.concatenate([a, a], axis=0).numpy(), np.concatenate([x, x], 0)
             )
+
+
+class TestScalarBoolKey(TestCase):
+    def test_scalar_bool_adds_axis_with_ellipsis(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        for split in (None, 0, 1):
+            a = ht.array(x, split=split)
+            np.testing.assert_array_equal(a[True, ...].numpy(), x[True, ...])
+            np.testing.assert_array_equal(a[np.True_, ...].numpy(), x[np.True_, ...])

@@ -351,3 +351,25 @@ class TestTorchCompatLayers(TestCase):
     def test_optim_lr_scheduler_namespace(self):
         sched = ht.optim.lr_scheduler.CosineAnnealingLR(init_value=0.1, decay_steps=10)
         self.assertLess(float(sched(10)), float(sched(0)))
+
+
+class TestLayerNormCompat(TestCase):
+    def test_torch_default_epsilon_pinned(self):
+        # reference ht.nn.LayerNorm IS torch.nn.LayerNorm (nn/__init__.py
+        # passthrough): torch's default eps is 1e-5, not flax's 1e-6
+        ln = ht.nn.LayerNorm(16)
+        assert ln.epsilon == 1e-5
+        assert ln.use_bias and ln.use_scale
+
+    def test_explicit_args_survive_extra_flax_kwargs(self):
+        ln = ht.nn.LayerNorm(16, eps=1e-3, use_fast_variance=False)
+        assert ln.epsilon == 1e-3
+        assert ln.use_fast_variance is False
+
+    def test_torch_bias_kwarg_maps_to_use_bias(self):
+        ln = ht.nn.LayerNorm(16, bias=False)
+        assert ln.use_bias is False and ln.use_scale is True
+
+    def test_elementwise_affine_false(self):
+        ln = ht.nn.LayerNorm(16, elementwise_affine=False)
+        assert ln.use_bias is False and ln.use_scale is False
