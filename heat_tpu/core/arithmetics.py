@@ -24,6 +24,7 @@ __all__ = [
     "bitwise_xor",
     "cumprod",
     "cumproduct",
+    "copysign",
     "cumsum",
     "diff",
     "div",
@@ -31,6 +32,7 @@ __all__ = [
     "floordiv",
     "floor_divide",
     "fmod",
+    "hypot",
     "invert",
     "left_shift",
     "mod",
@@ -94,6 +96,16 @@ floor_divide = floordiv
 def fmod(t1, t2) -> DNDarray:
     """Elementwise C-style remainder (sign of the dividend)."""
     return _binary_op(jnp.fmod, t1, t2)
+
+
+def hypot(t1, t2) -> DNDarray:
+    """Elementwise ``sqrt(t1**2 + t2**2)`` (numpy extra beyond the reference)."""
+    return _binary_op(jnp.hypot, t1, t2)
+
+
+def copysign(t1, t2) -> DNDarray:
+    """Magnitude of ``t1`` with the sign of ``t2`` (numpy extra beyond the reference)."""
+    return _binary_op(jnp.copysign, t1, t2)
 
 
 def mod(t1, t2) -> DNDarray:

@@ -529,7 +529,6 @@ class DNDarray:
         in_dim = 0
         out_dim = 0
         out_split: Optional[int] = None
-        bool_or_adv_seen = False
         for k in key:
             if k is None:
                 out_dim += 1
@@ -559,7 +558,6 @@ class DNDarray:
                 else:
                     in_dim += 1
                 out_dim += 1
-                bool_or_adv_seen = True
         # trailing unindexed dims: split stays at its offset position
         if in_dim <= split:
             out_split = out_dim + (split - in_dim)

@@ -182,7 +182,7 @@ def load_csv(
         from .. import native
 
         data = native.csv_parse(path, header_lines, sep, np.dtype(dtype.jax_type()))
-    if data is None:
+    if data is None and len(sep) == 1:
         # reference semantics (io.py:800-806): every field parsed with
         # float(), rows of fields -> always 2-D, then cast to the requested
         # dtype. loadtxt(ndmin=2) matches that exactly (genfromtxt would
@@ -190,6 +190,15 @@ def load_csv(
         data = np.loadtxt(
             path, delimiter=sep, skiprows=header_lines, dtype=np.float64, encoding=encoding, ndmin=2
         ).astype(np.dtype(dtype.jax_type()))
+    elif data is None:
+        # multi-character separators: loadtxt rejects them (numpy >= 1.23);
+        # parse with line.split(sep) like the reference does
+        with open(path, "r", encoding=encoding) as f:
+            lines = f.read().splitlines()[header_lines:]
+        rows = [
+            [float(field) for field in line.split(sep)] for line in lines if line.strip()
+        ]
+        data = np.array(rows, dtype=np.float64, ndmin=2).astype(np.dtype(dtype.jax_type()))
     return DNDarray(jnp.asarray(data), dtype=dtype, split=split, device=device, comm=comm)
 
 

@@ -17,6 +17,7 @@ __all__ = [
     "fabs",
     "floor",
     "modf",
+    "nan_to_num",
     "round",
     "sgn",
     "sign",
@@ -44,6 +45,16 @@ absolute = abs
 def fabs(x, out=None) -> DNDarray:
     """Float absolute value."""
     return _local_op(jnp.fabs, x, out=out)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, out=None) -> DNDarray:
+    """Replace NaN/±inf with finite numbers (numpy extra beyond the reference)."""
+    return _local_op(
+        lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf),
+        x,
+        out=out,
+        no_cast=True,
+    )
 
 
 def ceil(x, out=None) -> DNDarray:

@@ -47,9 +47,12 @@ _tried = False
 
 
 def _build() -> bool:
-    sources = sorted(
-        os.path.join(_SRC_DIR, f) for f in os.listdir(_SRC_DIR) if f.endswith(".cpp")
-    )
+    try:
+        names = os.listdir(_SRC_DIR)
+    except OSError:
+        # non-editable installs may ship without src/ — degrade to Python paths
+        return os.path.exists(_LIB_PATH)
+    sources = sorted(os.path.join(_SRC_DIR, f) for f in names if f.endswith(".cpp"))
     if not sources:
         return False
     newest_src = max(os.path.getmtime(s) for s in sources)
@@ -108,10 +111,17 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_int64),
         ]
-        lib.ht_csv_parse.restype = ctypes.c_int64
-        lib.ht_csv_parse.argtypes = [
+        lib.ht_csv_open.restype = ctypes.c_void_p
+        lib.ht_csv_open.argtypes = [
             ctypes.c_char_p,
             ctypes.c_int64,
+            ctypes.c_char,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.ht_csv_parse_h.restype = ctypes.c_int64
+        lib.ht_csv_parse_h.argtypes = [
+            ctypes.c_void_p,
             ctypes.c_char,
             ctypes.c_int32,
             ctypes.c_void_p,
@@ -119,6 +129,8 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_int64,
             ctypes.c_int32,
         ]
+        lib.ht_csv_close.restype = None
+        lib.ht_csv_close.argtypes = [ctypes.c_void_p]
         lib.ht_idx_header.restype = ctypes.c_int64
         lib.ht_idx_header.argtypes = [
             ctypes.c_char_p,
@@ -175,10 +187,6 @@ def csv_parse(
     lib = _load()
     if lib is None or len(sep) != 1:
         return None
-    dims = csv_dims(path, header_lines, sep)
-    if dims is None:
-        return None
-    rows, cols = dims
     np_dtype = np.dtype(dtype)
     cast_to = None
     if np_dtype == np.float32:
@@ -192,21 +200,31 @@ def csv_parse(
         # rounding behavior
         code, cast_to = 1, np_dtype
         np_dtype = np.dtype(np.float64)
-    if rows == 0 or cols == 0:
-        return np.empty((rows, cols), dtype=cast_to or np_dtype)
-    out = np.empty((rows, cols), dtype=np_dtype)
-    if nthreads <= 0:
-        nthreads = min(16, os.cpu_count() or 1)
-    rc = lib.ht_csv_parse(
-        path.encode(),
-        header_lines,
-        sep.encode(),
-        code,
-        out.ctypes.data_as(ctypes.c_void_p),
-        rows,
-        cols,
-        nthreads,
+    rows = ctypes.c_int64()
+    cols = ctypes.c_int64()
+    handle = lib.ht_csv_open(
+        path.encode(), header_lines, sep.encode(), ctypes.byref(rows), ctypes.byref(cols)
     )
+    if not handle:
+        return None
+    try:
+        rows, cols = rows.value, cols.value
+        if rows == 0 or cols == 0:
+            return np.empty((rows, cols), dtype=cast_to or np_dtype)
+        out = np.empty((rows, cols), dtype=np_dtype)
+        if nthreads <= 0:
+            nthreads = min(16, os.cpu_count() or 1)
+        rc = lib.ht_csv_parse_h(
+            handle,
+            sep.encode(),
+            code,
+            out.ctypes.data_as(ctypes.c_void_p),
+            rows,
+            cols,
+            nthreads,
+        )
+    finally:
+        lib.ht_csv_close(handle)
     if rc != 0:
         return None
     return out if cast_to is None else out.astype(cast_to)

@@ -159,26 +159,47 @@ int64_t ht_csv_dims(const char *path, int64_t header_lines, char sep,
   return 0;
 }
 
-// dtype: 0 = float32, 1 = float64
-int64_t ht_csv_parse(const char *path, int64_t header_lines, char sep,
-                     int32_t dtype, void *out, int64_t rows, int64_t cols,
-                     int32_t nthreads) {
-  if (!path || !out || rows < 0 || cols <= 0) return -4;
+// Handle-based one-pass API: mmap + line index built once, reused by the
+// parse call so large files are not scanned twice for dims then data.
+struct CsvHandle {
   Mapped m;
-  if (!map_file(path, m)) return -1;
   std::vector<Line> lines;
-  if (m.data) collect_lines(m.data, m.size, header_lines, lines);
-  int64_t rc;
+  int64_t cols = 0;
+};
+
+void *ht_csv_open(const char *path, int64_t header_lines, char sep,
+                  int64_t *rows, int64_t *cols) {
+  if (!path || !rows || !cols) return nullptr;
+  CsvHandle *h = new CsvHandle();
+  if (!map_file(path, h->m)) {
+    delete h;
+    return nullptr;
+  }
+  if (h->m.data) collect_lines(h->m.data, h->m.size, header_lines, h->lines);
+  h->cols = h->lines.empty() ? 0 : count_fields(h->lines.front(), sep);
+  *rows = static_cast<int64_t>(h->lines.size());
+  *cols = h->cols;
+  return h;
+}
+
+int64_t ht_csv_parse_h(void *handle, char sep, int32_t dtype, void *out,
+                       int64_t rows, int64_t cols, int32_t nthreads) {
+  if (!handle || !out || rows < 0 || cols <= 0) return -4;
+  CsvHandle *h = static_cast<CsvHandle *>(handle);
   if (dtype == 0)
-    rc = parse_all(lines, sep, static_cast<float *>(out), rows, cols,
-                   nthreads);
-  else if (dtype == 1)
-    rc = parse_all(lines, sep, static_cast<double *>(out), rows, cols,
-                   nthreads);
-  else
-    rc = -4;
-  unmap_file(m);
-  return rc;
+    return parse_all(h->lines, sep, static_cast<float *>(out), rows, cols,
+                     nthreads);
+  if (dtype == 1)
+    return parse_all(h->lines, sep, static_cast<double *>(out), rows, cols,
+                     nthreads);
+  return -4;
+}
+
+void ht_csv_close(void *handle) {
+  if (!handle) return;
+  CsvHandle *h = static_cast<CsvHandle *>(handle);
+  unmap_file(h->m);
+  delete h;
 }
 
 }  // extern "C"

@@ -105,8 +105,8 @@ int64_t ht_stream_next(void *h, void *out, int64_t cap) {
   Stream *s = static_cast<Stream *>(h);
   std::unique_lock<std::mutex> lk(s->mu);
   s->cv_cons.wait(lk, [&] { return s->filled > 0 || s->eof; });
-  if (s->err != 0) return s->err;
-  if (s->filled == 0) return 0;  // eof drained
+  // drain successfully-read slabs before surfacing a late pread error
+  if (s->filled == 0) return s->err != 0 ? s->err : 0;
   Slab &sl = s->ring[s->tail];
   if (sl.len > cap) return -3;
   int64_t n = sl.len;

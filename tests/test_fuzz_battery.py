@@ -1,0 +1,26 @@
+"""Differential battery: the full op surface vs numpy over every split.
+
+Runs the same sweep as ``tools/fuzz_sweep.py`` (op × shape × dtype × split)
+and asserts zero mismatches.  This is the bulk oracle in the spirit of the
+reference's ``assert_func_equal`` split-sweep (``basic_test.py:142-306``)
+applied across the whole API at once.
+"""
+from __future__ import annotations
+
+import runpy
+import sys
+import unittest
+from pathlib import Path
+
+
+class TestFuzzBattery(unittest.TestCase):
+    def test_battery_has_no_failures(self):
+        tool = Path(__file__).resolve().parent.parent / "tools" / "fuzz_sweep.py"
+        ns = runpy.run_path(str(tool))
+        failures = ns["FAILURES"]
+        msg = "\n".join(f"{lbl}" for lbl, _ in failures[:40])
+        self.assertEqual(len(failures), 0, f"{len(failures)} mismatches:\n{msg}")
+
+
+if __name__ == "__main__":
+    unittest.main()

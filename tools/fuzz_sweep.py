@@ -1,0 +1,344 @@
+"""Differential sweep: heat_tpu vs numpy over a wide op battery × splits.
+
+Reports every mismatch instead of stopping at the first — a gap-finding
+tool, not a test. Run on the virtual 8-device CPU mesh:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python tools/fuzz_sweep.py
+"""
+from __future__ import annotations
+
+import os
+import traceback
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+import heat_tpu as ht
+
+rng = np.random.default_rng(7)
+FAILURES = []
+
+
+def check(label, fn):
+    try:
+        fn()
+    except Exception:
+        FAILURES.append((label, traceback.format_exc(limit=3)))
+
+
+def cmp(label, got, expected, rtol=1e-4, atol=1e-5):
+    expected = np.asarray(expected)
+    if isinstance(got, ht.DNDarray):
+        got = got.numpy()
+    got = np.asarray(got)
+    if got.shape != expected.shape:
+        raise AssertionError(f"{label}: shape {got.shape} != {expected.shape}")
+    if np.issubdtype(expected.dtype, np.floating) or np.issubdtype(expected.dtype, np.complexfloating):
+        np.testing.assert_allclose(got.astype(expected.dtype), expected, rtol=rtol, atol=atol)
+    else:
+        np.testing.assert_array_equal(got.astype(expected.dtype), expected)
+
+
+def sweep(label, heat_fn, np_fn, shapes=((6, 7),), dtypes=("float32",), splits="all", **cmpkw):
+    for shape in shapes:
+        for dt in dtypes:
+            if dt.startswith("int") or dt.startswith("uint"):
+                a = rng.integers(1, 9, size=shape).astype(dt)
+            elif dt == "bool":
+                a = rng.integers(0, 2, size=shape).astype(bool)
+            else:
+                a = (rng.random(shape) * 4 - 2).astype(dt)
+            try:
+                exp = np_fn(a.copy())
+            except Exception:
+                continue
+            sp_list = [None] + list(range(len(shape))) if splits == "all" else splits
+            for sp in sp_list:
+                lbl = f"{label} shape={shape} dt={dt} split={sp}"
+                def run(a=a, sp=sp, exp=exp, lbl=lbl):
+                    x = ht.array(a, split=sp)
+                    got = heat_fn(x)
+                    if isinstance(got, ht.DNDarray) or isinstance(got, np.ndarray) or np.isscalar(got) or hasattr(got, "shape"):
+                        cmp(lbl, got, exp, **cmpkw)
+                    else:
+                        cmp(lbl, np.asarray(got), exp, **cmpkw)
+                check(lbl, run)
+
+
+# ---------------- elementwise unary ----------------
+UNARY = [
+    ("abs", ht.abs, np.abs), ("exp", ht.exp, np.exp), ("expm1", ht.expm1, np.expm1),
+    ("sqrt", lambda x: ht.sqrt(ht.abs(x)), lambda a: np.sqrt(np.abs(a))),
+    ("log", lambda x: ht.log(ht.abs(x) + 1), lambda a: np.log(np.abs(a) + 1)),
+    ("log2", lambda x: ht.log2(ht.abs(x) + 1), lambda a: np.log2(np.abs(a) + 1)),
+    ("log10", lambda x: ht.log10(ht.abs(x) + 1), lambda a: np.log10(np.abs(a) + 1)),
+    ("log1p", lambda x: ht.log1p(ht.abs(x)), lambda a: np.log1p(np.abs(a))),
+    ("sin", ht.sin, np.sin), ("cos", ht.cos, np.cos), ("tan", ht.tan, np.tan),
+    ("sinh", ht.sinh, np.sinh), ("cosh", ht.cosh, np.cosh), ("tanh", ht.tanh, np.tanh),
+    ("arcsin", lambda x: ht.arcsin(ht.clip(x, -0.9, 0.9)), lambda a: np.arcsin(np.clip(a, -0.9, 0.9))),
+    ("arccos", lambda x: ht.arccos(ht.clip(x, -0.9, 0.9)), lambda a: np.arccos(np.clip(a, -0.9, 0.9))),
+    ("arctan", ht.arctan, np.arctan),
+    ("arcsinh", ht.arcsinh, np.arcsinh),
+    ("arctanh", lambda x: ht.arctanh(ht.clip(x, -0.9, 0.9)), lambda a: np.arctanh(np.clip(a, -0.9, 0.9))),
+    ("floor", ht.floor, np.floor), ("ceil", ht.ceil, np.ceil), ("trunc", ht.trunc, np.trunc),
+    ("round", ht.round, np.round), ("sign", ht.sign, np.sign),
+    ("negative", lambda x: -x, lambda a: -a),
+    ("exp2", ht.exp2, np.exp2),
+    ("neg-abs", lambda x: ht.abs(-x), lambda a: np.abs(-a)),
+    ("sigmoid-ish", lambda x: 1 / (1 + ht.exp(-x)), lambda a: 1 / (1 + np.exp(-a))),
+    ("square", lambda x: x * x, lambda a: a * a),
+    ("modf0", lambda x: ht.modf(x)[0], lambda a: np.modf(a)[0]),
+    ("modf1", lambda x: ht.modf(x)[1], lambda a: np.modf(a)[1]),
+    ("frexp-ish-fabs", ht.fabs, np.fabs),
+    ("isfinite", ht.isfinite, np.isfinite), ("isinf", ht.isinf, np.isinf), ("isnan", ht.isnan, np.isnan),
+    ("logical_not", ht.logical_not, np.logical_not),
+]
+for name, hf, nf in UNARY:
+    sweep(f"unary/{name}", hf, nf, shapes=((6, 7), (5,), (3, 4, 5)))
+
+# ---------------- binary ----------------
+b_np = (rng.random((6, 7)) * 4 - 2).astype("float32")
+BINARY = [
+    ("add", lambda x: x + ht.array(b_np), lambda a: a + b_np),
+    ("sub", lambda x: x - ht.array(b_np), lambda a: a - b_np),
+    ("mul", lambda x: x * ht.array(b_np), lambda a: a * b_np),
+    ("div", lambda x: x / (ht.array(b_np) + 5), lambda a: a / (b_np + 5)),
+    ("floordiv", lambda x: (x * 3) // (ht.array(b_np) + 5), lambda a: (a * 3) // (b_np + 5)),
+    ("mod", lambda x: (x * 3) % (ht.array(b_np) + 5), lambda a: (a * 3) % (b_np + 5)),
+    ("pow", lambda x: ht.abs(x) ** 2.5, lambda a: np.abs(a) ** 2.5),
+    ("maximum", lambda x: ht.maximum(x, ht.array(b_np)), lambda a: np.maximum(a, b_np)),
+    ("minimum", lambda x: ht.minimum(x, ht.array(b_np)), lambda a: np.minimum(a, b_np)),
+    ("hypot", lambda x: ht.hypot(x, ht.array(b_np)), lambda a: np.hypot(a, b_np)),
+    ("atan2", lambda x: ht.arctan2(x, ht.array(b_np) + 5), lambda a: np.arctan2(a, b_np + 5)),
+    ("fmod", lambda x: ht.fmod(x * 3, ht.array(b_np) + 5), lambda a: np.fmod(a * 3, b_np + 5)),
+    ("copysign", lambda x: ht.copysign(x, ht.array(b_np)), lambda a: np.copysign(a, b_np)),
+    ("broadcast-row", lambda x: x + ht.array(b_np[0]), lambda a: a + b_np[0]),
+    ("broadcast-col", lambda x: x + ht.array(b_np[:, :1]), lambda a: a + b_np[:, :1]),
+    ("scalar-add", lambda x: x + 3, lambda a: a + 3),
+    ("scalar-radd", lambda x: 3 + x, lambda a: 3 + a),
+    ("scalar-rsub", lambda x: 3 - x, lambda a: 3 - a),
+    ("scalar-rdiv", lambda x: 3 / (x + 5), lambda a: 3 / (a + 5)),
+    ("eq", lambda x: x == ht.array(b_np), lambda a: a == b_np),
+    ("ne", lambda x: x != ht.array(b_np), lambda a: a != b_np),
+    ("lt", lambda x: x < ht.array(b_np), lambda a: a < b_np),
+    ("le", lambda x: x <= ht.array(b_np), lambda a: a <= b_np),
+    ("gt", lambda x: x > ht.array(b_np), lambda a: a > b_np),
+    ("ge", lambda x: x >= ht.array(b_np), lambda a: a >= b_np),
+]
+for name, hf, nf in BINARY:
+    sweep(f"binary/{name}", hf, nf, shapes=((6, 7),))
+
+# int bit ops
+ib = rng.integers(1, 7, size=(6, 7)).astype("int32")
+for name, hf, nf in [
+    ("and", lambda x: x & ht.array(ib), lambda a: a & ib),
+    ("or", lambda x: x | ht.array(ib), lambda a: a | ib),
+    ("xor", lambda x: x ^ ht.array(ib), lambda a: a ^ ib),
+    ("lshift", lambda x: x << 2, lambda a: a << 2),
+    ("rshift", lambda x: x >> 1, lambda a: a >> 1),
+    ("invert", ht.invert, np.invert),
+]:
+    sweep(f"bit/{name}", hf, nf, shapes=((6, 7),), dtypes=("int32",))
+
+# ---------------- reductions / cum ----------------
+for ax in (None, 0, 1):
+    sweep(f"red/sum ax={ax}", lambda x, ax=ax: ht.sum(x, axis=ax), lambda a, ax=ax: np.sum(a, axis=ax))
+    sweep(f"red/prod ax={ax}", lambda x, ax=ax: ht.prod(x, axis=ax), lambda a, ax=ax: np.prod(a, axis=ax))
+    sweep(f"red/mean ax={ax}", lambda x, ax=ax: ht.mean(x, axis=ax), lambda a, ax=ax: np.mean(a, axis=ax))
+    sweep(f"red/var ax={ax}", lambda x, ax=ax: ht.var(x, axis=ax), lambda a, ax=ax: np.var(a, axis=ax, ddof=0), rtol=1e-3)
+    sweep(f"red/std ax={ax}", lambda x, ax=ax: ht.std(x, axis=ax), lambda a, ax=ax: np.std(a, axis=ax, ddof=0), rtol=1e-3)
+    sweep(f"red/var ddof1 ax={ax}", lambda x, ax=ax: ht.var(x, axis=ax, ddof=1), lambda a, ax=ax: np.var(a, axis=ax, ddof=1), rtol=1e-3)
+    sweep(f"red/max ax={ax}", lambda x, ax=ax: ht.max(x, axis=ax), lambda a, ax=ax: np.max(a, axis=ax))
+    sweep(f"red/min ax={ax}", lambda x, ax=ax: ht.min(x, axis=ax), lambda a, ax=ax: np.min(a, axis=ax))
+    sweep(f"red/argmax ax={ax}", lambda x, ax=ax: ht.argmax(x, axis=ax), lambda a, ax=ax: np.argmax(a, axis=ax))
+    sweep(f"red/argmin ax={ax}", lambda x, ax=ax: ht.argmin(x, axis=ax), lambda a, ax=ax: np.argmin(a, axis=ax))
+    sweep(f"red/all ax={ax}", lambda x, ax=ax: ht.all(x > -10, axis=ax), lambda a, ax=ax: np.all(a > -10, axis=ax))
+    sweep(f"red/any ax={ax}", lambda x, ax=ax: ht.any(x > 1, axis=ax), lambda a, ax=ax: np.any(a > 1, axis=ax))
+for ax in (0, 1):
+    sweep(f"cum/cumsum ax={ax}", lambda x, ax=ax: ht.cumsum(x, axis=ax), lambda a, ax=ax: np.cumsum(a, axis=ax), rtol=1e-3)
+    sweep(f"cum/cumprod ax={ax}", lambda x, ax=ax: ht.cumprod(x, axis=ax), lambda a, ax=ax: np.cumprod(a, axis=ax), rtol=1e-3)
+sweep("red/sum keepdims", lambda x: ht.sum(x, axis=1, keepdims=True), lambda a: np.sum(a, axis=1, keepdims=True))
+sweep("red/sum tuple-axis", lambda x: ht.sum(x, axis=(0, 2)), lambda a: np.sum(a, axis=(0, 2)), shapes=((3, 4, 5),))
+sweep("arith/diff ax0", lambda x: ht.diff(x, axis=0), lambda a: np.diff(a, axis=0))
+sweep("arith/diff ax1", lambda x: ht.diff(x, axis=1), lambda a: np.diff(a, axis=1))
+sweep("arith/diff n2", lambda x: ht.diff(x, n=2, axis=0), lambda a: np.diff(a, n=2, axis=0))
+
+# ---------------- statistics ----------------
+sweep("stat/median ax=None", lambda x: ht.median(x), lambda a: np.median(a))
+for ax in (0, 1):
+    sweep(f"stat/median ax={ax}", lambda x, ax=ax: ht.median(x, axis=ax), lambda a, ax=ax: np.median(a, axis=ax))
+    sweep(f"stat/percentile30 ax={ax}", lambda x, ax=ax: ht.percentile(x, 30, axis=ax), lambda a, ax=ax: np.percentile(a, 30, axis=ax), rtol=1e-3)
+sweep("stat/average w", lambda x: ht.average(x, axis=0, weights=ht.arange(6, dtype=ht.float32) + 1),
+      lambda a: np.average(a, axis=0, weights=np.arange(6, dtype="float32") + 1))
+sweep("stat/cov", lambda x: ht.cov(x), lambda a: np.cov(a), rtol=1e-3)
+sweep("stat/bincount", lambda x: ht.bincount(x), lambda a: np.bincount(a), dtypes=("int32",), shapes=((20,),))
+sweep("stat/digitize", lambda x: ht.digitize(x, ht.array(np.array([-1.0, 0.0, 1.0], dtype="float32"))),
+      lambda a: np.digitize(a, np.array([-1.0, 0.0, 1.0], dtype="float32")))
+sweep("stat/skew", lambda x: ht.skew(x, axis=0), lambda a: __import__("scipy.stats", fromlist=["stats"]).skew(a, axis=0, bias=False) if False else _skew(a), rtol=1e-3) if False else None
+
+def _np_skew(a, axis=0):
+    m = a.mean(axis=axis, keepdims=True)
+    n = a.shape[axis]
+    m2 = ((a - m) ** 2).mean(axis=axis)
+    m3 = ((a - m) ** 3).mean(axis=axis)
+    g = m3 / m2 ** 1.5
+    return (np.sqrt(n * (n - 1)) / (n - 2)) * g
+
+def _np_kurt(a, axis=0):
+    # unbiased (k-statistics) Fisher kurtosis, the reference's default
+    # (statistics.py:727, unbiased=True, Fischer=True)
+    n = a.shape[axis]
+    m = a.mean(axis=axis, keepdims=True)
+    m2 = ((a - m) ** 2).mean(axis=axis)
+    m4 = ((a - m) ** 4).mean(axis=axis)
+    g2 = m4 / m2 ** 2 - 3
+    return ((n - 1) / ((n - 2) * (n - 3))) * ((n + 1) * g2 + 6)
+
+sweep("stat/skew unbiased ax0", lambda x: ht.skew(x, axis=0), lambda a: _np_skew(a, 0), rtol=1e-2, shapes=((12, 5),))
+sweep("stat/kurtosis ax0", lambda x: ht.kurtosis(x, axis=0), lambda a: _np_kurt(a, 0), rtol=1e-2, shapes=((12, 5),))
+sweep("stat/histc", lambda x: ht.histc(x, bins=8, min=-2, max=2), lambda a: np.histogram(a, bins=8, range=(-2, 2))[0].astype("float32"), shapes=((40,),))
+sweep("stat/bucketize", lambda x: ht.bucketize(x, ht.array(np.array([-1.0, 0.0, 1.0], dtype="float32"))),
+      lambda a: np.searchsorted(np.array([-1.0, 0.0, 1.0], dtype="float32"), a, side="left"))
+
+# maximum/minimum full reduce of 3-D
+sweep("red/max 3d ax=(1,2)...skip", lambda x: ht.max(x), lambda a: np.max(a), shapes=((3, 4, 5),))
+
+# ---------------- manipulations ----------------
+sweep("man/reshape", lambda x: ht.reshape(x, (7, 6)), lambda a: a.reshape(7, 6))
+sweep("man/reshape -1", lambda x: ht.reshape(x, (-1,)), lambda a: a.reshape(-1))
+sweep("man/reshape 3d", lambda x: ht.reshape(x, (5, 12)), lambda a: a.reshape(5, 12), shapes=((3, 4, 5),))
+sweep("man/ravel", ht.ravel, np.ravel)
+sweep("man/flatten", ht.flatten, np.ravel)
+sweep("man/sort ax0", lambda x: ht.sort(x, axis=0)[0], lambda a: np.sort(a, axis=0))
+sweep("man/sort ax1", lambda x: ht.sort(x, axis=1)[0], lambda a: np.sort(a, axis=1))
+sweep("man/sort desc", lambda x: ht.sort(x, axis=0, descending=True)[0], lambda a: -np.sort(-a, axis=0))
+sweep("man/unique", lambda x: ht.unique(x, sorted=True), lambda a: np.unique(a), dtypes=("int32",), shapes=((24,),))
+sweep("man/flip0", lambda x: ht.flip(x, 0), lambda a: np.flip(a, 0))
+sweep("man/flip1", lambda x: ht.flip(x, 1), lambda a: np.flip(a, 1))
+sweep("man/fliplr", ht.fliplr, np.fliplr)
+sweep("man/flipud", ht.flipud, np.flipud)
+sweep("man/roll 2 ax0", lambda x: ht.roll(x, 2, axis=0), lambda a: np.roll(a, 2, axis=0))
+sweep("man/roll -3 ax1", lambda x: ht.roll(x, -3, axis=1), lambda a: np.roll(a, -3, axis=1))
+sweep("man/roll flat", lambda x: ht.roll(x, 5), lambda a: np.roll(a, 5))
+sweep("man/rot90", lambda x: ht.rot90(x), lambda a: np.rot90(a))
+sweep("man/swapaxes", lambda x: ht.swapaxes(x, 0, 1), lambda a: np.swapaxes(a, 0, 1))
+sweep("man/moveaxis", lambda x: ht.moveaxis(x, 0, 2), lambda a: np.moveaxis(a, 0, 2), shapes=((3, 4, 5),))
+sweep("man/squeeze", lambda x: ht.squeeze(x), lambda a: np.squeeze(a), shapes=((3, 1, 5),))
+sweep("man/expand_dims", lambda x: ht.expand_dims(x, 1), lambda a: np.expand_dims(a, 1))
+sweep("man/tile", lambda x: ht.tile(x, (2, 3)), lambda a: np.tile(a, (2, 3)))
+sweep("man/repeat", lambda x: ht.repeat(x, 3), lambda a: np.repeat(a, 3))
+sweep("man/repeat ax", lambda x: ht.repeat(x, 2, axis=1), lambda a: np.repeat(a, 2, axis=1))
+sweep("man/pad", lambda x: ht.pad(x, ((1, 2), (0, 1))), lambda a: np.pad(a, ((1, 2), (0, 1))))
+sweep("man/transpose", lambda x: x.T, lambda a: a.T)
+sweep("man/topk", lambda x: ht.topk(x, 3, dim=0)[0], lambda a: -np.sort(-a, axis=0)[:3])
+sweep("man/topk largest=False", lambda x: ht.topk(x, 3, dim=0, largest=False)[0], lambda a: np.sort(a, axis=0)[:3])
+
+c_np = (rng.random((4, 7)) * 2).astype("float32")
+sweep("man/concat ax0", lambda x: ht.concatenate([x, ht.array(c_np)], axis=0), lambda a: np.concatenate([a, c_np], axis=0))
+sweep("man/concat ax1 self", lambda x: ht.concatenate([x, x], axis=1), lambda a: np.concatenate([a, a], axis=1))
+sweep("man/vstack", lambda x: ht.vstack([x, ht.array(c_np)]), lambda a: np.vstack([a, c_np]))
+sweep("man/hstack self", lambda x: ht.hstack([x, x]), lambda a: np.hstack([a, a]))
+sweep("man/stack", lambda x: ht.stack([x, x], axis=0), lambda a: np.stack([a, a], axis=0))
+sweep("man/column_stack self", lambda x: ht.column_stack([x, x]), lambda a: np.column_stack([a, a]))
+sweep("man/row_stack", lambda x: ht.row_stack([x, ht.array(c_np)]), lambda a: np.vstack([a, c_np]))
+sweep("man/split", lambda x: ht.split(x, 2, axis=0)[1], lambda a: np.split(a, 2, axis=0)[1], shapes=((6, 7),))
+sweep("man/dsplit", lambda x: ht.dsplit(x, 2)[0], lambda a: np.dsplit(a, 2)[0], shapes=((3, 4, 6),))
+sweep("man/hsplit", lambda x: ht.hsplit(x, 7)[3], lambda a: np.hsplit(a, 7)[3])
+sweep("man/vsplit", lambda x: ht.vsplit(x, 3)[2], lambda a: np.vsplit(a, 3)[2])
+sweep("man/diag", lambda x: ht.diag(x), lambda a: np.diag(a))
+sweep("man/diagonal", lambda x: ht.diagonal(x), lambda a: np.diagonal(a))
+sweep("man/diag k=1", lambda x: ht.diag(x, offset=1), lambda a: np.diag(a, k=1))
+sweep("man/clip", lambda x: ht.clip(x, -1, 1), lambda a: np.clip(a, -1, 1))
+
+# ---------------- indexing ----------------
+sweep("idx/nonzero", lambda x: ht.nonzero(x > 0)[0] if isinstance(ht.nonzero(x > 0), (tuple, list)) else ht.nonzero(x > 0),
+      lambda a: np.stack(np.nonzero(a > 0), axis=1) if len(a.shape) > 1 else np.nonzero(a > 0)[0])
+sweep("idx/where", lambda x: ht.where(x > 0, x, -x), lambda a: np.where(a > 0, a, -a))
+sweep("idx/getitem int", lambda x: x[2], lambda a: a[2])
+sweep("idx/getitem neg", lambda x: x[-1], lambda a: a[-1])
+sweep("idx/getitem slice", lambda x: x[1:5], lambda a: a[1:5])
+sweep("idx/getitem strided", lambda x: x[::2], lambda a: a[::2])
+sweep("idx/getitem col", lambda x: x[:, 3], lambda a: a[:, 3])
+sweep("idx/getitem 2dslice", lambda x: x[1:4, 2:6], lambda a: a[1:4, 2:6])
+sweep("idx/getitem ellipsis", lambda x: x[..., 1], lambda a: a[..., 1])
+sweep("idx/getitem none", lambda x: x[None, :, :], lambda a: a[None, :, :])
+sweep("idx/getitem boolmask", lambda x: x[x > 0], lambda a: a[a > 0], shapes=((12,),))
+sweep("idx/getitem intarray", lambda x: x[ht.array(np.array([0, 2, 4]))], lambda a: a[np.array([0, 2, 4])])
+def _si(x):
+    x = x.copy() if hasattr(x, 'copy') else x
+    x[1:3] = 0
+    return x
+sweep("idx/setitem slice", lambda x: _si(x), lambda a: _si(a))
+def _si2(x):
+    x = x.copy() if hasattr(x, 'copy') else x
+    x[:, 2] = 5
+    return x
+sweep("idx/setitem col", _si2, _si2)
+
+# ---------------- linalg ----------------
+A = (rng.random((8, 6)) - 0.5).astype("float32")
+B = (rng.random((6, 5)) - 0.5).astype("float32")
+for sa in (None, 0, 1):
+    for sb in (None, 0, 1):
+        def run(sa=sa, sb=sb):
+            x = ht.array(A, split=sa)
+            y = ht.array(B, split=sb)
+            cmp(f"linalg/matmul {sa}x{sb}", x @ y, A @ B, rtol=1e-3, atol=1e-4)
+        check(f"linalg/matmul {sa}x{sb}", run)
+sweep("linalg/outer", lambda x: ht.linalg.outer(x, x), lambda a: np.outer(a, a), shapes=((9,),))
+sweep("linalg/dot vec", lambda x: ht.dot(x, x), lambda a: np.dot(a, a), shapes=((9,),))
+sweep("linalg/norm", lambda x: ht.linalg.norm(x), lambda a: np.linalg.norm(a), rtol=1e-3)
+sweep("linalg/tril", ht.tril, np.tril)
+sweep("linalg/triu", ht.triu, np.triu)
+sweep("linalg/trace", lambda x: ht.trace(x), lambda a: np.trace(a))
+S = (rng.random((6, 6)) - 0.5).astype("float32") + np.eye(6, dtype="float32") * 3
+for sp in (None, 0, 1):
+    check(f"linalg/det sp={sp}", lambda sp=sp: cmp(f"det {sp}", ht.linalg.det(ht.array(S, split=sp)), np.linalg.det(S), rtol=1e-3))
+    check(f"linalg/inv sp={sp}", lambda sp=sp: cmp(f"inv {sp}", ht.linalg.inv(ht.array(S, split=sp)), np.linalg.inv(S), rtol=1e-2, atol=1e-3))
+T = (rng.random((16, 4)) - 0.5).astype("float32")
+for sp in (None, 0):
+    def run_qr(sp=sp):
+        q, r = ht.linalg.qr(ht.array(T, split=sp))
+        cmp(f"qr recon sp={sp}", q @ ht.array(r.numpy() if isinstance(r, ht.DNDarray) else r), T, rtol=1e-3, atol=1e-3)
+    check(f"linalg/qr sp={sp}", run_qr)
+sweep("linalg/vecdot", lambda x: ht.linalg.vecdot(x, x), lambda a: (a * a).sum(-1), shapes=((5, 7),))
+sweep("linalg/cross", lambda x: ht.cross(x, x + 1), lambda a: np.cross(a, a + 1), shapes=((5, 3),))
+sweep("linalg/matrix_norm fro", lambda x: ht.linalg.matrix_norm(x), lambda a: np.linalg.norm(a), rtol=1e-3)
+sweep("linalg/vector_norm", lambda x: ht.linalg.vector_norm(x), lambda a: np.linalg.norm(a), shapes=((9,),), rtol=1e-3)
+
+# ---------------- logical ----------------
+sweep("log/allclose", lambda x: ht.allclose(x, x), lambda a: np.allclose(a, a))
+sweep("log/isclose", lambda x: ht.isclose(x, x + 1e-9), lambda a: np.isclose(a, a + 1e-9))
+sweep("log/logical_and", lambda x: ht.logical_and(x > 0, x < 1), lambda a: np.logical_and(a > 0, a < 1))
+sweep("log/logical_or", lambda x: ht.logical_or(x > 1, x < -1), lambda a: np.logical_or(a > 1, a < -1))
+sweep("log/logical_xor", lambda x: ht.logical_xor(x > 0, x > 1), lambda a: np.logical_xor(a > 0, a > 1))
+sweep("log/signbit", ht.signbit, np.signbit)
+
+# ---------------- signal ----------------
+k_np = np.array([0.25, 0.5, 0.25], dtype="float32")
+sweep("sig/convolve full", lambda x: ht.convolve(x, ht.array(k_np), mode="full"), lambda a: np.convolve(a, k_np, mode="full"), shapes=((17,),), rtol=1e-3)
+sweep("sig/convolve same", lambda x: ht.convolve(x, ht.array(k_np), mode="same"), lambda a: np.convolve(a, k_np, mode="same"), shapes=((17,),), rtol=1e-3)
+sweep("sig/convolve valid", lambda x: ht.convolve(x, ht.array(k_np), mode="valid"), lambda a: np.convolve(a, k_np, mode="valid"), shapes=((17,),), rtol=1e-3)
+
+# ---------------- complex ----------------
+sweep("cpx/real", lambda x: ht.real(x + 0j) if False else ht.real(x), lambda a: np.real(a))
+cz = (rng.random((4, 5)) + 1j * rng.random((4, 5))).astype("complex64")
+for name, hf, nf in [("real", ht.real, np.real), ("imag", ht.imag, np.imag), ("conj", ht.conj, np.conj), ("angle", ht.angle, np.angle)]:
+    def run(hf=hf, nf=nf, name=name):
+        for sp in (None, 0, 1):
+            cmp(f"cpx/{name} sp={sp}", hf(ht.array(cz, split=sp)), nf(cz), rtol=1e-4)
+    check(f"cpx/{name}", run)
+
+# ---------------- rounding extras ----------------
+sweep("round/decimals", lambda x: ht.round(x, 2), lambda a: np.round(a, 2))
+sweep("nan/nan_to_num", lambda x: ht.nan_to_num(x / (x - x + 1)), lambda a: np.nan_to_num(a))
+
+print()
+print("=" * 70)
+print(f"{len(FAILURES)} failures")
+for lbl, tb in FAILURES:
+    last = [l for l in tb.strip().splitlines() if l.strip()][-1]
+    print(f"FAIL {lbl}: {last[:160]}")
