@@ -46,11 +46,20 @@ class KNeighborsClassifier(BaseEstimator, ClassificationMixin):
         """reference ``kneighborsclassifier.py:predict``"""
         if self.x is None:
             raise RuntimeError("fit needs to be called before predict")
-        Xq = x.larray.astype(jnp.float32)
-        Xt = self.x.larray.astype(jnp.float32)
         yt = self.y.larray.ravel()
-        d2 = _quadratic_expand(Xq, Xt)  # (nq, nt)
-        _, idx = jax.lax.top_k(-d2, self.n_neighbors)  # (nq, k) nearest
+        nq, nt = x.shape[0], self.x.shape[0]
+        from ..core.kernels import pallas_supported
+        from ..spatial.distance import nearest_neighbors
+
+        if pallas_supported() and nq * nt > 1 << 22 and x.split in (None, 0):
+            # fused pallas path: never materializes the (nq, nt) matrix
+            _, idx_nd = nearest_neighbors(x, self.x, self.n_neighbors)
+            idx = idx_nd.larray
+        else:
+            Xq = x.larray.astype(jnp.float32)
+            Xt = self.x.larray.astype(jnp.float32)
+            d2 = _quadratic_expand(Xq, Xt)  # (nq, nt)
+            _, idx = jax.lax.top_k(-d2, self.n_neighbors)  # (nq, k) nearest
         neigh_labels = jnp.take(yt, idx)  # (nq, k)
         votes = jnp.sum(
             one_hot_encoding(neigh_labels.ravel(), self.classes_).reshape(

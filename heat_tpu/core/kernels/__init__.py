@@ -1,0 +1,16 @@
+"""Pallas TPU kernels for heat_tpu's hot ops.
+
+The reference delegates its inner loops to libtorch kernels (SURVEY §2:
+"native under the hood"). On TPU most of those loops compile to optimal
+XLA programs already (the fused Lloyd step measures at one HBM pass over
+the data per iteration — the roofline). The kernels here cover the cases
+XLA cannot reach:
+
+- :func:`nearest_neighbors` — fused pairwise-distance + running top-k that
+  never materializes the (n, m) distance matrix (the flash-attention trick
+  applied to ``cdist`` + ``top_k``), for kNN on training sets where the
+  (n, m) intermediate would not fit in HBM.
+"""
+from .topk_distance import nearest_neighbors, pallas_supported
+
+__all__ = ["nearest_neighbors", "pallas_supported"]

@@ -103,57 +103,65 @@ def _load() -> Optional[ctypes.CDLL]:
             lib = ctypes.CDLL(_LIB_PATH)
         except OSError:
             return None
-        lib.ht_csv_dims.restype = ctypes.c_int64
-        lib.ht_csv_dims.argtypes = [
-            ctypes.c_char_p,
-            ctypes.c_int64,
-            ctypes.c_char,
-            ctypes.POINTER(ctypes.c_int64),
-            ctypes.POINTER(ctypes.c_int64),
-        ]
-        lib.ht_csv_open.restype = ctypes.c_void_p
-        lib.ht_csv_open.argtypes = [
-            ctypes.c_char_p,
-            ctypes.c_int64,
-            ctypes.c_char,
-            ctypes.POINTER(ctypes.c_int64),
-            ctypes.POINTER(ctypes.c_int64),
-        ]
-        lib.ht_csv_parse_h.restype = ctypes.c_int64
-        lib.ht_csv_parse_h.argtypes = [
-            ctypes.c_void_p,
-            ctypes.c_char,
-            ctypes.c_int32,
-            ctypes.c_void_p,
-            ctypes.c_int64,
-            ctypes.c_int64,
-            ctypes.c_int32,
-        ]
-        lib.ht_csv_close.restype = None
-        lib.ht_csv_close.argtypes = [ctypes.c_void_p]
-        lib.ht_idx_header.restype = ctypes.c_int64
-        lib.ht_idx_header.argtypes = [
-            ctypes.c_char_p,
-            ctypes.POINTER(ctypes.c_int64),
-            ctypes.POINTER(ctypes.c_int64),
-            ctypes.POINTER(ctypes.c_int32),
-        ]
-        lib.ht_idx_read.restype = ctypes.c_int64
-        lib.ht_idx_read.argtypes = [ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int64]
-        lib.ht_stream_open.restype = ctypes.c_void_p
-        lib.ht_stream_open.argtypes = [
-            ctypes.c_char_p,
-            ctypes.c_int64,
-            ctypes.c_int64,
-            ctypes.c_int64,
-            ctypes.c_int32,
-        ]
-        lib.ht_stream_next.restype = ctypes.c_int64
-        lib.ht_stream_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]
-        lib.ht_stream_close.restype = None
-        lib.ht_stream_close.argtypes = [ctypes.c_void_p]
+        try:
+            _bind_symbols(lib)
+        except AttributeError:
+            # stale prebuilt .so missing current symbols — degrade to Python
+            return None
         _lib = lib
         return _lib
+
+
+def _bind_symbols(lib: ctypes.CDLL) -> None:
+    lib.ht_csv_dims.restype = ctypes.c_int64
+    lib.ht_csv_dims.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.c_char,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.ht_csv_open.restype = ctypes.c_void_p
+    lib.ht_csv_open.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.c_char,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.ht_csv_parse_h.restype = ctypes.c_int64
+    lib.ht_csv_parse_h.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char,
+        ctypes.c_int32,
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_int32,
+    ]
+    lib.ht_csv_close.restype = None
+    lib.ht_csv_close.argtypes = [ctypes.c_void_p]
+    lib.ht_idx_header.restype = ctypes.c_int64
+    lib.ht_idx_header.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.ht_idx_read.restype = ctypes.c_int64
+    lib.ht_idx_read.argtypes = [ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int64]
+    lib.ht_stream_open.restype = ctypes.c_void_p
+    lib.ht_stream_open.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_int32,
+    ]
+    lib.ht_stream_next.restype = ctypes.c_int64
+    lib.ht_stream_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]
+    lib.ht_stream_close.restype = None
+    lib.ht_stream_close.argtypes = [ctypes.c_void_p]
 
 
 def available() -> bool:

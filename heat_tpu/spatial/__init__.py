@@ -1,3 +1,3 @@
 """Spatial distance functions (reference ``heat/spatial/``)."""
 from . import distance
-from .distance import cdist, manhattan, rbf
+from .distance import cdist, manhattan, nearest_neighbors, rbf

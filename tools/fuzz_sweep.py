@@ -177,8 +177,6 @@ sweep("stat/cov", lambda x: ht.cov(x), lambda a: np.cov(a), rtol=1e-3)
 sweep("stat/bincount", lambda x: ht.bincount(x), lambda a: np.bincount(a), dtypes=("int32",), shapes=((20,),))
 sweep("stat/digitize", lambda x: ht.digitize(x, ht.array(np.array([-1.0, 0.0, 1.0], dtype="float32"))),
       lambda a: np.digitize(a, np.array([-1.0, 0.0, 1.0], dtype="float32")))
-sweep("stat/skew", lambda x: ht.skew(x, axis=0), lambda a: __import__("scipy.stats", fromlist=["stats"]).skew(a, axis=0, bias=False) if False else _skew(a), rtol=1e-3) if False else None
-
 def _np_skew(a, axis=0):
     m = a.mean(axis=axis, keepdims=True)
     n = a.shape[axis]
@@ -324,8 +322,8 @@ sweep("sig/convolve same", lambda x: ht.convolve(x, ht.array(k_np), mode="same")
 sweep("sig/convolve valid", lambda x: ht.convolve(x, ht.array(k_np), mode="valid"), lambda a: np.convolve(a, k_np, mode="valid"), shapes=((17,),), rtol=1e-3)
 
 # ---------------- complex ----------------
-sweep("cpx/real", lambda x: ht.real(x + 0j) if False else ht.real(x), lambda a: np.real(a))
-cz = (rng.random((4, 5)) + 1j * rng.random((4, 5))).astype("complex64")
+sweep("cpx/real", lambda x: ht.real(x), lambda a: np.real(a))
+cz =(rng.random((4, 5)) + 1j * rng.random((4, 5))).astype("complex64")
 for name, hf, nf in [("real", ht.real, np.real), ("imag", ht.imag, np.imag), ("conj", ht.conj, np.conj), ("angle", ht.angle, np.angle)]:
     def run(hf=hf, nf=nf, name=name):
         for sp in (None, 0, 1):
