@@ -172,16 +172,33 @@ def matrix_norm(x: DNDarray, axis: Optional[Tuple[int, int]] = None, keepdims: b
     axis = sanitize_axis(x.shape, axis)
     row, col = axis
     arr = x.larray.astype(_float_type(x))
+    # after the inner sum drops an axis, the outer reduction index shifts
+    # (reference basics.py:1176-1212 does the same adjustment)
+    col_adj = col - 1 if (col > row and not keepdims) else col
+    row_adj = row - 1 if (row > col and not keepdims) else row
     if ord is None or ord == "fro":
         result = jnp.sqrt(jnp.sum(jnp.abs(arr) ** 2, axis=axis, keepdims=keepdims))
     elif ord == 1:
-        result = jnp.max(jnp.sum(jnp.abs(arr), axis=row, keepdims=keepdims), axis=col if not keepdims else col, keepdims=keepdims)
+        result = jnp.max(jnp.sum(jnp.abs(arr), axis=row, keepdims=keepdims), axis=col_adj, keepdims=keepdims)
     elif ord == -1:
-        result = jnp.min(jnp.sum(jnp.abs(arr), axis=row, keepdims=keepdims), axis=col, keepdims=keepdims)
+        result = jnp.min(jnp.sum(jnp.abs(arr), axis=row, keepdims=keepdims), axis=col_adj, keepdims=keepdims)
     elif ord == np.inf:
-        result = jnp.max(jnp.sum(jnp.abs(arr), axis=col, keepdims=keepdims), axis=row, keepdims=keepdims)
+        result = jnp.max(jnp.sum(jnp.abs(arr), axis=col, keepdims=keepdims), axis=row_adj, keepdims=keepdims)
     elif ord == -np.inf:
-        result = jnp.min(jnp.sum(jnp.abs(arr), axis=col, keepdims=keepdims), axis=row, keepdims=keepdims)
+        result = jnp.min(jnp.sum(jnp.abs(arr), axis=col, keepdims=keepdims), axis=row_adj, keepdims=keepdims)
+    elif ord in (2, -2, "nuc"):
+        # singular-value norms: the reference raises NotImplementedError
+        # (basics.py:1193-1218); here XLA's batched SVD covers them
+        moved = jnp.moveaxis(arr, (row, col), (-2, -1))
+        s = jnp.linalg.svd(moved, compute_uv=False)
+        if ord == 2:
+            result = jnp.max(s, axis=-1)
+        elif ord == -2:
+            result = jnp.min(s, axis=-1)
+        else:
+            result = jnp.sum(s, axis=-1)
+        if keepdims:
+            result = jnp.expand_dims(result, axis=(row, col))
     else:
         raise ValueError(f"Invalid norm order {ord} for matrices")
     split = _reduced_split(x.split, axis, x.ndim, keepdims)

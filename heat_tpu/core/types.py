@@ -363,50 +363,197 @@ def isreal(x):
 
 
 def promote_types(type1, type2) -> Type[datatype]:
-    """Smallest safe common type (numpy promotion rules, ref ``types.py:836``)."""
+    """Bit-width-preserving common type (reference ``types.py:836``):
+    the first type both operands cast to under the 'intuitive' rule —
+    e.g. ``int32 + float32 -> float32`` (numpy would say float64)."""
+    _init_promotion_tables()
     t1 = canonical_heat_type(type1)
     t2 = canonical_heat_type(type2)
-    return canonical_heat_type(np.promote_types(np.dtype(t1._jax_type), np.dtype(t2._jax_type)))
+    if t1 is t2:
+        return t1  # identity, incl. float16/bfloat16 (outside the table)
+    if t1 in (float16, bfloat16) and t2 in (float16, bfloat16):
+        return float32  # mixed half-precision formats widen
+    return _PROMOTE_TABLE[_type_code(t1)][_type_code(t2)]
 
 
 def result_type(*operands) -> Type[datatype]:
-    """np.result_type over heat types / scalars / DNDarrays (ref ``types.py:868``)."""
-    np_args = []
-    for op in operands:
-        if isinstance(op, type) and issubclass(op, datatype):
-            np_args.append(np.dtype(op._jax_type))
-        elif hasattr(op, "dtype") and isinstance(op.dtype, type) and issubclass(op.dtype, datatype):
-            # DNDarray: use a zero-dim numpy array so value-based rules for
-            # scalars still apply to actual scalars only
-            np_args.append(np.empty(0, dtype=np.dtype(op.dtype._jax_type)))
-        elif isinstance(op, (builtins.bool, builtins.int, builtins.float, builtins.complex)):
-            np_args.append(op)
-        else:
-            np_args.append(np.asarray(op))
-    return canonical_heat_type(np.result_type(*np_args))
+    """Promotion with operand precedence (reference ``types.py:868-948``):
+    arrays > types > scalars; within the same kind the higher-precedence
+    operand's type wins outright."""
+
+    def classify(arg):
+        # (heat type, precedence): 0 array, 1 type, 2 scalar array, 3 scalar
+        if isinstance(arg, type) and issubclass(arg, datatype):
+            return arg, 1
+        dt = getattr(arg, "dtype", None)
+        if dt is not None and not isinstance(arg, np.dtype):
+            t = dt if isinstance(dt, type) and issubclass(dt, datatype) else canonical_heat_type(dt)
+            prec = 0 if len(getattr(arg, "shape", ())) > 0 else 2
+            return t, prec
+        if isinstance(arg, (builtins.bool, builtins.int, builtins.float, builtins.complex)) and not isinstance(arg, np.generic):
+            return canonical_heat_type(type(arg)), 3
+        if isinstance(arg, np.ndarray):
+            return canonical_heat_type(arg.dtype), 0 if arg.ndim > 0 else 2
+        if isinstance(arg, (list, tuple)):
+            # python sequences take the factory's inference (floats ->
+            # float32, matching the reference's torch.tensor defaults)
+            a = np.asarray(arg)
+            t = float32 if a.dtype == np.float64 else canonical_heat_type(a.dtype)
+            return t, 0 if a.ndim > 0 else 2
+        return canonical_heat_type(arg), 1
+
+    def merge(a, b):
+        (t1, p1), (t2, p2) = a, b
+        if t1 is t2:
+            return t1, min(p1, p2)
+        if p1 == p2:
+            return promote_types(t1, t2), p1
+        for parent in (bool, integer, floating, complexfloating):
+            if issubdtype(t1, parent) and issubdtype(t2, parent):
+                return (t1, min(p1, p2)) if p1 < p2 else (t2, min(p1, p2))
+        # different kinds: the higher kind wins regardless of precedence
+        return (t2, min(p1, p2)) if _type_code(t1) < _type_code(t2) else (t1, min(p1, p2))
+
+    if not operands:
+        raise TypeError("result_type requires at least one operand")
+    acc = classify(operands[0])
+    for op in operands[1:]:
+        acc = merge(acc, classify(op))
+    return acc[0]
 
 
 def can_cast(from_, to, casting="intuitive") -> builtins.bool:
-    """Whether a cast is allowed under the given rule (ref ``types.py:671``).
+    """Whether a cast is allowed under the given rule (reference
+    ``types.py:671``): no/safe/same_kind/unsafe plus the reference's
+    ``intuitive`` (= safe + same-width int->float, e.g. int32->float32).
+    Python scalars are value-checked, as in the reference."""
+    _init_promotion_tables()
+    to_t = canonical_heat_type(to)
+    if isinstance(from_, (builtins.bool, builtins.int, builtins.float)) and not isinstance(
+        from_, np.generic
+    ):
+        if casting == "unsafe":
+            return True
+        if casting == "no":
+            return False  # a scalar has no type identical to the target
+        to_np = np.dtype(to_t._jax_type)
+        try:
+            if np.issubdtype(to_np, np.integer):
+                if isinstance(from_, builtins.float) and from_ != builtins.int(from_):
+                    return False
+                info = np.iinfo(to_np)
+                return info.min <= from_ <= info.max
+            if np.issubdtype(to_np, np.floating):
+                return builtins.bool(
+                    np.isfinite(to_np.type(from_))
+                ) or not np.isfinite(from_)
+            return True
+        except (OverflowError, ValueError):
+            return False
+    if isinstance(from_, builtins.complex) and not isinstance(from_, np.generic):
+        return issubclass(to_t, complexfloating) or casting == "unsafe"
 
-    ``intuitive`` (heat extension): like ``same_kind`` but also allows
-    int -> float and float -> complex of any width.
-    """
-    if isinstance(from_, type) and issubclass(from_, datatype):
-        from_np = np.dtype(from_._jax_type)
-    elif hasattr(from_, "dtype"):
+    if hasattr(from_, "dtype") and not isinstance(from_, np.dtype):
         d = from_.dtype
-        from_np = np.dtype(d._jax_type) if isinstance(d, type) and issubclass(d, datatype) else np.dtype(d)
-    elif isinstance(from_, (builtins.int, builtins.float, builtins.bool, builtins.complex)):
-        from_np = from_
+        from_t = d if isinstance(d, type) and issubclass(d, datatype) else canonical_heat_type(d)
     else:
-        from_np = np.dtype(from_)
-    to_np = np.dtype(canonical_heat_type(to)._jax_type)
+        from_t = canonical_heat_type(from_)
+
+    if casting == "no":
+        return from_t is to_t
+    if casting == "unsafe":
+        return True
+    # half-precision types sit outside the reference table: value-preserving
+    # only when widening (f16 -> f32/f64/c*, bf16 -> f32/f64/c*)
+    halves = (float16, bfloat16)
+    if from_t in halves or to_t in halves:
+        if from_t is to_t:
+            return True
+        widening = from_t in halves and to_t in (float32, float64, complex64, complex128)
+        if casting in ("safe", "intuitive"):
+            return widening
+        # same_kind: any float->float or float->complex conversion
+        return issubclass(from_t, (floating, complexfloating)) and issubclass(
+            to_t, (floating, complexfloating)
+        ) or widening
+    i, j = _type_code(from_t), _type_code(to_t)
+    if casting == "safe":
+        return _SAFE_CAST[i][j]
     if casting == "intuitive":
-        return np.can_cast(from_np, to_np, casting="same_kind") or np.can_cast(
-            from_np, to_np, casting="safe"
+        return _INTUITIVE_CAST[i][j]
+    if casting == "same_kind":
+        return _SAFE_CAST[i][j] or np.can_cast(
+            np.dtype(from_t._jax_type), np.dtype(to_t._jax_type), casting="same_kind"
         )
-    return np.can_cast(from_np, to_np, casting=casting)
+    raise ValueError(f"unknown casting rule {casting!r}")
+
+
+# ---------------------------------------------------------------------------
+# Promotion tables (reference ``types.py:605-668``). The reference's
+# "intuitive" rule preserves bit width where numpy widens; promotion picks
+# the first type (in ``_promotion_order``) both operands cast to.
+# ---------------------------------------------------------------------------
+
+
+def _promotion_order():
+    return [bool, uint8, int8, int16, int32, int64, float32, float64, complex64, complex128]
+
+
+def _cast_tables():
+    T, F = True, False
+    # rows/cols follow _promotion_order()
+    safe = [
+        # bool u8  i8  i16 i32 i64 f32 f64 c64 c128
+        [T, T, T, T, T, T, T, T, T, T],  # bool
+        [F, T, F, T, T, T, T, T, T, T],  # uint8
+        [F, F, T, T, T, T, T, T, T, T],  # int8
+        [F, F, F, T, T, T, T, T, T, T],  # int16
+        [F, F, F, F, T, T, F, T, F, T],  # int32
+        [F, F, F, F, F, T, F, T, F, T],  # int64
+        [F, F, F, F, F, F, T, T, T, T],  # float32
+        [F, F, F, F, F, F, F, T, F, T],  # float64
+        [F, F, F, F, F, F, F, F, T, T],  # complex64
+        [F, F, F, F, F, F, F, F, F, T],  # complex128
+    ]
+    # "intuitive" = safe plus same-width int->float/complex (int32->float32)
+    intuitive = [row[:] for row in safe]
+    intuitive[4][6] = intuitive[4][8] = True  # int32 -> float32 / complex64
+    return safe, intuitive
+
+
+_TYPE_ORDER = None
+_SAFE_CAST = None
+_INTUITIVE_CAST = None
+_PROMOTE_TABLE = None
+
+
+def _init_promotion_tables():
+    global _TYPE_ORDER, _SAFE_CAST, _INTUITIVE_CAST, _PROMOTE_TABLE
+    if _PROMOTE_TABLE is not None:
+        return
+    _TYPE_ORDER = _promotion_order()
+    _SAFE_CAST, _INTUITIVE_CAST = _cast_tables()
+    n = len(_TYPE_ORDER)
+    _PROMOTE_TABLE = [[None] * n for _ in range(n)]
+    for i in range(n):
+        for j in range(n):
+            for t in range(n):
+                if _INTUITIVE_CAST[i][t] and _INTUITIVE_CAST[j][t]:
+                    _PROMOTE_TABLE[i][j] = _TYPE_ORDER[t]
+                    break
+
+
+def _type_code(t) -> builtins.int:
+    _init_promotion_tables()
+    t = canonical_heat_type(t)
+    if t is float16 or t is bfloat16:
+        # half-precision extensions (absent from the reference's table):
+        # treated as float32 for promotion purposes
+        t = float32
+    try:
+        return _TYPE_ORDER.index(t)
+    except ValueError:
+        raise TypeError(f"type {t} has no promotion rule") from None
 
 
 class finfo:

@@ -157,9 +157,15 @@ class TestTypes(TestCase):
             ht.canonical_heat_type("notatype")
 
     def test_promote(self):
-        assert ht.promote_types(ht.int32, ht.float32) == ht.float64
+        # reference docstring examples (types.py:852-861): the 'intuitive'
+        # rule preserves bit width, unlike numpy
+        assert ht.promote_types(ht.int32, ht.float32) == ht.float32
         assert ht.promote_types(ht.int8, ht.uint8) == ht.int16
         assert ht.promote_types(ht.float32, ht.float64) == ht.float64
+        assert ht.promote_types(ht.int64, ht.float32) == ht.float64
+        assert ht.promote_types("i8", "f4") == ht.float64
+        assert ht.promote_types(ht.int32, ht.complex64) == ht.complex64
+        assert ht.promote_types(ht.int64, ht.complex64) == ht.complex128
 
     def test_heat_type_of(self):
         assert ht.heat_type_of(ht.zeros(3)) == ht.float32

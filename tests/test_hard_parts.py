@@ -1,0 +1,106 @@
+"""Regression tests for SURVEY §7's "hard parts": getitem/setitem split
+semantics, sort/unique determinism, redistribute, is_split, mixed-split
+rules — each swept over splits against the numpy oracle (the reference's
+``assert_func_equal`` discipline, ``basic_test.py:142-306``)."""
+from __future__ import annotations
+
+import unittest
+
+import numpy as np
+
+import heat_tpu as ht
+from tests.base import TestCase
+
+rng = np.random.default_rng(17)
+A = rng.normal(size=(8, 10)).astype(np.float32)
+
+
+class TestIndexingHardParts(TestCase):
+    def test_negative_step_slicing(self):
+        for sp in (None, 0, 1):
+            x = ht.array(A, split=sp)
+            np.testing.assert_allclose(x[::-1].numpy(), A[::-1])
+            np.testing.assert_allclose(x[:, ::-2].numpy(), A[:, ::-2])
+
+    def test_getitem_paired_advanced_indices(self):
+        for sp in (None, 0, 1):
+            x = ht.array(A, split=sp)
+            r = x[ht.array(np.array([0, 2])), ht.array(np.array([1, 3]))]
+            np.testing.assert_allclose(r.numpy(), A[[0, 2], [1, 3]])
+
+    def test_setitem_dndarray_value_cross_split(self):
+        for sp in (None, 0, 1):
+            x = ht.array(A.copy(), split=sp)
+            x[2:5] = ht.array(np.ones((3, 10), np.float32), split=0)
+            exp = A.copy()
+            exp[2:5] = 1
+            np.testing.assert_allclose(x.numpy(), exp)
+
+    def test_setitem_advanced_index(self):
+        for sp in (None, 0, 1):
+            x = ht.array(A.copy(), split=sp)
+            x[ht.array(np.array([1, 3]))] = 7.0
+            exp = A.copy()
+            exp[[1, 3]] = 7
+            np.testing.assert_allclose(x.numpy(), exp)
+
+    def test_setitem_boolean_mask(self):
+        for sp in (None, 0, 1):
+            x = ht.array(A.copy(), split=sp)
+            x[x < 0] = 0.0
+            exp = A.copy()
+            exp[exp < 0] = 0
+            np.testing.assert_allclose(x.numpy(), exp)
+
+
+class TestOrderingHardParts(TestCase):
+    def test_sort_returns_stable_indices(self):
+        for sp in (None, 0, 1):
+            v, i = ht.sort(ht.array(A, split=sp), axis=0)
+            np.testing.assert_allclose(v.numpy(), np.sort(A, 0))
+            np.testing.assert_array_equal(i.numpy(), np.argsort(A, 0, kind="stable"))
+
+    def test_unique_return_inverse(self):
+        B = rng.integers(0, 3, size=(12,)).astype(np.int32)
+        nu, ninv = np.unique(B, return_inverse=True)
+        for sp in (None, 0):
+            u, inv = ht.unique(ht.array(B, split=sp), return_inverse=True, sorted=True)
+            np.testing.assert_array_equal(u.numpy(), nu)
+            np.testing.assert_array_equal(inv.numpy(), ninv)
+
+
+class TestDistributionHardParts(TestCase):
+    def test_reshape_new_split(self):
+        x = ht.array(A, split=0)
+        r = ht.reshape(x, (10, 8), new_split=1)
+        self.assertEqual(r.split, 1)
+        np.testing.assert_allclose(r.numpy(), A.reshape(10, 8))
+
+    def test_concatenate_mixed_none_split(self):
+        for sa, sb in [(None, 0), (0, None), (None, 1), (1, None), (0, 0), (1, 1)]:
+            c = ht.concatenate([ht.array(A, split=sa), ht.array(A, split=sb)], axis=0)
+            np.testing.assert_allclose(c.numpy(), np.concatenate([A, A], 0))
+
+    def test_concatenate_differing_splits_raises(self):
+        # reference parity: differing non-None splits raise RuntimeError
+        # (reference manipulations.py:307-310)
+        with self.assertRaises(RuntimeError):
+            ht.concatenate([ht.array(A, split=0), ht.array(A, split=1)], axis=0)
+
+    def test_is_split_roundtrip(self):
+        full = np.arange(24, dtype=np.float32).reshape(8, 3)
+        x = ht.array(full, is_split=0)
+        self.assertEqual(tuple(x.shape), (8, 3))
+        np.testing.assert_allclose(x.numpy(), full)
+
+    def test_vdot_complex(self):
+        z = (rng.normal(size=(6,)) + 1j * rng.normal(size=(6,))).astype(np.complex64)
+        for sp in (None, 0):
+            x = ht.array(z, split=sp)
+            np.testing.assert_allclose(
+                complex(ht.vdot(x, x)), np.vdot(z, z), rtol=1e-5
+            )
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -49,7 +49,7 @@ class TestArithmetics(TestCase):
     def test_type_promotion(self):
         a = ht.arange(5, dtype=ht.int32)
         b = ht.ones(5, dtype=ht.float32)
-        assert (a + b).dtype == ht.float64  # numpy promotion int32+float32
+        assert (a + b).dtype == ht.float32  # reference 'intuitive' promotion
         c = ht.ones(5, dtype=ht.int64)
         assert (a + c).dtype == ht.int64
 

@@ -334,6 +334,98 @@ for name, hf, nf in [("real", ht.real, np.real), ("imag", ht.imag, np.imag), ("c
 sweep("round/decimals", lambda x: ht.round(x, 2), lambda a: np.round(a, 2))
 sweep("nan/nan_to_num", lambda x: ht.nan_to_num(x / (x - x + 1)), lambda a: np.nan_to_num(a))
 
+# ---------------- wave 2: kwarg and edge-case depth ----------------
+sweep("stat/average returned", lambda x: ht.average(x, axis=0, weights=ht.arange(6, dtype=ht.float32) + 1, returned=True)[1],
+      lambda a: np.average(a, axis=0, weights=np.arange(6, dtype="float32") + 1, returned=True)[1])
+sweep("stat/cov rowvar=False", lambda x: ht.cov(x, rowvar=False), lambda a: np.cov(a, rowvar=False), rtol=1e-3)
+sweep("stat/cov ddof0", lambda x: ht.cov(x, ddof=0), lambda a: np.cov(a, ddof=0), rtol=1e-3)
+sweep("stat/percentile vec", lambda x: ht.percentile(x, [10, 50, 90], axis=0),
+      lambda a: np.percentile(a, [10, 50, 90], axis=0), rtol=1e-3)
+sweep("stat/bincount weights", lambda x: ht.bincount(x, weights=ht.arange(20, dtype=ht.float32)),
+      lambda a: np.bincount(a, weights=np.arange(20, dtype="float32")), dtypes=("int32",), shapes=((20,),))
+sweep("stat/digitize right", lambda x: ht.digitize(x, ht.array(np.array([-1.0, 0.0, 1.0], dtype="float32")), right=True),
+      lambda a: np.digitize(a, np.array([-1.0, 0.0, 1.0], dtype="float32"), right=True))
+sweep("man/topk idx", lambda x: ht.topk(x, 3, dim=0)[1], lambda a: np.argsort(-a, axis=0, kind="stable")[:3], dtypes=("float32",))
+sweep("man/pad value", lambda x: ht.pad(x, ((1, 1), (2, 0)), constant_values=5),
+      lambda a: np.pad(a, ((1, 1), (2, 0)), constant_values=5))
+sweep("man/roll tuple", lambda x: ht.roll(x, (1, -2), axis=(0, 1)), lambda a: np.roll(a, (1, -2), axis=(0, 1)))
+sweep("man/repeat array", lambda x: ht.repeat(x, ht.array(np.array([1, 2, 0, 1, 3, 1])), axis=0),
+      lambda a: np.repeat(a, np.array([1, 2, 0, 1, 3, 1]), axis=0))
+sweep("man/split uneven", lambda x: ht.split(x, [2, 5], axis=0)[1], lambda a: np.split(a, [2, 5], axis=0)[1])
+sweep("man/rot90 k2 axes", lambda x: ht.rot90(x, k=2, axes=(0, 1)), lambda a: np.rot90(a, k=2, axes=(0, 1)))
+sweep("man/stack axis1", lambda x: ht.stack([x, x, x], axis=1), lambda a: np.stack([a, a, a], axis=1))
+sweep("man/squeeze axis", lambda x: ht.squeeze(x, axis=1), lambda a: np.squeeze(a, axis=1), shapes=((3, 1, 5),))
+
+for ordv in (1, 2, np.inf, -np.inf, "fro"):
+    def h(x, o=ordv): return ht.linalg.matrix_norm(x, ord=o)
+    def n(a, o=ordv): return np.linalg.norm(a, ord=o)
+    sweep(f"linalg/matrix_norm {ordv}", h, n, rtol=1e-3)
+for ordv in (0, 1, 2, 3, np.inf, -np.inf):
+    sweep(f"linalg/vector_norm {ordv}", lambda x, o=ordv: ht.linalg.vector_norm(x, ord=o),
+          lambda a, o=ordv: np.linalg.norm(a, ord=o), shapes=((9,),), rtol=1e-3)
+sweep("linalg/trace offset", lambda x: ht.trace(x, offset=1), lambda a: np.trace(a, offset=1))
+sweep("linalg/tril k", lambda x: ht.tril(x, k=1), lambda a: np.tril(a, k=1))
+sweep("linalg/triu k-1", lambda x: ht.triu(x, k=-1), lambda a: np.triu(a, k=-1))
+sweep("linalg/matmul vec", lambda x: ht.matmul(x, ht.array(np.ones(7, dtype="float32"))) if hasattr(ht, 'matmul') else x @ ht.array(np.ones(7, dtype="float32")),
+      lambda a: a @ np.ones(7, dtype="float32"), rtol=1e-3)
+
+# dtype promotion parity with the reference's numpy rules
+def t_promote():
+    cases = [
+        (ht.int32, ht.float32, "float32"), (ht.uint8, ht.int8, "int16"),
+        (ht.bool, ht.int8, "int8"), (ht.float32, ht.float64, "float64"),
+        (ht.int64, ht.float32, "float64"), (ht.complex64, ht.float64, "complex128"),
+    ]
+    for a, b, want in cases:
+        got = ht.promote_types(a, b)
+        if got is not getattr(ht, want):
+            raise AssertionError(f"promote {a} {b} -> {got}, want {want}")
+check("types/promote_types", t_promote)
+
+def t_finfo():
+    assert ht.finfo(ht.float32).max == np.finfo(np.float32).max
+    assert ht.iinfo(ht.int32).min == np.iinfo(np.int32).min
+check("types/finfo", t_finfo)
+
+def t_can_cast():
+    assert ht.can_cast(ht.int32, ht.int64)
+    assert not ht.can_cast(ht.float64, ht.int32)
+check("types/can_cast", t_can_cast)
+
+# random moments + determinism
+def t_random():
+    ht.random.seed(1234)
+    r = ht.random.randn(2000, split=0).numpy()
+    assert abs(r.mean()) < 0.1 and abs(r.std() - 1) < 0.1, (r.mean(), r.std())
+    ht.random.seed(1234)
+    r2 = ht.random.randn(2000, split=0).numpy()
+    np.testing.assert_array_equal(r, r2)
+    ri = ht.random.randint(3, 9, size=(500,)).numpy()
+    assert ri.min() >= 3 and ri.max() < 9
+    u = ht.random.rand(1000).numpy()
+    assert 0 <= u.min() and u.max() < 1
+    p = ht.random.randperm(64).numpy()
+    np.testing.assert_array_equal(np.sort(p), np.arange(64))
+    st = ht.random.get_state()
+    a1 = ht.random.rand(16).numpy()
+    ht.random.set_state(st)
+    np.testing.assert_array_equal(a1, ht.random.rand(16).numpy())
+check("random/moments+state", t_random)
+
+# DNDarray protocol methods
+def t_proto():
+    x = ht.arange(12, dtype=ht.float32, split=0).reshape((3, 4))
+    assert len(x) == 3
+    assert x.T.shape == (4, 3)
+    assert float(x.sum()) == 66.0
+    assert x.astype(ht.int64).dtype is ht.int64
+    rows = [r.numpy() for r in x]
+    np.testing.assert_allclose(np.stack(rows), x.numpy())
+    y = ht.array(np.float32(3.5))
+    assert y.item() == 3.5
+    assert x.tolist() == x.numpy().tolist()
+check("dndarray/protocol", t_proto)
+
 print()
 print("=" * 70)
 print(f"{len(FAILURES)} failures")
