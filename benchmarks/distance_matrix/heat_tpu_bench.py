@@ -6,7 +6,7 @@ import numpy as np
 import sys, pathlib
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[2]))
 import heat_tpu as ht
-from heat_tpu.utils.profiling import Timer
+from heat_tpu.utils.profiling import Timer, force_sync
 
 
 def main(n=40000, f=18, trials=10):
@@ -18,7 +18,7 @@ def main(n=40000, f=18, trials=10):
         for _ in range(trials):
             with Timer() as t:
                 d = ht.spatial.cdist(x, quadratic_expansion=quadratic)
-                d.larray.block_until_ready()
+                force_sync(d)
             times.append(t.elapsed)
         med = float(np.median(times))
         gb = (n * n * 4) / 1e9  # output bytes
@@ -26,4 +26,4 @@ def main(n=40000, f=18, trials=10):
 
 
 if __name__ == "__main__":
-    main()
+    main(n=4000, trials=3) if "--small" in sys.argv else main()

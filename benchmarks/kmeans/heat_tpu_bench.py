@@ -7,7 +7,7 @@ import numpy as np
 import sys, pathlib
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[2]))
 import heat_tpu as ht
-from heat_tpu.utils.profiling import Timer
+from heat_tpu.utils.profiling import Timer, force_sync
 
 
 def main(n=1 << 19, f=32, k=8, iters=30, trials=10):
@@ -20,10 +20,11 @@ def main(n=1 << 19, f=32, k=8, iters=30, trials=10):
         km = ht.cluster.KMeans(n_clusters=k, init="random", max_iter=iters, tol=None, random_state=t)
         with Timer() as timer:
             km.fit(x)
+            force_sync(km.cluster_centers_)
         times.append(timer.elapsed)
     print(f"kmeans fit ({iters} iters, n={n}, f={f}): median {np.median(times):.4f}s "
           f"({iters/np.median(times):.1f} iters/s)")
 
 
 if __name__ == "__main__":
-    main()
+    main(n=1 << 16, iters=10, trials=3) if "--small" in sys.argv else main()

@@ -5,7 +5,7 @@ import numpy as np
 import sys, pathlib
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[2]))
 import heat_tpu as ht
-from heat_tpu.utils.profiling import Timer
+from heat_tpu.utils.profiling import Timer, force_sync
 
 
 def main(n=1 << 20, f=64, trials=10):
@@ -19,9 +19,10 @@ def main(n=1 << 20, f=64, trials=10):
         lasso = ht.regression.Lasso(lam=0.01, max_iter=1)
         with Timer() as t:
             lasso.fit(xd, yd)
+            force_sync(lasso.theta)
         times.append(t.elapsed)
     print(f"lasso 1-iter fit (n={n}, f={f}): median {np.median(times):.4f}s")
 
 
 if __name__ == "__main__":
-    main()
+    main(n=1 << 16, trials=3) if "--small" in sys.argv else main()

@@ -5,7 +5,7 @@ import numpy as np
 import sys, pathlib
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[2]))
 import heat_tpu as ht
-from heat_tpu.utils.profiling import Timer
+from heat_tpu.utils.profiling import Timer, force_sync
 
 
 def main(shape=(1 << 22, 32), trials=10):
@@ -16,10 +16,10 @@ def main(shape=(1 << 22, 32), trials=10):
             for _ in range(trials):
                 with Timer() as t:
                     r = fn(x, axis)
-                    r.larray.block_until_ready()
+                    force_sync(r)
                 times.append(t.elapsed)
             print(f"{fn.__name__} axis={axis}: median {np.median(times)*1e3:.2f} ms")
 
 
 if __name__ == "__main__":
-    main()
+    main(shape=(1 << 14, 64), trials=3) if "--small" in sys.argv else main()

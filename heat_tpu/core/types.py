@@ -384,7 +384,7 @@ def result_type(*operands) -> Type[datatype]:
     def classify(arg):
         # (heat type, precedence): 0 array, 1 type, 2 scalar array, 3 scalar
         if isinstance(arg, type) and issubclass(arg, datatype):
-            return arg, 1
+            return canonical_heat_type(arg), 1  # abstract classes -> leaves
         dt = getattr(arg, "dtype", None)
         if dt is not None and not isinstance(arg, np.dtype):
             t = dt if isinstance(dt, type) and issubclass(dt, datatype) else canonical_heat_type(dt)
@@ -438,17 +438,21 @@ def can_cast(from_, to, casting="intuitive") -> builtins.bool:
             return False  # a scalar has no type identical to the target
         to_np = np.dtype(to_t._jax_type)
         try:
+            if to_t is bool:
+                # only 0/1 are value-preserved in bool
+                return from_ in (0, 1, True, False)
             if np.issubdtype(to_np, np.integer):
                 if isinstance(from_, builtins.float) and from_ != builtins.int(from_):
                     return False
                 info = np.iinfo(to_np)
                 return info.min <= from_ <= info.max
             if np.issubdtype(to_np, np.floating):
-                return builtins.bool(
-                    np.isfinite(to_np.type(from_))
-                ) or not np.isfinite(from_)
+                with np.errstate(over="ignore"):
+                    return builtins.bool(
+                        np.isfinite(to_np.type(from_))
+                    ) or not np.isfinite(from_)
             return True
-        except (OverflowError, ValueError):
+        except (OverflowError, ValueError, FloatingPointError):
             return False
     if isinstance(from_, builtins.complex) and not isinstance(from_, np.generic):
         return issubclass(to_t, complexfloating) or casting == "unsafe"

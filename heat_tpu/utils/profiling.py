@@ -14,7 +14,23 @@ from typing import Optional
 
 import jax
 
-__all__ = ["trace", "annotate", "Timer"]
+__all__ = ["trace", "annotate", "force_sync", "Timer"]
+
+
+def force_sync(*arrays) -> None:
+    """Block until the computations producing ``arrays`` have really run.
+
+    ``block_until_ready`` is not sufficient on tunneled/async TPU platforms
+    (the axon transport acknowledges dispatch, not completion); fetching a
+    scalar to the host is. Used by the benchmark harnesses.
+    """
+    import numpy as np
+
+    for x in arrays:
+        for leaf in jax.tree_util.tree_leaves(getattr(x, "larray", x)):
+            a = getattr(leaf, "larray", leaf)
+            if hasattr(a, "ravel"):
+                np.asarray(jax.device_get(a.ravel()[-1:]))
 
 
 @contextlib.contextmanager
@@ -50,9 +66,9 @@ class Timer:
         return self
 
     def stop(self, *block_on) -> float:
-        for x in block_on:
-            jax.block_until_ready(x)
-        if not block_on:
+        if block_on:
+            force_sync(*block_on)
+        else:
             for d in jax.devices():
                 jax.device_put(0.0, d).block_until_ready()
         self.elapsed = time.perf_counter() - self._t0
