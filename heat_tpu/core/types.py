@@ -384,7 +384,13 @@ def result_type(*operands) -> Type[datatype]:
     def classify(arg):
         # (heat type, precedence): 0 array, 1 type, 2 scalar array, 3 scalar
         if isinstance(arg, type) and issubclass(arg, datatype):
-            return canonical_heat_type(arg), 1  # abstract classes -> leaves
+            try:
+                return canonical_heat_type(arg), 1  # complexfloating -> c64
+            except TypeError:
+                # other abstract classes pass through; merge()'s parent-kind
+                # loop resolves them against concrete operands (reference
+                # result_type_rec, types.py:928)
+                return arg, 1
         dt = getattr(arg, "dtype", None)
         if dt is not None and not isinstance(arg, np.dtype):
             t = dt if isinstance(dt, type) and issubclass(dt, datatype) else canonical_heat_type(dt)

@@ -66,11 +66,15 @@ class Timer:
         return self
 
     def stop(self, *block_on) -> float:
+        import numpy as np
+
         if block_on:
             force_sync(*block_on)
         else:
+            # round-trip a sentinel per device: block_until_ready only
+            # acknowledges dispatch on tunneled TPU transports
             for d in jax.devices():
-                jax.device_put(0.0, d).block_until_ready()
+                np.asarray(jax.device_get(jax.device_put(0.0, d)))
         self.elapsed = time.perf_counter() - self._t0
         return self.elapsed
 
