@@ -209,9 +209,13 @@ def save_csv(
     sep: str = ",",
     decimals: int = -1,
     encoding: str = "utf-8",
+    comm=None,
+    truncate: bool = True,
     **kwargs,
 ) -> None:
-    """Save to CSV (reference ``io.py:926``)."""
+    """Save to CSV (reference ``io.py:926``). ``truncate=False`` appends to
+    an existing file instead of overwriting; ``comm`` is accepted for
+    signature parity (the controller writes once here)."""
     if not isinstance(data, DNDarray):
         raise TypeError(f"data must be a DNDarray, not {type(data)}")
     arr = data.numpy()
@@ -228,7 +232,15 @@ def save_csv(
         header = None
         if header_lines is not None:
             header = "\n".join(header_lines) if not isinstance(header_lines, str) else header_lines
-        np.savetxt(path, arr, fmt=fmt, delimiter=sep, header=header or "", comments="", encoding=encoding)
+        if truncate or not os.path.exists(path):
+            mode = "w"
+        else:
+            # reference semantics (io.py:926): without truncation the file
+            # is overwritten from offset 0 but never shortened
+            mode = "r+"
+        with open(path, mode, encoding=encoding) as fh:
+            fh.seek(0)
+            np.savetxt(fh, arr, fmt=fmt, delimiter=sep, header=header or "", comments="")
 
 
 def save(data: DNDarray, path: str, *args, **kwargs) -> None:

@@ -17,9 +17,78 @@ import jax.numpy as jnp
 from ..dndarray import DNDarray
 from .qr import qr
 
-__all__ = ["svd"]
+__all__ = ["rsvd", "svd"]
 
 SVD_out = collections.namedtuple("SVD", "U, S, Vh")
+
+
+def rsvd(
+    a: DNDarray,
+    rank: int,
+    n_oversamples: int = 10,
+    n_iter: int = 2,
+    random_state: Optional[int] = None,
+):
+    """Randomized truncated SVD (Halko-Martinsson-Tropp) of a distributed
+    2-D array — rank-``rank`` approximation for matrices of ANY shape/split.
+
+    Beyond the reference (its ``svd.py`` is an empty stub). The schedule is
+    TPU-native end to end: the range finder is two sharded MXU matmuls per
+    power iteration (GSPMD inserts the collectives), orthonormalization and
+    the small SVD run on the (n, k+p) / (k+p, k+p) replicated factors.
+
+    Returns ``SVD(U, S, Vh)`` with ``U (m, rank)`` carrying ``a``'s row
+    split, ``S (rank,)`` and ``Vh (rank, n)`` replicated.
+    """
+    if not isinstance(a, DNDarray):
+        raise TypeError(f"expected a DNDarray, got {type(a)}")
+    if a.ndim != 2:
+        raise ValueError(f"rsvd requires a 2-D array, got {a.ndim}-D")
+    m, n = a.shape
+    k = rank + n_oversamples
+    if not 0 < rank <= min(m, n):
+        raise ValueError(f"rank must be in [1, {min(m, n)}], got {rank}")
+    k = min(k, min(m, n))
+
+    from .. import random as ht_random
+
+    if random_state is not None:
+        ht_random.seed(random_state)
+    key = ht_random._next_key(k * n)
+
+    ftype = jnp.promote_types(a.larray.dtype, jnp.float32)
+    A = a.larray.astype(ftype)
+    distributed_rows = a.split == 0 and a.comm.size > 1
+
+    def ortho(Y):
+        # tall (m, k) panel: communication-avoiding TSQR when the rows are
+        # sharded (one all-gather of k x k factors), local QR otherwise
+        if distributed_rows:
+            Qd, _ = qr(DNDarray(Y, split=0, device=a.device, comm=a.comm))
+            return Qd.larray
+        return jnp.linalg.qr(Y)[0]
+
+    with jax.default_matmul_precision("highest"):
+        omega = jax.random.normal(key, (n, k), dtype=ftype)
+        Y = A @ omega  # (m, k) - sharded like A's rows
+        # power iterations with QR re-orthonormalization for stability
+        Q = ortho(Y)
+        for _ in range(n_iter):
+            Z = A.T @ Q  # (n, k) - replicated after the psum
+            Z = jnp.linalg.qr(Z)[0]
+            Y = A @ Z
+            Q = ortho(Y)
+        B = Q.T @ A  # (k, n) - replicated after the psum
+        u_b, s, vh = jnp.linalg.svd(B, full_matrices=False)
+        U = Q @ u_b  # (m, k), row-sharded
+    U = U[:, :rank]
+    s = s[:rank]
+    vh = vh[:rank]
+    return SVD_out(
+        DNDarray(U, split=a.split if a.split == 0 else None, device=a.device, comm=a.comm),
+        DNDarray(s, split=None, device=a.device, comm=a.comm),
+        DNDarray(vh, split=None, device=a.device, comm=a.comm),
+    )
 
 
 def svd(a: DNDarray, full_matrices: bool = False, compute_uv: bool = True):

@@ -126,8 +126,8 @@ def _dist(x: DNDarray, y: Optional[DNDarray], metric: Callable, use_ring: bool =
 
 
 def cdist(
-    x: DNDarray,
-    y: Optional[DNDarray] = None,
+    X: DNDarray,
+    Y: Optional[DNDarray] = None,
     quadratic_expansion: bool = False,
     use_ring: bool = False,
 ) -> DNDarray:
@@ -141,28 +141,28 @@ def cdist(
         metric = lambda a, b: jnp.sqrt(_quadratic_expand(a, b))
     else:
         metric = _euclidian
-    return _dist(x, y, metric, use_ring=use_ring)
+    return _dist(X, Y, metric, use_ring=use_ring)
 
 
-def manhattan(x: DNDarray, y: Optional[DNDarray] = None, expand: bool = False, use_ring: bool = False) -> DNDarray:
+def manhattan(X: DNDarray, Y: Optional[DNDarray] = None, expand: bool = False, use_ring: bool = False) -> DNDarray:
     """Manhattan (L1) distance matrix (reference ``distance.py:186``).
 
     ``expand`` selected a broadcast-vs-loop implementation in the reference
     with identical results; XLA fuses the broadcast form either way, so the
     flag is accepted for API parity and has no effect here.
     """
-    return _dist(x, y, _manhattan, use_ring=use_ring)
+    return _dist(X, Y, _manhattan, use_ring=use_ring)
 
 
 def rbf(
-    x: DNDarray,
-    y: Optional[DNDarray] = None,
+    X: DNDarray,
+    Y: Optional[DNDarray] = None,
     sigma: float = 1.0,
     quadratic_expansion: bool = False,
     use_ring: bool = False,
 ) -> DNDarray:
     """Gaussian RBF kernel matrix (reference ``distance.py:159``)."""
-    return _dist(x, y, lambda a, b: _gaussian(a, b, sigma), use_ring=use_ring)
+    return _dist(X, Y, lambda a, b: _gaussian(a, b, sigma), use_ring=use_ring)
 
 
 def nearest_neighbors(x: DNDarray, y: DNDarray, k: int):

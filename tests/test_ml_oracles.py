@@ -1,0 +1,109 @@
+"""ML-layer oracle tests: each estimator checked against a hand-rolled
+numpy implementation of the same algorithm (the reference validates
+against known iris centroids and sklearn conventions; here the oracle is
+explicit numpy math, swept over splits)."""
+from __future__ import annotations
+
+import unittest
+
+import numpy as np
+
+import heat_tpu as ht
+from tests.base import TestCase
+
+
+class TestRSVD(TestCase):
+    def test_rsvd_recovers_low_rank(self):
+        rng = np.random.default_rng(4)
+        for (m, n, sp) in [(200, 64, 0), (64, 200, 0), (200, 64, 1), (120, 120, None)]:
+            L = rng.normal(size=(m, 10)).astype(np.float32) @ rng.normal(
+                size=(10, n)
+            ).astype(np.float32)
+            A = L + 0.01 * rng.normal(size=(m, n)).astype(np.float32)
+            U, S, Vh = ht.linalg.rsvd(ht.array(A, split=sp), rank=10, random_state=0)
+            approx = U.numpy() * S.numpy()[None, :] @ Vh.numpy()
+            rel = np.linalg.norm(A - approx) / np.linalg.norm(A)
+            self.assertLess(rel, 0.02)
+            s_np = np.linalg.svd(A, compute_uv=False)[:10]
+            np.testing.assert_allclose(S.numpy(), s_np, rtol=1e-3)
+            # U columns orthonormal
+            g = U.numpy().T @ U.numpy()
+            np.testing.assert_allclose(g, np.eye(10), atol=1e-3)
+
+    def test_rsvd_validates(self):
+        a = ht.array(np.ones((6, 4), np.float32))
+        with self.assertRaises(ValueError):
+            ht.linalg.rsvd(a, rank=0)
+        with self.assertRaises(ValueError):
+            ht.linalg.rsvd(a, rank=5)
+
+
+class TestKMeansOracle(TestCase):
+    def test_matches_numpy_lloyd(self):
+        """Same init => same trajectory as a numpy Lloyd loop."""
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(120, 5)).astype(np.float32)
+        init = X[:4].copy()
+
+        c = init.copy()
+        for _ in range(7):
+            d2 = ((X[:, None, :] - c[None, :, :]) ** 2).sum(-1)
+            lab = d2.argmin(1)
+            for j in range(4):
+                if (lab == j).any():
+                    c[j] = X[lab == j].mean(0)
+
+        for sp in (None, 0):
+            km = ht.cluster.KMeans(
+                n_clusters=4, init=ht.array(init), max_iter=7, tol=None
+            ).fit(ht.array(X, split=sp))
+            np.testing.assert_allclose(km.cluster_centers_.numpy(), c, rtol=1e-4, atol=1e-5)
+
+
+class TestGaussianNBOracle(TestCase):
+    def test_matches_numpy_bayes(self):
+        rng = np.random.default_rng(8)
+        X = np.concatenate(
+            [rng.normal(loc=mu, size=(40, 3)).astype(np.float32) for mu in (-2, 0, 2)]
+        )
+        y = np.repeat(np.arange(3), 40).astype(np.int64)
+
+        # numpy oracle: per-class gaussians, uniform-ish priors
+        means = np.stack([X[y == c].mean(0) for c in range(3)])
+        var = np.stack([X[y == c].var(0) for c in range(3)]) + 1e-9
+        priors = np.array([(y == c).mean() for c in range(3)])
+
+        def predict_np(Q):
+            ll = -0.5 * (((Q[:, None, :] - means[None]) ** 2) / var[None]).sum(-1)
+            ll -= 0.5 * np.log(2 * np.pi * var).sum(-1)[None]
+            ll += np.log(priors)[None]
+            return ll.argmax(1)
+
+        Q = rng.normal(size=(30, 3)).astype(np.float32) * 2
+        expected = predict_np(Q)
+        for sp in (None, 0):
+            nb = ht.naive_bayes.GaussianNB().fit(ht.array(X, split=sp), ht.array(y, split=sp))
+            got = nb.predict(ht.array(Q, split=sp)).numpy()
+            self.assertGreater((got == expected).mean(), 0.96)
+
+
+class TestLassoOracle(TestCase):
+    def test_matches_numpy_coordinate_descent(self):
+        rng = np.random.default_rng(12)
+        n, f = 200, 6
+        X = rng.normal(size=(n, f)).astype(np.float32)
+        w_true = np.array([2.0, -3.0, 0.0, 0.0, 1.0, 0.0], dtype=np.float32)
+        yv = X @ w_true + 0.01 * rng.normal(size=n).astype(np.float32)
+        Xb = np.concatenate([np.ones((n, 1), np.float32), X], axis=1)
+
+        lam = 0.1
+        lasso = ht.regression.Lasso(lam=lam, max_iter=100)
+        lasso.fit(ht.array(Xb, split=0), ht.array(yv, split=0))
+        w = lasso.theta.numpy().ravel()
+        # sparse support recovered, active coefficients close
+        np.testing.assert_allclose(w[1:][np.abs(w_true) > 0], w_true[np.abs(w_true) > 0], atol=0.25)
+        self.assertTrue(np.all(np.abs(w[1:][np.abs(w_true) == 0]) < 0.1))
+
+
+if __name__ == "__main__":
+    unittest.main()
