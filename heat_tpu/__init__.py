@@ -22,3 +22,18 @@ from . import utils
 from .core import random
 from .core import version
 from .core.version import __version__
+
+
+def __getattr__(name: str):
+    # accelerator device singletons (ht.tpu / ht.gpu) resolve lazily via
+    # heat_tpu.core.devices so importing never initializes the XLA backend.
+    # Forward ONLY these names: anything else (incl. __all__) must miss
+    # without touching the devices module.
+    if name in ("tpu", "gpu", "cuda", "rocm", "axon"):
+        from heat_tpu.core import devices as _devices_mod
+
+        try:
+            return getattr(_devices_mod, name)
+        except AttributeError:
+            pass
+    raise AttributeError(f"module 'heat_tpu' has no attribute {name!r}")

@@ -40,6 +40,7 @@ __all__ = [
     "WORLD",
     "SELF",
     "get_comm",
+    "init_distributed",
     "use_comm",
     "sanitize_comm",
     "SPLIT_AXIS",
@@ -81,21 +82,25 @@ class MeshCommunication(Communication):
             if SPLIT_AXIS not in mesh.axis_names:
                 raise ValueError(f"mesh must contain axis {SPLIT_AXIS!r}, got {mesh.axis_names}")
             self._mesh = mesh
-        else:
-            if devices is None:
-                devices = jax.devices()
+        elif devices is not None:
             self._mesh = Mesh(np.array(devices), axis_names=(SPLIT_AXIS,))
-        self._devices = list(self._mesh.devices.flat)
+        else:
+            # defer jax.devices() so that `import heat_tpu` does not
+            # initialize the XLA backend — a prerequisite for
+            # init_distributed(), which must run before first backend use
+            self._mesh = None
 
     # -- world-style properties ------------------------------------------------
     @property
     def mesh(self) -> Mesh:
+        if self._mesh is None:
+            self._mesh = Mesh(np.array(jax.devices()), axis_names=(SPLIT_AXIS,))
         return self._mesh
 
     @property
     def size(self) -> int:
         """Number of shards along the split axis (MPI world-size analogue)."""
-        return self._mesh.shape[SPLIT_AXIS]
+        return self.mesh.shape[SPLIT_AXIS]
 
     @property
     def rank(self) -> int:
@@ -122,7 +127,7 @@ class MeshCommunication(Communication):
 
     def sharding(self, ndim: int, split: Optional[int]) -> NamedSharding:
         """NamedSharding for an ``ndim``-dim array split along ``split``."""
-        return NamedSharding(self._mesh, self.spec(ndim, split))
+        return NamedSharding(self.mesh, self.spec(ndim, split))
 
     def phys_split(self, shape, split: Optional[int]) -> Optional[int]:
         """The physically realizable split: XLA requires the sharded dim to
@@ -193,20 +198,36 @@ class MeshCommunication(Communication):
 
     # -- misc -----------------------------------------------------------------
     def __repr__(self) -> str:
+        # must not force lazy mesh resolution (would initialize the backend
+        # and break a subsequent init_distributed)
+        if self._mesh is None:
+            return "MeshCommunication(<world, unresolved>)"
         return f"MeshCommunication(size={self.size}, mesh={self._mesh!r})"
 
     def __eq__(self, other) -> bool:
+        # resolution-free: two unresolved world communicators are equal
         return isinstance(other, MeshCommunication) and self._mesh == other._mesh
 
     def __hash__(self):
-        return hash(self._mesh)
+        # constant per class: stable across lazy resolution (eq still
+        # discriminates; collisions only cost dict-probe time)
+        return hash(MeshCommunication)
 
 
 class _SelfCommunication(MeshCommunication):
-    """Single-device communicator (MPI_SELF analogue)."""
+    """Single-device communicator (MPI_SELF analogue); resolves its device
+    lazily so importing the package does not initialize the backend."""
 
     def __init__(self):
-        super().__init__(devices=[jax.devices()[0]])
+        self._mesh = None
+
+    @property
+    def mesh(self) -> Mesh:
+        if self._mesh is None:
+            # first LOCAL device: on a multi-host pod every process must
+            # pick a device it can address (jax.devices()[0] lives on host 0)
+            self._mesh = Mesh(np.array([jax.local_devices()[0]]), axis_names=(SPLIT_AXIS,))
+        return self._mesh
 
 
 # module-level singletons (reference communication.py:1886-1937)
@@ -222,6 +243,63 @@ _default_comm = WORLD
 def get_comm() -> MeshCommunication:
     """The current default communicator (reference ``communication.py:1907``)."""
     return _default_comm
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids=None,
+) -> MeshCommunication:
+    """Initialize the multi-host runtime and rebuild the world communicator.
+
+    The reference initializes at import under ``mpirun`` (MPI_Init inside
+    ``import heat``, reference ``communication.py:1886-1891``). The TPU
+    analogue is ``jax.distributed.initialize`` — on Cloud TPU pods every
+    argument auto-detects from the metadata server, so a bare
+    ``ht.init_distributed()`` at the top of the SPMD script is the whole
+    story; on other clusters pass coordinator/process arguments explicitly.
+
+    Importing ``heat_tpu`` does NOT initialize the XLA backend (the world
+    communicators resolve their device mesh lazily), so this must be the
+    first device-touching call of the program::
+
+        import heat_tpu as ht
+        ht.init_distributed()          # before any array is created
+        x = ht.zeros((N, F), split=0)  # sharded over the whole pod
+
+    After initialization the default communicator spans ALL global devices:
+    intra-host collectives ride ICI, inter-host DCN (XLA routes per edge).
+    """
+    global _default_comm
+    kwargs = {
+        k: v
+        for k, v in dict(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids,
+        ).items()
+        if v is not None
+    }
+    try:
+        jax.distributed.initialize(**kwargs)
+    except RuntimeError as e:
+        if "must be called before" in str(e):
+            raise RuntimeError(
+                "the XLA backend is already initialized: call "
+                "ht.init_distributed() before creating any array (or call "
+                "jax.distributed.initialize() before importing anything "
+                "that touches devices)"
+            ) from e
+        raise
+    # drop any lazily-cached single-host mesh so WORLD/SELF re-resolve over
+    # the now-global device set; aliases (MPI_WORLD, ht.WORLD, ...) keep
+    # pointing at the same objects, so they refresh too
+    WORLD._mesh = None
+    SELF._mesh = None
+    _default_comm = WORLD
+    return WORLD
 
 
 def use_comm(comm: Optional[MeshCommunication] = None) -> None:

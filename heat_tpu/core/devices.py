@@ -63,22 +63,44 @@ class Device:
 cpu = Device("cpu")
 """The CPU device singleton (reference ``devices.py:79``)."""
 
-# Expose an accelerator singleton when one is present (tpu preferred).
+# Accelerator detection is lazy: probing the platform initializes the XLA
+# backend, which must not happen at import time (init_distributed must be
+# callable first — see communication.init_distributed).
 _accel: Optional[Device] = None
-try:  # pragma: no cover - depends on runtime platform
-    _platform = jax.default_backend()
-    if _platform not in ("cpu",):
-        _accel = Device(_platform)
-        globals()[_platform] = _accel
-        __all__.append(_platform)
-except Exception:  # noqa: BLE001
-    pass
+_accel_probed = False
+__default_device: Optional[Device] = None
 
-__default_device = _accel if _accel is not None else cpu
+
+def _detect_accel() -> Optional[Device]:
+    global _accel, _accel_probed
+    if not _accel_probed:
+        _accel_probed = True
+        try:  # pragma: no cover - depends on runtime platform
+            platform = jax.default_backend()
+            if platform not in ("cpu",):
+                _accel = Device(platform)
+        except Exception:  # noqa: BLE001
+            pass
+    return _accel
+
+
+def __getattr__(name: str):
+    # expose the accelerator singleton by platform name (ht.tpu / ht.gpu);
+    # only these names may probe the backend — anything else must raise
+    # without initializing XLA (import machinery getattrs freely)
+    if name in ("tpu", "gpu", "cuda", "rocm", "axon"):
+        accel = _detect_accel()
+        if accel is not None and name == accel.device_type:
+            return accel
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def get_device() -> Device:
     """The currently globally-set default device (reference ``devices.py:121``)."""
+    global __default_device
+    if __default_device is None:
+        accel = _detect_accel()
+        __default_device = accel if accel is not None else cpu
     return __default_device
 
 
@@ -95,11 +117,12 @@ def sanitize_device(device: Optional[Union[str, Device]]) -> Device:
     if isinstance(device, Device):
         return device
     if isinstance(device, str):
+        accel = _detect_accel()
         name = device.lower().split(":")[0]
         if name == "cpu":
             return cpu
-        if _accel is not None and name == _accel.device_type:
-            return _accel
-        if name in ("gpu", "tpu", "axon") and _accel is not None:
-            return _accel
+        if accel is not None and name == accel.device_type:
+            return accel
+        if name in ("gpu", "tpu", "axon") and accel is not None:
+            return accel
     raise ValueError(f"Unknown device, must be 'cpu' or an available accelerator, got {device}")

@@ -213,8 +213,9 @@ def save_csv(
     truncate: bool = True,
     **kwargs,
 ) -> None:
-    """Save to CSV (reference ``io.py:926``). ``truncate=False`` appends to
-    an existing file instead of overwriting; ``comm`` is accepted for
+    """Save to CSV (reference ``io.py:926``). ``truncate=False`` overwrites
+    an existing file from offset 0 without shortening it (the reference's
+    semantics — stale trailing rows survive); ``comm`` is accepted for
     signature parity (the controller writes once here)."""
     if not isinstance(data, DNDarray):
         raise TypeError(f"data must be a DNDarray, not {type(data)}")

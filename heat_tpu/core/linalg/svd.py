@@ -50,11 +50,13 @@ def rsvd(
         raise ValueError(f"rank must be in [1, {min(m, n)}], got {rank}")
     k = min(k, min(m, n))
 
-    from .. import random as ht_random
-
     if random_state is not None:
-        ht_random.seed(random_state)
-    key = ht_random._next_key(k * n)
+        # local key: must not perturb the library-global RNG stream
+        key = jax.random.fold_in(jax.random.PRNGKey(random_state), k * n)
+    else:
+        from .. import random as ht_random
+
+        key = ht_random._next_key(k * n)
 
     ftype = jnp.promote_types(a.larray.dtype, jnp.float32)
     A = a.larray.astype(ftype)

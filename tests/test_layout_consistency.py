@@ -1,0 +1,90 @@
+"""Layout consistency: chunk() / lshape_map must describe the REAL XLA
+shard layout, and tiling metadata must partition the array exactly.
+
+The reference's chunk (communication.py:161-209) hands the remainder to
+the first ranks; XLA shards ceil-div with trailing short/empty shards.
+heat_tpu deliberately reports the XLA truth — these tests pin chunk(),
+lshape_map and the physical ``addressable_shards`` to each other so the
+three views can never drift apart.
+"""
+from __future__ import annotations
+
+import unittest
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.core.communication import MeshCommunication, comm_context
+from tests.base import TestCase
+
+
+class TestChunkMatchesPhysicalShards(TestCase):
+    def test_chunk_vs_addressable_shards(self):
+        import jax
+
+        for n_dev in (2, 5, 8):
+            comm = MeshCommunication(devices=jax.devices()[:n_dev])
+            with comm_context(comm):
+                for shape, split in [((16, 4), 0), ((9, 4), 0), ((4, 9), 1), ((7, 3, 5), 2)]:
+                    x = ht.zeros(shape, split=split)
+                    phys = x.larray.sharding
+                    if phys.is_fully_replicated:
+                        # non-divisible dims fall back to physical
+                        # replication; chunk still reports the LOGICAL
+                        # ceil-div partition and must cover the extent
+                        self.assertNotEqual(shape[split] % n_dev, 0)
+                        total = 0
+                        for r in range(comm.size):
+                            _, lshape, _ = comm.chunk(shape, x.split, rank=r)
+                            total += lshape[split]
+                        self.assertEqual(total, shape[split])
+                        continue
+                    shard_shape = phys.shard_shape(tuple(shape))
+                    _, lshape0, _ = comm.chunk(shape, split, rank=0)
+                    self.assertEqual(tuple(lshape0), tuple(shard_shape))
+
+    def test_lshape_map_sums_to_gshape(self):
+        import jax
+
+        for n_dev in (2, 5, 8):
+            comm = MeshCommunication(devices=jax.devices()[:n_dev])
+            with comm_context(comm):
+                for shape, split in [((16, 4), 0), ((9, 4), 0), ((4, 10), 1)]:
+                    x = ht.zeros(shape, split=split)
+                    m = np.asarray(x.lshape_map)
+                    self.assertEqual(m.shape, (comm.size, len(shape)))
+                    self.assertEqual(int(m[:, split].sum()), shape[split])
+                    for d in range(len(shape)):
+                        if d != split:
+                            self.assertTrue((m[:, d] == shape[d]).all())
+
+    def test_counts_displs(self):
+        comm = ht.get_comm()
+        counts, displs, out_shape = comm.counts_displs_shape((17, 3), 0)
+        counts = np.asarray(counts)
+        displs = np.asarray(displs)
+        self.assertEqual(int(counts.sum()), 17)
+        np.testing.assert_array_equal(displs, np.concatenate([[0], np.cumsum(counts)[:-1]]))
+
+
+class TestTilingMetadata(TestCase):
+    def test_split_tiles_partition(self):
+        x = ht.zeros((16, 12), split=0)
+        t = ht.tiling.SplitTiles(x)
+        ends = np.asarray(t.tile_ends_g)
+        # per-dim tile ends must finish at the global extent
+        self.assertEqual(int(ends[0][-1]), 16)
+        self.assertEqual(int(ends[1][-1]), 12)
+        locs = np.asarray(t.tile_locations)
+        self.assertEqual(locs.shape[0], x.comm.size)
+
+    def test_square_diag_tiles_cover(self):
+        x = ht.zeros((32, 32), split=0)
+        t = ht.tiling.SquareDiagTiles(x, tiles_per_proc=2)
+        self.assertGreaterEqual(t.tile_rows, 2)
+        self.assertEqual(len(t.row_indices), t.tile_rows)
+        self.assertEqual(len(t.col_indices), t.tile_columns)
+
+
+if __name__ == "__main__":
+    unittest.main()
