@@ -25,15 +25,10 @@ from .core.version import __version__
 
 
 def __getattr__(name: str):
-    # accelerator device singletons (ht.tpu / ht.gpu) resolve lazily via
-    # heat_tpu.core.devices so importing never initializes the XLA backend.
-    # Forward ONLY these names: anything else (incl. __all__) must miss
-    # without touching the devices module.
-    if name in ("tpu", "gpu", "cuda", "rocm", "axon"):
-        from heat_tpu.core import devices as _devices_mod
+    # delegate lazy accelerator names (ht.tpu / ht.gpu) to heat_tpu.core
+    from . import core as _core_mod
 
-        try:
-            return getattr(_devices_mod, name)
-        except AttributeError:
-            pass
-    raise AttributeError(f"module 'heat_tpu' has no attribute {name!r}")
+    try:
+        return getattr(_core_mod, name)
+    except AttributeError:
+        raise AttributeError(f"module 'heat_tpu' has no attribute {name!r}") from None

@@ -43,15 +43,10 @@ from .version import __version__
 
 
 def __getattr__(name: str):
-    # accelerator device singletons (ht.tpu / ht.gpu) resolve lazily via
-    # heat_tpu.core.devices so importing never initializes the XLA backend.
-    # Forward ONLY these names: anything else (incl. __all__) must miss
-    # without touching the devices module.
-    if name in ("tpu", "gpu", "cuda", "rocm", "axon"):
-        from heat_tpu.core import devices as _devices_mod
+    # accelerator device singletons (ht.core.tpu / gpu) resolve lazily in
+    # heat_tpu.core.devices so importing never initializes the XLA backend
+    from . import devices as _devices_mod
 
-        try:
-            return getattr(_devices_mod, name)
-        except AttributeError:
-            pass
+    if name in _devices_mod.ACCEL_NAMES:
+        return getattr(_devices_mod, name)
     raise AttributeError(f"module 'heat_tpu.core' has no attribute {name!r}")

@@ -205,8 +205,13 @@ class MeshCommunication(Communication):
         return f"MeshCommunication(size={self.size}, mesh={self._mesh!r})"
 
     def __eq__(self, other) -> bool:
-        # resolution-free: two unresolved world communicators are equal
-        return isinstance(other, MeshCommunication) and self._mesh == other._mesh
+        # resolution-free: two unresolved communicators are equal only when
+        # they are the same kind (unresolved SELF != unresolved WORLD)
+        return (
+            isinstance(other, MeshCommunication)
+            and type(self) is type(other)
+            and self._mesh == other._mesh
+        )
 
     def __hash__(self):
         # constant per class: stable across lazy resolution (eq still

@@ -84,13 +84,22 @@ def _detect_accel() -> Optional[Device]:
     return _accel
 
 
+# names that may lazily probe the backend (shared by the package-level
+# __getattr__ forwarders and sanitize_device); cuda/rocm alias 'gpu'
+ACCEL_NAMES = ("tpu", "gpu", "cuda", "rocm", "axon")
+_GPU_ALIASES = ("gpu", "cuda", "rocm")
+
+
 def __getattr__(name: str):
     # expose the accelerator singleton by platform name (ht.tpu / ht.gpu);
-    # only these names may probe the backend — anything else must raise
+    # only ACCEL_NAMES may probe the backend — anything else must raise
     # without initializing XLA (import machinery getattrs freely)
-    if name in ("tpu", "gpu", "cuda", "rocm", "axon"):
+    if name in ACCEL_NAMES:
         accel = _detect_accel()
-        if accel is not None and name == accel.device_type:
+        if accel is not None and (
+            name == accel.device_type
+            or (name in _GPU_ALIASES and accel.device_type in _GPU_ALIASES)
+        ):
             return accel
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
@@ -117,12 +126,13 @@ def sanitize_device(device: Optional[Union[str, Device]]) -> Device:
     if isinstance(device, Device):
         return device
     if isinstance(device, str):
-        accel = _detect_accel()
         name = device.lower().split(":")[0]
         if name == "cpu":
+            # must not probe the backend: sanitizing "cpu" is valid before
+            # init_distributed()
             return cpu
-        if accel is not None and name == accel.device_type:
-            return accel
-        if name in ("gpu", "tpu", "axon") and accel is not None:
-            return accel
+        if name in ACCEL_NAMES:
+            accel = _detect_accel()
+            if accel is not None:
+                return accel
     raise ValueError(f"Unknown device, must be 'cpu' or an available accelerator, got {device}")

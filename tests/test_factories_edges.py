@@ -1,0 +1,58 @@
+"""Factory edge cases + numpy-protocol interop (reference
+``factories.py:21-38`` API surface)."""
+from __future__ import annotations
+
+import unittest
+
+import numpy as np
+
+import heat_tpu as ht
+from tests.base import TestCase
+
+
+class TestFactoryEdges(TestCase):
+    def test_arange_variants(self):
+        np.testing.assert_array_equal(ht.arange(1, 10, 2).numpy(), np.arange(1, 10, 2))
+        np.testing.assert_array_equal(ht.arange(5).numpy(), np.arange(5))
+        np.testing.assert_allclose(
+            ht.arange(0, 1, 0.25, dtype=ht.float32).numpy(), np.arange(0, 1, 0.25, dtype="float32")
+        )
+
+    def test_linspace_endpoint_retstep(self):
+        np.testing.assert_allclose(
+            ht.linspace(0, 1, 5, endpoint=False).numpy(),
+            np.linspace(0, 1, 5, endpoint=False),
+            rtol=1e-6,
+        )
+        v, step = ht.linspace(0, 1, 5, retstep=True)
+        self.assertAlmostEqual(float(step), 0.25)
+        np.testing.assert_allclose(v.numpy(), np.linspace(0, 1, 5), rtol=1e-6)
+
+    def test_logspace_eye_meshgrid(self):
+        np.testing.assert_allclose(ht.logspace(0, 2, 3).numpy(), [1.0, 10.0, 100.0], rtol=1e-5)
+        np.testing.assert_array_equal(ht.eye((3, 5)).numpy(), np.eye(3, 5))
+        gi, gj = ht.meshgrid(ht.arange(2), ht.arange(3), indexing="ij")
+        self.assertEqual(tuple(gi.shape), (2, 3))
+        gx, gy = ht.meshgrid(ht.arange(2), ht.arange(3))
+        self.assertEqual(tuple(gx.shape), (3, 2))
+
+    def test_like_factories_override_dtype(self):
+        x = ht.array(np.ones((3, 4), np.float32), split=0)
+        z = ht.zeros_like(x, dtype=ht.int32)
+        self.assertIs(z.dtype, ht.int32)
+        self.assertEqual(tuple(z.shape), (3, 4))
+        self.assertEqual(z.split, x.split)
+        o = ht.ones_like(x)
+        np.testing.assert_array_equal(o.numpy(), np.ones((3, 4)))
+        f = ht.full_like(x, 7)
+        np.testing.assert_array_equal(f.numpy(), np.full((3, 4), 7.0, np.float32))
+
+    def test_numpy_protocol(self):
+        x = ht.array(np.arange(6, dtype=np.float32), split=0)
+        np.testing.assert_array_equal(np.asarray(x), np.arange(6, dtype=np.float32))
+        # ufunc dispatch goes through __array__, returning ndarray results
+        np.testing.assert_allclose(np.sin(x), np.sin(np.arange(6)), rtol=1e-6)
+
+
+if __name__ == "__main__":
+    unittest.main()
