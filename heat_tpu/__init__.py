@@ -25,10 +25,10 @@ from .core.version import __version__
 
 
 def __getattr__(name: str):
-    # delegate lazy accelerator names (ht.tpu / ht.gpu) to heat_tpu.core
-    from . import core as _core_mod
+    # delegate lazy accelerator names (ht.tpu / ht.gpu) to heat_tpu.core;
+    # nothing else is forwarded (core internals must stay private)
+    from .core import devices as _devices_mod
 
-    try:
-        return getattr(_core_mod, name)
-    except AttributeError:
-        raise AttributeError(f"module 'heat_tpu' has no attribute {name!r}") from None
+    if name in _devices_mod.ACCEL_NAMES:
+        return getattr(_devices_mod, name)
+    raise AttributeError(f"module 'heat_tpu' has no attribute {name!r}")

@@ -207,11 +207,7 @@ class MeshCommunication(Communication):
     def __eq__(self, other) -> bool:
         # resolution-free: two unresolved communicators are equal only when
         # they are the same kind (unresolved SELF != unresolved WORLD)
-        return (
-            isinstance(other, MeshCommunication)
-            and type(self) is type(other)
-            and self._mesh == other._mesh
-        )
+        return type(self) is type(other) and self._mesh == other._mesh
 
     def __hash__(self):
         # constant per class: stable across lazy resolution (eq still

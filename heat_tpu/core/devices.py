@@ -133,6 +133,11 @@ def sanitize_device(device: Optional[Union[str, Device]]) -> Device:
             return cpu
         if name in ACCEL_NAMES:
             accel = _detect_accel()
-            if accel is not None:
+            if accel is not None and (
+                name == accel.device_type
+                or (name in _GPU_ALIASES and accel.device_type in _GPU_ALIASES)
+                or name == "gpu"  # generic request matches any accelerator
+                or name == "axon"  # tunnel alias for the TPU platform
+            ):
                 return accel
     raise ValueError(f"Unknown device, must be 'cpu' or an available accelerator, got {device}")

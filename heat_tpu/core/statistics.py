@@ -32,6 +32,9 @@ __all__ = [
     "histogram",
     "kurtosis",
     "max",
+    "nanmax",
+    "nanmean",
+    "nanmin",
     "maximum",
     "mean",
     "median",
@@ -233,6 +236,21 @@ def mean(x: DNDarray, axis=None) -> DNDarray:
     """Arithmetic mean (reference ``statistics.py:891`` — local moments +
     Allreduce + pairwise merging; one jnp.mean here)."""
     return _reduce_op(jnp.mean, x, axis=axis)
+
+
+def nanmax(x: DNDarray, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
+    """Maximum ignoring NaNs (numpy extra beyond the reference)."""
+    return _reduce_op(jnp.nanmax, x, axis=axis, out=out, keepdims=bool(keepdim or keepdims))
+
+
+def nanmin(x: DNDarray, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
+    """Minimum ignoring NaNs (numpy extra beyond the reference)."""
+    return _reduce_op(jnp.nanmin, x, axis=axis, out=out, keepdims=bool(keepdim or keepdims))
+
+
+def nanmean(x: DNDarray, axis=None) -> DNDarray:
+    """Mean ignoring NaNs (numpy extra beyond the reference)."""
+    return _reduce_op(jnp.nanmean, x, axis=axis)
 
 
 def median(x: DNDarray, axis=None, keepdim: bool = False, keepdims=None) -> DNDarray:

@@ -369,6 +369,44 @@ sweep("linalg/triu k-1", lambda x: ht.triu(x, k=-1), lambda a: np.triu(a, k=-1))
 sweep("linalg/matmul vec", lambda x: ht.matmul(x, ht.array(np.ones(7, dtype="float32"))) if hasattr(ht, 'matmul') else x @ ht.array(np.ones(7, dtype="float32")),
       lambda a: a @ np.ones(7, dtype="float32"), rtol=1e-3)
 
+# ---------------- wave 3: NaN reductions, complex depth ----------------
+def _with_nans(a):
+    b = a.copy()
+    b.flat[::7] = np.nan
+    return b
+
+def nan_sweep(name, hf, nf, **kw):
+    def t():
+        a = _with_nans((rng.random((6, 7)) * 4 - 2).astype("float32"))
+        for sp in (None, 0, 1):
+            x = ht.array(a, split=sp)
+            cmp(f"{name} sp={sp}", hf(x), nf(a), **kw)
+    check(name, t)
+
+nan_sweep("nan/nansum ax0", lambda x: ht.nansum(x, axis=0), lambda a: np.nansum(a, axis=0))
+nan_sweep("nan/nansum all", lambda x: ht.nansum(x), lambda a: np.nansum(a), rtol=1e-4)
+nan_sweep("nan/nanprod ax1", lambda x: ht.nanprod(x, axis=1), lambda a: np.nanprod(a, axis=1), rtol=1e-3)
+nan_sweep("nan/isnan", lambda x: ht.isnan(x), lambda a: np.isnan(a))
+nan_sweep("nan/nanmax ax0", lambda x: ht.nanmax(x, axis=0), lambda a: np.nanmax(a, axis=0))
+nan_sweep("nan/nanmin ax1", lambda x: ht.nanmin(x, axis=1), lambda a: np.nanmin(a, axis=1))
+nan_sweep("nan/nanmean ax0", lambda x: ht.nanmean(x, axis=0), lambda a: np.nanmean(a, axis=0), rtol=1e-4)
+
+def t_complex_depth():
+    z = (rng.normal(size=(5, 4)) + 1j * rng.normal(size=(5, 4))).astype("complex64")
+    w = (rng.normal(size=(4, 3)) + 1j * rng.normal(size=(4, 3))).astype("complex64")
+    for sp in (None, 0, 1):
+        x = ht.array(z, split=sp)
+        cmp(f"cpx/matmul sp={sp}", x @ ht.array(w), z @ w, rtol=1e-4)
+        cmp(f"cpx/abs sp={sp}", ht.abs(x), np.abs(z), rtol=1e-4)
+        cmp(f"cpx/conj.T sp={sp}", ht.conj(x).T, np.conj(z).T, rtol=1e-4)
+        cmp(f"cpx/sum sp={sp}", ht.sum(x, axis=0), z.sum(0), rtol=1e-4)
+        cmp(f"cpx/exp sp={sp}", ht.exp(x), np.exp(z), rtol=1e-4)
+check("cpx/depth", t_complex_depth)
+
+for interp in ("linear", "lower", "higher", "nearest", "midpoint"):
+    sweep(f"stat/percentile {interp}", lambda x, i=interp: ht.percentile(x, 37.5, axis=0, interpolation=i),
+          lambda a, i=interp: np.percentile(a, 37.5, axis=0, method=i), rtol=1e-3)
+
 # dtype promotion parity with the reference's numpy rules
 def t_promote():
     cases = [
