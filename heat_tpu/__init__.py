@@ -25,10 +25,8 @@ from .core.version import __version__
 
 
 def __getattr__(name: str):
-    # delegate lazy accelerator names (ht.tpu / ht.gpu) to heat_tpu.core;
-    # nothing else is forwarded (core internals must stay private)
-    from .core import devices as _devices_mod
+    # lazy accelerator names (ht.tpu / ht.gpu) — one forwarder lives in
+    # heat_tpu.core; everything public is already star-imported above
+    from . import core as _core_mod
 
-    if name in _devices_mod.ACCEL_NAMES:
-        return getattr(_devices_mod, name)
-    raise AttributeError(f"module 'heat_tpu' has no attribute {name!r}")
+    return _core_mod.__getattr__(name)

@@ -43,10 +43,10 @@ from .version import __version__
 
 
 def __getattr__(name: str):
-    # accelerator device singletons (ht.core.tpu / gpu) resolve lazily in
+    # accelerator device singletons (tpu / gpu) resolve lazily in
     # heat_tpu.core.devices so importing never initializes the XLA backend
     from . import devices as _devices_mod
 
     if name in _devices_mod.ACCEL_NAMES:
         return getattr(_devices_mod, name)
-    raise AttributeError(f"module 'heat_tpu.core' has no attribute {name!r}")
+    raise AttributeError(f"module has no attribute {name!r} (heat_tpu namespace)")

@@ -90,16 +90,27 @@ ACCEL_NAMES = ("tpu", "gpu", "cuda", "rocm", "axon")
 _GPU_ALIASES = ("gpu", "cuda", "rocm")
 
 
+def _accel_matches(name: str, accel: Optional[Device]) -> bool:
+    """Single source of truth for accelerator-name matching: exact platform
+    name, cuda/rocm as gpu aliases, 'gpu' as a generic accelerator request,
+    'axon' as a TPU-tunnel alias."""
+    if accel is None:
+        return False
+    return (
+        name == accel.device_type
+        or (name in _GPU_ALIASES and accel.device_type in _GPU_ALIASES)
+        or name == "gpu"
+        or (name == "axon" and accel.device_type == "tpu")
+    )
+
+
 def __getattr__(name: str):
     # expose the accelerator singleton by platform name (ht.tpu / ht.gpu);
     # only ACCEL_NAMES may probe the backend — anything else must raise
     # without initializing XLA (import machinery getattrs freely)
     if name in ACCEL_NAMES:
         accel = _detect_accel()
-        if accel is not None and (
-            name == accel.device_type
-            or (name in _GPU_ALIASES and accel.device_type in _GPU_ALIASES)
-        ):
+        if _accel_matches(name, accel):
             return accel
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
@@ -133,11 +144,6 @@ def sanitize_device(device: Optional[Union[str, Device]]) -> Device:
             return cpu
         if name in ACCEL_NAMES:
             accel = _detect_accel()
-            if accel is not None and (
-                name == accel.device_type
-                or (name in _GPU_ALIASES and accel.device_type in _GPU_ALIASES)
-                or name == "gpu"  # generic request matches any accelerator
-                or name == "axon"  # tunnel alias for the TPU platform
-            ):
+            if _accel_matches(name, accel):
                 return accel
     raise ValueError(f"Unknown device, must be 'cpu' or an available accelerator, got {device}")

@@ -248,9 +248,9 @@ def nanmin(x: DNDarray, axis=None, out=None, keepdim=None, keepdims=None) -> DND
     return _reduce_op(jnp.nanmin, x, axis=axis, out=out, keepdims=bool(keepdim or keepdims))
 
 
-def nanmean(x: DNDarray, axis=None) -> DNDarray:
+def nanmean(x: DNDarray, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
     """Mean ignoring NaNs (numpy extra beyond the reference)."""
-    return _reduce_op(jnp.nanmean, x, axis=axis)
+    return _reduce_op(jnp.nanmean, x, axis=axis, out=out, keepdims=bool(keepdim or keepdims))
 
 
 def median(x: DNDarray, axis=None, keepdim: bool = False, keepdims=None) -> DNDarray:

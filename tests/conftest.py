@@ -6,8 +6,12 @@ hosts 8 XLA CPU devices and every sharded op runs a real GSPMD program.
 """
 import os
 
-_flag = "--xla_force_host_platform_device_count=8"
-if _flag not in os.environ.get("XLA_FLAGS", ""):
+# world size of the virtual mesh; CI can run the matrix
+#   HEAT_TPU_TEST_DEVICES={1,2,5,8} python -m pytest tests/
+# (the analogue of the reference's mpirun -n {1,2,5,8} sweep)
+_n = os.environ.get("HEAT_TPU_TEST_DEVICES", "8")
+_flag = f"--xla_force_host_platform_device_count={_n}"
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _flag).strip()
 
 import jax

@@ -251,12 +251,12 @@ class TestParallelPrimitives(TestCase):
     def test_ring_map_matches_direct(self):
         import jax.numpy as jnp
 
-        rng = np.random.default_rng(40)
-        x = rng.random((32, 4)).astype(np.float32)
-        y = rng.random((16, 4)).astype(np.float32)
         comm = ht.get_comm()
         if comm.size == 1:
             pytest.skip("needs multi-device mesh")
+        rng = np.random.default_rng(40)
+        x = rng.random((comm.size * 4, 4)).astype(np.float32)
+        y = rng.random((comm.size * 2, 4)).astype(np.float32)
         from heat_tpu.parallel import ring_map
 
         xj = ht.array(x, split=0).larray
@@ -270,10 +270,11 @@ class TestParallelPrimitives(TestCase):
             pytest.skip("needs multi-device mesh")
         from heat_tpu.parallel import halo_exchange
 
-        x = ht.arange(32, dtype=ht.float32, split=0).reshape((32, 1))
-        h = np.asarray(halo_exchange(x.larray, 1, comm))
         p = comm.size
-        block = 32 // p
+        n = p * 6  # divisible for any world size (halo requires even shards)
+        x = ht.arange(n, dtype=ht.float32, split=0).reshape((n, 1))
+        h = np.asarray(halo_exchange(x.larray, 1, comm))
+        block = n // p
         assert h.shape == (p, block + 2, 1)
         # interior shard i: first element is last element of shard i-1
         for i in range(1, p - 1):
@@ -285,8 +286,8 @@ class TestParallelPrimitives(TestCase):
 
         from heat_tpu.parallel import make_hierarchical_mesh
 
-        if len(jax.devices()) < 4:
-            pytest.skip("needs >=4 devices")
+        if len(jax.devices()) < 4 or len(jax.devices()) % 2:
+            pytest.skip("needs an even device count >= 4")
         mesh = make_hierarchical_mesh(n_slow=2)
         assert mesh.axis_names == ("nodes", "split")
         assert mesh.shape["nodes"] == 2

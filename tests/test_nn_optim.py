@@ -97,8 +97,8 @@ class TestDASO(TestCase):
 
         from heat_tpu.parallel import make_hierarchical_mesh
 
-        if len(jax.devices()) < 4:
-            pytest.skip("needs >=4 devices")
+        if len(jax.devices()) < 4 or len(jax.devices()) % 2:
+            pytest.skip("needs an even device count >= 4")
         mesh = make_hierarchical_mesh(n_slow=2)
         X, y, _ = _make_regression(n=64, f=4, seed=2)
         params = {"w": jnp.zeros((4, 1)), "b": jnp.zeros(1)}
@@ -131,8 +131,8 @@ class TestDASO(TestCase):
 
         from heat_tpu.parallel import make_hierarchical_mesh
 
-        if len(jax.devices()) < 4:
-            pytest.skip("needs >=4 devices")
+        if len(jax.devices()) < 4 or len(jax.devices()) % 2:
+            pytest.skip("needs an even device count >= 4")
         mesh = make_hierarchical_mesh(n_slow=2)
         rng = np.random.default_rng(9)
         X = rng.normal(size=(32, 4)).astype(np.float32)

@@ -21,7 +21,7 @@ class TestRingAttention(TestCase):
         if comm.size == 1:
             pytest.skip("needs multi-device mesh")
         rng = np.random.default_rng(0)
-        n, d = 64, 16
+        n, d = comm.size * 16, 16  # sequence divisible by any world size
         q = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
         k = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
         v = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
