@@ -90,18 +90,22 @@ ACCEL_NAMES = ("tpu", "gpu", "cuda", "rocm", "axon")
 _GPU_ALIASES = ("gpu", "cuda", "rocm")
 
 
-def _accel_matches(name: str, accel: Optional[Device]) -> bool:
-    """Single source of truth for accelerator-name matching: exact platform
-    name, cuda/rocm as gpu aliases, 'gpu' as a generic accelerator request,
-    'axon' as a TPU-tunnel alias."""
+def _accel_matches(name: str, accel: Optional[Device], strict: bool = False) -> bool:
+    """Single source of truth for accelerator-name matching.
+
+    ``strict`` (attribute access, e.g. ``ht.gpu``): exact platform name or
+    a cuda/rocm<->gpu alias — hasattr-based feature detection must not see
+    a TPU as a GPU. Non-strict (``sanitize_device``): additionally accepts
+    'gpu' as a generic accelerator request and 'axon' as a TPU alias."""
     if accel is None:
         return False
-    return (
-        name == accel.device_type
-        or (name in _GPU_ALIASES and accel.device_type in _GPU_ALIASES)
-        or name == "gpu"
-        or (name == "axon" and accel.device_type == "tpu")
-    )
+    if name == accel.device_type or (
+        name in _GPU_ALIASES and accel.device_type in _GPU_ALIASES
+    ):
+        return True
+    if strict:
+        return False
+    return name == "gpu" or (name == "axon" and accel.device_type == "tpu")
 
 
 def __getattr__(name: str):
@@ -110,7 +114,7 @@ def __getattr__(name: str):
     # without initializing XLA (import machinery getattrs freely)
     if name in ACCEL_NAMES:
         accel = _detect_accel()
-        if _accel_matches(name, accel):
+        if _accel_matches(name, accel, strict=True):
             return accel
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 

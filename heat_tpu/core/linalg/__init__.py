@@ -3,4 +3,4 @@ from . import basics, solver, svd
 from .basics import *
 from .qr import qr
 from .solver import *
-from .svd import rsvd, svd
+from .svd import lstsq, pinv, rsvd, svd

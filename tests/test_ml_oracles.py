@@ -105,5 +105,35 @@ class TestLassoOracle(TestCase):
         self.assertTrue(np.all(np.abs(w[1:][np.abs(w_true) == 0]) < 0.1))
 
 
+class TestLstsqPinv(TestCase):
+    def test_lstsq_matches_numpy(self):
+        import numpy as np
+
+        rng = np.random.default_rng(6)
+        A = rng.normal(size=(96, 8)).astype(np.float32)
+        b = A @ rng.normal(size=(8,)).astype(np.float32)
+        expected = np.linalg.lstsq(A, b, rcond=None)[0]
+        for sp in (None, 0):
+            x = ht.linalg.lstsq(ht.array(A, split=sp), ht.array(b, split=sp))
+            np.testing.assert_allclose(x.numpy().ravel(), expected, rtol=1e-3, atol=1e-4)
+
+    def test_pinv_properties(self):
+        import numpy as np
+
+        rng = np.random.default_rng(7)
+        for shape, sp in [((64, 6), 0), ((6, 64), 1), ((20, 20), None)]:
+            A = rng.normal(size=shape).astype(np.float32)
+            P = ht.linalg.pinv(ht.array(A, split=sp)).numpy()
+            # Moore-Penrose condition: A @ P @ A == A
+            np.testing.assert_allclose(A @ P @ A, A, rtol=1e-2, atol=1e-3)
+
+    def test_lstsq_validates(self):
+        import numpy as np
+
+        with self.assertRaises(ValueError):
+            ht.linalg.lstsq(ht.array(np.ones((4, 2), np.float32)),
+                            ht.array(np.ones((5,), np.float32)))
+
+
 if __name__ == "__main__":
     unittest.main()
