@@ -1,0 +1,42 @@
+"""Exact ring attention over a sequence sharded across the mesh.
+
+The long-context primitive: K/V blocks rotate around the device ring with
+``ppermute`` while each device folds one tile per step into its online
+softmax state — memory per device stays O(N/P · D) for an N-token
+sequence. Verified here against the materializing attention on a sequence
+that is only feasible sharded.
+
+Run (CPU mesh):
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/long_context/demo_ring_attention.py
+"""
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.parallel.ring_attention import attention, ring_attention
+
+
+def main():
+    comm = ht.get_comm()
+    p = comm.size
+    n, d = p * 256, 32  # sequence divisible over the ring
+    rng = np.random.default_rng(1)
+
+    q = ht.array(rng.normal(size=(n, d)).astype(np.float32), split=0)
+    k = ht.array(rng.normal(size=(n, d)).astype(np.float32), split=0)
+    v = ht.array(rng.normal(size=(n, d)).astype(np.float32), split=0)
+
+    out = ring_attention(q.larray, k.larray, v.larray, comm, causal=True)
+    print("ring attention:", out.shape, "devices:", p)
+
+    # oracle: single-device materializing attention
+    ref = attention(
+        np.asarray(q.larray), np.asarray(k.larray), np.asarray(v.larray), causal=True
+    )
+    err = float(np.abs(np.asarray(out) - np.asarray(ref)).max())
+    print("max |ring - materializing|:", err)
+    assert err < 1e-4
+
+
+if __name__ == "__main__":
+    main()
