@@ -39,14 +39,14 @@ class KNeighborsClassifier(BaseEstimator, ClassificationMixin):
             raise TypeError(f"input needs to be DNDarrays, but were {type(x)}, {type(y)}")
         self.x = x
         self.y = y
-        self.classes_ = jnp.unique(y.larray.ravel())
+        self.classes_ = jnp.unique(y._logical().ravel())
         return self
 
     def predict(self, x: DNDarray) -> DNDarray:
         """reference ``kneighborsclassifier.py:predict``"""
         if self.x is None:
             raise RuntimeError("fit needs to be called before predict")
-        yt = self.y.larray.ravel()
+        yt = self.y._logical().ravel()
         nq, nt = x.shape[0], self.x.shape[0]
         from ..core.kernels import pallas_supported
         from ..spatial.distance import nearest_neighbors
@@ -54,10 +54,10 @@ class KNeighborsClassifier(BaseEstimator, ClassificationMixin):
         if pallas_supported() and nq * nt > 1 << 22 and x.split in (None, 0):
             # fused pallas path: never materializes the (nq, nt) matrix
             _, idx_nd = nearest_neighbors(x, self.x, self.n_neighbors)
-            idx = idx_nd.larray
+            idx = idx_nd._logical()
         else:
-            Xq = x.larray.astype(jnp.float32)
-            Xt = self.x.larray.astype(jnp.float32)
+            Xq = x._logical().astype(jnp.float32)
+            Xt = self.x._logical().astype(jnp.float32)
             d2 = _quadratic_expand(Xq, Xt)  # (nq, nt)
             _, idx = jax.lax.top_k(-d2, self.n_neighbors)  # (nq, k) nearest
         neigh_labels = jnp.take(yt, idx)  # (nq, k)

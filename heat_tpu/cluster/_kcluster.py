@@ -71,7 +71,7 @@ class _KCluster(BaseEstimator, ClusteringMixin):
         """
         k = self.n_clusters
         xa = x.larray
-        n = xa.shape[0]
+        n = x.gshape[0]  # logical sample count; the buffer may carry padding
         if k > n:
             raise ValueError(f"n_clusters ({k}) cannot exceed the number of samples ({n})")
         if isinstance(self.init, DNDarray):
@@ -90,12 +90,13 @@ class _KCluster(BaseEstimator, ClusteringMixin):
             first = jax.random.randint(jax.random.fold_in(key, 0), (), 0, n)
             centers = jnp.zeros((k, xa.shape[1]), dtype=xa.dtype)
             centers = centers.at[0].set(xa[first])
-            d2 = _quadratic_expand(xa, centers[:1]).ravel()
+            # D^2 over the logical rows only (drop any buffer tail padding)
+            d2 = _quadratic_expand(xa, centers[:1]).ravel()[:n]
             for i in range(1, k):
                 probs = d2 / jnp.sum(d2)
                 nxt = jax.random.choice(jax.random.fold_in(key, i), n, p=probs)
                 centers = centers.at[i].set(xa[nxt])
-                d2 = jnp.minimum(d2, _quadratic_expand(xa, centers[i : i + 1]).ravel())
+                d2 = jnp.minimum(d2, _quadratic_expand(xa, centers[i : i + 1]).ravel()[:n])
             return centers
         raise ValueError(f"Initialization method {self.init!r} not supported")
 
@@ -104,8 +105,13 @@ class _KCluster(BaseEstimator, ClusteringMixin):
         if self._cluster_centers is None:
             raise RuntimeError("fit needs to be called before predict")
         labels = jnp.argmin(self._metric(x.larray, self._cluster_centers.larray), axis=1)
+        labels = labels.astype(jnp.int64)
+        n = x.gshape[0]
+        if x.split is not None and labels.shape[0] != n:
+            # padded buffer rows produced dead labels in the tail
+            return DNDarray._from_buffer(labels, (n,), types.int64, 0, x.device, x.comm)
         return DNDarray(
-            labels.astype(jnp.int64), dtype=types.int64, split=x.split, device=x.device, comm=x.comm
+            labels[:n], dtype=types.int64, split=x.split, device=x.device, comm=x.comm
         )
 
     def predict(self, x: DNDarray) -> DNDarray:

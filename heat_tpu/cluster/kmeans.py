@@ -25,17 +25,23 @@ from ._kcluster import _KCluster
 __all__ = ["KMeans"]
 
 
-def _lloyd_body(xa: jnp.ndarray, centers: jnp.ndarray, k: int):
+def _lloyd_body(xa: jnp.ndarray, centers: jnp.ndarray, k: int, n_valid):
     """One Lloyd iteration: (assign, update, shift) fused into one program.
 
     The distance+argmin runs on the sharded data; the one-hot update is an
-    MXU matmul whose reduction XLA psums over ICI.
+    MXU matmul whose reduction XLA psums over ICI. Rows past ``n_valid``
+    are buffer tail padding: their one-hot weight is zeroed so they never
+    touch counts or sums (labels in the padded rows are dead values).
     """
     d2 = _quadratic_expand(xa, centers)  # (n, k), sharded on n
     labels = jnp.argmin(d2, axis=1).astype(jnp.int32)
     onehot = jax.nn.one_hot(labels, k, dtype=xa.dtype)  # (n, k)
+    valid = jnp.arange(xa.shape[0]) < n_valid
+    onehot = onehot * valid[:, None].astype(xa.dtype)
+    # zero the padded rows themselves too: 0-weight x inf-garbage is nan
+    xa_safe = jnp.where(valid[:, None], xa, 0.0)
     counts = jnp.sum(onehot, axis=0)  # (k,)
-    sums = onehot.T @ xa  # (k, f) — MXU matmul + psum
+    sums = onehot.T @ xa_safe  # (k, f) — MXU matmul + psum
     new_centers = jnp.where(
         counts[:, None] > 0, sums / jnp.maximum(counts, 1.0)[:, None], centers
     )
@@ -47,13 +53,17 @@ _lloyd_step = partial(jax.jit, static_argnames=("k",))(_lloyd_body)
 
 
 @partial(jax.jit, static_argnames=("k",))
-def _inertia(xa: jnp.ndarray, centers: jnp.ndarray, k: int) -> jnp.ndarray:
+def _inertia(xa: jnp.ndarray, centers: jnp.ndarray, k: int, n_valid=None) -> jnp.ndarray:
     d2 = _quadratic_expand(xa, centers)
-    return jnp.sum(jnp.min(d2, axis=1))
+    per_row = jnp.min(d2, axis=1)
+    if n_valid is None:
+        return jnp.sum(per_row)
+    valid = jnp.arange(xa.shape[0]) < n_valid
+    return jnp.sum(jnp.where(valid, per_row, 0.0))
 
 
 @partial(jax.jit, static_argnames=("k", "max_iter"))
-def _lloyd_fit(xa: jnp.ndarray, centers: jnp.ndarray, k: int, max_iter: int, tol: float):
+def _lloyd_fit(xa: jnp.ndarray, centers: jnp.ndarray, k: int, max_iter: int, tol: float, n_valid=None):
     """The whole fit as ONE device program: a ``lax.while_loop`` over fused
     Lloyd iterations with the tol check on device. A full fit is a single
     dispatch — essential when the host drives the TPU over a network
@@ -65,10 +75,11 @@ def _lloyd_fit(xa: jnp.ndarray, centers: jnp.ndarray, k: int, max_iter: int, tol
 
     def body(state):
         i, c, _, _ = state
-        new_c, labels, shift = _lloyd_body(xa, c, k)
+        new_c, labels, shift = _lloyd_body(xa, c, k, nv)
         return (i + 1, new_c, labels, shift)
 
     n = xa.shape[0]
+    nv = n if n_valid is None else n_valid
     state0 = (0, centers, jnp.zeros((n,), dtype=jnp.int32), jnp.asarray(jnp.inf, xa.dtype))
     i, c, labels, _ = jax.lax.while_loop(cond, body, state0)
     return c, labels, i
@@ -111,15 +122,22 @@ class KMeans(_KCluster):
             raise ValueError(f"max_iter must be >= 1, got {self.max_iter}")
         k = self.n_clusters
         xa = x.larray.astype(jnp.promote_types(x.larray.dtype, jnp.float32))
+        n = x.gshape[0]
         centers = self._initialize_cluster_centers(x).astype(xa.dtype)
 
         tol = -1.0 if self.tol is None else float(self.tol)
-        centers, labels, n_iter = _lloyd_fit(xa, centers, k, self.max_iter, tol)
+        centers, labels, n_iter = _lloyd_fit(xa, centers, k, self.max_iter, tol, n)
 
         self._cluster_centers = DNDarray(centers, split=None, device=x.device, comm=x.comm)
-        self._labels = DNDarray(
-            labels.astype(jnp.int64), dtype=types.int64, split=x.split, device=x.device, comm=x.comm
-        )
-        self._inertia = float(_inertia(xa, centers, k))
+        labels = labels.astype(jnp.int64)
+        if x.split is not None and labels.shape[0] != n:
+            self._labels = DNDarray._from_buffer(
+                labels, (n,), types.int64, 0, x.device, x.comm
+            )
+        else:
+            self._labels = DNDarray(
+                labels[:n], dtype=types.int64, split=x.split, device=x.device, comm=x.comm
+            )
+        self._inertia = float(_inertia(xa, centers, k, n))
         self._n_iter = int(n_iter)
         return self

@@ -63,7 +63,7 @@ class KMedoids(_KCluster):
         if not isinstance(x, DNDarray):
             raise TypeError(f"input needs to be a DNDarray, but was {type(x)}")
         k = self.n_clusters
-        xa = x.larray.astype(jnp.promote_types(x.larray.dtype, jnp.float32))
+        xa = x._logical().astype(jnp.promote_types(x.larray.dtype, jnp.float32))
         centers = self._initialize_cluster_centers(x).astype(xa.dtype)
 
         labels = None

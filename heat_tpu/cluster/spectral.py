@@ -80,9 +80,9 @@ class Spectral(BaseEstimator, ClusteringMixin):
         m = min(self.n_lanczos, L.shape[0])
         V, T = lanczos(L, m)
         # local eigendecomposition of the tridiagonal T
-        evals, evecs = jnp.linalg.eigh(T.larray)
+        evals, evecs = jnp.linalg.eigh(T._logical())
         # back-project onto the Lanczos basis
-        full = V.larray @ evecs
+        full = V._logical() @ evecs
         return (
             DNDarray(evals, split=None, device=x.device, comm=x.comm),
             DNDarray(full, split=None, device=x.device, comm=x.comm),
@@ -95,12 +95,12 @@ class Spectral(BaseEstimator, ClusteringMixin):
         eigenvalues, eigenvectors = self._spectral_embedding(x)
         if self.n_clusters is None:
             # eigengap heuristic on sorted eigenvalues
-            ev = eigenvalues.larray
+            ev = eigenvalues._logical()
             diffs = jnp.diff(ev[: min(len(ev), 20)])
             self.n_clusters = int(jnp.argmax(diffs)) + 1
             self._cluster.n_clusters = max(self.n_clusters, 2)
         k = max(self.n_clusters, 2)
-        components = eigenvectors.larray[:, :k]
+        components = eigenvectors._logical()[:, :k]
         embedding = DNDarray(components, split=x.split, device=x.device, comm=x.comm)
         self._cluster.fit(embedding)
         self._labels = self._cluster.labels_
@@ -114,6 +114,6 @@ class Spectral(BaseEstimator, ClusteringMixin):
         _, eigenvectors = self._spectral_embedding(x)
         k = max(self.n_clusters, 2)
         embedding = DNDarray(
-            eigenvectors.larray[:, :k], split=x.split, device=x.device, comm=x.comm
+            eigenvectors._logical()[:, :k], split=x.split, device=x.device, comm=x.comm
         )
         return self._cluster.predict(embedding)

@@ -10,6 +10,11 @@ remains is exactly the *semantic* layer:
 - numpy/heat type-promotion (reference ``_operations.py:42-77``),
 - broadcast + split-axis compatibility and propagation,
 - reduction split bookkeeping (reference ``_operations.py:462-472``),
+- **padding discipline**: buffers are tail-padded along the split axis
+  (see :mod:`heat_tpu.core.dndarray`), so binary ops align operand buffers,
+  and reductions that cross the split axis mask the padding with the op's
+  neutral element (the analogue of the reference's neutral-element fill for
+  empty shards, ``_operations.py:424-436``),
 - ``out=`` rewriting.
 """
 from __future__ import annotations
@@ -25,7 +30,7 @@ from .communication import sanitize_comm
 from .dndarray import DNDarray
 from .stride_tricks import broadcast_shape, sanitize_axis
 
-__all__ = ["_binary_op", "_local_op", "_reduce_op", "_cum_op"]
+__all__ = ["_binary_op", "_local_op", "_reduce_op", "_cum_op", "_mask_padding"]
 
 Scalar = (int, float, bool, complex, np.number, np.bool_)
 
@@ -45,11 +50,80 @@ def _out_split_after_broadcast(ndim_out: int, operand: DNDarray) -> Optional[int
     return operand.split + (ndim_out - operand.ndim)
 
 
+def _neutral_value(neutral, dtype):
+    """Resolve a neutral-element spec to a concrete scalar for ``dtype``.
+
+    ``neutral`` may be a scalar; one of the strings ``"min"``/``"max"``
+    (the dtype's most negative / most positive value — the identity of
+    max/min reductions); ``"nan"`` (the ignored element of the jnp.nan*
+    reductions, inexact dtypes only); or a pair ``(inexact_spec, int_spec)``
+    choosing by dtype class (e.g. ``("nan", 0)`` for nansum, where integer
+    inputs degenerate to a plain sum). Returns None when the spec has no
+    value for this dtype — the caller then reduces the exact logical array.
+    """
+    d = jnp.dtype(dtype)
+    if isinstance(neutral, tuple):
+        neutral = neutral[0] if jnp.issubdtype(d, jnp.inexact) else neutral[1]
+    if isinstance(neutral, str):
+        if neutral == "nan":
+            return jnp.nan if jnp.issubdtype(d, jnp.inexact) else None
+        if jnp.issubdtype(d, jnp.inexact):
+            return -jnp.inf if neutral == "min" else jnp.inf
+        if d == np.bool_:
+            return neutral == "max"
+        info = jnp.iinfo(d)
+        return info.min if neutral == "min" else info.max
+    return neutral
+
+
+def _mask_padding(buffer: jax.Array, gshape, split: int, fill) -> jax.Array:
+    """Overwrite the tail padding along ``split`` with ``fill``."""
+    n = gshape[split]
+    if buffer.shape[split] == n:
+        return buffer
+    fill = _neutral_value(fill, buffer.dtype)
+    if fill is None:
+        raise ValueError("no neutral value for this dtype; reduce the logical array instead")
+    iota = jax.lax.broadcasted_iota(jnp.int32, buffer.shape, split)
+    return jnp.where(iota < n, buffer, jnp.asarray(fill, dtype=buffer.dtype))
+
+
+def _aligned_operand_buffer(
+    op: DNDarray, jt, out_shape, out_split: Optional[int], out_pshape
+) -> jax.Array:
+    """Operand buffer cast to ``jt`` and physically broadcast-compatible
+    with the (possibly padded) output buffer."""
+    buf = op.larray.astype(jt)
+    if out_split is None or out_shape == tuple(out_pshape):
+        # unpadded output: any padded operand must be trimmed (only happens
+        # for a size-1 split dim padded to the mesh size)
+        return op._logical().astype(jt) if op.padded else buf
+    j = out_split - (len(out_shape) - op.ndim)
+    if j < 0:
+        return buf  # operand has no dim at the output split axis
+    d = op.gshape[j]
+    if d == 1:
+        # broadcasts against the padded extent; drop any padding of its own
+        return op._logical().astype(jt) if op.padded else buf
+    if op.split == j:
+        return buf  # padded identically to the output by construction
+    # replicated (or differently laid out) operand at full logical extent:
+    # zero-pad to the output's buffer extent
+    pad = [(0, 0)] * op.ndim
+    pad[j] = (0, out_pshape[out_split] - d)
+    base = op._logical() if op.padded else op.larray
+    return jnp.pad(base.astype(jt), pad)
+
+
 def _write_out(out: DNDarray, result: DNDarray) -> DNDarray:
     """Rewrite ``out`` in place with ``result`` (reference out= semantics)."""
     if tuple(out.shape) != tuple(result.shape):
         raise ValueError(f"output shape {out.shape} does not match result shape {result.shape}")
-    out.larray = result.larray.astype(out.dtype.jax_type())
+    target_t = out.dtype.jax_type()
+    if out.split == result.split:
+        out._set_buffer(result.larray.astype(target_t), result.gshape)
+    else:
+        out.larray = result._logical().astype(target_t)
     return out
 
 
@@ -84,20 +158,32 @@ def _binary_op(
             f"DNDarrays must have the same split axes, found {a.split} and {b.split}"
         )
     out_split = sa if sa is not None else sb
+    out_pshape = comm.padded_shape(out_shape, out_split)
 
     jt = promoted.jax_type()
-    result = operation(a.larray.astype(jt), b.larray.astype(jt), **fn_kwargs)
+    buf_a = _aligned_operand_buffer(a, jt, out_shape, out_split, out_pshape)
+    buf_b = _aligned_operand_buffer(b, jt, out_shape, out_split, out_pshape)
+    result = operation(buf_a, buf_b, **fn_kwargs)
     if where is not True:
-        where_arr = where.larray if isinstance(where, DNDarray) else jnp.asarray(where)
-        base = out.larray if out is not None else jnp.zeros(out_shape, dtype=result.dtype)
+        where_nd = _as_dndarray(where, device, comm)
+        where_arr = _aligned_operand_buffer(
+            where_nd, where_nd.dtype.jax_type(), out_shape, out_split, out_pshape
+        )
+        if out is not None:
+            base = _aligned_operand_buffer(
+                out, result.dtype, out_shape, out_split, out_pshape
+            )
+        else:
+            base = jnp.zeros(out_pshape, dtype=result.dtype)
         result = jnp.where(where_arr, result, base)
 
-    res = DNDarray(
+    res = DNDarray._from_buffer(
         result,
-        dtype=types.canonical_heat_type(result.dtype),
-        split=out_split,
-        device=device,
-        comm=comm,
+        out_shape,
+        types.canonical_heat_type(result.dtype),
+        out_split,
+        device,
+        comm,
     )
     if out is not None:
         return _write_out(out, res)
@@ -113,7 +199,8 @@ def _local_op(
     **kwargs,
 ) -> DNDarray:
     """Embarrassingly-parallel elementwise op (reference
-    ``_operations.py:305-376``). Split and sharding are inherited."""
+    ``_operations.py:305-376``). Split, sharding and padding are inherited:
+    the op runs on the padded buffer (pad content stays unspecified)."""
     if not isinstance(x, DNDarray):
         raise TypeError(f"expected x to be a DNDarray, but was {type(x)}")
     arr = x.larray
@@ -125,13 +212,18 @@ def _local_op(
             arr = arr.astype(types.promote_types(x.dtype, types.float32).jax_type())
     result = operation(arr, **kwargs)
     dtype = out_dtype if out_dtype is not None else types.canonical_heat_type(result.dtype)
-    res = DNDarray(
-        result.astype(dtype.jax_type()),
-        dtype=dtype,
-        split=x.split if result.ndim == x.ndim else None,
-        device=x.device,
-        comm=x.comm,
-    )
+    if tuple(result.shape) == x.pshape:
+        res = DNDarray._from_buffer(
+            result.astype(dtype.jax_type()), x.gshape, dtype, x.split, x.device, x.comm
+        )
+    else:
+        res = DNDarray(
+            result.astype(dtype.jax_type()),
+            dtype=dtype,
+            split=x.split if result.ndim == x.ndim else None,
+            device=x.device,
+            comm=x.comm,
+        )
     if out is not None:
         return _write_out(out, res)
     return res
@@ -144,6 +236,7 @@ def _reduce_op(
     out: Optional[DNDarray] = None,
     keepdims: bool = False,
     out_dtype=None,
+    neutral=None,
     **kwargs,
 ) -> DNDarray:
     """Global reduction (reference ``_operations.py:379-505``).
@@ -151,24 +244,49 @@ def _reduce_op(
     The reference computed a local partial then Allreduced with a custom MPI
     op when the split axis was reduced; XLA compiles ``jnp`` reductions over
     sharded inputs to the identical partial+all-reduce schedule on ICI.
-    Split bookkeeping follows reference ``_operations.py:462-472``.
+
+    ``neutral`` is the op's identity element (scalar, ``"min"``/``"max"``,
+    or ``"nan"``): tail padding is overwritten with it before reducing — the
+    analogue of the reference's neutral fill for empty chunks
+    (``_operations.py:424-436``). A padded input with no neutral given falls
+    back to reducing the exact logical array.
     """
     if not isinstance(x, DNDarray):
         raise TypeError(f"expected x to be a DNDarray, but was {type(x)}")
     axis = sanitize_axis(x.shape, axis)
-    result = operation(x.larray, axis=axis, keepdims=keepdims, **kwargs)
+    arr = x.larray
+    if x.padded:
+        fill = None if neutral is None else _neutral_value(neutral, arr.dtype)
+        if fill is not None:
+            arr = _mask_padding(arr, x.gshape, x.split, fill)
+        else:
+            arr = x._logical()
+    result = operation(arr, axis=axis, keepdims=keepdims, **kwargs)
     out_split = _reduced_split(x.split, axis, x.ndim, keepdims)
     dtype = out_dtype if out_dtype is not None else types.canonical_heat_type(result.dtype)
-    res = DNDarray(
-        jnp.asarray(result).astype(dtype.jax_type()),
-        dtype=dtype,
-        split=out_split,
-        device=x.device,
-        comm=x.comm,
-    )
+    result = jnp.asarray(result).astype(dtype.jax_type())
+    out_gshape = _reduced_shape(x.gshape, axis, keepdims)
+    if out_split is not None and tuple(result.shape) != out_gshape:
+        res = DNDarray._from_buffer(result, out_gshape, dtype, out_split, x.device, x.comm)
+    else:
+        res = DNDarray(
+            result, gshape=out_gshape, dtype=dtype, split=out_split,
+            device=x.device, comm=x.comm,
+        )
     if out is not None:
         return _write_out(out, res)
     return res
+
+
+def _reduced_shape(gshape, axis, keepdims: bool) -> Tuple[int, ...]:
+    """Logical shape after reducing ``axis``."""
+    if axis is None:
+        axes = tuple(range(len(gshape)))
+    else:
+        axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    if keepdims:
+        return tuple(1 if i in axes else s for i, s in enumerate(gshape))
+    return tuple(s for i, s in enumerate(gshape) if i not in axes)
 
 
 def _reduced_split(
@@ -199,7 +317,9 @@ def _cum_op(
 
     The reference's local-cumop + ``Exscan`` + combine pattern is exactly
     what XLA generates for a cumulative op over a sharded axis; a single
-    global ``jnp`` call suffices.
+    global ``jnp`` call suffices. Tail padding is harmless here: it sits
+    strictly *after* every valid element along the split axis, so valid
+    prefixes never include it.
     """
     if not isinstance(x, DNDarray):
         raise TypeError(f"expected x to be a DNDarray, but was {type(x)}")
@@ -211,12 +331,13 @@ def _cum_op(
         dtype = types.canonical_heat_type(dtype)
         arr = arr.astype(dtype.jax_type())
     result = operation(arr, axis=axis)
-    res = DNDarray(
+    res = DNDarray._from_buffer(
         result,
-        dtype=types.canonical_heat_type(result.dtype),
-        split=x.split,
-        device=x.device,
-        comm=x.comm,
+        x.gshape,
+        types.canonical_heat_type(result.dtype),
+        x.split,
+        x.device,
+        x.comm,
     )
     if out is not None:
         return _write_out(out, res)

@@ -212,14 +212,14 @@ def diff(a: DNDarray, n: int = 1, axis: int = -1, prepend=None, append=None) -> 
     def _edge(v):
         if v is None:
             return None
-        arr = v.larray if isinstance(v, DNDarray) else jnp.asarray(v)
+        arr = v._logical() if isinstance(v, DNDarray) else jnp.asarray(v)
         if arr.ndim == 0:
             shape = list(a.shape)
             shape[axis] = 1
             arr = jnp.broadcast_to(arr, shape)
         return arr
 
-    result = jnp.diff(a.larray, n=n, axis=axis, prepend=_edge(prepend), append=_edge(append))
+    result = jnp.diff(a._logical(), n=n, axis=axis, prepend=_edge(prepend), append=_edge(append))
     return DNDarray(
         result,
         dtype=types.canonical_heat_type(result.dtype),
@@ -247,20 +247,20 @@ def _merge_keepdim(keepdim, keepdims) -> bool:
 def sum(a: DNDarray, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
     """Sum over axis (reference ``arithmetics.py:960``)."""
     kd = _merge_keepdim(keepdim, keepdims)
-    return _reduce_op(jnp.sum, a, axis=axis, out=out, keepdims=kd, out_dtype=_int_to_int64(a))
+    return _reduce_op(jnp.sum, a, axis=axis, out=out, keepdims=kd, out_dtype=_int_to_int64(a), neutral=0)
 
 
 def prod(a: DNDarray, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
     """Product over axis (reference ``arithmetics.py:870``)."""
     kd = _merge_keepdim(keepdim, keepdims)
-    return _reduce_op(jnp.prod, a, axis=axis, out=out, keepdims=kd, out_dtype=_int_to_int64(a))
+    return _reduce_op(jnp.prod, a, axis=axis, out=out, keepdims=kd, out_dtype=_int_to_int64(a), neutral=1)
 
 
 def nansum(a: DNDarray, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
     """Sum ignoring NaNs."""
-    return _reduce_op(jnp.nansum, a, axis=axis, out=out, keepdims=_merge_keepdim(keepdim, keepdims))
+    return _reduce_op(jnp.nansum, a, axis=axis, out=out, keepdims=_merge_keepdim(keepdim, keepdims), neutral=("nan", None))
 
 
 def nanprod(a: DNDarray, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
     """Product ignoring NaNs."""
-    return _reduce_op(jnp.nanprod, a, axis=axis, out=out, keepdims=_merge_keepdim(keepdim, keepdims))
+    return _reduce_op(jnp.nanprod, a, axis=axis, out=out, keepdims=_merge_keepdim(keepdim, keepdims), neutral=("nan", None))

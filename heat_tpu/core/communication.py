@@ -129,20 +129,40 @@ class MeshCommunication(Communication):
         """NamedSharding for an ``ndim``-dim array split along ``split``."""
         return NamedSharding(self.mesh, self.spec(ndim, split))
 
-    def phys_split(self, shape, split: Optional[int]) -> Optional[int]:
-        """The physically realizable split: XLA requires the sharded dim to
-        divide the mesh size; non-divisible dims are replicated (the
-        DNDarray keeps the logical ``split`` as metadata)."""
+    def padded_dim(self, n: int) -> int:
+        """Physical size of a split dimension of logical size ``n``: the
+        smallest multiple of the mesh size >= ``n`` (ceil-div padding).
+
+        JAX rejects uneven ``NamedSharding``s at every array boundary
+        (``device_put``/jit in/out); the TPU-native answer is static even
+        shards + tail padding, with validity masks at reductions. The
+        reference instead allowed ragged per-rank chunks
+        (``communication.py:161-209``) — same logical layout, since the
+        ceil-div chunks here are exactly the valid prefixes of the padded
+        blocks.
+        """
+        n = int(n)
+        block = -(-n // self.size) if n else 0
+        return max(block, 1) * self.size
+
+    def padded_shape(self, shape, split: Optional[int]) -> Tuple[int, ...]:
+        """Physical (buffer) shape for a logical ``shape`` split at ``split``."""
+        shape = tuple(int(s) for s in shape)
         if split is None:
-            return None
-        if shape[split] % self.size != 0:
-            return None
-        return split
+            return shape
+        out = list(shape)
+        out[split] = self.padded_dim(shape[split])
+        return tuple(out)
 
     def array_sharding(self, shape, split: Optional[int]) -> NamedSharding:
-        """Sharding actually applied to an array of ``shape`` (divisibility
-        rule included)."""
-        return self.sharding(len(shape), self.phys_split(shape, split))
+        """Sharding applied to a physical buffer of ``shape``. The split dim
+        must already be padded to a multiple of the mesh size."""
+        if split is not None and shape[split] % self.size != 0:
+            raise ValueError(
+                f"buffer dim {split} of shape {tuple(shape)} is not a multiple of the "
+                f"mesh size {self.size}; pad with padded_shape() first"
+            )
+        return self.sharding(len(shape), split)
 
     # -- partition bookkeeping (reference communication.py:161-239) -----------
     def chunk(

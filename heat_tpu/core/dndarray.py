@@ -7,6 +7,16 @@ interaction is hand-written MPI. Here the underlying object is a **global**
 ``split`` axis maps 1:1 onto the mesh axis ``"split"`` of the array's
 ``PartitionSpec``. Consequences:
 
+- **Padded buffers**: JAX requires every sharded dimension to be divisible
+  by the mesh size, so the stored buffer is padded along the split axis to
+  ``P * ceil(n / P)`` (``pshape``); the logical extent ``gshape`` is
+  metadata. Padding sits strictly at the global tail, so logical index ->
+  buffer index is the identity for every valid element; the valid region of
+  device ``r``'s block is exactly the reference's ceil-div ``comm.chunk``.
+  Pad content is *unspecified* — reductions/contractions mask it with the
+  op's neutral element (see ``_operations``), data-movement ops work on the
+  logical view (:meth:`_logical`). For divisible shapes (and ``split=None``)
+  buffer == logical array and nothing changes.
 - ``redistribute_``/``balance_`` (reference ``dndarray.py:1029,470``) are
   metadata-trivial: XLA always lays shards out in canonical ceil-div blocks,
   so every DNDarray is permanently balanced.
@@ -88,34 +98,116 @@ class DNDarray:
             array = array.astype(dtype.jax_type())
         if array.ndim == 0:
             split = None
-        split = sanitize_axis(array.shape, split)
+        if gshape is None:
+            gshape = tuple(array.shape)
+        else:
+            gshape = tuple(int(s) for s in gshape)
+        split = sanitize_axis(gshape, split)
         self.__dtype = dtype
         self.__split = split
-        self.__array = _place(array, self.__comm, split)
+        self.__gshape = gshape
+        self.__array = _place(array, self.__comm, split, gshape)
+
+    @classmethod
+    def _from_buffer(
+        cls,
+        buffer: jax.Array,
+        gshape: Tuple[int, ...],
+        dtype,
+        split: Optional[int],
+        device: Optional[Device] = None,
+        comm: Optional[MeshCommunication] = None,
+    ) -> "DNDarray":
+        """Wrap an already-padded, already-placed physical buffer.
+
+        Internal fast path for op results: ``buffer.shape`` must equal
+        ``comm.padded_shape(gshape, split)``.
+        """
+        out = cls.__new__(cls)
+        out._DNDarray__comm = sanitize_comm(comm)
+        out._DNDarray__device = devices.sanitize_device(device)
+        out._DNDarray__dtype = types.canonical_heat_type(dtype)
+        out._DNDarray__split = split
+        out._DNDarray__gshape = tuple(int(s) for s in gshape)
+        out._DNDarray__array = _place(buffer, out._DNDarray__comm, split, out._DNDarray__gshape)
+        return out
 
     # ------------------------------------------------------------------ meta
     @property
     def larray(self) -> jax.Array:
-        """The underlying global ``jax.Array``.
+        """The underlying global physical buffer (``jax.Array``).
 
         The reference returns the rank-local torch shard
         (``dndarray.py:110``); under single-controller JAX the process
         addresses the global sharded array, which is the analogous handle.
-        Per-device shards are available via :attr:`local_shards`.
+        **The buffer is padded along the split axis** when the logical
+        extent does not divide the mesh size (``pshape`` vs ``gshape``);
+        use :meth:`_logical` for the exact logical array. Per-device shards
+        are available via :attr:`local_shards`.
         """
         return self.__array
 
     @larray.setter
     def larray(self, value):
+        """Replace the data; ``value`` is interpreted as the *logical*
+        global array (it will be padded/placed as needed)."""
         if not isinstance(value, jax.Array):
             value = jnp.asarray(value)
-        self.__array = _place(value, self.__comm, sanitize_axis(value.shape, self.__split))
+        gshape = tuple(value.shape)
+        split = sanitize_axis(gshape, self.__split)
+        self.__array = _place(value, self.__comm, split, gshape)
+        self.__gshape = gshape
+        self.__split = split
         self.__dtype = types.canonical_heat_type(value.dtype)
+
+    def _set_buffer(self, buffer: jax.Array, gshape=None) -> None:
+        """Replace the physical buffer in place (internal; buffer must be
+        padded for the current split)."""
+        gshape = self.__gshape if gshape is None else tuple(int(s) for s in gshape)
+        self.__array = _place(buffer, self.__comm, self.__split, gshape)
+        self.__gshape = gshape
+        self.__dtype = types.canonical_heat_type(buffer.dtype)
+
+    @property
+    def pshape(self) -> Tuple[int, ...]:
+        """Shape of the physical buffer (== ``gshape`` unless padded)."""
+        return tuple(self.__array.shape)
+
+    @property
+    def padded(self) -> bool:
+        """True when the buffer carries tail padding along the split axis."""
+        return tuple(self.__array.shape) != self.__gshape
+
+    def _logical(self) -> jax.Array:
+        """The exact logical global array (buffer with tail padding sliced
+        off). Cheap no-op when not padded; otherwise an XLA slice that may
+        reshard — intended for data-movement ops, not hot elementwise paths.
+        """
+        if not self.padded:
+            return self.__array
+        sl = tuple(slice(0, s) for s in self.__gshape)
+        return self.__array[sl]
 
     @property
     def local_shards(self) -> List[jax.Array]:
-        """Per-device addressable shards (TPU-native view of 'local' data)."""
-        return [s.data for s in self.__array.addressable_shards]
+        """Per-device addressable shards, trimmed to their *valid* extent
+        (TPU-native view of 'local' data): shard ``r``'s shape equals the
+        reference's ``comm.chunk`` result even when the buffer is padded."""
+        shards = sorted(
+            self.__array.addressable_shards,
+            key=lambda s: tuple(sl.start or 0 for sl in s.index),
+        )
+        if self.__split is None or not self.padded:
+            return [s.data for s in shards]
+        n = self.__gshape[self.__split]
+        out = []
+        for s in shards:
+            start = s.index[self.__split].start or 0
+            valid = max(0, min(n - start, s.data.shape[self.__split]))
+            sl = [slice(None)] * self.ndim
+            sl[self.__split] = slice(0, valid)
+            out.append(s.data[tuple(sl)])
+        return out
 
     @property
     def comm(self) -> MeshCommunication:
@@ -144,20 +236,35 @@ class DNDarray:
 
     @property
     def gshape(self) -> Tuple[int, ...]:
-        return tuple(self.__array.shape)
+        return self.__gshape
 
     @property
     def shape(self) -> Tuple[int, ...]:
-        return tuple(self.__array.shape)
+        return self.__gshape
 
     @property
     def lshape(self) -> Tuple[int, ...]:
-        """Shape of this process's first shard (reference: the rank-local
-        shape, ``dndarray.py:172``)."""
+        """Shape of the data addressable by *this process* (reference: the
+        rank-local shape, ``dndarray.py:172``). Single-host this is the
+        whole logical array; multi-host it is the union of the valid chunks
+        of this process's devices (a contiguous split-axis range, since mesh
+        order is process-major)."""
         if self.__split is None:
-            return tuple(self.__array.shape)
-        _, lshape, _ = self.__comm.chunk(self.gshape, self.__split, rank=0)
-        return lshape
+            return self.__gshape
+        counts, displs = self.counts_displs()
+        pid = jax.process_index()
+        mine = [
+            i
+            for i, d in enumerate(self.__comm.mesh.devices.ravel())
+            if d.process_index == pid
+        ]
+        if not mine:  # pragma: no cover - defensive
+            mine = list(range(len(counts)))
+        lo = displs[mine[0]]
+        hi = displs[mine[-1]] + counts[mine[-1]]
+        lshape = list(self.__gshape)
+        lshape[self.__split] = hi - lo
+        return tuple(lshape)
 
     @property
     def lshape_map(self) -> np.ndarray:
@@ -179,11 +286,11 @@ class DNDarray:
 
     @property
     def ndim(self) -> int:
-        return self.__array.ndim
+        return len(self.__gshape)
 
     @property
     def size(self) -> int:
-        return int(np.prod(self.__array.shape)) if self.__array.ndim else 1
+        return int(np.prod(self.__gshape)) if self.__gshape else 1
 
     @property
     def gnumel(self) -> int:
@@ -311,12 +418,15 @@ class DNDarray:
         """Return a host-memory copy (reference ``dndarray.py:560`` moved
         torch storage to CPU). The returned DNDarray's buffer lives on the
         JAX CPU backend — it does not occupy accelerator HBM."""
-        host = jax.device_put(self.__array, jax.local_devices(backend="cpu")[0])
+        host = jax.device_put(
+            jnp.asarray(self.numpy()), jax.local_devices(backend="cpu")[0]
+        )
         out = DNDarray.__new__(DNDarray)
         out._DNDarray__comm = self.__comm
         out._DNDarray__device = devices.cpu
         out._DNDarray__dtype = self.__dtype
         out._DNDarray__split = None
+        out._DNDarray__gshape = self.__gshape
         out._DNDarray__array = host
         return out
 
@@ -328,7 +438,7 @@ class DNDarray:
         axis = sanitize_axis(self.gshape, axis)
         if axis == self.__split:
             return self
-        self.__array = _place(self.__array, self.__comm, axis, force=True)
+        self.__array = _place(self._logical(), self.__comm, axis, self.__gshape, force=True)
         self.__split = axis
         return self
 
@@ -336,7 +446,8 @@ class DNDarray:
         """Out-of-place resplit (reference ``manipulations.py:3329``)."""
         axis = sanitize_axis(self.gshape, axis)
         return DNDarray(
-            _place(self.__array, self.__comm, axis, force=True),
+            self._logical(),
+            gshape=self.__gshape,
             dtype=self.__dtype,
             split=axis,
             device=self.__device,
@@ -392,17 +503,20 @@ class DNDarray:
         dtype = types.canonical_heat_type(dtype)
         casted = self.__array.astype(dtype.jax_type())
         if copy:
-            return DNDarray(
-                casted, dtype=dtype, split=self.__split, device=self.__device, comm=self.__comm
+            return DNDarray._from_buffer(
+                casted, self.__gshape, dtype, self.__split, self.__device, self.__comm
             )
         self.__array = casted
         self.__dtype = dtype
         return self
 
     def numpy(self) -> np.ndarray:
-        """Gather the global array to host memory (reference
-        ``dndarray.py:991``)."""
-        return np.asarray(jax.device_get(self.__array))
+        """Gather the logical global array to host memory (reference
+        ``dndarray.py:991``). Tail padding is sliced off host-side."""
+        host = np.asarray(jax.device_get(self.__array))
+        if self.padded:
+            host = host[tuple(slice(0, s) for s in self.__gshape)]
+        return host
 
     def __array__(self, dtype=None):
         out = self.numpy()
@@ -413,6 +527,8 @@ class DNDarray:
 
     def item(self):
         """Scalar extraction (reference ``dndarray.py:955``)."""
+        if self.padded:
+            return self._logical().item()
         return self.__array.item()
 
     def __bool__(self) -> bool:
@@ -429,7 +545,7 @@ class DNDarray:
 
     def __cast(self, cast_function):
         if np.prod(self.shape) == 1:
-            return cast_function(self.__array.reshape(()).item())
+            return cast_function(self._logical().reshape(()).item())
         raise TypeError("only size-1 arrays can be converted to Python scalars")
 
     def __len__(self) -> int:
@@ -449,7 +565,11 @@ class DNDarray:
             raise ValueError("input array must be 2D")
         idx = jnp.arange(n)
         self.__array = _place(
-            self.__array.at[idx, idx].set(value), self.__comm, self.__split, force=True
+            self.__array.at[idx, idx].set(value),
+            self.__comm,
+            self.__split,
+            self.__gshape,
+            force=True,
         )
         return self
 
@@ -474,19 +594,30 @@ class DNDarray:
         )
 
     def __setitem__(self, key, value) -> None:
-        """Global scatter-update (reference ``dndarray.py:1359-1676``)."""
+        """Global scatter-update (reference ``dndarray.py:1359-1676``).
+
+        Keys are normalized to the logical extent, so only valid elements
+        are ever written; tail padding stays untouched."""
         key_t, _ = self.__translate_key(key)
         if isinstance(value, DNDarray):
-            value = value.larray
+            value = value._logical()
         self.__array = _place(
             self.__array.at[key_t].set(jnp.asarray(value, dtype=self.__dtype.jax_type())),
             self.__comm,
             self.__split,
+            self.__gshape,
             force=True,
         )
 
     def __translate_key(self, key):
-        """Normalize an index key and compute the resulting split axis."""
+        """Normalize an index key against the *logical* shape and compute
+        the resulting split axis.
+
+        Keys addressing the (possibly padded) split dimension are rewritten
+        so they can never select tail padding: slices get explicit logical
+        bounds, negative scalars/arrays are wrapped mod the logical extent,
+        boolean masks are False-padded to the buffer extent.
+        """
         split = self.__split
         if isinstance(key, DNDarray):
             # coordinate-list indexing: x[nonzero(x)] with an (n, ndim) int
@@ -498,12 +629,13 @@ class DNDarray:
                 and key.gshape[1] == self.ndim
                 and types.issubdtype(key.dtype, types.integer)
             ):
-                cols = tuple(key.larray[:, d] for d in range(self.ndim))
+                logical_key = key._logical()
+                cols = tuple(logical_key[:, d] for d in range(self.ndim))
                 return cols, (0 if split is not None else None)
-            key = key.larray
+            key = key._logical()
         if not isinstance(key, tuple):
             key = (key,)
-        key = tuple(k.larray if isinstance(k, DNDarray) else k for k in key)
+        key = tuple(k._logical() if isinstance(k, DNDarray) else k for k in key)
         # jnp accepts builtin-bool scalar keys but asserts on np.bool_ ones
         key = tuple(bool(k) if isinstance(k, np.bool_) else k for k in key)
         # expand ellipsis ("in"/.index would trip elementwise == on array keys);
@@ -523,26 +655,53 @@ class DNDarray:
         if e is not None:
             fill = (slice(None),) * (self.ndim - n_specified)
             key = key[:e] + fill + key[e + 1 :]
+            n_specified = self.ndim  # ellipsis expansion covers every dim
         if split is None:
             return key, None
-        # walk input dims -> output dims to find where split lands
+        needs_norm = self.padded
+        n_split = self.__gshape[split]
+        n_buf = self.__array.shape[split]
+        if needs_norm and n_specified <= split:
+            # make sure the split dim is explicitly keyed so normalization
+            # below can exclude the tail padding
+            key = key + (slice(None),) * (split + 1 - n_specified)
+        # walk input dims -> output dims to find where split lands,
+        # normalizing split-dim keys against the logical extent
         in_dim = 0
         out_dim = 0
         out_split: Optional[int] = None
+        new_key = []
         for k in key:
             if k is None:
+                new_key.append(k)
                 out_dim += 1
                 continue
             if isinstance(k, (bool, np.bool_)):
+                new_key.append(k)
                 out_dim += 1  # scalar bool adds an axis, consumes none
                 continue
             if in_dim == split:
                 if isinstance(k, slice):
                     out_split = out_dim
+                    if needs_norm:
+                        k = _normalize_slice(k, n_split)
                 elif isinstance(k, (int, np.integer)):
                     out_split = None  # scalar on split axis -> replicated bcast
+                    if needs_norm and k < 0:
+                        k = int(k) + n_split
                 else:
                     out_split = 0  # advanced index on split axis -> split 0
+                    if needs_norm:
+                        arr = jnp.asarray(k)
+                        if arr.dtype == jnp.bool_:
+                            # mask covers dims [in_dim, in_dim + arr.ndim);
+                            # False-pad the split-dim axis to buffer extent
+                            pads = [(0, 0)] * arr.ndim
+                            pads[split - in_dim] = (0, n_buf - n_split)
+                            k = jnp.pad(arr, pads, constant_values=False)
+                        else:
+                            k = jnp.where(arr < 0, arr + n_split, arr)
+                new_key.append(k)
                 in_dim += 1
                 out_dim += 1 if not isinstance(k, (int, np.integer)) else 0
                 continue
@@ -554,12 +713,18 @@ class DNDarray:
             else:  # array-like advanced index
                 arr = np.asarray(k) if not isinstance(arr_k := k, jax.Array) else arr_k
                 if arr.dtype == np.bool_ or arr.dtype == jnp.bool_:
+                    if needs_norm and in_dim < split < in_dim + arr.ndim:
+                        pads = [(0, 0)] * arr.ndim
+                        pads[split - in_dim] = (0, n_buf - n_split)
+                        k = jnp.pad(jnp.asarray(arr), pads, constant_values=False)
                     in_dim += arr.ndim
                 else:
                     in_dim += 1
                 out_dim += 1
+            new_key.append(k)
+        key = tuple(new_key)
         # trailing unindexed dims: split stays at its offset position
-        if in_dim <= split:
+        if in_dim <= split and out_split is None:
             out_split = out_dim + (split - in_dim)
         return key, out_split
 
@@ -941,16 +1106,43 @@ class DNDarray:
         return printing.__str__(self)
 
 
-def _place(
-    array: jax.Array, comm: MeshCommunication, split: Optional[int], force: bool = False
-) -> jax.Array:
-    """Ensure ``array`` carries the NamedSharding implied by (comm, split).
+def _normalize_slice(s: slice, n: int) -> slice:
+    """Rewrite ``s`` with explicit bounds for a logical extent ``n`` so it
+    can be applied to a tail-padded buffer without selecting padding."""
+    start, stop, step = s.indices(n)
+    if step < 0:
+        # stop == -1 means "run through index 0"; an explicit -1 would wrap
+        return slice(start, None if stop < 0 else stop, step)
+    return slice(start, stop, step)
 
-    ``split`` is *logical* metadata: XLA requires the sharded dimension to
-    divide the mesh size, so non-divisible dims are physically replicated
-    (ops stay correct; algorithms that need real shards — TSQR, shard_map
-    kernels — pad explicitly). Divisible dims get the true 1-D sharding.
+
+def _place(
+    array: jax.Array,
+    comm: MeshCommunication,
+    split: Optional[int],
+    gshape: Optional[Tuple[int, ...]] = None,
+    force: bool = False,
+) -> jax.Array:
+    """Ensure ``array`` is the padded physical buffer for (comm, split,
+    gshape), carrying the even NamedSharding over the mesh.
+
+    ``array`` may arrive as the logical array (shape == gshape; it is
+    zero-padded along the split dim to a multiple of the mesh size) or as an
+    already-padded buffer (shape == padded_shape; taken as-is). Every shape
+    is shardable this way — non-divisible logical extents get tail padding
+    instead of the replication fallback of round 1.
     """
+    gshape = tuple(array.shape) if gshape is None else tuple(int(s) for s in gshape)
+    if split is not None:
+        target_shape = comm.padded_shape(gshape, split)
+        if tuple(array.shape) == gshape and gshape != target_shape:
+            pad = [(0, t - s) for t, s in zip(target_shape, array.shape)]
+            array = jnp.pad(array, pad)
+        elif tuple(array.shape) != target_shape:
+            raise ValueError(
+                f"buffer shape {tuple(array.shape)} matches neither logical {gshape} "
+                f"nor padded {target_shape}"
+            )
     target = comm.array_sharding(array.shape, split)
     current = getattr(array, "sharding", None)
     if not force and current is not None and current.is_equivalent_to(target, array.ndim):

@@ -67,7 +67,7 @@ def array(
     if isinstance(obj, DNDarray):
         if dtype is None:
             dtype = obj.dtype
-        data = obj.larray
+        data = obj._logical()
     else:
         data = obj
 
@@ -117,27 +117,36 @@ def asarray(obj, dtype=None, copy=None, order="C", is_split=None, device=None) -
 def _sharded_factory(shape, split, comm, fill) -> jax.Array:
     """jit a fill function straight into the target sharding (no host pass).
 
-    jit output shardings require the split dim to divide the mesh; uneven
-    shapes fall back to compute-then-reshard (device-to-device on ICI).
+    ``fill`` receives the *physical* (padded) shape to build; the result is
+    born in its final even sharding, so large distributed arrays never
+    materialize on one device.
     """
-    sharding = comm.array_sharding(shape, split)
-    return jax.jit(fill, out_shardings=sharding)()
+    pshape = comm.padded_shape(shape, split)
+    sharding = comm.array_sharding(pshape, split)
+    return jax.jit(lambda: fill(pshape), out_shardings=sharding)()
+
+
+def _build(shape, split, comm, dtype, device, fill) -> DNDarray:
+    """Run a padded-shape fill and wrap it with logical-gshape metadata."""
+    data = _sharded_factory(shape, split, comm, fill)
+    return DNDarray._from_buffer(
+        data, shape, dtype, split, devices.sanitize_device(device), comm
+    )
 
 
 def __factory(shape, dtype, split, device, comm, fill_name) -> DNDarray:
     shape = sanitize_shape(shape)
     dtype = types.canonical_heat_type(dtype)
     split = sanitize_axis(shape, split)
-    device = devices.sanitize_device(device)
     comm = sanitize_comm(comm)
     jt = dtype.jax_type()
     if fill_name == "zeros":
-        data = _sharded_factory(shape, split, comm, lambda: jnp.zeros(shape, dtype=jt))
+        fill = lambda ps: jnp.zeros(ps, dtype=jt)
     elif fill_name == "ones":
-        data = _sharded_factory(shape, split, comm, lambda: jnp.ones(shape, dtype=jt))
+        fill = lambda ps: jnp.ones(ps, dtype=jt)
     else:
         raise ValueError(fill_name)
-    return DNDarray(data, dtype=dtype, split=split, device=device, comm=comm)
+    return _build(shape, split, comm, dtype, device, fill)
 
 
 def zeros(shape, dtype=types.float32, split=None, device=None, comm=None, order="C") -> DNDarray:
@@ -166,8 +175,9 @@ def full(shape, fill_value, dtype=None, split=None, device=None, comm=None, orde
     comm = sanitize_comm(comm)
     split = sanitize_axis(shape, split)
     jt = dtype.jax_type()
-    data = _sharded_factory(shape, split, comm, lambda: jnp.full(shape, fill_value, dtype=jt))
-    return DNDarray(data, dtype=dtype, split=split, device=devices.sanitize_device(device), comm=comm)
+    return _build(
+        shape, split, comm, dtype, device, lambda ps: jnp.full(ps, fill_value, dtype=jt)
+    )
 
 
 def _like_meta(a: DNDarray, dtype, split, device, comm):
@@ -217,10 +227,16 @@ def arange(*args, dtype=None, split=None, device=None, comm=None) -> DNDarray:
     n = int(max(0, -(-(stop - start) // step))) if step != 0 else 0
     split = sanitize_axis((n,), split)
     jt = dtype.jax_type()
-    data = _sharded_factory(
-        (n,), split, comm, lambda: jnp.arange(start, stop, step, dtype=jt)
+    return _build(
+        (n,),
+        split,
+        comm,
+        dtype,
+        device,
+        # fill the physical extent by extending the progression; the tail
+        # (indices >= n) is padding and never observed
+        lambda ps: (start + step * jnp.arange(ps[0])).astype(jt),
     )
-    return DNDarray(data, dtype=dtype, split=split, device=devices.sanitize_device(device), comm=comm)
 
 
 def linspace(
@@ -240,10 +256,12 @@ def linspace(
     split = sanitize_axis((num,), split)
     dtype = types.canonical_heat_type(dtype) if dtype is not None else types.float32
     jt = dtype.jax_type()
-    data = _sharded_factory(
-        (num,), split, comm, lambda: jnp.linspace(start, stop, num, endpoint=endpoint).astype(jt)
-    )
-    res = DNDarray(data, dtype=dtype, split=split, device=devices.sanitize_device(device), comm=comm)
+
+    def _fill(ps):
+        vals = jnp.linspace(start, stop, num, endpoint=endpoint).astype(jt)
+        return jnp.pad(vals, (0, ps[0] - num))
+
+    res = _build((num,), split, comm, dtype, device, _fill)
     if retstep:
         step = (stop - start) / max(1, (num - 1 if endpoint else num))
         return res, step
@@ -274,8 +292,9 @@ def eye(shape, dtype=types.float32, split=None, device=None, comm=None, order="C
     comm = sanitize_comm(comm)
     split = sanitize_axis((n, m), split)
     jt = dtype.jax_type()
-    data = _sharded_factory((n, m), split, comm, lambda: jnp.eye(n, m, dtype=jt))
-    return DNDarray(data, dtype=dtype, split=split, device=devices.sanitize_device(device), comm=comm)
+    return _build(
+        (n, m), split, comm, dtype, device, lambda ps: jnp.eye(ps[0], ps[1], dtype=jt)
+    )
 
 
 def meshgrid(*arrays, indexing: str = "xy") -> List[DNDarray]:
@@ -289,7 +308,7 @@ def meshgrid(*arrays, indexing: str = "xy") -> List[DNDarray]:
     comm = dnd[0].comm
     device = dnd[0].device
     splits = [a.split for a in dnd]
-    grids = jnp.meshgrid(*[a.larray for a in dnd], indexing=indexing)
+    grids = jnp.meshgrid(*[a._logical() for a in dnd], indexing=indexing)
     # determine output split: if any input was split, shard outputs along the
     # dimension that input occupies in the grid
     out_split = None

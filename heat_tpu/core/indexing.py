@@ -20,7 +20,7 @@ def nonzero(x: DNDarray) -> DNDarray:
     """
     if not isinstance(x, DNDarray):
         raise TypeError(f"expected x to be a DNDarray, but was {type(x)}")
-    result = jnp.stack(jnp.nonzero(x.larray), axis=1)
+    result = jnp.stack(jnp.nonzero(x._logical()), axis=1)
     if x.ndim == 1:
         result = result.reshape(-1)
     split = 0 if x.split is not None else None
@@ -35,9 +35,9 @@ def where(cond: DNDarray, x=None, y=None) -> DNDarray:
         return nonzero(cond)
     if x is None or y is None:
         raise TypeError("either both or neither of x and y should be given")
-    xs = x.larray if isinstance(x, DNDarray) else x
-    ys = y.larray if isinstance(y, DNDarray) else y
-    result = jnp.where(cond.larray.astype(jnp.bool_), xs, ys)
+    xs = x._logical() if isinstance(x, DNDarray) else x
+    ys = y._logical() if isinstance(y, DNDarray) else y
+    result = jnp.where(cond._logical().astype(jnp.bool_), xs, ys)
     split = cond.split
     if isinstance(x, DNDarray) and x.split is not None:
         split = x.split if split is None else split

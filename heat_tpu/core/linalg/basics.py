@@ -16,7 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import types
-from .._operations import _local_op, _reduced_split
+from .._operations import _local_op, _mask_padding, _reduced_split
 from ..dndarray import DNDarray
 from ..stride_tricks import sanitize_axis
 
@@ -40,6 +40,43 @@ __all__ = [
 ]
 
 
+def _contract_safe(x: DNDarray, jt, contract_dim: int):
+    """Operand buffer for a contraction: if the tail padding lies on the
+    contracted dimension, zero it so padded products vanish exactly (garbage
+    could be inf/nan, where 0*garbage != 0)."""
+    buf = x.larray.astype(jt)
+    if x.padded and x.split == contract_dim:
+        buf = _mask_padding(buf, x.gshape, x.split, 0)
+    return buf
+
+
+def _matmul_gshape(sa: Tuple[int, ...], sb: Tuple[int, ...]) -> Tuple[int, ...]:
+    """Logical matmul result shape from logical operand shapes (numpy's
+    matmul shape semantics, including 1-D promotion and batch broadcast)."""
+    return tuple((np.empty(sa, dtype=np.int8) @ np.empty(sb, dtype=np.int8)).shape)
+
+
+def _wrap_result(result, out_gshape, split, dtype, device, comm) -> DNDarray:
+    """Wrap a raw matmul/contraction result whose dims may carry padding
+    inherited from either operand: trim every dim to its logical extent
+    except the split dim, which keeps its canonical padded extent."""
+    if split is not None:
+        split = split % len(out_gshape)  # mat@vec: -2 from the matrix case
+    target = comm.padded_shape(out_gshape, split)
+    if tuple(result.shape) == target:
+        return DNDarray._from_buffer(result, out_gshape, dtype, split, device, comm)
+    sl = []
+    for i, (r, g) in enumerate(zip(result.shape, out_gshape)):
+        if split is not None and i == split and r >= target[i]:
+            sl.append(slice(0, target[i]))
+        else:
+            sl.append(slice(0, g))
+    result = result[tuple(sl)]
+    if tuple(result.shape) == target:
+        return DNDarray._from_buffer(result, out_gshape, dtype, split, device, comm)
+    return DNDarray(result, gshape=out_gshape, dtype=dtype, split=split, device=device, comm=comm)
+
+
 def _matmul_out_split(a: DNDarray, b: DNDarray, out_ndim: int) -> Optional[int]:
     """Result split of a matmul: row-split a -> row-split out; col-split b ->
     col-split out; contracted splits -> replicated (XLA psums over ICI)."""
@@ -58,12 +95,27 @@ def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False) -> DNDarray:
         raise TypeError("both operands must be DNDarrays")
     promoted = types.promote_types(a.dtype, b.dtype)
     jt = promoted.jax_type()
-    result = jnp.matmul(a.larray.astype(jt), b.larray.astype(jt))
+    buf_a = _contract_safe(a, jt, a.ndim - 1 if a.ndim > 1 else 0)
+    buf_b = _contract_safe(b, jt, b.ndim - 2 if b.ndim > 1 else 0)
+    # align (possibly padded) contraction extents with zero fill
+    ka = buf_a.shape[-1] if a.ndim > 1 else buf_a.shape[0]
+    kb = buf_b.shape[-2] if b.ndim > 1 else buf_b.shape[0]
+    if ka != kb:
+        tgt = max(ka, kb)
+        if ka < tgt:
+            pad = [(0, 0)] * buf_a.ndim
+            pad[-1 if a.ndim > 1 else 0] = (0, tgt - ka)
+            buf_a = jnp.pad(buf_a, pad)
+        else:
+            pad = [(0, 0)] * buf_b.ndim
+            pad[-2 if b.ndim > 1 else 0] = (0, tgt - kb)
+            buf_b = jnp.pad(buf_b, pad)
+    result = jnp.matmul(buf_a, buf_b)
     if result.ndim == 0:
-        split = None
-    else:
-        split = _matmul_out_split(a, b, result.ndim)
-    return DNDarray(result, dtype=promoted, split=split, device=a.device, comm=a.comm)
+        return DNDarray(result, dtype=promoted, split=None, device=a.device, comm=a.comm)
+    split = _matmul_out_split(a, b, result.ndim)
+    out_gshape = _matmul_gshape(a.gshape, b.gshape)
+    return _wrap_result(result, out_gshape, split, promoted, a.device, a.comm)
 
 
 def dot(a: DNDarray, b: DNDarray, out: Optional[DNDarray] = None):
@@ -71,7 +123,7 @@ def dot(a: DNDarray, b: DNDarray, out: Optional[DNDarray] = None):
     if not isinstance(a, DNDarray) or not isinstance(b, DNDarray):
         raise TypeError("both operands must be DNDarrays")
     if a.ndim == 1 and b.ndim == 1:
-        result = jnp.dot(a.larray, b.larray)
+        result = jnp.dot(a._logical(), b._logical())
         res = DNDarray(result, split=None, device=a.device, comm=a.comm)
         if out is not None:
             from .._operations import _write_out
@@ -90,7 +142,7 @@ def dot(a: DNDarray, b: DNDarray, out: Optional[DNDarray] = None):
 
 def vdot(x1: DNDarray, x2: DNDarray) -> DNDarray:
     """Conjugated dot product of flattened inputs (reference ``basics.py:2236``)."""
-    result = jnp.vdot(x1.larray, x2.larray)
+    result = jnp.vdot(x1._logical(), x2._logical())
     return DNDarray(result, split=None, device=x1.device, comm=x1.comm)
 
 
@@ -100,7 +152,7 @@ def vecdot(x1: DNDarray, x2: DNDarray, axis: Optional[int] = None, keepdim=None,
     if axis is None:
         axis = -1
     axis = sanitize_axis(tuple(np.broadcast_shapes(x1.shape, x2.shape)), axis)
-    result = jnp.sum(jnp.conj(x1.larray) * x2.larray, axis=axis, keepdims=keepdims)
+    result = jnp.sum(jnp.conj(x1._logical()) * x2._logical(), axis=axis, keepdims=keepdims)
     ndim = max(x1.ndim, x2.ndim)
     anchor = x1 if x1.split is not None else x2
     split = _reduced_split(anchor.split, axis, ndim, keepdims)
@@ -110,7 +162,7 @@ def vecdot(x1: DNDarray, x2: DNDarray, axis: Optional[int] = None, keepdim=None,
 def outer(a: DNDarray, b: DNDarray, out: Optional[DNDarray] = None, split: Optional[int] = None) -> DNDarray:
     """Outer product (reference ``basics.py:1372`` used a ring Send/Recv of
     shards; a sharded broadcast-multiply under GSPMD here)."""
-    result = jnp.outer(a.larray, b.larray)
+    result = jnp.outer(a._logical(), b._logical())
     if split is None:
         split = 0 if (a.split is not None or b.split is not None) else None
     res = DNDarray(result, split=split, device=a.device, comm=a.comm)
@@ -130,7 +182,7 @@ def projection(a: DNDarray, b: DNDarray) -> DNDarray:
 
 def cross(a: DNDarray, b: DNDarray, axisa: int = -1, axisb: int = -1, axisc: int = -1, axis: int = -1) -> DNDarray:
     """Cross product (reference ``basics.py:47``)."""
-    result = jnp.cross(a.larray, b.larray, axisa=axisa, axisb=axisb, axisc=axisc)
+    result = jnp.cross(a._logical(), b._logical(), axisa=axisa, axisb=axisb, axisc=axisc)
     split = a.split if a.split is not None else b.split
     if split is not None and result.ndim != a.ndim:
         split = None
@@ -141,14 +193,14 @@ def det(a: DNDarray) -> DNDarray:
     """Determinant (reference ``basics.py:160`` — distributed pivoted
     elimination with per-row Bcasts; batched local LU under XLA here)."""
     _square_check(a)
-    result = jnp.linalg.det(a.larray.astype(_float_type(a)))
+    result = jnp.linalg.det(a._logical().astype(_float_type(a)))
     return DNDarray(result, split=None if a.ndim == 2 else a.split, device=a.device, comm=a.comm)
 
 
 def inv(a: DNDarray) -> DNDarray:
     """Matrix inverse (reference ``basics.py:312``)."""
     _square_check(a)
-    result = jnp.linalg.inv(a.larray.astype(_float_type(a)))
+    result = jnp.linalg.inv(a._logical().astype(_float_type(a)))
     return DNDarray(result, split=a.split, device=a.device, comm=a.comm)
 
 
@@ -171,7 +223,7 @@ def matrix_norm(x: DNDarray, axis: Optional[Tuple[int, int]] = None, keepdims: b
         axis = (0, 1)
     axis = sanitize_axis(x.shape, axis)
     row, col = axis
-    arr = x.larray.astype(_float_type(x))
+    arr = x._logical().astype(_float_type(x))
     # after the inner sum drops an axis, the outer reduction index shifts
     # (reference basics.py:1176-1212 does the same adjustment)
     col_adj = col - 1 if (col > row and not keepdims) else col
@@ -208,7 +260,7 @@ def matrix_norm(x: DNDarray, axis: Optional[Tuple[int, int]] = None, keepdims: b
 def vector_norm(x: DNDarray, axis=None, keepdims: bool = False, ord=None) -> DNDarray:
     """Vector norm (reference ``basics.py:2309``)."""
     axis_s = sanitize_axis(x.shape, axis)
-    arr = x.larray.astype(_float_type(x))
+    arr = x._logical().astype(_float_type(x))
     result = jnp.linalg.norm(
         arr if axis_s is not None or x.ndim == 1 else arr.ravel(),
         ord=2 if ord is None else ord,
@@ -222,7 +274,7 @@ def vector_norm(x: DNDarray, axis=None, keepdims: bool = False, ord=None) -> DND
 def norm(x: DNDarray, axis=None, keepdims: bool = False, ord=None) -> DNDarray:
     """General norm dispatch (reference ``basics.py:1223``)."""
     if axis is None and ord is None:
-        arr = x.larray.astype(_float_type(x))
+        arr = x._logical().astype(_float_type(x))
         return DNDarray(jnp.sqrt(jnp.sum(jnp.abs(arr) ** 2)), split=None, device=x.device, comm=x.comm)
     if axis is None:
         if x.ndim == 1:
@@ -239,13 +291,9 @@ def norm(x: DNDarray, axis=None, keepdims: bool = False, ord=None) -> DNDarray:
 
 def trace(a: DNDarray, offset: int = 0, axis1: int = 0, axis2: int = 1, dtype=None, out=None):
     """Sum along diagonals (reference ``basics.py:1629``)."""
-    result = jnp.trace(a.larray, offset=offset, axis1=axis1, axis2=axis2)
+    result = jnp.trace(a._logical(), offset=offset, axis1=axis1, axis2=axis2)
     if dtype is not None:
         result = result.astype(types.canonical_heat_type(dtype).jax_type())
-    if a.ndim == 2:
-        res = DNDarray(result, split=None, device=a.device, comm=a.comm)
-        if out is None:
-            return res.item() if False else res
     res = DNDarray(result, split=None, device=a.device, comm=a.comm)
     if out is not None:
         from .._operations import _write_out
@@ -267,7 +315,8 @@ def transpose(a: DNDarray, axes: Optional[List[int]] = None) -> DNDarray:
             raise ValueError("axes do not match tensor shape")
     result = jnp.transpose(a.larray, axes)
     new_split = axes.index(a.split) if a.split is not None else None
-    return DNDarray(result, dtype=a.dtype, split=new_split, device=a.device, comm=a.comm)
+    new_gshape = tuple(a.gshape[ax] for ax in axes)
+    return DNDarray._from_buffer(result, new_gshape, a.dtype, new_split, a.device, a.comm)
 
 
 def tril(m: DNDarray, k: int = 0) -> DNDarray:
@@ -283,11 +332,13 @@ def triu(m: DNDarray, k: int = 0) -> DNDarray:
 def _tri_op(m: DNDarray, k: int, op) -> DNDarray:
     if not isinstance(m, DNDarray):
         raise TypeError(f"expected m to be a DNDarray, got {type(m)}")
-    arr = m.larray
-    vector = arr.ndim == 1
+    vector = m.ndim == 1
     if vector:
         # reference semantics: a 1-D input becomes a (n, n) triangle of tiles
-        arr = jnp.tile(arr, (arr.shape[0], 1))
-    result = op(arr, k=k)
-    split = m.split if not vector else (0 if m.split is not None else None)
-    return DNDarray(result, dtype=m.dtype, split=split, device=m.device, comm=m.comm)
+        arr = m._logical()
+        result = op(jnp.tile(arr, (arr.shape[0], 1)), k=k)
+        split = 0 if m.split is not None else None
+        return DNDarray(result, dtype=m.dtype, split=split, device=m.device, comm=m.comm)
+    # 2-D+: triangle masks use absolute indices, which padding never shifts
+    result = op(m.larray, k=k)
+    return DNDarray._from_buffer(result, m.gshape, m.dtype, m.split, m.device, m.comm)

@@ -19,6 +19,7 @@ from jax.sharding import PartitionSpec as P
 from jax import shard_map
 
 from .. import types
+from .._operations import _mask_padding
 from ..communication import SPLIT_AXIS
 from ..dndarray import DNDarray
 
@@ -50,27 +51,28 @@ def qr(
 
 def _qr_impl(a: DNDarray, calc_q: bool) -> QR_out:
     ftype = jnp.promote_types(a.larray.dtype, jnp.float32)
-    arr = a.larray.astype(ftype)
-    m, n = arr.shape
+    m, n = a.gshape
     comm = a.comm
     p = comm.size
 
     if a.split is None or p == 1:
-        q, r = jnp.linalg.qr(arr)
+        q, r = jnp.linalg.qr(a._logical().astype(ftype))
         Q = DNDarray(q, split=a.split, device=a.device, comm=comm) if calc_q else None
         return QR_out(Q, DNDarray(r, split=a.split, device=a.device, comm=comm))
 
     if a.split == 1:
         # column-split: the reduced factors are column-blocked; gather and
         # factor once (reference ``__split1_qr_loop`` did a per-block loop).
-        q, r = jnp.linalg.qr(arr)
+        q, r = jnp.linalg.qr(a._logical().astype(ftype))
         Q = DNDarray(q, split=1, device=a.device, comm=comm) if calc_q else None
         return QR_out(Q, DNDarray(r, split=1, device=a.device, comm=comm))
 
-    # split == 0: TSQR
-    pad = (-m) % p
-    if pad:
-        arr = jnp.concatenate([arr, jnp.zeros((pad, n), dtype=ftype)], axis=0)
+    # split == 0: TSQR. The buffer is already tail-padded to a multiple of
+    # the mesh size; zero the padding (QR of [A; 0] has the same R and a
+    # zero-row-extended Q).
+    arr = a.larray.astype(ftype)
+    if a.padded:
+        arr = _mask_padding(arr, a.gshape, 0, 0)
     mp = arr.shape[0]
     mesh = comm.mesh
 
@@ -98,6 +100,9 @@ def _qr_impl(a: DNDarray, calc_q: bool) -> QR_out:
     r_dnd = DNDarray(r, split=None, device=a.device, comm=comm)
     if not calc_q:
         return QR_out(None, r_dnd)
-    q_full = q_sh.reshape(mp, q_sh.shape[-1])[:m]
-    Q = DNDarray(q_full, split=0, device=a.device, comm=comm)
+    # the padded rows of Q are exact zeros; keep them as canonical buffer pad
+    q_buf = q_sh.reshape(mp, q_sh.shape[-1])
+    Q = DNDarray._from_buffer(
+        q_buf, (m, q_buf.shape[-1]), types.canonical_heat_type(q_buf.dtype), 0, a.device, comm
+    )
     return QR_out(Q, r_dnd)

@@ -1,8 +1,17 @@
 """Iterative solvers (reference ``heat/core/linalg/solver.py``).
 
-``cg`` and ``lanczos`` are written against the DNDarray API exactly like
-the reference — every matvec is a sharded ``matmul`` whose reduction XLA
-compiles to a psum over ICI.
+The reference's ``cg`` (``solver.py:13``) checks convergence on the host
+every iteration and ``lanczos`` (``solver.py:68``) is an eager Python loop —
+per-iteration host round-trips. Here both are **device-resident programs**:
+``cg`` is one ``lax.while_loop`` with the convergence test on device, and
+``lanczos`` is one ``lax.fori_loop`` — a single dispatch each, with GSPMD
+inserting the matvec psums over ICI inside the loop body.
+
+Padding discipline: the square operand is zero-extended to its padded
+buffer extent on *both* axes and every Krylov vector carries a zero tail.
+Zero rows/columns keep the iteration exactly in the valid subspace (the
+residual's tail entries start at 0 and stay 0), so no per-iteration masking
+is needed.
 """
 from __future__ import annotations
 
@@ -12,14 +21,39 @@ import jax
 import jax.numpy as jnp
 
 from .. import factories
+from .._operations import _mask_padding
 from ..dndarray import DNDarray
-from .basics import matmul, transpose
 
 __all__ = ["cg", "lanczos"]
 
 
+def _square_padded(A: DNDarray, ftype):
+    """(n_pad, n_pad) zero-extended operand and the padded extent."""
+    n = A.gshape[0]
+    arr = A.larray.astype(ftype)
+    if A.padded:
+        arr = _mask_padding(arr, A.gshape, A.split, 0)
+    n_pad = arr.shape[A.split] if A.split is not None else n
+    pad = [(0, n_pad - s) for s in arr.shape]
+    if any(p for _, p in pad):
+        arr = jnp.pad(arr, pad)
+    return arr, n, n_pad
+
+
+def _padded_vector(v: DNDarray, n: int, n_pad: int, ftype):
+    vec = v._logical().astype(ftype)
+    if n_pad != n:
+        vec = jnp.pad(vec, (0, n_pad - n))
+    return vec
+
+
 def cg(A: DNDarray, b: DNDarray, x0: DNDarray, out: Optional[DNDarray] = None) -> DNDarray:
-    """Conjugate gradients for s.p.d. ``A`` (reference ``solver.py:13``)."""
+    """Conjugate gradients for s.p.d. ``A`` (reference ``solver.py:13``).
+
+    One compiled ``lax.while_loop``; convergence (``sqrt(r.r) < 1e-10``,
+    the reference's threshold) is evaluated on device — no host sync until
+    the final result is read.
+    """
     if not isinstance(A, DNDarray) or not isinstance(b, DNDarray) or not isinstance(x0, DNDarray):
         raise TypeError(f"A, b and x0 need to be DNDarrays, got {type(A)}, {type(b)}, {type(x0)}")
     if A.ndim != 2:
@@ -29,30 +63,41 @@ def cg(A: DNDarray, b: DNDarray, x0: DNDarray, out: Optional[DNDarray] = None) -
     if x0.ndim != 1:
         raise RuntimeError("x0 needs to be a 1D vector")
 
+    ftype = jnp.promote_types(A.larray.dtype, jnp.float32)
+    arr, n, n_pad = _square_padded(A, ftype)
+    bv = _padded_vector(b, n, n_pad, ftype)
+    xv = _padded_vector(x0, n, n_pad, ftype)
+
     with jax.default_matmul_precision("highest"):
-        return _cg_impl(A, b, x0, out)
+        x = _cg_device(arr, bv, xv, n)
+
+    res = DNDarray(x[:n], split=b.split, device=b.device, comm=b.comm)
+    if out is not None:
+        out.larray = res._logical()
+        return out
+    return res
 
 
-def _cg_impl(A, b, x0, out):
-    r = b - matmul(A, x0)
-    p = r.copy()
-    rsold = matmul(r, r)
-    x = x0.copy()
+@jax.jit
+def _cg_device(arr, bv, xv, n):
+    r0 = bv - arr @ xv
+    state = (xv, r0, r0, jnp.dot(r0, r0), jnp.int32(0))
 
-    for _ in range(len(b)):
-        Ap = matmul(A, p)
-        alpha = rsold / matmul(p, Ap)
+    def cond(s):
+        _, _, _, rs, i = s
+        return jnp.logical_and(rs >= 1e-20, i < n)
+
+    def body(s):
+        x, r, p, rsold, i = s
+        Ap = arr @ p
+        alpha = rsold / jnp.dot(p, Ap)
         x = x + alpha * p
         r = r - alpha * Ap
-        rsnew = matmul(r, r)
-        if float(jnp.sqrt(rsnew.larray)) < 1e-10:
-            break
+        rsnew = jnp.dot(r, r)
         p = r + (rsnew / rsold) * p
-        rsold = rsnew
+        return (x, r, p, rsnew, i + 1)
 
-    if out is not None:
-        out.larray = x.larray
-        return out
+    x, *_ = jax.lax.while_loop(cond, body, state)
     return x
 
 
@@ -66,7 +111,9 @@ def lanczos(
     """Lanczos tridiagonalization of a symmetric matrix (reference
     ``solver.py:68``). Returns (V, T) with A ~= V T V^T.
 
-    Full re-orthogonalization is applied every step (the reference
+    One compiled ``lax.fori_loop`` over the m steps — O(1) dispatches where
+    the reference paid a collective round-trip per step. Full
+    re-orthogonalization is applied every step (the reference
     re-orthogonalizes conditionally); the extra matvec is cheap on the MXU
     and buys numerical stability.
     """
@@ -76,20 +123,32 @@ def lanczos(
         raise RuntimeError("A needs to be a square matrix")
     m = int(m)
 
-    with jax.default_matmul_precision("highest"):
-        return _lanczos_impl(A, m, v0, V_out, T_out)
-
-
-def _lanczos_impl(A, m, v0, V_out, T_out):
-    n = A.shape[0]
-    arr = A.larray.astype(jnp.promote_types(A.larray.dtype, jnp.float32))
+    ftype = jnp.promote_types(A.larray.dtype, jnp.float32)
+    arr, n, n_pad = _square_padded(A, ftype)
     if v0 is None:
-        v = jnp.ones(n, dtype=arr.dtype) / jnp.sqrt(float(n))
+        v = jnp.pad(jnp.ones(n, dtype=arr.dtype) / jnp.sqrt(float(n)), (0, n_pad - n))
     else:
-        v = v0.larray.astype(arr.dtype)
+        v = _padded_vector(v0, n, n_pad, arr.dtype)
         v = v / jnp.linalg.norm(v)
 
-    V = jnp.zeros((m, n), dtype=arr.dtype)
+    with jax.default_matmul_precision("highest"):
+        V, T = _lanczos_device(arr, v, m)
+
+    V_dnd = DNDarray(V[:, :n].T, split=None, device=A.device, comm=A.comm)
+    T_dnd = DNDarray(T, split=None, device=A.device, comm=A.comm)
+    if V_out is not None:
+        V_out.larray = V_dnd._logical()
+        V_dnd = V_out
+    if T_out is not None:
+        T_out.larray = T_dnd._logical()
+        T_dnd = T_out
+    return V_dnd, T_dnd
+
+
+def _lanczos_device(arr, v, m):
+    n_pad = arr.shape[0]
+
+    V = jnp.zeros((m, n_pad), dtype=arr.dtype)
     alphas = jnp.zeros(m, dtype=arr.dtype)
     betas = jnp.zeros(m, dtype=arr.dtype)
 
@@ -99,7 +158,8 @@ def _lanczos_impl(A, m, v0, V_out, T_out):
     w = w - alpha * v
     alphas = alphas.at[0].set(alpha)
 
-    for i in range(1, m):
+    def body(i, state):
+        V, alphas, betas, w = state
         beta = jnp.linalg.norm(w)
         v_next = jnp.where(beta > 1e-12, w / jnp.where(beta == 0, 1.0, beta), jnp.zeros_like(w))
         # full re-orthogonalization against previous Lanczos vectors
@@ -107,19 +167,14 @@ def _lanczos_impl(A, m, v0, V_out, T_out):
         nrm = jnp.linalg.norm(v_next)
         v_next = jnp.where(nrm > 1e-12, v_next / jnp.where(nrm == 0, 1.0, nrm), v_next)
         V = V.at[i].set(v_next)
-        w = arr @ v_next
-        alpha = jnp.dot(w, v_next)
-        w = w - alpha * v_next - beta * V[i - 1]
-        alphas = alphas.at[i].set(alpha)
-        betas = betas.at[i].set(beta)
+        w2 = arr @ v_next
+        alpha = jnp.dot(w2, v_next)
+        w2 = w2 - alpha * v_next - beta * V[i - 1]
+        return (V, alphas.at[i].set(alpha), betas.at[i].set(beta), w2)
+
+    V, alphas, betas, _ = jax.jit(
+        lambda V, a, b, w: jax.lax.fori_loop(1, m, body, (V, a, b, w))
+    )(V, alphas, betas, w)
 
     T = jnp.diag(alphas) + jnp.diag(betas[1:], 1) + jnp.diag(betas[1:], -1)
-    V_dnd = DNDarray(V.T, split=None, device=A.device, comm=A.comm)
-    T_dnd = DNDarray(T, split=None, device=A.device, comm=A.comm)
-    if V_out is not None:
-        V_out.larray = V_dnd.larray
-        V_dnd = V_out
-    if T_out is not None:
-        T_out.larray = T_dnd.larray
-        T_dnd = T_out
-    return V_dnd, T_dnd
+    return V, T

@@ -59,14 +59,30 @@ def rsvd(
         key = ht_random._next_key(k * n)
 
     ftype = jnp.promote_types(a.larray.dtype, jnp.float32)
+    from .._operations import _mask_padding
+
     A = a.larray.astype(ftype)
+    if a.padded:
+        if a.split == 0:
+            # zero the tail padding: padded rows contribute exact zeros to
+            # every product, and the TSQR path consumes the even buffer
+            A = _mask_padding(A, a.gshape, a.split, 0)
+        else:
+            # column padding would leak into omega/Vh extents; materialize
+            A = a._logical().astype(ftype)
     distributed_rows = a.split == 0 and a.comm.size > 1
 
     def ortho(Y):
         # tall (m, k) panel: communication-avoiding TSQR when the rows are
         # sharded (one all-gather of k x k factors), local QR otherwise
         if distributed_rows:
-            Qd, _ = qr(DNDarray(Y, split=0, device=a.device, comm=a.comm))
+            from .. import types as _t
+
+            Qd, _ = qr(
+                DNDarray._from_buffer(
+                    Y, (m, Y.shape[1]), _t.canonical_heat_type(Y.dtype), 0, a.device, a.comm
+                )
+            )
             return Qd.larray
         return jnp.linalg.qr(Y)[0]
 
@@ -86,8 +102,16 @@ def rsvd(
     U = U[:, :rank]
     s = s[:rank]
     vh = vh[:rank]
+    if a.split == 0:
+        from .. import types as _t
+
+        U_dnd = DNDarray._from_buffer(
+            U, (m, rank), _t.canonical_heat_type(U.dtype), 0, a.device, a.comm
+        )
+    else:
+        U_dnd = DNDarray(U, split=None, device=a.device, comm=a.comm)
     return SVD_out(
-        DNDarray(U, split=a.split if a.split == 0 else None, device=a.device, comm=a.comm),
+        U_dnd,
         DNDarray(s, split=None, device=a.device, comm=a.comm),
         DNDarray(vh, split=None, device=a.device, comm=a.comm),
     )
@@ -129,31 +153,41 @@ def lstsq(a: DNDarray, b: DNDarray, rcond: Optional[float] = None) -> DNDarray:
 
     with jax.default_matmul_precision("highest"):
         if m >= n and rcond is None:
+            ftype = jnp.promote_types(a.larray.dtype, jnp.float32)
+            eps_cut = float(jnp.finfo(ftype).eps) * max(m, n)
             Q, R = qr(a)
             diag = jnp.abs(jnp.diagonal(R.larray))
-            if float(jnp.min(diag)) > 1e-7 * float(jnp.max(diag)):
+            if float(jnp.min(diag)) > eps_cut * float(jnp.max(diag)):
                 # well-conditioned: qᴴ b is replicated after the psum,
                 # R is a k x k replicated triangular solve
                 qhb = complex_math.conj(Q).T @ b
                 x = jax.scipy.linalg.solve_triangular(R.larray, qhb.larray, lower=False)
                 return DNDarray(x, split=None, device=a.device, comm=a.comm)
             # rank-deficient: match numpy's min-norm solution via the SVD
-        p = pinv(a, rcond=rcond if rcond is not None else 1e-6)
+        p = pinv(a, rcond=rcond)
         return p @ b
 
 
-def pinv(a: DNDarray, rcond: float = 1e-6) -> DNDarray:
+def pinv(a: DNDarray, rcond: Optional[float] = None) -> DNDarray:
     """Moore-Penrose pseudoinverse via the SVD (beyond the reference:
-    its ``svd.py`` is an empty stub)."""
+    its ``svd.py`` is an empty stub).
+
+    ``rcond=None`` derives the cutoff from the operand dtype's machine
+    epsilon, ``eps * max(m, n)`` — numpy's default — instead of a fixed
+    constant, so ill-conditioned but full-rank float64 systems keep their
+    genuine singular values."""
     if not isinstance(a, DNDarray):
         raise TypeError("pinv expects a DNDarray")
     if a.ndim != 2:
         raise ValueError(f"pinv requires a 2-D array, got {a.ndim}-D")
     U, s, Vh = svd(a, full_matrices=False)
+    if rcond is None:
+        ftype = jnp.promote_types(a.larray.dtype, jnp.float32)
+        rcond = float(jnp.finfo(ftype).eps) * max(a.gshape)
     cutoff = rcond * jnp.max(s.larray)
     s_inv = jnp.where(s.larray > cutoff, 1.0 / s.larray, 0.0)
     with jax.default_matmul_precision("highest"):
-        result = (Vh.larray.conj().T * s_inv[None, :]) @ U.larray.conj().T
+        result = (Vh.larray.conj().T * s_inv[None, :]) @ U._logical().conj().T
     return DNDarray(result, split=None, device=a.device, comm=a.comm)
 
 
@@ -175,9 +209,9 @@ def _svd_impl(a: DNDarray, full_matrices: bool, compute_uv: bool):
 
     ftype = jnp.promote_types(a.larray.dtype, jnp.float32)
     if not compute_uv:
-        s = jnp.linalg.svd(a.larray.astype(ftype), compute_uv=False)
+        s = jnp.linalg.svd(a._logical().astype(ftype), compute_uv=False)
         return DNDarray(s, split=None, device=a.device, comm=a.comm)
-    u, s, vh = jnp.linalg.svd(a.larray.astype(ftype), full_matrices=full_matrices)
+    u, s, vh = jnp.linalg.svd(a._logical().astype(ftype), full_matrices=full_matrices)
     return SVD_out(
         DNDarray(u, split=a.split if a.split == 0 else None, device=a.device, comm=a.comm),
         DNDarray(s, split=None, device=a.device, comm=a.comm),

@@ -31,18 +31,18 @@ def all(x, axis=None, out=None, keepdim=False, keepdims=None) -> DNDarray:
     """Whether all elements are truthy (reference ``logical.py:38`` —
     MPI.LAND reduce; XLA emits the equivalent all-reduce). ``keepdim`` is
     the reference spelling; ``keepdims`` accepted for numpy users."""
-    return _reduce_op(jnp.all, x, axis=axis, out=out, keepdims=bool(keepdim or keepdims), out_dtype=types.bool)
+    return _reduce_op(jnp.all, x, axis=axis, out=out, keepdims=bool(keepdim or keepdims), out_dtype=types.bool, neutral=True)
 
 
 def any(x, axis=None, out=None, keepdim=False, keepdims=None) -> DNDarray:
     """Whether any element is truthy (reference ``logical.py:157``)."""
-    return _reduce_op(jnp.any, x, axis=axis, out=out, keepdims=bool(keepdim or keepdims), out_dtype=types.bool)
+    return _reduce_op(jnp.any, x, axis=axis, out=out, keepdims=bool(keepdim or keepdims), out_dtype=types.bool, neutral=False)
 
 
 def allclose(x, y, rtol: float = 1e-05, atol: float = 1e-08, equal_nan: bool = False) -> bool:
     """Global closeness check to one python bool (reference ``logical.py:105``)."""
     close = isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
-    return bool(jnp.all(close.larray))
+    return bool(jnp.all(close._logical()))
 
 
 def isclose(x, y, rtol: float = 1e-05, atol: float = 1e-08, equal_nan: bool = False) -> DNDarray:

@@ -90,7 +90,7 @@ def broadcast_arrays(*arrays: DNDarray) -> List[DNDarray]:
 def broadcast_to(x: DNDarray, shape) -> DNDarray:
     """Broadcast to a new shape (reference ``manipulations.py``)."""
     shape = sanitize_shape(shape)
-    result = jnp.broadcast_to(x.larray, shape)
+    result = jnp.broadcast_to(x._logical(), shape)
     split = x.split + (len(shape) - x.ndim) if x.split is not None else None
     return _wrap(result, x, split)
 
@@ -98,7 +98,7 @@ def broadcast_to(x: DNDarray, shape) -> DNDarray:
 def column_stack(arrays: Sequence[DNDarray]) -> DNDarray:
     """Stack 1-D/2-D arrays as columns (reference ``manipulations.py``)."""
     dnd = [a if isinstance(a, DNDarray) else DNDarray(jnp.asarray(a)) for a in arrays]
-    result = jnp.column_stack([a.larray for a in dnd])
+    result = jnp.column_stack([a._logical() for a in dnd])
     split = next((a.split for a in dnd if a.split is not None and a.ndim > 1), None)
     if split is None and any(a.split is not None for a in dnd):
         split = 0
@@ -129,21 +129,21 @@ def concatenate(arrays: Sequence[DNDarray], axis: int = 0) -> DNDarray:
     for a in arrays[1:]:
         promoted = types.promote_types(promoted, a.dtype)
     jt = promoted.jax_type()
-    result = jnp.concatenate([a.larray.astype(jt) for a in arrays], axis=axis)
+    result = jnp.concatenate([a._logical().astype(jt) for a in arrays], axis=axis)
     return _wrap(result, arrays[0], out_split)
 
 
 def diag(a: DNDarray, offset: int = 0) -> DNDarray:
     """Extract or construct a diagonal (reference ``manipulations.py``)."""
     if a.ndim == 1:
-        result = jnp.diag(a.larray, k=offset)
+        result = jnp.diag(a._logical(), k=offset)
         return _wrap(result, a, a.split)
     return diagonal(a, offset=offset)
 
 
 def diagonal(a: DNDarray, offset: int = 0, dim1: int = 0, dim2: int = 1) -> DNDarray:
     """Diagonal view (reference ``manipulations.py``)."""
-    result = jnp.diagonal(a.larray, offset=offset, axis1=dim1, axis2=dim2)
+    result = jnp.diagonal(a._logical(), offset=offset, axis1=dim1, axis2=dim2)
     split = None if a.split in (dim1, dim2) else a.split
     if split is not None:
         removed = sum(1 for d in (dim1, dim2) if d < split)
@@ -159,7 +159,7 @@ def dsplit(x: DNDarray, indices_or_sections) -> List[DNDarray]:
 def expand_dims(a: DNDarray, axis: int) -> DNDarray:
     """Insert a new axis (reference ``manipulations.py``)."""
     axis = sanitize_axis(a.shape + (1,), axis)
-    result = jnp.expand_dims(a.larray, axis)
+    result = jnp.expand_dims(a._logical(), axis)
     split = a.split
     if split is not None and axis <= split:
         split += 1
@@ -168,13 +168,13 @@ def expand_dims(a: DNDarray, axis: int) -> DNDarray:
 
 def flatten(a: DNDarray) -> DNDarray:
     """Flatten to 1-D (reference ``manipulations.py``); result split 0."""
-    result = jnp.ravel(a.larray)
+    result = jnp.ravel(a._logical())
     return _wrap(result, a, 0 if a.split is not None else None)
 
 
 def flip(a: DNDarray, axis=None) -> DNDarray:
     """Reverse element order along axis (reference ``manipulations.py``)."""
-    result = jnp.flip(a.larray, axis=axis)
+    result = jnp.flip(a._logical(), axis=axis)
     return _wrap(result, a, a.split)
 
 
@@ -232,9 +232,9 @@ def pad(array: DNDarray, pad_width, mode: str = "constant", constant_values=0) -
             if len(np_pad) < array.ndim:
                 np_pad = [(0, 0)] * (array.ndim - len(np_pad)) + np_pad
     if mode == "constant":
-        result = jnp.pad(array.larray, np_pad, mode=mode, constant_values=constant_values)
+        result = jnp.pad(array._logical(), np_pad, mode=mode, constant_values=constant_values)
     else:
-        result = jnp.pad(array.larray, np_pad, mode=mode)
+        result = jnp.pad(array._logical(), np_pad, mode=mode)
     return _wrap(result, array, array.split)
 
 
@@ -255,8 +255,8 @@ def redistribute(arr: DNDarray, lshape_map=None, target_map=None) -> DNDarray:
 def repeat(a: DNDarray, repeats, axis: Optional[int] = None) -> DNDarray:
     """Repeat elements (reference ``manipulations.py``)."""
     if isinstance(repeats, DNDarray):
-        repeats = repeats.larray
-    result = jnp.repeat(a.larray, repeats, axis=axis)
+        repeats = repeats._logical()
+    result = jnp.repeat(a._logical(), repeats, axis=axis)
     if axis is None:
         split = 0 if a.split is not None else None
     else:
@@ -285,7 +285,7 @@ def reshape(a: DNDarray, *shape, new_split: Optional[int] = None, **kwargs) -> D
     if new_split is None:
         new_split = a.split if a.split is not None and a.split < len(shape) else (0 if a.split is not None else None)
     new_split = sanitize_axis(shape, new_split)
-    result = jnp.reshape(a.larray, shape)
+    result = jnp.reshape(a._logical(), shape)
     return _wrap(result, a, new_split)
 
 
@@ -299,13 +299,13 @@ def resplit(arr: DNDarray, axis: Optional[int] = None) -> DNDarray:
 def roll(x: DNDarray, shift, axis=None) -> DNDarray:
     """Circular shift (reference ``manipulations.py:1989`` — rank-to-rank
     sends; a collective-permute under XLA)."""
-    result = jnp.roll(x.larray, shift, axis=axis)
+    result = jnp.roll(x._logical(), shift, axis=axis)
     return _wrap(result, x, x.split)
 
 
 def rot90(m: DNDarray, k: int = 1, axes=(0, 1)) -> DNDarray:
     """Rotate in the plane of two axes (reference ``manipulations.py``)."""
-    result = jnp.rot90(m.larray, k=k, axes=axes)
+    result = jnp.rot90(m._logical(), k=k, axes=axes)
     split = m.split
     if split in axes and k % 4 != 0:
         if k % 2 == 1:
@@ -322,7 +322,7 @@ def sort(a: DNDarray, axis: int = -1, descending: bool = False, out=None):
     parallel sample-sort with Alltoallv bucket exchange; ``jnp.sort`` over a
     sharded axis compiles to the equivalent distributed sort)."""
     axis = sanitize_axis(a.shape, axis)
-    arr = a.larray
+    arr = a._logical()
     indices = jnp.argsort(arr, axis=axis, descending=descending, stable=True)
     values = jnp.take_along_axis(arr, indices, axis=axis)
     res_v = _wrap(values, a, a.split)
@@ -341,9 +341,9 @@ def split(x: DNDarray, indices_or_sections, axis: int = 0) -> List[DNDarray]:
     if isinstance(indices_or_sections, DNDarray):
         indices_or_sections = indices_or_sections.tolist()
     if isinstance(indices_or_sections, (list, tuple, np.ndarray)):
-        parts = jnp.split(x.larray, np.asarray(indices_or_sections, dtype=np.int64), axis=axis)
+        parts = jnp.split(x._logical(), np.asarray(indices_or_sections, dtype=np.int64), axis=axis)
     else:
-        parts = jnp.split(x.larray, int(indices_or_sections), axis=axis)
+        parts = jnp.split(x._logical(), int(indices_or_sections), axis=axis)
     return [_wrap(p, x, x.split) for p in parts]
 
 
@@ -357,7 +357,7 @@ def squeeze(x: DNDarray, axis=None) -> DNDarray:
                 raise ValueError(f"cannot select an axis to squeeze out which has size not equal to one, got axis {ax}")
     else:
         axes = tuple(i for i, s in enumerate(x.shape) if s == 1)
-    result = jnp.squeeze(x.larray, axis=axes if axes else None)
+    result = jnp.squeeze(x._logical(), axis=axes if axes else None)
     split = x.split
     if split is not None:
         if split in axes:
@@ -370,7 +370,7 @@ def squeeze(x: DNDarray, axis=None) -> DNDarray:
 def stack(arrays: Sequence[DNDarray], axis: int = 0, out=None) -> DNDarray:
     """Join along a new axis (reference ``manipulations.py``)."""
     dnd = [a if isinstance(a, DNDarray) else DNDarray(jnp.asarray(a)) for a in arrays]
-    result = jnp.stack([a.larray for a in dnd], axis=axis)
+    result = jnp.stack([a._logical() for a in dnd], axis=axis)
     base_split = next((a.split for a in dnd if a.split is not None), None)
     split = None
     if base_split is not None:
@@ -399,7 +399,7 @@ def tile(x: DNDarray, reps) -> DNDarray:
     """Tile an array (reference ``manipulations.py``)."""
     if isinstance(reps, DNDarray):
         reps = reps.tolist()
-    result = jnp.tile(x.larray, reps)
+    result = jnp.tile(x._logical(), reps)
     split = x.split
     if split is not None:
         split += result.ndim - x.ndim
@@ -410,7 +410,7 @@ def topk(a: DNDarray, k: int, dim: int = -1, largest: bool = True, sorted: bool 
     """Top-k values and indices (reference ``manipulations.py:3834`` with a
     custom MPI merge op; ``lax.top_k`` + XLA collectives here)."""
     dim = sanitize_axis(a.shape, dim)
-    arr = a.larray
+    arr = a._logical()
     moved = jnp.moveaxis(arr, dim, -1)
     if largest:
         values, indices = jax.lax.top_k(moved, k)
@@ -441,7 +441,7 @@ def unfold(a: DNDarray, axis: int, size: int, step: int = 1) -> DNDarray:
         raise ValueError(f"size {size} exceeds dimension {length}")
     n_windows = (length - size) // step + 1
     starts = jnp.arange(n_windows) * step
-    moved = jnp.moveaxis(a.larray, axis, 0)
+    moved = jnp.moveaxis(a._logical(), axis, 0)
     windows = jax.vmap(lambda s: jax.lax.dynamic_slice_in_dim(moved, s, size, axis=0))(starts)
     # windows: (n_windows, size, ...) -> restore axis order, window dim last
     windows = jnp.moveaxis(windows, 1, -1)  # (n_windows, ..., size)
@@ -457,9 +457,9 @@ def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False, axis
     if axis is not None:
         axis = sanitize_axis(a.shape, axis)
     if return_inverse:
-        vals, inverse = jnp.unique(a.larray, return_inverse=True, axis=axis)
+        vals, inverse = jnp.unique(a._logical(), return_inverse=True, axis=axis)
     else:
-        vals = jnp.unique(a.larray, axis=axis)
+        vals = jnp.unique(a._logical(), axis=axis)
     split = 0 if a.split is not None else None
     res = DNDarray(vals, dtype=a.dtype, split=split, device=a.device, comm=a.comm)
     if return_inverse:

@@ -13,7 +13,7 @@ def copy(x: DNDarray) -> DNDarray:
     if not isinstance(x, DNDarray):
         raise TypeError(f"input needs to be a DNDarray, but was {type(x)}")
     return DNDarray(
-        jnp.copy(x.larray), dtype=x.dtype, split=x.split, device=x.device, comm=x.comm
+        jnp.copy(x.larray), gshape=x.gshape, dtype=x.dtype, split=x.split, device=x.device, comm=x.comm
     )
 
 

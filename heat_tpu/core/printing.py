@@ -85,6 +85,10 @@ def __str__(dndarray) -> str:
         ordered = [unique[k] for k in sorted(unique)]
         if split is not None and len(ordered) > 1:
             data = np.concatenate([np.asarray(s.data) for s in ordered], axis=split)
+            if dndarray.padded:  # drop the tail padding of the last shard
+                sl = [slice(None)] * data.ndim
+                sl[split] = slice(0, dndarray.gshape[split])
+                data = data[tuple(sl)]
         else:
             data = np.asarray(ordered[0].data)
     else:

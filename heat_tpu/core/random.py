@@ -94,6 +94,21 @@ def _finalize(data: jax.Array, dtype, split, device, comm) -> DNDarray:
     )
 
 
+def _sharded_fill(gen, key, shape, dtype, split, device, comm) -> DNDarray:
+    """Generate directly at the *padded* physical shape, born in its final
+    even sharding. With ``jax_threefry_partitionable`` each element's value
+    depends only on its (row-major) position, so the valid region is
+    bit-identical to an unpadded/unsplit generation — the reference's
+    split-invariant-stream guarantee (``random.py:55-201``) extends to the
+    padding for free."""
+    pshape = comm.padded_shape(shape, split)
+    sharding = comm.array_sharding(pshape, split)
+    data = jax.jit(lambda k: gen(k, pshape), out_shardings=sharding)(key)
+    return DNDarray._from_buffer(
+        data, shape, dtype, split, devices.sanitize_device(device), comm
+    )
+
+
 def _float_jt(dtype):
     dtype = types.canonical_heat_type(dtype) if dtype is not None else types.float32
     if dtype not in (types.float16, types.bfloat16, types.float32, types.float64):
@@ -109,11 +124,10 @@ def rand(*d, dtype=types.float32, split=None, device=None, comm=None) -> DNDarra
     dtype, jt = _float_jt(dtype)
     comm_ = sanitize_comm(comm)
     key = _next_key(int(np.prod(shape)) if shape else 1)
-    sharding = comm_.array_sharding(shape, split if shape else None)
-    data = jax.jit(
-        lambda k: jax.random.uniform(k, shape, dtype=jt), out_shardings=sharding
-    )(key)
-    return _finalize(data, dtype, split if shape else None, device, comm_)
+    return _sharded_fill(
+        lambda k, ps: jax.random.uniform(k, ps, dtype=jt),
+        key, shape, dtype, split if shape else None, device, comm_,
+    )
 
 
 def randn(*d, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
@@ -126,11 +140,10 @@ def randn(*d, dtype=types.float32, split=None, device=None, comm=None) -> DNDarr
     dtype, jt = _float_jt(dtype)
     comm_ = sanitize_comm(comm)
     key = _next_key(int(np.prod(shape)) if shape else 1)
-    sharding = comm_.array_sharding(shape, split if shape else None)
-    data = jax.jit(
-        lambda k: jax.random.normal(k, shape, dtype=jt), out_shardings=sharding
-    )(key)
-    return _finalize(data, dtype, split if shape else None, device, comm_)
+    return _sharded_fill(
+        lambda k, ps: jax.random.normal(k, ps, dtype=jt),
+        key, shape, dtype, split if shape else None, device, comm_,
+    )
 
 
 def randint(
@@ -154,12 +167,10 @@ def randint(
     comm_ = sanitize_comm(comm)
     key = _next_key(int(np.prod(shape)) if shape else 1)
     split_ = split if shape else None
-    sharding = comm_.array_sharding(shape, split_)
-    data = jax.jit(
-        lambda k: jax.random.randint(k, shape, low, high, dtype=jnp.int64).astype(dtype.jax_type()),
-        out_shardings=sharding,
-    )(key)
-    return _finalize(data, dtype, split_, device, comm_)
+    return _sharded_fill(
+        lambda k, ps: jax.random.randint(k, ps, low, high, dtype=jnp.int64).astype(dtype.jax_type()),
+        key, shape, dtype, split_, device, comm_,
+    )
 
 
 random_integer = randint
@@ -184,13 +195,8 @@ def normal(mean=0.0, std=1.0, shape=None, dtype=types.float32, split=None, devic
         shape = ()
     shape = sanitize_shape(shape) if shape != () else ()
     base = randn(*shape, dtype=dtype, split=split, device=device, comm=comm)
-    if isinstance(mean, DNDarray):
-        mean = mean.larray
-    if isinstance(std, DNDarray):
-        std = std.larray
-    return DNDarray(
-        base.larray * std + mean, dtype=base.dtype, split=base.split, device=base.device, comm=base.comm
-    )
+    # DNDarray arithmetic keeps padding/broadcast alignment correct
+    return (base * std + mean).astype(base.dtype)
 
 
 def standard_normal(shape=None, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
@@ -207,9 +213,7 @@ def uniform(low=0.0, high=1.0, size=None, dtype=types.float32, split=None, devic
         size = ()
     shape = sanitize_shape(size) if size != () else ()
     base = rand(*shape, dtype=dtype, split=split, device=device, comm=comm)
-    return DNDarray(
-        base.larray * (high - low) + low, dtype=base.dtype, split=base.split, device=base.device, comm=base.comm
-    )
+    return (base * (high - low) + low).astype(base.dtype)
 
 
 def randperm(n: int, dtype=types.int64, split=None, device=None, comm=None) -> DNDarray:
@@ -230,5 +234,5 @@ def permutation(x, split=None, device=None, comm=None) -> DNDarray:
         raise TypeError(f"x must be int or DNDarray, got {type(x)}")
     key = _next_key(x.shape[0])
     perm = jax.random.permutation(key, x.shape[0])
-    result = jnp.take(x.larray, perm, axis=0)
+    result = jnp.take(x._logical(), perm, axis=0)
     return DNDarray(result, dtype=x.dtype, split=x.split, device=x.device, comm=x.comm)

@@ -30,7 +30,7 @@ def equal(x, y) -> bool:
         res = _binary_op(jnp.equal, x, y)
     except ValueError:
         return False
-    return bool(jnp.all(res.larray))
+    return bool(jnp.all(res._logical()))
 
 
 def ge(x, y) -> DNDarray:

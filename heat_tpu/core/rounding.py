@@ -73,9 +73,9 @@ def clip(x, min=None, max=None, out=None, *, a_min=None, a_max=None) -> DNDarray
     if lo is None and hi is None:
         raise ValueError("either min or max must be set")
     if isinstance(lo, DNDarray):
-        lo = lo.larray
+        lo = lo.larray if lo.pshape == x.pshape else lo._logical()
     if isinstance(hi, DNDarray):
-        hi = hi.larray
+        hi = hi.larray if hi.pshape == x.pshape else hi._logical()
     return _local_op(lambda t: jnp.clip(t, lo, hi), x, out=out, no_cast=True)
 
 

@@ -34,7 +34,7 @@ def convolve(a: DNDarray, v: DNDarray, mode: str = "full") -> DNDarray:
         raise ValueError("mode 'same' cannot be used with even-sized kernel")
     promoted = types.promote_types(a.dtype, v.dtype)
     jt = promoted.jax_type()
-    result = jnp.convolve(a.larray.astype(jt), v.larray.astype(jt), mode=mode)
+    result = jnp.convolve(a._logical().astype(jt), v._logical().astype(jt), mode=mode)
     return DNDarray(
         result,
         dtype=types.canonical_heat_type(result.dtype),

@@ -16,7 +16,15 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import types
-from ._operations import _binary_op, _local_op, _reduce_op, _reduced_split
+from ._operations import (
+    _binary_op,
+    _local_op,
+    _mask_padding,
+    _neutral_value,
+    _reduce_op,
+    _reduced_shape,
+    _reduced_split,
+)
 from .dndarray import DNDarray
 from .stride_tricks import sanitize_axis
 
@@ -61,15 +69,30 @@ def _arg_reduce(op, x, axis, out):
     if not isinstance(x, DNDarray):
         raise TypeError(f"expected x to be a DNDarray, but was {type(x)}")
     axis = sanitize_axis(x.shape, axis)
-    result = op(x.larray, axis=axis)
+    arr = x.larray
+    if x.padded:
+        # padding can never win: fill it with the op's worst value
+        fill = _neutral_value("min" if op is jnp.argmax else "max", arr.dtype)
+        arr = _mask_padding(arr, x.gshape, x.split, fill)
+    result = op(arr, axis=axis)
+    if x.padded and axis is None and x.ndim > 1:
+        # flat indices refer to the padded buffer; remap to logical layout
+        coords = jnp.unravel_index(result, arr.shape)
+        result = jnp.ravel_multi_index(coords, x.gshape, mode="clip")
     split = _reduced_split(x.split, axis if axis is not None else None, x.ndim, False)
-    res = DNDarray(
-        result.astype(jnp.int64),
-        dtype=types.int64,
-        split=split,
-        device=x.device,
-        comm=x.comm,
-    )
+    result = result.astype(jnp.int64)
+    out_gshape = _reduced_shape(x.gshape, axis, False)
+    if split is not None and tuple(result.shape) != out_gshape:
+        res = DNDarray._from_buffer(result, out_gshape, types.int64, split, x.device, x.comm)
+    else:
+        res = DNDarray(
+            result,
+            gshape=out_gshape,
+            dtype=types.int64,
+            split=split,
+            device=x.device,
+            comm=x.comm,
+        )
     if out is not None:
         from ._operations import _write_out
 
@@ -88,8 +111,8 @@ def average(x: DNDarray, axis=None, weights: Optional[DNDarray] = None, returned
             return result, factories.full_like(result, float(n))
         return result
     axis_s = sanitize_axis(x.shape, axis)
-    w = weights.larray if isinstance(weights, DNDarray) else jnp.asarray(weights)
-    xa = x.larray
+    w = weights._logical() if isinstance(weights, DNDarray) else jnp.asarray(weights)
+    xa = x._logical()
     if w.ndim != xa.ndim:
         if axis_s is None or isinstance(axis_s, tuple):
             raise TypeError("Axis must be specified when shapes of x and weights differ.")
@@ -115,15 +138,15 @@ def _axes(x, axis):
 
 def bincount(x: DNDarray, weights=None, minlength: int = 0) -> DNDarray:
     """Count occurrences of each value (reference ``statistics.py:322``)."""
-    w = weights.larray if isinstance(weights, DNDarray) else weights
-    result = jnp.bincount(x.larray, weights=w, minlength=minlength)
+    w = weights._logical() if isinstance(weights, DNDarray) else weights
+    result = jnp.bincount(x._logical(), weights=w, minlength=minlength)
     return DNDarray(result, dtype=types.canonical_heat_type(result.dtype), split=None, device=x.device, comm=x.comm)
 
 
 def bucketize(input: DNDarray, boundaries, out_int32: bool = False, right: bool = False, out=None) -> DNDarray:
     """Index of the bucket each value falls into (reference
     ``statistics.py:393``)."""
-    b = boundaries.larray if isinstance(boundaries, DNDarray) else jnp.asarray(boundaries)
+    b = boundaries._logical() if isinstance(boundaries, DNDarray) else jnp.asarray(boundaries)
     side = "left" if right else "right"
     idx_type = types.int32 if out_int32 else types.int64
     jt = idx_type.jax_type()
@@ -133,7 +156,7 @@ def bucketize(input: DNDarray, boundaries, out_int32: bool = False, right: bool 
 def digitize(x: DNDarray, bins, right: bool = False) -> DNDarray:
     """Index of the bin each value belongs to (reference
     ``statistics.py:541``)."""
-    b = bins.larray if isinstance(bins, DNDarray) else jnp.asarray(bins)
+    b = bins._logical() if isinstance(bins, DNDarray) else jnp.asarray(bins)
     return _local_op(lambda t: jnp.digitize(t, b, right=right).astype(jnp.int64), x, no_cast=True, out_dtype=types.int64)
 
 
@@ -141,13 +164,13 @@ def cov(m: DNDarray, y: Optional[DNDarray] = None, rowvar: bool = True, bias: bo
     """Covariance matrix estimate (reference ``statistics.py:466``)."""
     if ddof is None:
         ddof = 0 if bias else 1
-    x = m.larray
+    x = m._logical()
     if x.ndim == 1:
         x = x[None, :]
     elif not rowvar and x.shape[0] != 1:
         x = x.T
     if y is not None:
-        ya = y.larray
+        ya = y._logical()
         if ya.ndim == 1:
             ya = ya[None, :]
         elif not rowvar:
@@ -164,7 +187,7 @@ def cov(m: DNDarray, y: Optional[DNDarray] = None, rowvar: bool = True, bias: bo
 def histc(input: DNDarray, bins: int = 100, min: float = 0.0, max: float = 0.0, out=None) -> DNDarray:
     """Histogram with equal-width bins (torch-style; reference
     ``statistics.py:616``)."""
-    arr = input.larray
+    arr = input._logical()
     lo, hi = float(min), float(max)
     if lo == 0.0 and hi == 0.0:
         lo, hi = float(jnp.min(arr)), float(jnp.max(arr))
@@ -180,7 +203,7 @@ def histc(input: DNDarray, bins: int = 100, min: float = 0.0, max: float = 0.0, 
 def histogram(a: DNDarray, bins: int = 10, range=None, normed=None, weights=None, density=None):
     """numpy-style histogram (reference exposes torch histc; numpy parity
     added for convenience)."""
-    hist, edges = jnp.histogram(a.larray, bins=bins, range=range, density=density)
+    hist, edges = jnp.histogram(a._logical(), bins=bins, range=range, density=density)
     return (
         DNDarray(hist, split=None, device=a.device, comm=a.comm),
         DNDarray(edges, split=None, device=a.device, comm=a.comm),
@@ -192,7 +215,7 @@ def kurtosis(x: DNDarray, axis=None, unbiased: bool = True, Fischer: bool = True
     sample-size correction, ``Fischer`` subtracts 3 — reference arg names).
     Moment merging is XLA's problem now."""
     axis_s = sanitize_axis(x.shape, axis)
-    arr = x.larray.astype(jnp.promote_types(x.larray.dtype, jnp.float32))
+    arr = x._logical().astype(jnp.promote_types(x.larray.dtype, jnp.float32))
     n = arr.size if axis_s is None else arr.shape[axis_s]
     mu = jnp.mean(arr, axis=axis_s, keepdims=True)
     m2 = jnp.mean((arr - mu) ** 2, axis=axis_s)
@@ -210,7 +233,7 @@ def skew(x: DNDarray, axis=None, unbiased: bool = True) -> DNDarray:
     """Skewness (reference ``statistics.py:1676``; ``unbiased`` applies the
     Fisher-Pearson sample correction)."""
     axis_s = sanitize_axis(x.shape, axis)
-    arr = x.larray.astype(jnp.promote_types(x.larray.dtype, jnp.float32))
+    arr = x._logical().astype(jnp.promote_types(x.larray.dtype, jnp.float32))
     n = arr.size if axis_s is None else arr.shape[axis_s]
     mu = jnp.mean(arr, axis=axis_s, keepdims=True)
     m2 = jnp.mean((arr - mu) ** 2, axis=axis_s)
@@ -224,7 +247,7 @@ def skew(x: DNDarray, axis=None, unbiased: bool = True) -> DNDarray:
 
 def max(x: DNDarray, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
     """Maximum along axis (reference ``statistics.py:781``)."""
-    return _reduce_op(jnp.max, x, axis=axis, out=out, keepdims=bool(keepdim or keepdims))
+    return _reduce_op(jnp.max, x, axis=axis, out=out, keepdims=bool(keepdim or keepdims), neutral="min")
 
 
 def maximum(x1, x2, out=None) -> DNDarray:
@@ -240,31 +263,31 @@ def mean(x: DNDarray, axis=None) -> DNDarray:
 
 def nanmax(x: DNDarray, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
     """Maximum ignoring NaNs (numpy extra beyond the reference)."""
-    return _reduce_op(jnp.nanmax, x, axis=axis, out=out, keepdims=bool(keepdim or keepdims))
+    return _reduce_op(jnp.nanmax, x, axis=axis, out=out, keepdims=bool(keepdim or keepdims), neutral=("nan", None))
 
 
 def nanmin(x: DNDarray, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
     """Minimum ignoring NaNs (numpy extra beyond the reference)."""
-    return _reduce_op(jnp.nanmin, x, axis=axis, out=out, keepdims=bool(keepdim or keepdims))
+    return _reduce_op(jnp.nanmin, x, axis=axis, out=out, keepdims=bool(keepdim or keepdims), neutral=("nan", None))
 
 
 def nanmean(x: DNDarray, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
     """Mean ignoring NaNs (numpy extra beyond the reference)."""
-    return _reduce_op(jnp.nanmean, x, axis=axis, out=out, keepdims=bool(keepdim or keepdims))
+    return _reduce_op(jnp.nanmean, x, axis=axis, out=out, keepdims=bool(keepdim or keepdims), neutral=("nan", None))
 
 
 def median(x: DNDarray, axis=None, keepdim: bool = False, keepdims=None) -> DNDarray:
     """Median (reference ``statistics.py:1017``, gather-based)."""
     kd = bool(keepdim or keepdims)
     axis_s = sanitize_axis(x.shape, axis)
-    result = jnp.median(x.larray, axis=axis_s, keepdims=kd)
+    result = jnp.median(x._logical(), axis=axis_s, keepdims=kd)
     split = _reduced_split(x.split, axis_s, x.ndim, kd)
     return DNDarray(result, dtype=types.canonical_heat_type(result.dtype), split=split, device=x.device, comm=x.comm)
 
 
 def min(x: DNDarray, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
     """Minimum along axis (reference ``statistics.py:1114``)."""
-    return _reduce_op(jnp.min, x, axis=axis, out=out, keepdims=bool(keepdim or keepdims))
+    return _reduce_op(jnp.min, x, axis=axis, out=out, keepdims=bool(keepdim or keepdims), neutral="max")
 
 
 def minimum(x1, x2, out=None) -> DNDarray:
@@ -276,9 +299,9 @@ def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear
     global jnp.percentile here — XLA handles the sharded sort)."""
     kd = bool(keepdim or keepdims)
     axis_s = sanitize_axis(x.shape, axis)
-    q_arr = q.larray if isinstance(q, DNDarray) else jnp.asarray(q)
+    q_arr = q._logical() if isinstance(q, DNDarray) else jnp.asarray(q)
     method = {"lower": "lower", "higher": "higher", "midpoint": "midpoint", "nearest": "nearest", "linear": "linear"}[interpolation]
-    result = jnp.percentile(x.larray.astype(jnp.float64 if x.larray.dtype == jnp.float64 else jnp.float32), q_arr, axis=axis_s, method=method, keepdims=kd)
+    result = jnp.percentile(x._logical().astype(jnp.float64 if x.larray.dtype == jnp.float64 else jnp.float32), q_arr, axis=axis_s, method=method, keepdims=kd)
     res = DNDarray(result, dtype=types.canonical_heat_type(result.dtype), split=None, device=x.device, comm=x.comm)
     if out is not None:
         from ._operations import _write_out

@@ -69,7 +69,7 @@ class Laplacian:
         S = self.similarity_metric(x)
         if not isinstance(S, DNDarray):
             raise TypeError("similarity metric must return a DNDarray")
-        A = S.larray
+        A = S._logical()
         if self.mode == "eNeighbour":
             key, val = self.epsilon
             if key == "upper":

@@ -43,12 +43,12 @@ class GaussianNB(BaseEstimator, ClassificationMixin):
         """Incremental fit (reference ``gaussianNB.py:200``)."""
         if not isinstance(x, DNDarray) or not isinstance(y, DNDarray):
             raise TypeError(f"input needs to be DNDarrays, but were {type(x)}, {type(y)}")
-        X = x.larray.astype(jnp.promote_types(x.larray.dtype, jnp.float32))
-        Y = y.larray.ravel()
+        X = x._logical().astype(jnp.promote_types(x.larray.dtype, jnp.float32))
+        Y = y._logical().ravel()
         if classes is not None:
-            class_vals = jnp.asarray(classes if not isinstance(classes, DNDarray) else classes.larray)
+            class_vals = jnp.asarray(classes if not isinstance(classes, DNDarray) else classes._logical())
         elif not _refit and getattr(self, "classes_", None) is not None:
-            class_vals = self.classes_.larray
+            class_vals = self.classes_._logical()
         elif _refit:
             class_vals = jnp.unique(Y)
         else:
@@ -65,7 +65,7 @@ class GaussianNB(BaseEstimator, ClassificationMixin):
 
         member = (Y[:, None] == class_vals[None, :]).astype(X.dtype)  # (n, k)
         if sample_weight is not None:
-            w = sample_weight.larray if isinstance(sample_weight, DNDarray) else jnp.asarray(sample_weight)
+            w = sample_weight._logical() if isinstance(sample_weight, DNDarray) else jnp.asarray(sample_weight)
             member = member * w[:, None]
         counts = jnp.sum(member, axis=0)  # (k,)
         sums = member.T @ X  # (k, f)
@@ -79,9 +79,9 @@ class GaussianNB(BaseEstimator, ClassificationMixin):
         else:
             # merge with previous moments (parallel Welford, reference
             # ``__update_mean_variance`` gaussianNB.py:131)
-            old_counts = self.class_count_.larray
-            old_means = self.theta_.larray
-            old_vars = self.sigma_.larray - self.epsilon_
+            old_counts = self.class_count_._logical()
+            old_means = self.theta_._logical()
+            old_vars = self.sigma_._logical() - self.epsilon_
             tot = old_counts + counts
             delta = means - old_means
             new_means = old_means + delta * (counts / jnp.maximum(tot, 1.0))[:, None]
@@ -97,7 +97,7 @@ class GaussianNB(BaseEstimator, ClassificationMixin):
         self.theta_ = DNDarray(new_means, split=None, device=x.device, comm=x.comm)
         self.sigma_ = DNDarray(new_vars + eps, split=None, device=x.device, comm=x.comm)
         if self.priors is not None:
-            pr = self.priors.larray if isinstance(self.priors, DNDarray) else jnp.asarray(self.priors)
+            pr = self.priors._logical() if isinstance(self.priors, DNDarray) else jnp.asarray(self.priors)
             self.class_prior_ = DNDarray(pr, split=None, device=x.device, comm=x.comm)
         else:
             self.class_prior_ = DNDarray(
@@ -107,9 +107,9 @@ class GaussianNB(BaseEstimator, ClassificationMixin):
 
     def __joint_log_likelihood(self, X: jnp.ndarray) -> jnp.ndarray:
         """reference ``gaussianNB.py:391``"""
-        theta = self.theta_.larray  # (k, f)
-        sigma = self.sigma_.larray
-        prior = self.class_prior_.larray
+        theta = self.theta_._logical()  # (k, f)
+        sigma = self.sigma_._logical()
+        prior = self.class_prior_._logical()
         log_prior = jnp.log(jnp.maximum(prior, 1e-300))
         # (n, k): -0.5 * sum(log(2 pi sigma)) - 0.5 * sum((x-mu)^2/sigma)
         n_ij = -0.5 * jnp.sum(jnp.log(2.0 * jnp.pi * sigma), axis=1)  # (k,)
@@ -122,24 +122,24 @@ class GaussianNB(BaseEstimator, ClassificationMixin):
         """reference ``gaussianNB.py:407``"""
         from jax.scipy.special import logsumexp as lse
 
-        out = lse(a.larray, axis=axis)
+        out = lse(a._logical(), axis=axis)
         return DNDarray(out, split=None, device=a.device, comm=a.comm)
 
     def predict(self, x: DNDarray) -> DNDarray:
         """reference ``gaussianNB.py:480``"""
         if getattr(self, "theta_", None) is None:
             raise RuntimeError("fit needs to be called before predict")
-        X = x.larray.astype(self.theta_.larray.dtype)
+        X = x._logical().astype(self.theta_.larray.dtype)
         jll = self.__joint_log_likelihood(X)
         idx = jnp.argmax(jll, axis=1)
-        pred = jnp.take(self.classes_.larray, idx)
+        pred = jnp.take(self.classes_._logical(), idx)
         return DNDarray(pred, split=x.split, device=x.device, comm=x.comm)
 
     def predict_proba(self, x: DNDarray) -> DNDarray:
         """Posterior probabilities (reference ``gaussianNB.py``)."""
         from jax.scipy.special import logsumexp as lse
 
-        X = x.larray.astype(self.theta_.larray.dtype)
+        X = x._logical().astype(self.theta_.larray.dtype)
         jll = self.__joint_log_likelihood(X)
         log_prob = jll - lse(jll, axis=1, keepdims=True)
         return DNDarray(jnp.exp(log_prob), split=x.split, device=x.device, comm=x.comm)
@@ -147,6 +147,6 @@ class GaussianNB(BaseEstimator, ClassificationMixin):
     def predict_log_proba(self, x: DNDarray) -> DNDarray:
         from jax.scipy.special import logsumexp as lse
 
-        X = x.larray.astype(self.theta_.larray.dtype)
+        X = x._logical().astype(self.theta_.larray.dtype)
         jll = self.__joint_log_likelihood(X)
         return DNDarray(jll - lse(jll, axis=1, keepdims=True), split=x.split, device=x.device, comm=x.comm)

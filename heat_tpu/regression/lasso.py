@@ -80,14 +80,14 @@ class Lasso(BaseEstimator, RegressionMixin):
         if isinstance(rho, DNDarray):
             import jax.numpy as jnp
 
-            r = rho.larray
+            r = rho._logical()
             out = jnp.sign(r) * jnp.maximum(jnp.abs(r) - lam, 0.0)
             return DNDarray(out, split=rho.split, device=rho.device, comm=rho.comm)
         return jnp.sign(rho) * jnp.maximum(jnp.abs(rho) - lam, 0.0)
 
     def rmse(self, gt: DNDarray, yest: DNDarray) -> float:
         """Root mean squared error (reference ``lasso.py``)."""
-        diff = gt.larray.ravel() - yest.larray.ravel()
+        diff = gt._logical().ravel() - yest._logical().ravel()
         return float(jnp.sqrt(jnp.mean(diff * diff)))
 
     def fit(self, x: DNDarray, y: DNDarray) -> "Lasso":
@@ -96,8 +96,8 @@ class Lasso(BaseEstimator, RegressionMixin):
             raise TypeError(f"input needs to be DNDarrays, but were {type(x)}, {type(y)}")
         if x.ndim != 2:
             raise ValueError(f"x needs to be 2D, but was {x.ndim}D")
-        X = x.larray.astype(jnp.promote_types(x.larray.dtype, jnp.float32))
-        Y = y.larray.astype(X.dtype).ravel()
+        X = x._logical().astype(jnp.promote_types(x.larray.dtype, jnp.float32))
+        Y = y._logical().astype(X.dtype).ravel()
         m = X.shape[1]
         theta = jnp.zeros(m, dtype=X.dtype)
         lam = jnp.asarray(self.lam, dtype=X.dtype)
@@ -116,5 +116,5 @@ class Lasso(BaseEstimator, RegressionMixin):
         """reference ``lasso.py:predict``"""
         if self.__theta is None:
             raise RuntimeError("fit needs to be called before predict")
-        out = x.larray @ self.__theta.larray
+        out = x._logical() @ self.__theta._logical()
         return DNDarray(out, split=x.split, device=x.device, comm=x.comm)

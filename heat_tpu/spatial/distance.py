@@ -22,6 +22,7 @@ import jax.numpy as jnp
 
 from ..core import types
 from ..core.dndarray import DNDarray
+from ..core.linalg.basics import _wrap_result
 
 __all__ = ["cdist", "manhattan", "nearest_neighbors", "rbf"]
 
@@ -107,22 +108,22 @@ def _dist(x: DNDarray, y: Optional[DNDarray], metric: Callable, use_ring: bool =
 
     promoted = types.promote_types(x.dtype, types.float32)
     jt = promoted.jax_type()
+    # padded tail rows produce tiles that land in the (trimmed) output
+    # padding, so the buffers can be consumed directly
     xa = x.larray.astype(jt)
     ya = y.larray.astype(jt)
+    out_gshape = (x.gshape[0], y.gshape[0])
+    out_split = 0 if x.split is not None else (1 if y.split is not None else None)
 
-    if use_ring and x.split == 0 and y.split == 0:
+    if use_ring and x.split == 0 and y.split == 0 and x.comm.size > 1:
         from ..parallel.ring import ring_map
 
-        p = x.comm.size
-        if xa.shape[0] % p == 0 and ya.shape[0] % p == 0 and p > 1:
-            result = ring_map(metric, xa, ya, x.comm)
-            out_split = 0
-            return DNDarray(result, dtype=promoted, split=out_split, device=x.device, comm=x.comm)
+        result = ring_map(metric, xa, ya, x.comm)
+        return _wrap_result(result, out_gshape, 0, promoted, x.device, x.comm)
 
     # GSPMD path: one global expression; XLA inserts the collectives
     result = metric(xa, ya)
-    out_split = 0 if x.split is not None else (1 if y.split is not None else None)
-    return DNDarray(result, dtype=promoted, split=out_split, device=x.device, comm=x.comm)
+    return _wrap_result(result, out_gshape, out_split, promoted, x.device, x.comm)
 
 
 def cdist(
@@ -188,9 +189,10 @@ def nearest_neighbors(x: DNDarray, y: DNDarray, k: int):
     if x.split not in (None, 0):
         raise NotImplementedError("nearest_neighbors: x must be split=0 or replicated")
 
-    # the kernel computes in f32 (MXU precision); cast once here
+    # the kernel computes in f32 (MXU precision); cast once here.
+    # y must be its logical extent: the kernel's indices are global rows
     xa = x.larray.astype(jnp.float32)
-    ya = y.larray.astype(jnp.float32)
+    ya = y._logical().astype(jnp.float32)
 
     p = x.comm.size
     if x.split == 0 and p > 1 and xa.shape[0] % p == 0:
@@ -208,6 +210,7 @@ def nearest_neighbors(x: DNDarray, y: DNDarray, k: int):
         )(xa, ya)
     else:
         d, idx = _nn_local(xa, ya, k)
-    dist = DNDarray(d, dtype=types.float32, split=x.split, device=x.device, comm=x.comm)
-    indices = DNDarray(idx, dtype=types.int32, split=x.split, device=x.device, comm=x.comm)
+    out_gshape = (x.gshape[0], k)
+    dist = _wrap_result(d, out_gshape, x.split, types.float32, x.device, x.comm)
+    indices = _wrap_result(idx, out_gshape, x.split, types.int32, x.device, x.comm)
     return dist, indices

@@ -20,6 +20,9 @@ from tests.base import TestCase
 
 class TestChunkMatchesPhysicalShards(TestCase):
     def test_chunk_vs_addressable_shards(self):
+        """Every shape — divisible or not — must be REALLY sharded: the
+        buffer is tail-padded to an even layout (never replicated), and the
+        trimmed per-device shards match ``comm.chunk`` exactly."""
         import jax
 
         for n_dev in (2, 5, 8):
@@ -30,20 +33,25 @@ class TestChunkMatchesPhysicalShards(TestCase):
                 for shape, split in [((16, 4), 0), ((9, 4), 0), ((4, 9), 1), ((7, 3, 5), 2)]:
                     x = ht.zeros(shape, split=split)
                     phys = x.larray.sharding
-                    if phys.is_fully_replicated:
-                        # non-divisible dims fall back to physical
-                        # replication; chunk still reports the LOGICAL
-                        # ceil-div partition and must cover the extent
-                        self.assertNotEqual(shape[split] % n_dev, 0)
-                        total = 0
-                        for r in range(comm.size):
-                            _, lshape, _ = comm.chunk(shape, x.split, rank=r)
-                            total += lshape[split]
-                        self.assertEqual(total, shape[split])
-                        continue
-                    shard_shape = phys.shard_shape(tuple(shape))
-                    _, lshape0, _ = comm.chunk(shape, split, rank=0)
-                    self.assertEqual(tuple(lshape0), tuple(shard_shape))
+                    if n_dev > 1:
+                        self.assertFalse(
+                            phys.is_fully_replicated,
+                            f"split={split} {shape} must not be replicated on {n_dev} devices",
+                        )
+                    # physical buffer: even ceil-div blocks of the padded dim
+                    self.assertEqual(x.pshape, comm.padded_shape(shape, split))
+                    self.assertEqual(x.pshape[split] % n_dev, 0)
+                    # trimmed local shards == the reference's chunk map
+                    for r, shard in enumerate(x.local_shards):
+                        _, lshape, _ = comm.chunk(shape, split, rank=r)
+                        self.assertEqual(tuple(shard.shape), tuple(lshape))
+                    # per-device memory is the padded block, ~1/P of global
+                    blocks = [s.data for s in x.larray.addressable_shards]
+                    per_dev = max(int(np.prod(b.shape)) for b in blocks)
+                    self.assertEqual(
+                        per_dev, int(np.prod(x.pshape)) // n_dev,
+                        "per-device buffer must be exactly 1/P of the padded global",
+                    )
 
     def test_lshape_map_sums_to_gshape(self):
         import jax

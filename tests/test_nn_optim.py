@@ -238,7 +238,7 @@ class TestDataTools(TestCase):
             ds = ht.utils.data.MNISTDataset(d, train=True, split=0)
             assert len(ds) == 10
             np.testing.assert_allclose(
-                np.asarray(ds.htdata.larray), imgs.astype(np.float32) / 255.0
+                ds.htdata.numpy(), imgs.astype(np.float32) / 255.0
             )
             img, target = ds[3]
             assert int(target) == int(lbls[3])
