@@ -51,7 +51,14 @@ class KNeighborsClassifier(BaseEstimator, ClassificationMixin):
         from ..core.kernels import pallas_supported
         from ..spatial.distance import nearest_neighbors
 
-        if pallas_supported() and nq * nt > 1 << 22 and x.split in (None, 0):
+        # the fused kernel's merge is O(k*(k+tile_m)) per tile — past k~64
+        # the materializing cdist+top_k path wins, so gate on k as well
+        if (
+            pallas_supported()
+            and nq * nt > 1 << 22
+            and x.split in (None, 0)
+            and self.n_neighbors <= 64
+        ):
             # fused pallas path: never materializes the (nq, nt) matrix
             _, idx_nd = nearest_neighbors(x, self.x, self.n_neighbors)
             idx = idx_nd._logical()

@@ -77,7 +77,9 @@ class _KCluster(BaseEstimator, ClusteringMixin):
         if isinstance(self.init, DNDarray):
             if self.init.shape != (k, x.shape[1]):
                 raise ValueError(f"passed centroids have wrong shape {self.init.shape}")
-            return self.init.larray.astype(xa.dtype)
+            # logical view: a split init's buffer may carry pad rows, which
+            # would otherwise enter the fit as phantom centroids
+            return self.init._logical().astype(xa.dtype)
         if self.random_state is not None:
             ht_random.seed(self.random_state)
         if self.init == "random":

@@ -49,9 +49,6 @@ def _lloyd_body(xa: jnp.ndarray, centers: jnp.ndarray, k: int, n_valid):
     return new_centers, labels, shift
 
 
-_lloyd_step = partial(jax.jit, static_argnames=("k",))(_lloyd_body)
-
-
 @partial(jax.jit, static_argnames=("k",))
 def _inertia(xa: jnp.ndarray, centers: jnp.ndarray, k: int, n_valid=None) -> jnp.ndarray:
     d2 = _quadratic_expand(xa, centers)

@@ -144,7 +144,10 @@ def nearest_neighbors(
     ----------
     x : (n, f) queries; y : (m, f) references — single-device arrays
         (callers shard_map over a mesh for split operands).
-    k : neighbors to keep (k <= m).
+    k : neighbors to keep (k <= m). The merge pass costs O(k*(k+tile_m))
+        per tile, so the kernel is profitable for small k (<= ~64);
+        callers should prefer the materializing cdist+top_k path beyond
+        that (see ``KNeighborsClassifier.predict``'s gate).
 
     Returns
     -------

@@ -52,8 +52,16 @@ def _contract_safe(x: DNDarray, jt, contract_dim: int):
 
 def _matmul_gshape(sa: Tuple[int, ...], sb: Tuple[int, ...]) -> Tuple[int, ...]:
     """Logical matmul result shape from logical operand shapes (numpy's
-    matmul shape semantics, including 1-D promotion and batch broadcast)."""
-    return tuple((np.empty(sa, dtype=np.int8) @ np.empty(sb, dtype=np.int8)).shape)
+    matmul shape semantics, including 1-D promotion and batch broadcast),
+    derived analytically — no host arrays are materialized."""
+    a1, b1 = len(sa) == 1, len(sb) == 1
+    ea = (1,) + tuple(sa) if a1 else tuple(sa)
+    eb = tuple(sb) + (1,) if b1 else tuple(sb)
+    if ea[-1] != eb[-2]:
+        raise ValueError(f"matmul: contraction mismatch {sa} x {sb}")
+    batch = np.broadcast_shapes(ea[:-2], eb[:-2])
+    core = () if a1 and b1 else (eb[-1],) if a1 else (ea[-2],) if b1 else (ea[-2], eb[-1])
+    return tuple(batch) + core
 
 
 def _wrap_result(result, out_gshape, split, dtype, device, comm) -> DNDarray:
@@ -97,6 +105,28 @@ def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False) -> DNDarray:
     jt = promoted.jax_type()
     buf_a = _contract_safe(a, jt, a.ndim - 1 if a.ndim > 1 else 0)
     buf_b = _contract_safe(b, jt, b.ndim - 2 if b.ndim > 1 else 0)
+
+    # padding on a BATCH dim breaks jnp.matmul's broadcast semantics (a
+    # size-1 batch dim padded to P no longer broadcasts; unequal padded
+    # extents fail outright). It is only safe when both operands carry the
+    # identical batch layout; otherwise drop to the logical view.
+    def _batch_padded(x):
+        return x.padded and x.split is not None and x.ndim > 2 and x.split < x.ndim - 2
+
+    pa, pb = _batch_padded(a), _batch_padded(b)
+    if pa or pb:
+        identical = (
+            pa
+            and pb
+            and a.ndim == b.ndim
+            and a.split == b.split
+            and a.gshape[a.split] == b.gshape[b.split]
+        )
+        if not identical:
+            if pa:
+                buf_a = a._logical().astype(jt)
+            if pb:
+                buf_b = b._logical().astype(jt)
     # align (possibly padded) contraction extents with zero fill
     ka = buf_a.shape[-1] if a.ndim > 1 else buf_a.shape[0]
     kb = buf_b.shape[-2] if b.ndim > 1 else buf_b.shape[0]

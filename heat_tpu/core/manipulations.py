@@ -142,13 +142,21 @@ def diag(a: DNDarray, offset: int = 0) -> DNDarray:
 
 
 def diagonal(a: DNDarray, offset: int = 0, dim1: int = 0, dim2: int = 1) -> DNDarray:
-    """Diagonal view (reference ``manipulations.py``)."""
+    """Diagonal view, split-rule parity with reference ``manipulations.py:641-650``:
+    the split axis survives with its position shifted past the removed dims;
+    if the split axis *is* one of the diagonal dims the result is split along
+    the new last axis (the diagonal itself)."""
+    dim1, dim2 = sanitize_axis(a.shape, dim1), sanitize_axis(a.shape, dim2)
+    if dim1 == dim2:
+        raise ValueError("dim1 and dim2 need to be different")
     result = jnp.diagonal(a._logical(), offset=offset, axis1=dim1, axis2=dim2)
-    split = None if a.split in (dim1, dim2) else a.split
-    if split is not None:
-        removed = sum(1 for d in (dim1, dim2) if d < split)
-        split = split - removed
-    return _wrap(result, a, 0 if a.split in (dim1, dim2) and a.split is not None else split)
+    if a.split is None:
+        split = None
+    elif a.split in (dim1, dim2):
+        split = result.ndim - 1
+    else:
+        split = a.split - sum(1 for d in (dim1, dim2) if d < a.split)
+    return _wrap(result, a, split)
 
 
 def dsplit(x: DNDarray, indices_or_sections) -> List[DNDarray]:

@@ -95,15 +95,27 @@ def _finalize(data: jax.Array, dtype, split, device, comm) -> DNDarray:
 
 
 def _sharded_fill(gen, key, shape, dtype, split, device, comm) -> DNDarray:
-    """Generate directly at the *padded* physical shape, born in its final
-    even sharding. With ``jax_threefry_partitionable`` each element's value
-    depends only on its (row-major) position, so the valid region is
-    bit-identical to an unpadded/unsplit generation — the reference's
-    split-invariant-stream guarantee (``random.py:55-201``) extends to the
-    padding for free."""
+    """Generate at the LOGICAL shape and zero-pad to the physical buffer,
+    all inside one jitted program born in its final even sharding.
+
+    With ``jax_threefry_partitionable`` an element's value depends on its
+    index *within the generated shape*, so generation must happen at the
+    logical extent: generating at the padded shape would shift the
+    row-major counters whenever a non-leading dim is padded and break the
+    reference's split-invariant-stream guarantee (``random.py:55-201``).
+    GSPMD partitions the generation itself, so each device still produces
+    only (about) its own region; the pad is deterministic zeros, masked at
+    every consumption point like any other buffer padding."""
     pshape = comm.padded_shape(shape, split)
     sharding = comm.array_sharding(pshape, split)
-    data = jax.jit(lambda k: gen(k, pshape), out_shardings=sharding)(key)
+
+    def fill(k):
+        x = gen(k, tuple(shape))
+        if tuple(pshape) != tuple(shape):
+            x = jnp.pad(x, [(0, p - s) for p, s in zip(pshape, shape)])
+        return x
+
+    data = jax.jit(fill, out_shardings=sharding)(key)
     return DNDarray._from_buffer(
         data, shape, dtype, split, devices.sanitize_device(device), comm
     )

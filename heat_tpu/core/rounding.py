@@ -6,7 +6,7 @@ from typing import Optional
 import jax.numpy as jnp
 
 from . import types
-from ._operations import _local_op
+from ._operations import _aligned_operand_buffer, _local_op
 from .dndarray import DNDarray
 
 __all__ = [
@@ -72,10 +72,14 @@ def clip(x, min=None, max=None, out=None, *, a_min=None, a_max=None) -> DNDarray
     hi = a_max if a_max is not None else max
     if lo is None and hi is None:
         raise ValueError("either min or max must be set")
+    # DNDarray bounds must be aligned to x's (possibly padded) buffer the
+    # same way _binary_op aligns operands: a bare pshape match can be a
+    # coincidence of different logical layouts, and a logical view cannot
+    # broadcast against a padded buffer
     if isinstance(lo, DNDarray):
-        lo = lo.larray if lo.pshape == x.pshape else lo._logical()
+        lo = _aligned_operand_buffer(lo, lo.dtype.jax_type(), x.gshape, x.split, x.pshape)
     if isinstance(hi, DNDarray):
-        hi = hi.larray if hi.pshape == x.pshape else hi._logical()
+        hi = _aligned_operand_buffer(hi, hi.dtype.jax_type(), x.gshape, x.split, x.pshape)
     return _local_op(lambda t: jnp.clip(t, lo, hi), x, out=out, no_cast=True)
 
 

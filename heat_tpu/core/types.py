@@ -432,36 +432,16 @@ def can_cast(from_, to, casting="intuitive") -> builtins.bool:
     """Whether a cast is allowed under the given rule (reference
     ``types.py:671``): no/safe/same_kind/unsafe plus the reference's
     ``intuitive`` (= safe + same-width int->float, e.g. int32->float32).
-    Python scalars are value-checked, as in the reference."""
+    Python scalars resolve to their heat type (``heat_type_of``) and consult
+    the cast table — type-based, exactly like the reference implementation
+    (``types.py:729-734``); e.g. ``can_cast(5, uint8)`` is False because
+    int32 -> uint8 is not a safe cast, regardless of the value."""
     _init_promotion_tables()
     to_t = canonical_heat_type(to)
-    if isinstance(from_, (builtins.bool, builtins.int, builtins.float)) and not isinstance(
-        from_, np.generic
-    ):
-        if casting == "unsafe":
-            return True
-        if casting == "no":
-            return False  # a scalar has no type identical to the target
-        to_np = np.dtype(to_t._jax_type)
-        try:
-            if to_t is bool:
-                # only 0/1 are value-preserved in bool
-                return from_ in (0, 1, True, False)
-            if np.issubdtype(to_np, np.integer):
-                if isinstance(from_, builtins.float) and from_ != builtins.int(from_):
-                    return False
-                info = np.iinfo(to_np)
-                return info.min <= from_ <= info.max
-            if np.issubdtype(to_np, np.floating):
-                with np.errstate(over="ignore"):
-                    return builtins.bool(
-                        np.isfinite(to_np.type(from_))
-                    ) or not np.isfinite(from_)
-            return True
-        except (OverflowError, ValueError, FloatingPointError):
-            return False
-    if isinstance(from_, builtins.complex) and not isinstance(from_, np.generic):
-        return issubclass(to_t, complexfloating) or casting == "unsafe"
+    if isinstance(
+        from_, (builtins.bool, builtins.int, builtins.float, builtins.complex)
+    ) and not isinstance(from_, np.generic):
+        from_ = heat_type_of(from_)
 
     if hasattr(from_, "dtype") and not isinstance(from_, np.dtype):
         d = from_.dtype

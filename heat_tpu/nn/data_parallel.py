@@ -94,7 +94,7 @@ class DataParallel:
         """Initialize replicated parameters (deterministic seed on every
         process, like reference ``data_parallel.py:108``)."""
         if isinstance(sample_input, DNDarray):
-            sample_input = sample_input.larray
+            sample_input = sample_input._logical()
         key = jax.random.PRNGKey(self._seed)
         if hasattr(self.module, "init"):
             self.params = self.module.init(key, sample_input)
@@ -107,7 +107,9 @@ class DataParallel:
     # -- forward --------------------------------------------------------------
     def __call__(self, inputs):
         """Forward pass on (possibly sharded) inputs."""
-        data = inputs.larray if isinstance(inputs, DNDarray) else inputs
+        # _logical(): the padded buffer must never leak into user math —
+        # a pad row would otherwise enter the forward as a phantom sample
+        data = inputs._logical() if isinstance(inputs, DNDarray) else inputs
         if hasattr(self.module, "apply"):
             out = self.module.apply(self.params, data)
         else:
@@ -126,8 +128,8 @@ class DataParallel:
         DNDarrays; gradients come out replicated (XLA inserts the
         all-reduce, the analogue of the reference's Iallreduce hooks).
         """
-        xb = batch.larray if isinstance(batch, DNDarray) else batch
-        yb = labels.larray if isinstance(labels, DNDarray) else labels
+        xb = batch._logical() if isinstance(batch, DNDarray) else batch
+        yb = labels._logical() if isinstance(labels, DNDarray) else labels
 
         def objective(params):
             if hasattr(self.module, "apply"):
@@ -169,8 +171,8 @@ class DataParallel:
         key = id(loss_fn)
         if key not in self._jitted_steps:
             self._jitted_steps[key] = self._build_step(loss_fn)
-        xb = batch.larray if isinstance(batch, DNDarray) else batch
-        yb = labels.larray if isinstance(labels, DNDarray) else labels
+        xb = batch._logical() if isinstance(batch, DNDarray) else batch
+        yb = labels._logical() if isinstance(labels, DNDarray) else labels
         self.params, self._opt_state, loss = self._jitted_steps[key](
             self.params, self._opt_state, xb, yb
         )

@@ -48,6 +48,22 @@ class TestSignatureParity(TestCase):
         np.testing.assert_allclose(ht.clip(a, min=0.0).numpy(), np.clip(self.x, 0.0, None))
         np.testing.assert_allclose(ht.clip(a, a_min=-1.0, a_max=1.0).numpy(), np.clip(self.x, -1, 1))
 
+    def test_clip_dndarray_bounds_padded(self):
+        """DNDarray bounds must align to x's padded buffer (regression:
+        replicated/differently-split bounds vs a padded x crashed or read
+        pad garbage)."""
+        n = ht.get_comm().size + 1  # non-divisible split dim => padded buffer
+        x = np.arange(n * 4, dtype=np.float32).reshape(n, 4)
+        a = ht.array(x, split=0)
+        lo = ht.array(np.full((n, 4), 5.0, dtype=np.float32))  # replicated
+        hi = ht.array(np.full((n, 4), 20.0, dtype=np.float32), split=1)
+        np.testing.assert_allclose(
+            ht.clip(a, min=lo, max=30.0).numpy(), np.clip(x, 5.0, 30.0)
+        )
+        np.testing.assert_allclose(
+            ht.clip(a, min=0.0, max=hi).numpy(), np.clip(x, 0.0, 20.0)
+        )
+
     def test_diff_prepend_append(self):
         a = ht.array(self.x, split=0)
         np.testing.assert_allclose(

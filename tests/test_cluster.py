@@ -123,6 +123,20 @@ class TestKMeans(TestCase):
         km.fit(ht.array(pts, split=0))
         assert km.n_iter_ <= 5  # should converge nearly immediately
 
+    def test_init_dndarray_split_padded(self):
+        """A split init whose buffer carries pad rows must not inject
+        phantom centroids (regression: init.larray leaked padding)."""
+        pts, true_centers = make_blobs(seed=8)
+        pts = pts[: len(pts) - 1]  # non-divisible sample count too
+        init = ht.array(true_centers, split=0)  # k=4 rows over P devices
+        km = ht.cluster.KMeans(n_clusters=4, init=init, max_iter=10)
+        km.fit(ht.array(pts, split=0))
+        assert km.cluster_centers_.shape == (4, pts.shape[1])
+        # every sample lands in a real cluster
+        labels = km.labels_.numpy()
+        assert labels.min() >= 0 and labels.max() < 4
+        assert len(np.unique(labels)) == 4
+
     def test_get_set_params(self):
         km = ht.cluster.KMeans(n_clusters=3)
         params = km.get_params()

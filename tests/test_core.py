@@ -195,6 +195,22 @@ class TestTypes(TestCase):
         assert ht.can_cast(ht.int32, ht.float64)
         assert ht.can_cast(ht.uint8, ht.int16, casting="safe")
 
+    def test_can_cast_scalars_type_based(self):
+        # reference resolves scalars via heat_type_of and consults the cast
+        # table (types.py:729-734): the VALUE never matters
+        assert not ht.can_cast(5, ht.uint8)  # int32 -> uint8 unsafe
+        assert ht.can_cast(1, ht.float64)
+        assert not ht.can_cast(2.0e200, "u1")
+        assert ht.can_cast(2 + 3j, ht.complex64)
+        assert not ht.can_cast(2 + 3j, ht.float64)
+        assert ht.can_cast(5, ht.uint8, casting="unsafe")
+        # reference docstring examples (types.py:705-722)
+        assert not ht.can_cast(ht.int16, ht.int8)
+        assert not ht.can_cast("i8", "i4", "no")
+        assert not ht.can_cast("i8", "i4", "safe")
+        assert ht.can_cast("i8", "i4", "same_kind")
+        assert ht.can_cast("i8", "i4", "unsafe")
+
 
 class TestPrinting(TestCase):
     def test_repr(self):

@@ -32,6 +32,27 @@ class TestMatmul(TestCase):
         c = ht.array(self.a, split=0) @ ht.array(self.b)
         self.assert_array_equal(c, self.a @ self.b, rtol=1e-4, atol=1e-4)
 
+    def test_matmul_shape_semantics(self):
+        """Analytic result-shape derivation: 1-D promotion, batch
+        broadcast, and contraction-mismatch errors (numpy matmul rules)."""
+        import pytest
+
+        for sa, sb in [
+            ((3, 4), (4, 5)),
+            ((4,), (4, 5)),
+            ((3, 4), (4,)),
+            ((4,), (4,)),
+            ((2, 3, 4), (2, 4, 5)),
+            ((1, 3, 4), (7, 4, 5)),
+            ((6, 1, 3, 4), (2, 4, 2)),
+        ]:
+            a, b = np.ones(sa, np.float32), np.ones(sb, np.float32)
+            got = ht.matmul(ht.array(a, split=0), ht.array(b))
+            assert got.shape == (a @ b).shape, (sa, sb)
+            self.assert_array_equal(got, a @ b, rtol=1e-5)
+        with pytest.raises(ValueError):
+            ht.matmul(ht.zeros((3, 4)), ht.zeros((5, 6)))
+
     def test_dot_vectors(self):
         v = np.arange(16, dtype=np.float32)
         w = np.arange(16, dtype=np.float32)[::-1].copy()

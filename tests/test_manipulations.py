@@ -127,6 +127,31 @@ class TestManipulations(TestCase):
         x = np.arange(16, dtype=np.float32).reshape(4, 4)
         self.assert_array_equal(ht.diag(ht.array(x, split=0)), np.diag(x))
 
+    def test_diagonal_split_rules(self):
+        # reference split rules (manipulations.py:641-650): split below both
+        # dims survives; between -> -1; above both -> -2; split IS a
+        # diagonal dim -> result split along the new last axis
+        x = np.arange(120, dtype=np.float32).reshape(2, 3, 4, 5)
+        for split, dim1, dim2, want in [
+            (0, 2, 3, 0),   # split < both dims: unchanged
+            (2, 0, 3, 1),   # between: shifted by one
+            (3, 0, 1, 1),   # above both: shifted by two
+            (0, 0, 1, 2),   # split is dim1: last axis
+            (1, 0, 1, 2),   # split is dim2: last axis
+        ]:
+            a = ht.array(x, split=split)
+            d = ht.diagonal(a, dim1=dim1, dim2=dim2)
+            assert d.split == want, (split, dim1, dim2, d.split)
+            self.assert_array_equal(d, np.diagonal(x, axis1=dim1, axis2=dim2))
+        with pytest.raises(ValueError):
+            ht.diagonal(ht.array(x), dim1=1, dim2=1)
+        # offsets
+        y = np.arange(20, dtype=np.float32).reshape(4, 5)
+        for off in (-2, -1, 0, 1, 3):
+            self.assert_array_equal(
+                ht.diagonal(ht.array(y, split=0), offset=off), np.diagonal(y, offset=off)
+            )
+
     def test_broadcast_to(self):
         v = np.arange(4, dtype=np.float32)
         self.assert_array_equal(ht.broadcast_to(ht.array(v), (3, 4)), np.broadcast_to(v, (3, 4)))

@@ -40,6 +40,43 @@ class TestDataParallel(TestCase):
         losses = [dp.train_step(mse, xb, yb) for _ in range(50)]
         assert losses[-1] < losses[0] * 0.1
 
+    def test_non_divisible_batch_excludes_padding(self):
+        """A (9, f) batch on an 8-device mesh carries a pad row in its
+        buffer; forward shape and loss must reflect only the 9 logical
+        samples (regression: padded buffers leaking into user math)."""
+        import flax.linen as fnn
+        import jax.numpy as jnp
+        import optax
+
+        rng = np.random.default_rng(3)
+        n = ht.get_comm().size + 1  # never divisible by the world size > 1
+        X = rng.normal(size=(n, 4)).astype(np.float32)
+        y = np.ones((n, 1), dtype=np.float32)
+
+        class Model(fnn.Module):
+            @fnn.compact
+            def __call__(self, x):
+                return fnn.Dense(1)(x)
+
+        dp = ht.nn.DataParallel(Model(), optimizer=optax.sgd(0.0))
+        xb = ht.array(X, split=0)
+        dp.init(X[:1])
+        out = dp(xb)
+        assert out.shape[0] == n
+        np.testing.assert_allclose(
+            out.numpy(), dp.module.apply(dp.params, X), rtol=1e-6
+        )
+
+        def mse(pred, target):
+            return jnp.mean((pred - target) ** 2)
+
+        loss, _ = dp.loss_and_grad(mse, xb, ht.array(y, split=0))
+        ref_loss = float(np.mean((dp.module.apply(dp.params, X) - y) ** 2))
+        assert abs(float(loss) - ref_loss) < 1e-6
+        # jitted step path sees the same logical batch
+        step_loss = dp.train_step(mse, xb, ht.array(y, split=0))
+        assert abs(step_loss - ref_loss) < 1e-6
+
     def test_forward_keeps_split(self):
         import flax.linen as fnn
         import optax

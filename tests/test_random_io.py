@@ -21,13 +21,21 @@ class TestRandom(TestCase):
     def test_split_invariant_stream(self):
         """The reference's core guarantee (``random.py:55-201``): same
         global stream for every split."""
-        for shape in [(16,), (8, 8), (13,)]:
+        # (9, 5)/(3, 7, 5): non-divisible on EVERY axis — regression for
+        # padded-shape generation shifting the threefry counters when a
+        # non-leading dim was padded
+        for shape in [(16,), (8, 8), (13,), (9, 5), (3, 7, 5)]:
             ht.random.seed(7)
             ref = ht.random.rand(*shape, split=None).numpy()
             for split in range(len(shape)):
                 ht.random.seed(7)
                 got = ht.random.rand(*shape, split=split).numpy()
                 np.testing.assert_array_equal(ref, got)
+            ht.random.seed(11)
+            iref = ht.random.randint(0, 100, size=shape, split=None).numpy()
+            ht.random.seed(11)
+            igot = ht.random.randint(0, 100, size=shape, split=len(shape) - 1).numpy()
+            np.testing.assert_array_equal(iref, igot)
 
     def test_state_roundtrip(self):
         ht.random.seed(1)
