@@ -103,6 +103,11 @@ def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False) -> DNDarray:
         raise TypeError("both operands must be DNDarrays")
     promoted = types.promote_types(a.dtype, b.dtype)
     jt = promoted.jax_type()
+    if a.ndim == 0 or b.ndim == 0:
+        raise ValueError("matmul: operands must have ndim >= 1")
+    # validate logical shapes up front: the padded-buffer zero-fill below
+    # must never paper over a genuine contraction mismatch
+    out_gshape = _matmul_gshape(a.gshape, b.gshape)
     buf_a = _contract_safe(a, jt, a.ndim - 1 if a.ndim > 1 else 0)
     buf_b = _contract_safe(b, jt, b.ndim - 2 if b.ndim > 1 else 0)
 
@@ -144,7 +149,6 @@ def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False) -> DNDarray:
     if result.ndim == 0:
         return DNDarray(result, dtype=promoted, split=None, device=a.device, comm=a.comm)
     split = _matmul_out_split(a, b, result.ndim)
-    out_gshape = _matmul_gshape(a.gshape, b.gshape)
     return _wrap_result(result, out_gshape, split, promoted, a.device, a.comm)
 
 

@@ -327,14 +327,32 @@ def shape(a: DNDarray) -> Tuple[int, ...]:
 
 def sort(a: DNDarray, axis: int = -1, descending: bool = False, out=None):
     """Sort along an axis (reference ``manipulations.py:2267`` implements a
-    parallel sample-sort with Alltoallv bucket exchange; ``jnp.sort`` over a
-    sharded axis compiles to the equivalent distributed sort)."""
+    parallel sample-sort with Alltoallv bucket exchange).
+
+    When the sort axis IS the split axis, a true distributed sort runs:
+    block odd-even transposition over ``ppermute`` with O(n/P) memory per
+    device (see :mod:`heat_tpu.parallel.dsort` — ``jnp.sort`` on a sharded
+    axis would all-gather instead). Any other axis is embarrassingly
+    parallel and sorts shard-locally."""
     axis = sanitize_axis(a.shape, axis)
-    arr = a._logical()
-    indices = jnp.argsort(arr, axis=axis, descending=descending, stable=True)
-    values = jnp.take_along_axis(arr, indices, axis=axis)
-    res_v = _wrap(values, a, a.split)
-    res_i = DNDarray(indices.astype(jnp.int64), dtype=types.int64, split=a.split, device=a.device, comm=a.comm)
+    if (
+        a.split == axis
+        and a.comm.size > 1
+        and not types.issubdtype(a.dtype, types.complexfloating)
+    ):
+        from ..parallel.dsort import distributed_sort
+
+        vals, idxs = distributed_sort(a.larray, a.gshape, axis, a.comm, descending)
+        res_v = DNDarray._from_buffer(vals, a.gshape, a.dtype, a.split, a.device, a.comm)
+        res_i = DNDarray._from_buffer(
+            idxs.astype(jnp.int64), a.gshape, types.int64, a.split, a.device, a.comm
+        )
+    else:
+        arr = a._logical()
+        indices = jnp.argsort(arr, axis=axis, descending=descending, stable=True)
+        values = jnp.take_along_axis(arr, indices, axis=axis)
+        res_v = _wrap(values, a, a.split)
+        res_i = DNDarray(indices.astype(jnp.int64), dtype=types.int64, split=a.split, device=a.device, comm=a.comm)
     if out is not None:
         from ._operations import _write_out
 

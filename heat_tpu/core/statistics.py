@@ -277,9 +277,14 @@ def nanmean(x: DNDarray, axis=None, out=None, keepdim=None, keepdims=None) -> DN
 
 
 def median(x: DNDarray, axis=None, keepdim: bool = False, keepdims=None) -> DNDarray:
-    """Median (reference ``statistics.py:1017``, gather-based)."""
+    """Median (reference ``statistics.py:1017``, gather-based; when the
+    reduced axis is the split axis the distributed-sort percentile path
+    runs instead — O(n/P) memory, see :func:`percentile`)."""
     kd = bool(keepdim or keepdims)
     axis_s = sanitize_axis(x.shape, axis)
+    if _use_sorted_percentile(x, axis_s):
+        result = _sorted_percentile(x, jnp.asarray(50.0), axis_s, "linear", kd)
+        return DNDarray(result, dtype=types.canonical_heat_type(result.dtype), split=None, device=x.device, comm=x.comm)
     result = jnp.median(x._logical(), axis=axis_s, keepdims=kd)
     split = _reduced_split(x.split, axis_s, x.ndim, kd)
     return DNDarray(result, dtype=types.canonical_heat_type(result.dtype), split=split, device=x.device, comm=x.comm)
@@ -294,14 +299,93 @@ def minimum(x1, x2, out=None) -> DNDarray:
     return _binary_op(jnp.minimum, x1, x2, out=out)
 
 
+def _use_sorted_percentile(x: DNDarray, axis_s) -> bool:
+    """True when the reduction runs along the split axis of a distributed,
+    sortable array — the case where ``jnp.percentile`` on the logical view
+    would all-gather O(n) to every device."""
+    return (
+        x.split is not None
+        and x.comm.size > 1
+        and not types.issubdtype(x.dtype, types.complexfloating)
+        and (axis_s is None or axis_s == x.split)
+    )
+
+
+def _sorted_percentile(x: DNDarray, q_arr: jnp.ndarray, axis_s, method: str, kd: bool) -> jnp.ndarray:
+    """Percentile via sort + O(q) takes, with numpy's exact semantics
+    (q-dims first, float32/float64 compute, NaN propagates to every q,
+    round-half-even tie-breaking for ``nearest``). The sort is the
+    distributed transposition sort when the reduced axis is the split
+    axis of a multi-device array, a local ``jnp.sort`` otherwise — one
+    interpolation code path either way (``jnp.percentile``'s own
+    ``nearest`` rounds ties differently from numpy, so it is not used)."""
+    from . import manipulations as manip
+
+    if axis_s is None and x.ndim > 1:
+        xs, ax = manip.flatten(x), 0
+    else:
+        xs, ax = x, (0 if axis_s is None else axis_s)
+    if xs.split == ax and xs.comm.size > 1:
+        sv, _ = manip.sort(xs, axis=ax)
+        arr = sv._logical()
+    else:
+        arr = jnp.sort(xs._logical(), axis=ax)
+    n = arr.shape[ax]
+    ct = jnp.float64 if arr.dtype == jnp.float64 else jnp.float32
+    q = q_arr.astype(ct)
+    pos = q / 100.0 * (n - 1)
+    lo_i = jnp.clip(jnp.floor(pos).astype(jnp.int64), 0, n - 1)
+    hi_i = jnp.clip(jnp.ceil(pos).astype(jnp.int64), 0, n - 1)
+    take = lambda i: jnp.take(arr, i, axis=ax).astype(ct)
+    if method == "lower":
+        res = take(lo_i)
+    elif method == "higher":
+        res = take(hi_i)
+    elif method == "nearest":
+        res = take(jnp.clip(jnp.round(pos).astype(jnp.int64), 0, n - 1))
+    else:
+        vlo, vhi = take(lo_i), take(hi_i)
+        if method == "midpoint":
+            res = (vlo + vhi) / 2
+        else:  # linear
+            w = pos - jnp.floor(pos)
+            w = w.reshape((1,) * ax + q.shape + (1,) * (arr.ndim - 1 - ax))
+            res = vlo + w * (vhi - vlo)
+    # numpy layout: q-dims lead the reduced shape
+    qn = q.ndim
+    if qn and ax:
+        perm = list(range(ax, ax + qn)) + list(range(ax)) + list(range(ax + qn, res.ndim))
+        res = jnp.transpose(res, perm)
+    # NaN propagates to every q (numpy partition semantics)
+    if jnp.issubdtype(arr.dtype, jnp.floating):
+        anynan = jnp.any(jnp.isnan(arr), axis=ax)  # psum'd over the split axis
+        res = jnp.where(anynan.reshape((1,) * qn + anynan.shape), jnp.asarray(jnp.nan, ct), res)
+    if kd:
+        restore = (x.ndim * (1,)) if axis_s is None else None
+        if restore is not None:
+            res = res.reshape(tuple(q.shape) + restore)
+        else:
+            res = jnp.expand_dims(res, qn + ax)
+    return res
+
+
 def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear", keepdim: bool = False, keepdims=None) -> DNDarray:
-    """q-th percentile (reference ``statistics.py:1406``, gather-based;
-    global jnp.percentile here — XLA handles the sharded sort)."""
+    """q-th percentile (reference ``statistics.py:1406``, gather-based).
+
+    When the reduced axis is the split axis, the computation routes
+    through the distributed transposition sort + O(q) element takes
+    (:mod:`heat_tpu.parallel.dsort`) instead of ``jnp.percentile`` on the
+    logical view, which would all-gather the full array to every device."""
     kd = bool(keepdim or keepdims)
     axis_s = sanitize_axis(x.shape, axis)
     q_arr = q._logical() if isinstance(q, DNDarray) else jnp.asarray(q)
     method = {"lower": "lower", "higher": "higher", "midpoint": "midpoint", "nearest": "nearest", "linear": "linear"}[interpolation]
-    result = jnp.percentile(x._logical().astype(jnp.float64 if x.larray.dtype == jnp.float64 else jnp.float32), q_arr, axis=axis_s, method=method, keepdims=kd)
+    if (axis_s is None or isinstance(axis_s, int)) and not types.issubdtype(
+        x.dtype, types.complexfloating
+    ):
+        result = _sorted_percentile(x, q_arr, axis_s, method, kd)
+    else:  # tuple axis: jnp fallback (gather semantics, like the reference)
+        result = jnp.percentile(x._logical().astype(jnp.float64 if x.larray.dtype == jnp.float64 else jnp.float32), q_arr, axis=axis_s, method=method, keepdims=kd)
     res = DNDarray(result, dtype=types.canonical_heat_type(result.dtype), split=None, device=x.device, comm=x.comm)
     if out is not None:
         from ._operations import _write_out

@@ -1,0 +1,134 @@
+"""Distributed sort (block odd-even transposition over ppermute).
+
+The reference's analogue is the Alltoallv sample-sort
+(``heat/core/manipulations.py:2267-2430``), tested by comparing against
+single-process numpy at several world sizes. Same oracle here, plus an
+HLO assertion that the kernel really is distributed: no all-gather, only
+neighbor collective-permutes, O(n/P) intermediates.
+"""
+from functools import partial
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from tests.base import TestCase
+
+
+class TestDistributedSort(TestCase):
+    def _oracle(self, x, axis, descending):
+        import jax.numpy as jnp
+
+        i = np.asarray(jnp.argsort(x, axis=axis, descending=descending, stable=True))
+        return np.take_along_axis(x, i, axis=axis), i
+
+    def test_split_axis_sort_oracle(self):
+        rng = np.random.default_rng(0)
+        for shape, axis in [((64,), 0), ((37,), 0), ((9,), 0), ((40, 7), 0), ((7, 41), 1), ((5, 9, 4), 1)]:
+            x = rng.normal(size=shape).astype(np.float32)
+            x.ravel()[::5] = 1.5  # duplicates exercise the stability keys
+            for descending in (False, True):
+                v, i = ht.sort(ht.array(x, split=axis), axis=axis, descending=descending)
+                assert v.split == axis and i.split == axis
+                ev, ei = self._oracle(x, axis, descending)
+                np.testing.assert_array_equal(v.numpy(), ev, err_msg=f"{shape} d={descending}")
+                np.testing.assert_array_equal(i.numpy(), ei, err_msg=f"{shape} d={descending}")
+
+    def test_nan_inf_extremes(self):
+        x = np.array([3.0, np.nan, -np.inf, 1.0, np.inf, np.nan, -1.0, 0.0, 2.0], np.float32)
+        for descending in (False, True):
+            v, i = ht.sort(ht.array(x, split=0), descending=descending)
+            ev, ei = self._oracle(x, 0, descending)
+            np.testing.assert_array_equal(v.numpy(), ev)
+            np.testing.assert_array_equal(i.numpy(), ei)
+
+    def test_int_bool_dtypes(self):
+        rng = np.random.default_rng(2)
+        xi = rng.integers(-50, 50, size=43).astype(np.int64)
+        xb = rng.integers(0, 2, size=19).astype(bool)
+        for x in (xi, xb):
+            for descending in (False, True):
+                v, i = ht.sort(ht.array(x, split=0), descending=descending)
+                ev, ei = self._oracle(x, 0, descending)
+                np.testing.assert_array_equal(v.numpy(), ev)
+                np.testing.assert_array_equal(i.numpy(), ei)
+
+    def test_sort_out_param(self):
+        x = np.random.default_rng(3).normal(size=24).astype(np.float32)
+        a = ht.array(x, split=0)
+        out = ht.zeros(24, split=0)
+        res, idx = ht.sort(a, out=out)
+        assert res is out
+        np.testing.assert_array_equal(out.numpy(), np.sort(x))
+
+    def test_non_split_axis_stays_local(self):
+        x = np.random.default_rng(4).normal(size=(16, 6)).astype(np.float32)
+        v, i = ht.sort(ht.array(x, split=0), axis=1)
+        np.testing.assert_array_equal(v.numpy(), np.sort(x, axis=1))
+
+    def test_hlo_is_distributed(self):
+        """The compiled kernel must contain NO all-gather, only
+        collective-permutes, and no full-length per-device intermediate
+        (``jnp.sort`` on a sharded axis all-gathers; VERDICT item 3)."""
+        import re
+
+        import jax
+        import jax.numpy as jnp
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from heat_tpu.core.communication import SPLIT_AXIS
+        from heat_tpu.parallel.dsort import _transposition_kernel
+
+        comm = ht.get_comm()
+        p = comm.size
+        if p == 1:
+            pytest.skip("needs a multi-device mesh")
+        n = 128 * p
+        x = ht.array(np.arange(n, dtype=np.float32)[::-1].copy(), split=0)
+        kernel = partial(
+            _transposition_kernel,
+            axis=0, axis_name=SPLIT_AXIS, p=p, c=n // p, n=n,
+            descending=False, idx_t=jnp.int64,
+        )
+        prog = jax.jit(
+            shard_map(kernel, mesh=comm.mesh, in_specs=P(SPLIT_AXIS), out_specs=(P(SPLIT_AXIS), P(SPLIT_AXIS)))
+        )
+        hlo = prog.lower(x.larray).compile().as_text()
+        assert hlo.count("all-gather") == 0
+        assert hlo.count("collective-permute") > 0
+        sizes = [int(s) for s in re.findall(r"f32\[(\d+)\]", hlo)]
+        assert max(sizes) <= 2 * (n // p)
+
+    def test_percentile_median_distributed_route(self):
+        rng = np.random.default_rng(5)
+        for shape, axis in [((101,), 0), ((9, 40), 1), ((40, 9), 0), ((6, 10), None)]:
+            x = rng.normal(size=shape).astype(np.float32)
+            split = axis if axis not in (None,) else 0
+            a = ht.array(x, split=split)
+            for q in (30.0, [10.0, 50.0, 90.0]):
+                for method in ("linear", "lower", "higher", "midpoint", "nearest"):
+                    got = ht.percentile(a, q, axis=axis, interpolation=method).numpy()
+                    want = np.percentile(x, q, axis=axis, method=method).astype(np.float32)
+                    np.testing.assert_allclose(got, want, rtol=2e-6, atol=1e-6)
+            np.testing.assert_allclose(
+                ht.median(a, axis=axis).numpy(), np.median(x, axis=axis), rtol=2e-6, atol=1e-6
+            )
+        # keepdims layouts
+        x = rng.normal(size=(9, 40)).astype(np.float32)
+        a = ht.array(x, split=1)
+        got = ht.percentile(a, [25.0, 75.0], axis=1, keepdim=True).numpy()
+        np.testing.assert_allclose(
+            got, np.percentile(x, [25.0, 75.0], axis=1, keepdims=True), rtol=2e-6, atol=1e-6
+        )
+
+    def test_percentile_nan_propagates(self):
+        x = np.random.default_rng(6).normal(size=33).astype(np.float32)
+        x[5] = np.nan
+        got = ht.percentile(ht.array(x, split=0), 25.0).numpy()
+        assert np.isnan(got)
+
+    def test_percentile_float64(self):
+        x = np.random.default_rng(7).normal(size=41)
+        got = ht.percentile(ht.array(x, split=0), 37.5).numpy()
+        np.testing.assert_allclose(got, np.percentile(x, 37.5), rtol=1e-12)
