@@ -358,3 +358,102 @@ def comm_context(comm: MeshCommunication):
 # maps onto the mesh-collective backend here; there is no CUDA staging on TPU.
 MPICommunication = MeshCommunication
 CUDA_AWARE_MPI = False
+
+
+def _assemble_from_chunks(read_chunk, gshape, split, comm, np_dtype):
+    """Build the padded global buffer from per-device chunk reads.
+
+    ``read_chunk(slices) -> np.ndarray`` returns the data for one device's
+    valid chunk, addressed in GLOBAL coordinates (``comm.chunk`` layout).
+    Each process materializes only its addressable devices' blocks,
+    zero-padded to the even block size; the global ``jax.Array`` is
+    stitched with ``make_array_from_single_device_arrays`` — the analogue
+    of the reference's per-rank parallel reads (``io.py:57-147``). No
+    device and no host ever holds the full array.
+    """
+    pshape = comm.padded_shape(gshape, split)
+    sharding = comm.array_sharding(pshape, split)
+    block_shape = list(pshape)
+    block_shape[split] = pshape[split] // comm.size
+    pid = jax.process_index()
+    arrays = []
+    for rank, dev in enumerate(comm.mesh.devices.ravel()):
+        if dev.process_index != pid:
+            continue
+        _, lshape, slices = comm.chunk(gshape, split, rank=rank)
+        buf = np.zeros(tuple(block_shape), dtype=np_dtype)
+        if all(s > 0 for s in lshape):
+            buf[tuple(slice(0, s) for s in lshape)] = read_chunk(slices)
+        arrays.append(jax.device_put(buf, dev))
+    return jax.make_array_from_single_device_arrays(pshape, sharding, arrays)
+
+
+def assemble_local_shards(local: np.ndarray, split: int, comm: MeshCommunication):
+    """Infer the global shape from per-process ``is_split`` shards and build
+    the padded global buffer (reference ``factories.py:383-426``: neighbor
+    Isend/Probe/Recv shape exchange + Allreduce consistency checks).
+
+    Returns ``(buffer, gshape)``. Non-split dims must agree across
+    processes; the split dim is the sum of the local extents. When every
+    process holds the same extent and it divides evenly over the local
+    devices, blocks align with process boundaries and assembly is
+    local-only; otherwise the shards are allgathered once (O(n) host
+    memory — the uneven path, like the reference's staged Recv).
+    """
+    from jax.experimental import multihost_utils
+
+    nproc = jax.process_count()
+    pid = jax.process_index()
+    shapes = multihost_utils.process_allgather(np.asarray(local.shape, dtype=np.int64))
+    shapes = np.asarray(shapes).reshape(nproc, local.ndim)
+    for d in range(local.ndim):
+        if d != split and len(set(int(s) for s in shapes[:, d])) != 1:
+            raise ValueError(
+                f"local shards disagree on non-split dim {d}: {sorted(set(int(s) for s in shapes[:, d]))}"
+            )
+    sizes = [int(s) for s in shapes[:, split]]
+    gshape = list(local.shape)
+    gshape[split] = sum(sizes)
+    gshape = tuple(gshape)
+
+    dpp = jax.local_device_count()
+    block = comm.padded_shape(gshape, split)[split] // comm.size
+    # is_split semantics: the global array is the pid-ordered concatenation
+    # of the local shards. The local-only fast path requires every one of
+    # THIS process's device blocks (rank r covers global rows
+    # [r*block, (r+1)*block)) to fall inside this process's own rows —
+    # true for equal, locally-divisible extents on a process-major mesh,
+    # checked explicitly so permuted meshes fall back to the allgather.
+    my_ranks = [
+        r for r, d in enumerate(comm.mesh.devices.ravel()) if d.process_index == pid
+    ]
+    aligned = (
+        len(set(sizes)) == 1
+        and sizes[0] % dpp == 0
+        and sizes[0] // dpp == block
+        and all(r * block // sizes[0] == pid for r in my_ranks)
+    )
+    if aligned:
+        offset = pid * sizes[0]  # this process's rows in global coordinates
+
+        def read_chunk(slices):
+            local_slices = list(slices)
+            s = slices[split]
+            local_slices[split] = slice(s.start - offset, s.stop - offset)
+            return local[tuple(local_slices)]
+
+    else:
+        cap = max(sizes)
+        padded = np.zeros((cap,) + local.shape[:split] + local.shape[split + 1 :], local.dtype)
+        moved = np.moveaxis(local, split, 0)
+        padded[: moved.shape[0]] = moved
+        everything = multihost_utils.process_allgather(padded)  # (nproc, cap, ...)
+        everything = np.asarray(everything).reshape((nproc, cap) + padded.shape[1:])
+        full = np.concatenate([everything[p, : sizes[p]] for p in range(nproc)], axis=0)
+        full = np.moveaxis(full, 0, split)
+
+        def read_chunk(slices):
+            return full[slices]
+
+    buf = _assemble_from_chunks(read_chunk, gshape, split, comm, local.dtype)
+    return buf, gshape

@@ -5,7 +5,9 @@ Every factory builds the array *directly in its target sharding* via
 arrays never materialize on one device. The reference's ``is_split=``
 global-shape inference (neighbor Isend/Probe/Recv, ``factories.py:383-426``)
 is only meaningful multi-host; under multi-process JAX it maps onto
-``jax.make_array_from_process_local_data``.
+``communication.assemble_local_shards`` (allgathered shape inference +
+padded per-device assembly, with an allgather-of-data fallback for uneven
+local extents).
 """
 from __future__ import annotations
 
@@ -57,7 +59,7 @@ def array(
     ``is_split=k`` declares the input to be this *process's* local shard;
     with one controlling process the local data is the global data, and
     multi-host processes are assembled with
-    ``jax.make_array_from_process_local_data``.
+    ``communication.assemble_local_shards`` (uneven extents supported).
     """
     if split is not None and is_split is not None:
         raise ValueError(f"split and is_split are mutually exclusive, got {split}, {is_split}")
@@ -91,11 +93,13 @@ def array(
 
     if is_split is not None:
         is_split = sanitize_axis(data.shape, is_split)
-        if jax.process_count() > 1:  # pragma: no cover - multi-host only
-            sharding = comm.sharding(data.ndim, is_split)
-            gshape = list(data.shape)
-            gshape[is_split] = data.shape[is_split] * jax.process_count()
-            data = jax.make_array_from_process_local_data(sharding, np.asarray(data), tuple(gshape))
+        if jax.process_count() > 1:
+            from .communication import assemble_local_shards
+
+            buf, gshape = assemble_local_shards(np.asarray(data), is_split, comm)
+            if dtype is None:
+                dtype = types.canonical_heat_type(buf.dtype)
+            return DNDarray._from_buffer(buf, gshape, dtype, is_split, device, comm)
         split = is_split
 
     return DNDarray(data, dtype=dtype, split=split, device=device, comm=comm)

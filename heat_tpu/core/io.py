@@ -18,7 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import devices, types
-from .communication import sanitize_comm
+from .communication import _assemble_from_chunks, sanitize_comm
 from .dndarray import DNDarray
 
 try:
@@ -100,32 +100,75 @@ def load_hdf5(
     with h5py.File(path, "r") as handle:
         data = handle[dataset]
         gshape = tuple(data.shape)
-        if jax.process_count() > 1 and split is not None:  # pragma: no cover
-            _, _, slices = comm.chunk(gshape, split, rank=jax.process_index())
-            local = np.asarray(data[slices], dtype=np.dtype(dtype.jax_type()))
-            sharding = comm.sharding(len(gshape), split)
-            arrays = [
-                jax.device_put(local[_local_slice(comm, gshape, split, d, local)], d)
-                for d in sharding.addressable_devices
-            ]
-            garr = jax.make_array_from_single_device_arrays(gshape, sharding, arrays)
-            return DNDarray(garr, dtype=dtype, split=split, device=device, comm=comm)
+        if split is not None:
+            from .stride_tricks import sanitize_axis
+
+            split = sanitize_axis(gshape, split)
+        if split is not None and comm.size > 1:
+            # chunked path (reference io.py:57-147's per-rank slice reads):
+            # each PROCESS reads only its devices' slices from the file and
+            # the global padded buffer is assembled shard-by-shard — no
+            # device and no host ever holds the full array.
+            garr = _assemble_from_chunks(
+                lambda slices: np.asarray(data[slices], dtype=np.dtype(dtype.jax_type())),
+                gshape,
+                split,
+                comm,
+                np.dtype(dtype.jax_type()),
+            )
+            return DNDarray._from_buffer(
+                garr, gshape, dtype, split, devices.sanitize_device(device), comm
+            )
         arr = np.asarray(data[...], dtype=np.dtype(dtype.jax_type()))
     return DNDarray(jnp.asarray(arr), dtype=dtype, split=split, device=device, comm=comm)
 
 
-def _local_slice(comm, gshape, split, device, local):  # pragma: no cover - multi-host
-    return tuple(slice(None) for _ in gshape)
 
 
 def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs) -> None:
-    """Save to HDF5 (reference ``io.py:149``)."""
+    """Save to HDF5 (reference ``io.py:149``: parallel ``mpio`` driver or
+    rank-serialized writes; rank-serialized here — each process writes only
+    its local shards' regions, coordinated by a global barrier)."""
     if not __HAS_HDF5:
         raise ImportError("h5py is required for HDF5 support")
     if not isinstance(data, DNDarray):
         raise TypeError(f"data must be a DNDarray, not {type(data)}")
     if not isinstance(path, str):
         raise TypeError(f"path must be str, not {type(path)}")
+    nproc = jax.process_count()
+    comm_spans_processes = (
+        len({d.process_index for d in data.comm.mesh.devices.ravel()}) > 1
+    )
+    if nproc > 1 and data.split is not None and comm_spans_processes:
+        from jax.experimental import multihost_utils
+
+        pid = jax.process_index()
+        gshape = data.gshape
+        # each addressable shard's global placement comes straight from
+        # jax (shard.index on the padded buffer), clipped to the logical
+        # extent — no hand-rolled device->rank bookkeeping
+        local = []  # (clipped global slices, trimmed chunk)
+        for shard in data.larray.addressable_shards:
+            sl, trim = [], []
+            for d, s in enumerate(shard.index):
+                start = 0 if s.start is None else min(s.start, gshape[d])
+                stop = gshape[d] if s.stop is None else min(s.stop, gshape[d])
+                sl.append(slice(start, stop))
+                trim.append(slice(0, stop - start))
+            if all(s.stop > s.start for s in sl):
+                local.append((tuple(sl), np.asarray(shard.data)[tuple(trim)]))
+        for p in range(nproc):
+            if pid == p:
+                with h5py.File(path, mode if p == 0 else "a") as handle:
+                    if p == 0:
+                        handle.create_dataset(
+                            dataset, shape=gshape, dtype=np.dtype(data.dtype.jax_type()), **kwargs
+                        )
+                    dset = handle[dataset]
+                    for slices, chunk in local:
+                        dset[slices] = chunk
+            multihost_utils.sync_global_devices(f"heat_tpu_save_hdf5_{p}")
+        return
     arr = data.numpy()
     if jax.process_index() == 0:
         with h5py.File(path, mode) as handle:
