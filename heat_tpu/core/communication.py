@@ -377,15 +377,31 @@ def _assemble_from_chunks(read_chunk, gshape, split, comm, np_dtype):
     block_shape[split] = pshape[split] // comm.size
     pid = jax.process_index()
     arrays = []
-    for rank, dev in enumerate(comm.mesh.devices.ravel()):
+    blocks = {}  # split-rank -> host block, shared by replicated devices
+    for rank, dev in _split_ranks(comm):
         if dev.process_index != pid:
             continue
-        _, lshape, slices = comm.chunk(gshape, split, rank=rank)
-        buf = np.zeros(tuple(block_shape), dtype=np_dtype)
-        if all(s > 0 for s in lshape):
-            buf[tuple(slice(0, s) for s in lshape)] = read_chunk(slices)
-        arrays.append(jax.device_put(buf, dev))
+        if rank not in blocks:
+            _, lshape, slices = comm.chunk(gshape, split, rank=rank)
+            buf = np.zeros(tuple(block_shape), dtype=np_dtype)
+            if all(s > 0 for s in lshape):
+                buf[tuple(slice(0, s) for s in lshape)] = read_chunk(slices)
+            blocks[rank] = buf
+        arrays.append(jax.device_put(blocks[rank], dev))
     return jax.make_array_from_single_device_arrays(pshape, sharding, arrays)
+
+
+def _split_ranks(comm: MeshCommunication):
+    """(split_rank, device) for every mesh device.
+
+    A device's shard rank is its COORDINATE along the split mesh axis —
+    not its position in ``devices.ravel()``, which diverges on multi-axis
+    meshes (e.g. a 2-D DASO mesh, where devices sharing a split coordinate
+    replicate the same shard)."""
+    devs = comm.mesh.devices
+    axis_idx = list(comm.mesh.axis_names).index(SPLIT_AXIS)
+    for coords in np.ndindex(devs.shape):
+        yield coords[axis_idx], devs[coords]
 
 
 def assemble_local_shards(local: np.ndarray, split: int, comm: MeshCommunication):
@@ -424,9 +440,9 @@ def assemble_local_shards(local: np.ndarray, split: int, comm: MeshCommunication
     # [r*block, (r+1)*block)) to fall inside this process's own rows —
     # true for equal, locally-divisible extents on a process-major mesh,
     # checked explicitly so permuted meshes fall back to the allgather.
-    my_ranks = [
-        r for r, d in enumerate(comm.mesh.devices.ravel()) if d.process_index == pid
-    ]
+    my_ranks = sorted(
+        {r for r, d in _split_ranks(comm) if d.process_index == pid}
+    )
     aligned = (
         len(set(sizes)) == 1
         and sizes[0] % dpp == 0

@@ -158,16 +158,21 @@ def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs
             if all(s.stop > s.start for s in sl):
                 local.append((tuple(sl), np.asarray(shard.data)[tuple(trim)]))
         for p in range(nproc):
-            if pid == p:
-                with h5py.File(path, mode if p == 0 else "a") as handle:
-                    if p == 0:
-                        handle.create_dataset(
-                            dataset, shape=gshape, dtype=np.dtype(data.dtype.jax_type()), **kwargs
-                        )
-                    dset = handle[dataset]
-                    for slices, chunk in local:
-                        dset[slices] = chunk
-            multihost_utils.sync_global_devices(f"heat_tpu_save_hdf5_{p}")
+            try:
+                if pid == p:
+                    with h5py.File(path, mode if p == 0 else "a") as handle:
+                        if p == 0:
+                            handle.create_dataset(
+                                dataset, shape=gshape, dtype=np.dtype(data.dtype.jax_type()), **kwargs
+                            )
+                        dset = handle[dataset]
+                        for slices, chunk in local:
+                            dset[slices] = chunk
+            finally:
+                # the barrier must be reached even when this process's write
+                # throws, or every other process hangs in sync forever; the
+                # exception then propagates (MPI-style fail-stop)
+                multihost_utils.sync_global_devices(f"heat_tpu_save_hdf5_{p}")
         return
     arr = data.numpy()
     if jax.process_index() == 0:

@@ -108,16 +108,33 @@ class DASO:
         self._batch = 0
         self._opt_state = None
         self._mesh = None
+        self._slow_axis = "nodes"
+        self._param_shardings = None
         self._n_groups = 1
         self._pending = None  # (averaged replicas, apply_at_batch)
         self._step_fn = None
         self._avg_fn = None
 
     # -- setup ----------------------------------------------------------------
+    def _replica_sharding(self, leaf_ndim: int):
+        """Replica-stacked leaves: leading axis over the slow mesh axis,
+        everything else replicated within the group (each fast-axis device
+        holds its group's full replica, like the reference's per-GPU model
+        copies under node-local DDP)."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return NamedSharding(
+            self._mesh, PartitionSpec(self._slow_axis, *(None,) * (leaf_ndim - 1))
+        )
+
+    def _tree_shardings(self, tree):
+        return jax.tree_util.tree_map(lambda p: self._replica_sharding(p.ndim), tree)
+
     def init(self, params, mesh, slow_axis: str = "nodes"):
-        """Stack parameters into per-group replicas sharded over the slow
-        axis and build the jitted step/average programs once."""
+        """Stack parameters into per-group replicas physically sharded over
+        the slow axis and build the jitted step/average programs once."""
         self._mesh = mesh
+        self._slow_axis = slow_axis
         n = mesh.shape.get(slow_axis, 1) if slow_axis in mesh.axis_names else 1
         self._n_groups = max(n, 1)
         down = self.downcast_type
@@ -125,27 +142,56 @@ class DASO:
         stacked = jax.tree_util.tree_map(
             lambda p: jnp.broadcast_to(p[None], (self._n_groups,) + p.shape), params
         )
-        self._opt_state = self.local_optimizer.init(stacked)
+        # pin replica r to slow-mesh group r — without this constraint XLA
+        # may replicate the stack and the hierarchy is metadata only
+        stacked = jax.device_put(stacked, self._tree_shardings(stacked))
+        self._param_shardings = self._tree_shardings(stacked)
+        # opt state inherits the replica sharding through jit propagation
+        self._opt_state = jax.jit(self.local_optimizer.init)(stacked)
 
-        def avg(reps):
-            # bf16 on the wire (DCN), accumulate back in the param dtype
+        # bf16 on the wire: the replica average is ONE explicit lax.pmean
+        # over the slow (DCN) axis, written in bf16 inside a shard_map so
+        # the collective itself carries the downcast dtype (the reference
+        # needed a custom MPI op for exactly this, dp_optimizer.py:21-44)
+        from jax import shard_map
+        from jax.sharding import PartitionSpec
+
+        specs = jax.tree_util.tree_map(
+            lambda p: PartitionSpec(slow_axis, *(None,) * (p.ndim - 1)), stacked
+        )
+        slow = slow_axis
+
+        def avg_body(tree):
             return jax.tree_util.tree_map(
-                lambda p: jnp.broadcast_to(
-                    jnp.mean(p.astype(down), axis=0).astype(p.dtype)[None], p.shape
-                ),
-                reps,
+                lambda p: jax.lax.pmean(p.astype(down), slow).astype(p.dtype), tree
             )
 
-        self._avg_fn = jax.jit(avg)
+        def avg(reps):
+            return shard_map(avg_body, mesh=mesh, in_specs=(specs,), out_specs=specs)(reps)
+
+        self._avg_fn = jax.jit(
+            avg,
+            in_shardings=(self._tree_shardings(stacked),),
+            out_shardings=self._tree_shardings(stacked),
+        )
         return stacked
 
     def _build_step(self, loss_and_grad_fn, n_args: int):
         import optax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        fast = tuple(a for a in self._mesh.axis_names if a != self._slow_axis)
+        mesh = self._mesh
+        slow = self._slow_axis
 
         def step(params, opt_state, *batch):
-            # split the global batch into one slice per replica group
+            # split the global batch into one slice per replica group and
+            # keep group g's rows on slow-row g, spread over the fast axis
             def regroup(b):
-                return b.reshape((self._n_groups, b.shape[0] // self._n_groups) + b.shape[1:])
+                g = b.reshape((self._n_groups, b.shape[0] // self._n_groups) + b.shape[1:])
+                return jax.lax.with_sharding_constraint(
+                    g, NamedSharding(mesh, PartitionSpec(slow, fast))
+                )
 
             grouped = tuple(regroup(b) for b in batch)
             losses, grads = jax.vmap(loss_and_grad_fn)(params, *grouped)
@@ -153,7 +199,16 @@ class DASO:
             params = optax.apply_updates(params, updates)
             return params, opt_state, jnp.mean(losses)
 
-        return jax.jit(step, donate_argnums=(0, 1))
+        # no in_shardings on the batches: a leading dim only divisible by
+        # the group count (the documented contract) must stay accepted;
+        # the with_sharding_constraint above pins the grouped layout
+        opt_shardings = jax.tree_util.tree_map(lambda x: x.sharding, self._opt_state)
+        return jax.jit(
+            step,
+            donate_argnums=(0, 1),
+            in_shardings=(self._param_shardings, opt_shardings, *([None] * n_args)),
+            out_shardings=(self._param_shardings, opt_shardings, None),
+        )
 
     # -- phase logic (reference dp_optimizer.py:336) --------------------------
     def epoch_loss_logic(self, loss: float) -> None:

@@ -98,6 +98,30 @@ class TestIO(TestCase):
             via_load = ht.load(path, dataset="data", split=0)
             np.testing.assert_allclose(via_load.numpy(), x.numpy(), rtol=1e-6)
 
+    def test_hdf5_load_multi_axis_mesh(self):
+        """Chunked loads on a 2-D (nodes x split) mesh: a device's shard
+        rank is its coordinate along the split axis, and devices sharing a
+        split coordinate replicate the same block (regression: ravel
+        position was used as the rank, zero-filling the second row)."""
+        import jax
+        from jax.sharding import Mesh
+
+        import h5py
+
+        if ht.get_comm().size != 8:
+            pytest.skip("needs 8 devices for the 2x4 topology")
+        devs = np.array(jax.devices()).reshape(2, 4)
+        comm = ht.MPICommunication(mesh=Mesh(devs, ("nodes", "split")))
+        x = np.arange(24, dtype=np.float32).reshape(12, 2)
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "ma.h5")
+            with h5py.File(path, "w") as f:
+                f.create_dataset("d", data=x)
+            a = ht.load_hdf5(path, "d", split=0, comm=comm)
+        sums = [float(np.asarray(s.data).sum()) for s in a.larray.addressable_shards]
+        assert sums[:4] == sums[4:], f"nodes-axis replicas differ: {sums}"
+        np.testing.assert_array_equal(np.asarray(a._logical()), x)
+
     def test_csv_roundtrip(self):
         x = ht.arange(24, dtype=ht.float32).reshape((6, 4))
         with tempfile.TemporaryDirectory() as d:
