@@ -157,9 +157,13 @@ def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs
                 trim.append(slice(0, stop - start))
             if all(s.stop > s.start for s in sl):
                 local.append((tuple(sl), np.asarray(shard.data)[tuple(trim)]))
+        # a failed write must not desert the remaining barriers (the other
+        # processes would hang forever) — carry the error through every
+        # round, then let ALL processes fail together via a status gather
+        err = None
         for p in range(nproc):
             try:
-                if pid == p:
+                if pid == p and err is None:
                     with h5py.File(path, mode if p == 0 else "a") as handle:
                         if p == 0:
                             handle.create_dataset(
@@ -168,11 +172,18 @@ def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs
                         dset = handle[dataset]
                         for slices, chunk in local:
                             dset[slices] = chunk
-            finally:
-                # the barrier must be reached even when this process's write
-                # throws, or every other process hangs in sync forever; the
-                # exception then propagates (MPI-style fail-stop)
-                multihost_utils.sync_global_devices(f"heat_tpu_save_hdf5_{p}")
+            except Exception as e:  # noqa: BLE001 - re-raised below
+                err = e
+            multihost_utils.sync_global_devices(f"heat_tpu_save_hdf5_{p}")
+        statuses = np.asarray(
+            multihost_utils.process_allgather(np.asarray([0 if err is None else 1]))
+        ).ravel()
+        if err is not None:
+            raise err
+        if statuses.any():
+            raise RuntimeError(
+                f"save_hdf5 failed on process(es) {np.nonzero(statuses)[0].tolist()}"
+            )
         return
     arr = data.numpy()
     if jax.process_index() == 0:

@@ -120,12 +120,12 @@ class DASO:
         """Replica-stacked leaves: leading axis over the slow mesh axis,
         everything else replicated within the group (each fast-axis device
         holds its group's full replica, like the reference's per-GPU model
-        copies under node-local DDP)."""
+        copies under node-local DDP). On a mesh without the slow axis
+        (n_groups == 1) the single replica is simply replicated."""
         from jax.sharding import NamedSharding, PartitionSpec
 
-        return NamedSharding(
-            self._mesh, PartitionSpec(self._slow_axis, *(None,) * (leaf_ndim - 1))
-        )
+        lead = self._slow_axis if self._slow_axis in self._mesh.axis_names else None
+        return NamedSharding(self._mesh, PartitionSpec(lead, *(None,) * (leaf_ndim - 1)))
 
     def _tree_shardings(self, tree):
         return jax.tree_util.tree_map(lambda p: self._replica_sharding(p.ndim), tree)
@@ -135,6 +135,7 @@ class DASO:
         the slow axis and build the jitted step/average programs once."""
         self._mesh = mesh
         self._slow_axis = slow_axis
+        self._step_fn = None  # re-init on a new mesh must rebuild the step
         n = mesh.shape.get(slow_axis, 1) if slow_axis in mesh.axis_names else 1
         self._n_groups = max(n, 1)
         down = self.downcast_type
@@ -144,21 +145,23 @@ class DASO:
         )
         # pin replica r to slow-mesh group r — without this constraint XLA
         # may replicate the stack and the hierarchy is metadata only
-        stacked = jax.device_put(stacked, self._tree_shardings(stacked))
         self._param_shardings = self._tree_shardings(stacked)
+        stacked = jax.device_put(stacked, self._param_shardings)
         # opt state inherits the replica sharding through jit propagation
         self._opt_state = jax.jit(self.local_optimizer.init)(stacked)
+
+        if self._n_groups == 1:
+            # nothing to average across; keep the API uniform
+            self._avg_fn = jax.jit(lambda reps: reps)
+            return stacked
 
         # bf16 on the wire: the replica average is ONE explicit lax.pmean
         # over the slow (DCN) axis, written in bf16 inside a shard_map so
         # the collective itself carries the downcast dtype (the reference
         # needed a custom MPI op for exactly this, dp_optimizer.py:21-44)
         from jax import shard_map
-        from jax.sharding import PartitionSpec
 
-        specs = jax.tree_util.tree_map(
-            lambda p: PartitionSpec(slow_axis, *(None,) * (p.ndim - 1)), stacked
-        )
+        specs = jax.tree_util.tree_map(lambda s: s.spec, self._param_shardings)
         slow = slow_axis
 
         def avg_body(tree):
@@ -171,8 +174,8 @@ class DASO:
 
         self._avg_fn = jax.jit(
             avg,
-            in_shardings=(self._tree_shardings(stacked),),
-            out_shardings=self._tree_shardings(stacked),
+            in_shardings=(self._param_shardings,),
+            out_shardings=self._param_shardings,
         )
         return stacked
 
@@ -182,7 +185,7 @@ class DASO:
 
         fast = tuple(a for a in self._mesh.axis_names if a != self._slow_axis)
         mesh = self._mesh
-        slow = self._slow_axis
+        slow = self._slow_axis if self._slow_axis in self._mesh.axis_names else None
 
         def step(params, opt_state, *batch):
             # split the global batch into one slice per replica group and

@@ -324,6 +324,30 @@ class TestDASOMeshBinding(TestCase):
                 assert any(d < 4 for d in g) and any(d >= 4 for d in g), rows
             assert "bf16" in block, "replica average must ride the wire in bf16"
 
+    def test_one_group_on_flat_mesh(self):
+        """A mesh without the slow axis keeps working with a single
+        replica group (regression: sharding referenced the missing axis)."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from heat_tpu.optim import DASO
+        from heat_tpu.parallel import make_mesh
+
+        daso = DASO(optax.sgd(0.05), total_epochs=4)
+        stacked = daso.init({"w": jnp.zeros((4, 1), jnp.float32)}, make_mesh())
+        X = np.ones((16, 4), np.float32)
+        Y = np.ones((16, 1), np.float32)
+
+        def lg(p, xb, yb):
+            return jax.value_and_grad(lambda p: jnp.mean((xb @ p["w"] - yb) ** 2))(p)
+
+        params, loss = daso.step(lg, stacked, X, Y)
+        assert params["w"].shape == (1, 4, 1)
+        assert np.isfinite(loss)
+        avg = daso._avg_fn(params)
+        np.testing.assert_array_equal(np.asarray(avg["w"]), np.asarray(params["w"]))
+
     def test_divergence_then_sync_semantics(self):
         import jax
         import jax.numpy as jnp
