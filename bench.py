@@ -129,12 +129,6 @@ def cdist_bench():
     data = rng.normal(size=(n, f)).astype(np.float32)
     xa = jnp.asarray(data)
 
-    # each trial is its own jit call: the (n, n) matrix is a committed jit
-    # OUTPUT buffer, so XLA cannot elide the HBM write (inside one fused
-    # loop it can — only the final scalar would be observable). Trials are
-    # serialized by a device-scalar dependency; completion is forced with
-    # one scalar fetch at the end; constant RPC overhead cancels in the
-    # long-minus-short marginal difference.
     @jax.jit
     def one_trial(x, eps):
         xx = x + eps * jnp.float32(1e-30)
@@ -165,14 +159,15 @@ def cdist_bench():
     short, long_ = 4, 24
     out_gb = n * n * 4 / 1e9
     for _ in range(3):  # retry on timing-noise inversions
-        t_marginal = (timed(long_) - timed(short)) / (long_ - short)
+        t_long = timed(long_)
+        t_marginal = (t_long - timed(short)) / (long_ - short)
         if t_marginal > 0:
             gbps = out_gb / t_marginal
             break
     else:
         # noise never resolved: report the conservative whole-run rate
         # (includes dispatch overhead) instead of a corrupted number
-        gbps = out_gb * long_ / timed(long_)
+        gbps = out_gb * long_ / t_long
 
     # numpy baseline on a smaller n (same bytes/s semantics), best of 3
     nb = 8000

@@ -163,7 +163,7 @@ def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs
         err = None
         for p in range(nproc):
             try:
-                if pid == p and err is None:
+                if pid == p:
                     with h5py.File(path, mode if p == 0 else "a") as handle:
                         if p == 0:
                             handle.create_dataset(

@@ -135,7 +135,15 @@ class DASO:
         the slow axis and build the jitted step/average programs once."""
         self._mesh = mesh
         self._slow_axis = slow_axis
-        self._step_fn = None  # re-init on a new mesh must rebuild the step
+        # re-init on a new mesh must rebuild the step and drop ALL
+        # carried-over schedule state from the previous run
+        self._step_fn = None
+        self._pending = None
+        self._batch = 0
+        self.epoch = 0
+        self.global_skip = 4
+        self.batches_to_wait = 1
+        self.stability.reset()
         n = mesh.shape.get(slow_axis, 1) if slow_axis in mesh.axis_names else 1
         self._n_groups = max(n, 1)
         down = self.downcast_type

@@ -1,0 +1,160 @@
+"""Ground-truth estimator tests on the bundled datasets.
+
+The reference validates estimators on shipped real datasets with known
+outcomes (``heat/cluster/tests/test_kmeans.py:77-107`` fits iris;
+NB/kNN tests assert accuracies). Here every bundled file stores its own
+generating truth (see ``heat_tpu/datasets/generate.py``), so the
+assertions compare against recorded centers/labels/coefficients instead
+of magic constants.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu import datasets
+from tests.base import TestCase
+
+
+def _match_centers(found: np.ndarray, true: np.ndarray) -> float:
+    """Greedy-pair found centers to true ones; return the max distance."""
+    found = found.copy()
+    worst = 0.0
+    for t in true:
+        d = np.linalg.norm(found - t, axis=1)
+        i = int(d.argmin())
+        worst = max(worst, float(d[i]))
+        found[i] = np.inf
+    return worst
+
+
+def _cluster_accuracy(pred: np.ndarray, truth: np.ndarray, k: int) -> float:
+    """Fraction correct after majority-mapping cluster ids to labels."""
+    mapped = np.zeros_like(pred)
+    for c in range(k):
+        mask = pred == c
+        if mask.any():
+            mapped[mask] = np.bincount(truth[mask], minlength=k).argmax()
+    return float((mapped == truth).mean())
+
+
+class TestBlobsClustering(TestCase):
+    def test_load_and_shapes(self):
+        for split in (0, None):
+            data, labels, centers = datasets.load_blobs(split=split)
+            assert data.shape == (600, 2) and data.split == split
+            assert labels.shape == (600,)
+            assert centers.shape == (4, 2)
+
+    def test_kmeans_recovers_centers(self):
+        data, labels, centers = datasets.load_blobs(split=0)
+        km = ht.cluster.KMeans(n_clusters=4, random_state=3, max_iter=50).fit(data)
+        worst = _match_centers(km.cluster_centers_.numpy(), centers.numpy())
+        assert worst < 0.2, f"centroid off by {worst}"
+        acc = _cluster_accuracy(km.labels_.numpy(), labels.numpy(), 4)
+        assert acc == 1.0, f"blobs are separated by >10 sigma; got acc {acc}"
+
+    def test_kmedians_kmedoids_recover_centers(self):
+        data, labels, centers = datasets.load_blobs(split=0)
+        for cls, tol in ((ht.cluster.KMedians, 0.2), (ht.cluster.KMedoids, 0.3)):
+            est = cls(n_clusters=4, random_state=5, max_iter=50).fit(data)
+            worst = _match_centers(est.cluster_centers_.numpy(), centers.numpy())
+            assert worst < tol, f"{cls.__name__} centroid off by {worst}"
+
+    def test_spectral_groups_blobs(self):
+        data, labels, _ = datasets.load_blobs(split=0)
+        sub = ht.array(data.numpy()[:160], split=0)
+        truth = labels.numpy()[:160]
+        sp = ht.cluster.Spectral(n_clusters=4, gamma=0.05, n_lanczos=40, random_state=1)
+        pred = sp.fit_predict(sub).numpy().ravel()
+        assert _cluster_accuracy(pred, truth, 4) > 0.95
+
+    def test_blobs_csv_matches_h5(self):
+        data, _, _ = datasets.load_blobs(split=None)
+        csv = ht.load_csv(datasets.dataset_path("blobs.csv"), sep=";", split=0)
+        np.testing.assert_allclose(csv.numpy(), data.numpy(), atol=1e-4)
+
+
+class TestClassesClassification(TestCase):
+    def test_gaussian_nb_accuracy(self):
+        (tx, ty), (vx, vy) = datasets.load_classes(split=0)
+        nb = ht.naive_bayes.GaussianNB().fit(tx, ty)
+        acc = float((nb.predict(vx).numpy().ravel() == vy.numpy()).mean())
+        assert acc >= 0.95, f"GaussianNB accuracy {acc}"
+        # per-class variances differ by construction; the fitted sigmas
+        # must reproduce that ordering (class 2 widest, class 0 tightest)
+        sig = np.asarray(nb.sigma_ if hasattr(nb, "sigma_") else nb.var_)
+        assert sig[0].mean() < sig[1].mean() < sig[2].mean()
+
+    def test_knn_accuracy(self):
+        (tx, ty), (vx, vy) = datasets.load_classes(split=0)
+        knn = ht.classification.KNeighborsClassifier(n_neighbors=5).fit(tx, ty)
+        acc = float((knn.predict(vx).numpy().ravel() == vy.numpy()).mean())
+        assert acc >= 0.95, f"kNN accuracy {acc}"
+
+
+class TestRegression(TestCase):
+    def test_lasso_recovers_support(self):
+        x, y, coef = datasets.load_regression(split=0)
+        true = coef.numpy()
+        # reference Lasso convention: column 0 of the design matrix is the
+        # (unregularized) bias column (``heat/examples/lasso``)
+        xb = ht.array(
+            np.hstack([np.ones((x.shape[0], 1), np.float32), x.numpy()]), split=0
+        )
+        model = ht.regression.Lasso(lam=0.02, max_iter=300)
+        model.fit(xb, y)
+        w = np.asarray(model.coef_._logical()).ravel()
+        assert w.shape == true.shape
+        on = np.abs(true) > 0
+        # every true coefficient recovered with the right sign and size
+        np.testing.assert_allclose(w[on], true[on], atol=0.15)
+        assert np.all(np.abs(w[~on]) < 0.05), f"noise dims not suppressed: {w[~on]}"
+        assert abs(np.asarray(model.intercept_._logical()).ravel()[0]) < 0.05
+
+    def test_lstsq_recovers_coef(self):
+        x, y, coef = datasets.load_regression(split=0)
+        sol = ht.linalg.lstsq(x, y.reshape((-1, 1)))
+        np.testing.assert_allclose(sol.numpy().ravel(), coef.numpy(), atol=0.02)
+
+
+class TestIris(TestCase):
+    """The reference's iris flows (``test_kmeans.py:77-107``,
+    ``examples/knn``): parallel CSV load at several splits + fit."""
+
+    def test_load_iris_csv_splits(self):
+        path = datasets.dataset_path("iris.csv")
+        base = ht.load_csv(path, sep=";", split=None)
+        assert base.shape == (150, 4)
+        for split in (0, 1):
+            x = ht.load_csv(path, sep=";", split=split)
+            assert x.split == split
+            np.testing.assert_allclose(x.numpy(), base.numpy())
+
+    def test_kmeans_on_iris(self):
+        iris = ht.load_csv(datasets.dataset_path("iris.csv"), sep=";", split=0)
+        for k in (1, 3):
+            km = ht.cluster.KMeans(n_clusters=k, random_state=0).fit(iris)
+            assert km.cluster_centers_.shape == (k, 4)
+            # the classic iris 3-means inertia basin
+            if k == 3:
+                assert float(km.inertia_) < 110.0
+
+
+class TestGeneratorIsDeterministic(TestCase):
+    def test_regenerate_bitwise_identical(self, ):
+        import tempfile
+
+        import h5py
+
+        from heat_tpu.datasets import generate
+
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "blobs.h5")
+            generate.make_blobs_file(p)
+            with h5py.File(p, "r") as fa, h5py.File(
+                datasets.dataset_path("blobs.h5"), "r"
+            ) as fb:
+                for key in ("data", "labels", "centers"):
+                    np.testing.assert_array_equal(fa[key][...], fb[key][...])

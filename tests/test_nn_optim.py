@@ -360,10 +360,11 @@ class TestDASOMeshBinding(TestCase):
 
         mesh = make_hierarchical_mesh(n_slow=2)
         daso = DASO(optax.sgd(0.1), total_epochs=10, warmup_epochs=0, cooldown_epochs=0)
+        stacked = daso.init({"w": jnp.zeros((4, 1), jnp.float32)}, mesh)
+        # schedule knobs AFTER init (init resets all schedule state)
         daso.epoch = 1  # inside the cycling phase: skips active
         daso.global_skip = 4
         daso.batches_to_wait = 0
-        stacked = daso.init({"w": jnp.zeros((4, 1), jnp.float32)}, mesh)
 
         rng = np.random.default_rng(0)
         X = rng.normal(size=(16, 4)).astype(np.float32)
