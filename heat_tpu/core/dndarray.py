@@ -17,9 +17,12 @@ interaction is hand-written MPI. Here the underlying object is a **global**
   op's neutral element (see ``_operations``), data-movement ops work on the
   logical view (:meth:`_logical`). For divisible shapes (and ``split=None``)
   buffer == logical array and nothing changes.
-- ``redistribute_``/``balance_`` (reference ``dndarray.py:1029,470``) are
-  metadata-trivial: XLA always lays shards out in canonical ceil-div blocks,
-  so every DNDarray is permanently balanced.
+- ``balance_`` (reference ``dndarray.py:470``) is metadata-trivial: XLA
+  always lays shards out in canonical ceil-div blocks, so every DNDarray is
+  permanently balanced. ``redistribute_`` (reference ``dndarray.py:1029``)
+  performs canonical target maps exactly (including the canonical map of a
+  different split axis, via one resharding) and raises on arbitrary
+  unbalanced maps, which have no XLA representation.
 - ``resplit_`` (reference ``dndarray.py:1235-1357``, tile-by-tile
   Isend/Irecv) is a single ``jax.device_put`` to a new sharding — XLA emits
   the optimal all-to-all/all-gather over ICI.
@@ -32,7 +35,6 @@ interaction is hand-written MPI. Here the underlying object is a **global**
 """
 from __future__ import annotations
 
-import warnings
 from typing import List, Optional, Tuple, Union
 
 import jax
@@ -455,21 +457,59 @@ class DNDarray:
         )
 
     def redistribute_(self, lshape_map=None, target_map=None) -> "DNDarray":
-        """Reference ``dndarray.py:1029`` moved data to an *arbitrary*
-        per-rank shape map. XLA shardings are always canonical ceil-div
-        blocks, so arbitrary maps are not representable; data stays in the
-        canonical balanced layout (which is the reference's
-        ``balance_()`` fixed point). A non-canonical ``target_map`` warns.
+        """Move data to a target per-rank shape map (reference
+        ``dndarray.py:1029-1233``).
+
+        The physical layout on TPU is always the canonical ceil-div block
+        layout of SOME split axis, so exactly the canonical maps are
+        representable:
+
+        - the canonical map of the current split: already there, no-op;
+        - the canonical map of a *different* split axis: performed exactly
+          (one resharding — the analogue of the reference's chained
+          Send/Recv, chosen by XLA);
+        - any other map: ``ValueError`` (the reference's arbitrary
+          unbalanced maps have no XLA representation — rebalance with
+          ``balance_()``/``resplit_()`` instead). The old behavior of
+          warning and silently doing nothing dropped the reference's
+          guarantee that the move happens.
+
+        ``lshape_map`` (the current-layout hint in the reference, computed
+        there with an Allreduce) is validated against the true metadata.
         """
-        if target_map is not None:
-            canonical = self.lshape_map
-            if not np.array_equal(np.asarray(target_map), canonical):
-                warnings.warn(
-                    "TPU backend keeps XLA-canonical shard layout; "
-                    "redistribute_ to a custom target_map is a no-op",
-                    stacklevel=2,
+        if lshape_map is not None:
+            given = np.asarray(lshape_map)
+            if given.shape != self.lshape_map.shape or not np.array_equal(
+                given, self.lshape_map
+            ):
+                raise ValueError(
+                    f"lshape_map {given.tolist()} does not describe this array's "
+                    f"current layout {self.lshape_map.tolist()}"
                 )
-        return self
+        if target_map is None:
+            return self
+        target = np.asarray(target_map)
+        size, ndim = self.__comm.size, max(self.ndim, 1)
+        if target.shape != (size, ndim):
+            raise ValueError(
+                f"target_map must have shape {(size, ndim)}, got {target.shape}"
+            )
+        if (target < 0).any():
+            raise ValueError("target_map entries must be non-negative")
+        if np.array_equal(target, self.lshape_map):
+            return self  # already in this layout (covers split=None too)
+        for axis in ([self.__split] if self.__split is not None else []) + [
+            k for k in range(self.ndim) if k != self.__split
+        ]:
+            if np.array_equal(target, self.__comm.lshape_map(self.gshape, axis)):
+                if axis != self.__split:
+                    self.resplit_(axis)
+                return self
+        raise ValueError(
+            "target_map is not the canonical layout of any split axis; "
+            "arbitrary unbalanced maps are not representable in the XLA "
+            "block layout — use balance_() or resplit_()"
+        )
 
     def balance_(self) -> "DNDarray":
         """Already balanced by construction (reference ``dndarray.py:470``)."""

@@ -102,18 +102,22 @@ class DASO:
         self.downcast_type = downcast_type
         self.verbose = verbose
 
-        self.global_skip = 4
-        self.batches_to_wait = 1
-        self.epoch = 0
-        self._batch = 0
+        self._reset_schedule()
         self._opt_state = None
         self._mesh = None
         self._slow_axis = "nodes"
         self._param_shardings = None
         self._n_groups = 1
-        self._pending = None  # (averaged replicas, apply_at_batch)
         self._step_fn = None
         self._avg_fn = None
+
+    def _reset_schedule(self) -> None:
+        """Schedule defaults, shared by construction and re-``init``."""
+        self.global_skip = 4
+        self.batches_to_wait = 1
+        self.epoch = 0
+        self._batch = 0
+        self._pending = None  # (averaged replicas, apply_at_batch)
 
     # -- setup ----------------------------------------------------------------
     def _replica_sharding(self, leaf_ndim: int):
@@ -138,11 +142,7 @@ class DASO:
         # re-init on a new mesh must rebuild the step and drop ALL
         # carried-over schedule state from the previous run
         self._step_fn = None
-        self._pending = None
-        self._batch = 0
-        self.epoch = 0
-        self.global_skip = 4
-        self.batches_to_wait = 1
+        self._reset_schedule()
         self.stability.reset()
         n = mesh.shape.get(slow_axis, 1) if slow_axis in mesh.axis_names else 1
         self._n_groups = max(n, 1)

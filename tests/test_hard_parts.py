@@ -7,6 +7,7 @@ from __future__ import annotations
 import unittest
 
 import numpy as np
+import pytest
 
 import heat_tpu as ht
 from tests.base import TestCase
@@ -86,6 +87,46 @@ class TestDistributionHardParts(TestCase):
         # (reference manipulations.py:307-310)
         with self.assertRaises(RuntimeError):
             ht.concatenate([ht.array(A, split=0), ht.array(A, split=1)], axis=0)
+
+    def test_redistribute_canonical_maps(self):
+        """redistribute_ (reference dndarray.py:1029-1233): exact for
+        canonical maps — same split is a no-op, another split's canonical
+        map performs the resharding — and a hard error for arbitrary maps
+        (no silent warn-and-skip)."""
+        x = np.arange(48, dtype=np.float32).reshape(12, 4)
+        a = ht.array(x, split=0)
+        comm = a.comm
+        # current canonical map: no-op, values unchanged
+        a.redistribute_(target_map=comm.lshape_map((12, 4), 0))
+        assert a.split == 0
+        np.testing.assert_array_equal(a.numpy(), x)
+        # canonical map of split=1: performed via resharding (at world
+        # size 1 every canonical map coincides, so nothing distinguishes
+        # the splits and the call is a valid no-op)
+        a.redistribute_(target_map=comm.lshape_map((12, 4), 1))
+        if comm.size > 1:
+            assert a.split == 1
+        np.testing.assert_array_equal(a.numpy(), x)
+        # lshape_map hint validated against the true layout
+        if comm.size > 1:
+            with pytest.raises(ValueError):
+                a.redistribute_(lshape_map=comm.lshape_map((12, 4), 0))
+        # arbitrary unbalanced map: ValueError, not a warning
+        bad = comm.lshape_map((12, 4), 1).copy()
+        if comm.size > 1:
+            bad[0, 1] += 1
+            bad[1, 1] -= 1
+            with pytest.raises(ValueError):
+                a.redistribute_(target_map=bad)
+        with pytest.raises(ValueError):
+            a.redistribute_(target_map=np.full((comm.size, 2), -1))
+        with pytest.raises(ValueError):
+            a.redistribute_(target_map=np.ones((comm.size + 1, 2), np.int64))
+        # function form mirrors the method out-of-place
+        b = ht.redistribute(ht.array(x, split=1), target_map=comm.lshape_map((12, 4), 0))
+        if comm.size > 1:
+            assert b.split == 0
+        np.testing.assert_array_equal(b.numpy(), x)
 
     def test_is_split_roundtrip(self):
         full = np.arange(24, dtype=np.float32).reshape(8, 3)

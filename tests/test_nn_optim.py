@@ -179,9 +179,10 @@ class TestDASO(TestCase):
             return jax.value_and_grad(lambda p: jnp.mean((xb @ p["w"] - yb) ** 2))(p)
 
         daso = ht.optim.DASO(optax.sgd(0.1), total_epochs=10, warmup_epochs=0, cooldown_epochs=0)
+        params = daso.init({"w": jnp.zeros((4, 1))}, mesh)
+        # knobs AFTER init (init resets all schedule state)
         daso.global_skip = 100  # effectively never sync
         daso.batches_to_wait = 0
-        params = daso.init({"w": jnp.zeros((4, 1))}, mesh)
         for _ in range(1, 5):  # steps 1..4, no sync (step 0 syncs)
             params, _ = daso.step(loss_and_grad, params, jnp.asarray(X), jnp.asarray(y))
         reps = np.asarray(params["w"])
