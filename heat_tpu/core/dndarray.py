@@ -489,7 +489,9 @@ class DNDarray:
         if target_map is None:
             return self
         target = np.asarray(target_map)
-        size, ndim = self.__comm.size, max(self.ndim, 1)
+        # 0-d arrays have an empty (size, 0) map — matching lshape_map's
+        # convention, so the identity early-return below covers them
+        size, ndim = self.__comm.size, self.ndim
         if target.shape != (size, ndim):
             raise ValueError(
                 f"target_map must have shape {(size, ndim)}, got {target.shape}"

@@ -155,8 +155,22 @@ class DASO:
         # may replicate the stack and the hierarchy is metadata only
         self._param_shardings = self._tree_shardings(stacked)
         stacked = jax.device_put(stacked, self._param_shardings)
-        # opt state inherits the replica sharding through jit propagation
-        self._opt_state = jax.jit(self.local_optimizer.init)(stacked)
+        # opt state: moment leaves mirror the replica sharding; scalar
+        # bookkeeping leaves (e.g. adam's count) must be explicitly
+        # replicated over the WHOLE mesh or they land on one device and
+        # clash with the mesh-wide params in the jitted step
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        opt_state = jax.jit(self.local_optimizer.init)(stacked)
+        self._opt_state = jax.device_put(
+            opt_state,
+            jax.tree_util.tree_map(
+                lambda leaf: self._replica_sharding(leaf.ndim)
+                if getattr(leaf, "ndim", 0) and leaf.shape[0] == self._n_groups
+                else NamedSharding(mesh, PartitionSpec()),
+                opt_state,
+            ),
+        )
 
         if self._n_groups == 1:
             # nothing to average across; keep the API uniform
