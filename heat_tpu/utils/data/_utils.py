@@ -19,12 +19,28 @@ needs TensorFlow. The TPU-native equivalents here are dependency-free
 """
 from __future__ import annotations
 
-import base64
+import binascii
 import os
 import struct
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
+
+
+def _crc32c(data: bytes) -> int:
+    """CRC-32C (Castagnoli), the TFRecord framing checksum."""
+    crc = 0xFFFFFFFF
+    for byte in data:
+        crc ^= byte
+        for _ in range(8):
+            crc = (crc >> 1) ^ (0x82F63B78 if crc & 1 else 0)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc32c(data: bytes) -> int:
+    """TFRecord's masked crc: rot15(crc32c) + magic constant."""
+    crc = _crc32c(data)
+    return ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
 
 __all__ = [
     "tfrecord_index",
@@ -52,13 +68,23 @@ def tfrecord_index(path: str) -> List[Tuple[int, int]]:
             if not header:
                 return entries
             if len(header) < 8:
+                if start == 0:  # can't even hold one header: not a TFRecord
+                    raise ValueError(f"not a TFRecord: {path} is too short")
                 raise ValueError(f"truncated record header at byte {start} of {path}")
             (length,) = struct.unpack("<Q", header)
-            # validate BEFORE reading: garbage bytes decode as absurd
-            # lengths and a blind read would try to allocate them
+            # the header's masked crc32c distinguishes a genuine (possibly
+            # truncated) TFRecord from an arbitrary file whose first bytes
+            # decode as an absurd length
+            crc_bytes = f.read(4)
+            if len(crc_bytes) < 4 or struct.unpack("<I", crc_bytes)[0] != _masked_crc32c(header):
+                raise ValueError(
+                    f"not a TFRecord: bad header checksum at byte {start} of {path}"
+                )
+            # validate BEFORE seeking past the payload: a truncated shard
+            # must surface as an error, never as a silent short index
             if start + 8 + 4 + length + 4 > file_size:
                 raise ValueError(f"truncated record payload at byte {start} of {path}")
-            f.seek(4 + length + 4, os.SEEK_CUR)  # len-crc + payload + crc
+            f.seek(length + 4, os.SEEK_CUR)  # payload + payload-crc
             entries.append((start, 8 + 4 + length + 4))
 
 
@@ -75,10 +101,9 @@ def write_tfrecord_indexes(data_dir: str, idx_dir: str) -> List[str]:
         try:
             entries = tfrecord_index(src)
         except ValueError as e:
-            # a file that fails at byte 0 simply is not a TFRecord (README,
-            # checksums, ...) — skip it; corruption past the first record
-            # is a genuinely truncated shard and must surface
-            if "at byte 0 " in str(e):
+            # non-TFRecord files (README, checksums, ...) are skipped — the
+            # header-crc check identifies them; TRUNCATED TFRecords raise
+            if "not a TFRecord" in str(e):
                 continue
             raise
         dst = os.path.join(idx_dir, name + ".idx")
@@ -134,6 +159,15 @@ def merge_shards_to_hdf5(
                 raise ValueError(
                     f"shard {path} rows {tuple(images.shape[1:])} != {row_shape}"
                 )
+            if images.dtype != img_ds.dtype:
+                raise ValueError(
+                    f"shard {path} image dtype {images.dtype} != {img_ds.dtype}; "
+                    "h5py would silently cast and corrupt the merged data"
+                )
+            if labels is not None and lab_ds is not None and labels.dtype != lab_ds.dtype:
+                raise ValueError(
+                    f"shard {path} label dtype {labels.dtype} != {lab_ds.dtype}"
+                )
             n = images.shape[0]
             img_ds.resize(total + n, axis=0)
             img_ds[total : total + n] = images
@@ -167,12 +201,12 @@ def encode_image_bytes(image: np.ndarray) -> str:
     """uint8 image array -> base64 ASCII string (the reference's HDF5
     image storage convention, ``_utils.py:75-77``)."""
     image = np.ascontiguousarray(image, dtype=np.uint8)
-    return base64.binascii.b2a_base64(image.tobytes()).decode("ascii")
+    return binascii.b2a_base64(image.tobytes()).decode("ascii")
 
 
 def decode_image_bytes(payload: str, shape: Sequence[int]) -> np.ndarray:
     """Inverse of :func:`encode_image_bytes` (the reference documents the
     torch decode incantation; numpy equivalent here)."""
-    raw = base64.binascii.a2b_base64(payload.encode("ascii"))
+    raw = binascii.a2b_base64(payload.encode("ascii"))
     # copy: frombuffer views are read-only, augmentation pipelines mutate
     return np.frombuffer(raw, dtype=np.uint8).reshape(tuple(shape)).copy()

@@ -54,8 +54,15 @@ class TestCase(unittest.TestCase):
         numpy_args = numpy_args or {}
         rng = np.random.default_rng(42)
         for dtype in dtypes:
-            if dtype.startswith("int"):
+            if dtype.startswith(("int", "uint")):
                 np_arr = rng.integers(low, high, size=shape).astype(dtype)
+            elif dtype.startswith("complex"):
+                np_arr = (
+                    (rng.random(shape) * (high - low) + low)
+                    + 1j * (rng.random(shape) * (high - low) + low)
+                ).astype(dtype)
+            elif dtype == "bool":
+                np_arr = rng.random(shape) > 0.5
             else:
                 np_arr = (rng.random(shape) * (high - low) + low).astype(dtype)
             expected = numpy_func(np_arr.copy(), **numpy_args)

@@ -1,0 +1,375 @@
+"""Wide dtype x split oracle sweeps over the op surface.
+
+The reference's ``assert_func_equal`` runs every op over several dtypes
+AND every split axis against numpy (``basic_test.py:142-306``); round 1
+mostly swept float32 only. This file systematically covers float32/
+float64/int32/int64/complex64/bool across the elementwise, reduction,
+cumulative, manipulation, statistics, and linalg surfaces.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from tests.base import TestCase
+
+FLOATS = ("float32", "float64")
+INTS = ("int32", "int64")
+NUMERIC = FLOATS + INTS
+SHAPE = (9, 10)  # never divisible by the default 8-device mesh
+
+
+class TestElementwiseDtypes(TestCase):
+    def test_binary_ops_all_dtypes(self):
+        rng = np.random.default_rng(0)
+        b_f = rng.uniform(1, 4, size=SHAPE)
+        b_i = rng.integers(1, 5, size=SHAPE)
+        for name, np_fn in [
+            ("add", np.add),
+            ("sub", np.subtract),
+            ("mul", np.multiply),
+            ("div", np.divide),
+            ("pow", np.power),
+            ("fmod", np.fmod),
+            ("floordiv", np.floor_divide),
+            ("minimum", np.minimum),
+            ("maximum", np.maximum),
+        ]:
+            for dt in NUMERIC:
+                if name in ("div",) and dt in INTS:
+                    continue  # heat div promotes; covered in float
+                other = (b_i if dt in INTS else b_f).astype(dt)
+                self.assert_func_equal(
+                    SHAPE,
+                    lambda x, o=other, n=name: getattr(ht, n)(x, ht.array(o)),
+                    lambda x, o=other, f=np_fn: f(x, o),
+                    dtypes=(dt,),
+                    low=1,
+                    high=8,
+                    rtol=1e-4,
+                )
+
+    def test_unary_float_ops(self):
+        for name, np_fn, lo, hi in [
+            ("exp", np.exp, -2, 2),
+            ("log", np.log, 0.1, 9),
+            ("sqrt", np.sqrt, 0.0, 9),
+            ("sin", np.sin, -3, 3),
+            ("cos", np.cos, -3, 3),
+            ("tan", np.tan, -1, 1),
+            ("arcsin", np.arcsin, -0.9, 0.9),
+            ("arctan", np.arctan, -5, 5),
+            ("sinh", np.sinh, -2, 2),
+            ("cosh", np.cosh, -2, 2),
+            ("tanh", np.tanh, -3, 3),
+            ("floor", np.floor, -5, 5),
+            ("ceil", np.ceil, -5, 5),
+            ("trunc", np.trunc, -5, 5),
+            ("abs", np.abs, -5, 5),
+            ("sign", np.sign, -5, 5),
+            ("log2", np.log2, 0.1, 9),
+            ("log10", np.log10, 0.1, 9),
+            ("log1p", np.log1p, -0.5, 5),
+            ("expm1", np.expm1, -2, 2),
+        ]:
+            self.assert_func_equal(
+                SHAPE, getattr(ht, name), np_fn, dtypes=FLOATS, low=lo, high=hi, rtol=1e-4
+            )
+
+    def test_int_bitwise_ops(self):
+        other = np.random.default_rng(1).integers(1, 7, size=SHAPE)
+        for name, np_fn in [
+            ("bitwise_and", np.bitwise_and),
+            ("bitwise_or", np.bitwise_or),
+            ("bitwise_xor", np.bitwise_xor),
+            ("left_shift", np.left_shift),
+            ("right_shift", np.right_shift),
+        ]:
+            for dt in INTS:
+                o = other.astype(dt)
+                self.assert_func_equal(
+                    SHAPE,
+                    lambda x, o=o, n=name: getattr(ht, n)(x, ht.array(o)),
+                    lambda x, o=o, f=np_fn: f(x, o),
+                    dtypes=(dt,),
+                    low=0,
+                    high=16,
+                )
+        self.assert_func_equal(
+            SHAPE, ht.invert, np.invert, dtypes=INTS + ("bool",), low=0, high=9
+        )
+
+    def test_complex_ops(self):
+        for name, np_fn in [
+            ("real", np.real),
+            ("imag", np.imag),
+            ("conjugate", np.conjugate),
+            ("angle", np.angle),
+            ("abs", np.abs),
+        ]:
+            self.assert_func_equal(
+                SHAPE, getattr(ht, name), np_fn, dtypes=("complex64",), rtol=1e-4
+            )
+        self.assert_func_equal(
+            SHAPE,
+            lambda x: ht.exp(x) * ht.conjugate(x),
+            lambda x: np.exp(x) * np.conjugate(x),
+            dtypes=("complex64",),
+            low=-1,
+            high=1,
+            rtol=1e-4,
+        )
+
+    def test_relational_bool(self):
+        other = np.random.default_rng(2).uniform(-5, 5, size=SHAPE).astype(np.float32)
+        for name, np_fn in [
+            ("eq", np.equal),
+            ("ne", np.not_equal),
+            ("lt", np.less),
+            ("le", np.less_equal),
+            ("gt", np.greater),
+            ("ge", np.greater_equal),
+        ]:
+            self.assert_func_equal(
+                SHAPE,
+                lambda x, n=name: getattr(ht, n)(x, ht.array(other)),
+                lambda x, f=np_fn: f(x, other),
+                dtypes=("float32", "int32"),
+            )
+        self.assert_func_equal(
+            SHAPE,
+            lambda x: ht.logical_and(x > 0, x < 3),
+            lambda x: np.logical_and(x > 0, x < 3),
+            dtypes=NUMERIC,
+        )
+
+
+class TestReductionDtypes(TestCase):
+    def test_reductions_axes_dtypes(self):
+        for name, np_fn in [("sum", np.sum), ("prod", np.prod), ("max", np.max), ("min", np.min)]:
+            for axis in (None, 0, 1):
+                self.assert_func_equal(
+                    SHAPE,
+                    lambda x, n=name, a=axis: getattr(ht, n)(x, axis=a),
+                    lambda x, f=np_fn, a=axis: f(x, axis=a),
+                    dtypes=NUMERIC,
+                    low=1,
+                    high=3,  # keep prod in range
+                    rtol=1e-4,
+                )
+
+    def test_mean_var_std_f64(self):
+        for name, np_kwargs in [("mean", {}), ("var", {"ddof": 1}), ("std", {"ddof": 1})]:
+            for axis in (None, 0, 1):
+                self.assert_func_equal(
+                    SHAPE,
+                    lambda x, n=name, a=axis: getattr(ht, n)(x, axis=a, **np_kwargs),
+                    lambda x, n=name, a=axis: getattr(np, n)(x, axis=a, **np_kwargs),
+                    dtypes=FLOATS,
+                    rtol=1e-4,
+                )
+
+    def test_int_mean_promotes(self):
+        a = ht.array(np.arange(10, dtype=np.int32), split=0)
+        m = ht.mean(a)
+        assert m.dtype in (ht.float32, ht.float64)
+        assert abs(float(m.item()) - 4.5) < 1e-6
+
+    def test_cumops_dtypes(self):
+        for name, np_fn in [("cumsum", np.cumsum), ("cumprod", np.cumprod)]:
+            for axis in (0, 1):
+                self.assert_func_equal(
+                    SHAPE,
+                    lambda x, n=name, a=axis: getattr(ht, n)(x, a),
+                    lambda x, f=np_fn, a=axis: f(x, axis=a),
+                    dtypes=("float64", "int64"),
+                    low=1,
+                    high=2,
+                    rtol=1e-4,
+                )
+
+    def test_argreductions(self):
+        for name, np_fn in [("argmax", np.argmax), ("argmin", np.argmin)]:
+            for axis in (None, 0, 1):
+                self.assert_func_equal(
+                    SHAPE,
+                    lambda x, n=name, a=axis: getattr(ht, n)(x, axis=a),
+                    lambda x, f=np_fn, a=axis: f(x, axis=a),
+                    dtypes=NUMERIC,
+                )
+
+    def test_nan_reductions_f64(self):
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=SHAPE)
+        x[x > 1] = np.nan
+        for name, np_fn in [
+            ("nansum", np.nansum),
+            ("nanmax", np.nanmax),
+            ("nanmin", np.nanmin),
+            ("nanmean", np.nanmean),
+        ]:
+            for split in (None, 0, 1):
+                got = getattr(ht, name)(ht.array(x, split=split), axis=0)
+                np.testing.assert_allclose(got.numpy(), np_fn(x, axis=0), rtol=1e-6)
+
+
+class TestManipulationDtypes(TestCase):
+    def test_structure_ops(self):
+        for fn, np_fn, kw in [
+            (ht.flip, np.flip, {"axis": 0}),
+            (ht.roll, np.roll, {"shift": 3, "axis": 0}),
+            (lambda x: ht.reshape(x, (10, 9)), lambda x: np.reshape(x, (10, 9)), None),
+            (lambda x: ht.expand_dims(x, 1), lambda x: np.expand_dims(x, 1), None),
+            (lambda x: ht.swapaxes(x, 0, 1), lambda x: np.swapaxes(x, 0, 1), None),
+            (lambda x: ht.tile(x, (2, 1)), lambda x: np.tile(x, (2, 1)), None),
+            (lambda x: ht.repeat(x, 2, 0), lambda x: np.repeat(x, 2, 0), None),
+            (lambda x: ht.pad(x, ((1, 2), (0, 1))), lambda x: np.pad(x, ((1, 2), (0, 1))), None),
+        ]:
+            if kw is None:
+                self.assert_func_equal(SHAPE, fn, np_fn, dtypes=NUMERIC + ("complex64",))
+            else:
+                self.assert_func_equal(
+                    SHAPE,
+                    lambda x, f=fn, k=kw: f(x, **k),
+                    lambda x, f=np_fn, k=kw: f(x, **k),
+                    dtypes=NUMERIC + ("complex64",),
+                )
+
+    def test_sort_unique_topk_dtypes(self):
+        rng = np.random.default_rng(4)
+        for dt in ("float64", "int32", "int64"):
+            x = (
+                rng.integers(-20, 20, size=37).astype(dt)
+                if dt.startswith("int")
+                else rng.normal(size=37).astype(dt)
+            )
+            for split in (None, 0):
+                v, i = ht.sort(ht.array(x, split=split))
+                np.testing.assert_array_equal(v.numpy(), np.sort(x))
+                u = ht.unique(ht.array(x, split=split))
+                u = u[0] if isinstance(u, tuple) else u
+                np.testing.assert_array_equal(u.numpy(), np.unique(x))
+                tv, ti = ht.topk(ht.array(x, split=split), 5)
+                np.testing.assert_array_equal(tv.numpy(), np.sort(x)[::-1][:5])
+
+    def test_concat_stack_dtype_promotion(self):
+        a = np.arange(12, dtype=np.int32).reshape(4, 3)
+        b = np.arange(12, dtype=np.float64).reshape(4, 3)
+        r = ht.concatenate([ht.array(a, split=0), ht.array(b, split=0)], axis=0)
+        assert r.dtype == ht.float64
+        np.testing.assert_allclose(r.numpy(), np.concatenate([a, b], axis=0))
+
+
+class TestLinalgDtypes(TestCase):
+    def test_matmul_dtypes(self):
+        rng = np.random.default_rng(5)
+        for dt in ("float64", "int64", "complex64"):
+            if dt == "int64":
+                a = rng.integers(-3, 3, size=(9, 6)).astype(dt)
+                b = rng.integers(-3, 3, size=(6, 7)).astype(dt)
+            elif dt == "complex64":
+                a = (rng.normal(size=(9, 6)) + 1j * rng.normal(size=(9, 6))).astype(dt)
+                b = (rng.normal(size=(6, 7)) + 1j * rng.normal(size=(6, 7))).astype(dt)
+            else:
+                a = rng.normal(size=(9, 6)).astype(dt)
+                b = rng.normal(size=(6, 7)).astype(dt)
+            want = a @ b
+            for sa in (None, 0, 1):
+                got = ht.matmul(ht.array(a, split=sa), ht.array(b))
+                np.testing.assert_allclose(got.numpy(), want, rtol=1e-4, atol=1e-4)
+
+    def test_transpose_trace_norm_f64_complex(self):
+        rng = np.random.default_rng(6)
+        for dt in ("float64", "complex64"):
+            x = rng.normal(size=(7, 9)).astype(dt)
+            if dt == "complex64":
+                x = (x + 1j * rng.normal(size=(7, 9))).astype(dt)
+            for split in (None, 0, 1):
+                a = ht.array(x, split=split)
+                np.testing.assert_allclose(
+                    ht.linalg.transpose(a).numpy(), x.T, rtol=1e-5
+                )
+                np.testing.assert_allclose(
+                    complex(ht.linalg.trace(a[:, :7]).item()),
+                    np.trace(x[:, :7]),
+                    rtol=1e-4,
+                )
+            np.testing.assert_allclose(
+                float(ht.linalg.norm(ht.array(x.real.astype("float64"), split=0)).item()),
+                np.linalg.norm(x.real),
+                rtol=1e-6,
+            )
+
+    def test_qr_solve_f64(self):
+        rng = np.random.default_rng(7)
+        a = rng.normal(size=(40, 6))
+        q, r = ht.linalg.qr(ht.array(a, split=0))
+        np.testing.assert_allclose(ht.matmul(q, r).numpy(), a, atol=1e-10)
+        # f64 TSQR orthogonality at machine precision
+        qtq = (ht.linalg.transpose(q) @ q).numpy()
+        np.testing.assert_allclose(qtq, np.eye(6), atol=1e-12)
+
+
+class TestEdgeShapes(TestCase):
+    """Empty shards, singletons, and shard-smaller-than-halo shapes."""
+
+    def _p(self):
+        return ht.get_comm().size
+
+    def test_empty_shard_reductions(self):
+        p = self._p()
+        if p == 1:
+            pytest.skip("needs empty shards")
+        # (p-1) rows over p devices: the tail shard is empty
+        for n in (p - 1, 1):
+            x = np.arange(n * 3, dtype=np.float64).reshape(n, 3)
+            a = ht.array(x, split=0)
+            np.testing.assert_allclose(ht.sum(a).item(), x.sum())
+            np.testing.assert_allclose(ht.mean(a, axis=0).numpy(), x.mean(axis=0))
+            np.testing.assert_allclose(ht.max(a).item(), x.max())
+            v, _ = ht.sort(ht.array(x[:, 0].copy(), split=0))
+            np.testing.assert_array_equal(v.numpy(), np.sort(x[:, 0]))
+
+    def test_zero_size_arrays(self):
+        z = ht.array(np.zeros((0, 4), np.float32), split=0)
+        assert z.shape == (0, 4)
+        assert float(ht.sum(z).item()) == 0.0
+        c = ht.concatenate([z, ht.ones((2, 4), split=0)], axis=0)
+        np.testing.assert_array_equal(c.numpy(), np.ones((2, 4), np.float32))
+
+    def test_singleton_ops(self):
+        one = ht.array(np.array([7.0], np.float32), split=0)
+        assert float(ht.sum(one).item()) == 7.0
+        v, i = ht.sort(one)
+        assert float(v.item()) == 7.0 and int(i.item()) == 0
+        np.testing.assert_allclose(ht.cumsum(one, 0).numpy(), [7.0])
+
+    def test_convolve_kernel_wider_than_shard(self):
+        p = self._p()
+        n = max(2 * p, 8)  # shard size ~2; kernel 5 spans shards
+        x = np.random.default_rng(8).normal(size=n).astype(np.float32)
+        k = np.array([0.2, 0.3, 0.4, 0.3, 0.2], np.float32)
+        for mode in ("full", "same", "valid"):
+            got = ht.convolve(ht.array(x, split=0), ht.array(k), mode=mode)
+            np.testing.assert_allclose(got.numpy(), np.convolve(x, k, mode=mode), rtol=1e-5, atol=1e-5)
+
+    def test_getitem_setitem_empty_shard(self):
+        p = self._p()
+        if p == 1:
+            pytest.skip("needs empty shards")
+        x = np.arange((p - 1) * 2, dtype=np.float32).reshape(p - 1, 2)
+        a = ht.array(x, split=0)
+        np.testing.assert_array_equal(a[0].numpy(), x[0])
+        a[0] = ht.array(np.array([100.0, 200.0], np.float32))
+        x[0] = [100.0, 200.0]
+        np.testing.assert_array_equal(a.numpy(), x)
+
+    def test_matmul_thin_dims(self):
+        rng = np.random.default_rng(9)
+        a = rng.normal(size=(1, 5)).astype(np.float32)
+        b = rng.normal(size=(5, 1)).astype(np.float32)
+        for sa in (None, 0, 1):
+            sb = 0 if sa is not None else None
+            got = ht.matmul(ht.array(a, split=sa), ht.array(b, split=sb))
+            np.testing.assert_allclose(got.numpy(), a @ b, rtol=1e-4)

@@ -399,18 +399,16 @@ class TestDataPrepUtils(TestCase):
     @staticmethod
     def _write_tfrecord(path, payloads):
         import struct
-        import zlib
 
-        def masked_crc(data):  # framing requires A crc; readers skip it
-            return (zlib.crc32(data) + 0xA282EAD8) & 0xFFFFFFFF
+        from heat_tpu.utils.data._utils import _masked_crc32c
 
         with open(path, "wb") as f:
             for p in payloads:
                 hdr = struct.pack("<Q", len(p))
                 f.write(hdr)
-                f.write(struct.pack("<I", masked_crc(hdr)))
+                f.write(struct.pack("<I", _masked_crc32c(hdr)))
                 f.write(p)
-                f.write(struct.pack("<I", masked_crc(p)))
+                f.write(struct.pack("<I", _masked_crc32c(p)))
 
     def test_tfrecord_index(self):
         import tempfile
@@ -435,11 +433,18 @@ class TestDataPrepUtils(TestCase):
             assert len(out) == 1
             lines = open(out[0]).read().splitlines()
             assert lines[1].split() == [str(idx[1][0]), str(idx[1][1])]
-            # truncated file raises
+            # truncated file raises (valid header crc, short payload)
             with open(rec, "r+b") as f:
                 f.truncate(os.path.getsize(rec) - 2)
-            with pytest.raises(ValueError):
+            with pytest.raises(ValueError, match="truncated"):
                 tfrecord_index(rec)
+            # an arbitrary file is identified as not-a-TFRecord (and thus
+            # skipped by write_tfrecord_indexes, unlike real corruption)
+            junk = os.path.join(d, "README")
+            with open(junk, "w") as f:
+                f.write("this is definitely not a tfrecord")
+            with pytest.raises(ValueError, match="not a TFRecord"):
+                tfrecord_index(junk)
 
     def test_merge_shards_to_hdf5(self):
         import tempfile
