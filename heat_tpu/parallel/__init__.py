@@ -18,3 +18,4 @@ from .ring import ring_map, ring_reduce
 # note: the ring_attention *function* is the public name; the dense oracle
 # is exposed as `attention` (the submodule is shadowed by design)
 from .ring_attention import attention, ring_attention
+from .ulysses import ulysses_attention

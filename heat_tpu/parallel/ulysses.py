@@ -1,0 +1,75 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence reshard.
+
+The second canonical long-context schedule next to
+:mod:`.ring_attention`. Ring attention keeps queries resident and
+rotates K/V blocks (P-1 neighbor ppermutes, O(N/P * D) peak memory per
+head); Ulysses instead RESHARDS: one ``lax.all_to_all`` turns the
+sequence-sharded (N/P, H, D) blocks into head-sharded (N, H/P, D)
+blocks, every device runs ordinary full-sequence attention for its H/P
+heads on the MXU, and a second all-to-all restores sequence sharding.
+
+Trade-offs (both exact): Ulysses moves 2x the activations but in just
+two bisection-bandwidth collectives and computes each head's attention
+unblocked (better MXU utilization, trivially supports any per-head
+attention variant); ring keeps memory strictly O(N/P) and overlaps
+compute with neighbor traffic. Ulysses requires ``H % P == 0``; ring
+has no head constraint. Pick per workload — both ride the same mesh.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..core.communication import SPLIT_AXIS, MeshCommunication
+from .ring_attention import attention
+
+__all__ = ["ulysses_attention"]
+
+
+def ulysses_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    comm: MeshCommunication,
+    causal: bool = False,
+    axis_name: str = SPLIT_AXIS,
+) -> jnp.ndarray:
+    """Exact attention over (N, H, D) arrays sharded on the sequence axis.
+
+    Requires ``N % P == 0`` and ``H % P == 0`` (each device owns whole
+    heads after the reshard). Returns the (N, H, D) output in the same
+    sequence sharding.
+    """
+    if q.ndim != 3:
+        raise ValueError(f"expected (N, H, D) inputs, got {q.shape}")
+    if q.shape != k.shape or q.shape != v.shape:
+        raise ValueError(f"q/k/v shapes differ: {q.shape}, {k.shape}, {v.shape}")
+    mesh = comm.mesh
+    p = mesh.shape[axis_name]
+    n, h, _ = q.shape
+    if n % p:
+        raise ValueError(f"mesh size {p} must divide the sequence length {n}")
+    if h % p:
+        raise ValueError(f"mesh size {p} must divide the head count {h}")
+
+    def local(qb, kb, vb):  # blocks: (N/P, H, D)
+        def seq_to_head(x):
+            # scatter heads, gather sequence -> (N, H/P, D); concat order
+            # follows device order, i.e. the global sequence order
+            return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=0, tiled=True)
+
+        qh, kh, vh = seq_to_head(qb), seq_to_head(kb), seq_to_head(vb)
+        # whole-sequence attention per local head, heads as the batch dim
+        o = attention(
+            jnp.moveaxis(qh, 1, 0), jnp.moveaxis(kh, 1, 0), jnp.moveaxis(vh, 1, 0),
+            causal=causal,
+        )  # (H/P, N, D)
+        o = jnp.moveaxis(o, 0, 1)  # (N, H/P, D)
+        # scatter sequence, gather heads -> (N/P, H, D)
+        return lax.all_to_all(o, axis_name, split_axis=0, concat_axis=1, tiled=True)
+
+    spec = P(axis_name, None, None)
+    return shard_map(
+        local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
+    )(q, k, v)

@@ -27,13 +27,25 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 
-def _crc32c(data: bytes) -> int:
-    """CRC-32C (Castagnoli), the TFRecord framing checksum."""
-    crc = 0xFFFFFFFF
-    for byte in data:
-        crc ^= byte
+def _build_crc32c_table():
+    table = []
+    for i in range(256):
+        crc = i
         for _ in range(8):
             crc = (crc >> 1) ^ (0x82F63B78 if crc & 1 else 0)
+        table.append(crc)
+    return tuple(table)
+
+
+_CRC32C_TABLE = _build_crc32c_table()
+
+
+def _crc32c(data: bytes) -> int:
+    """CRC-32C (Castagnoli), the TFRecord framing checksum (table-driven:
+    one lookup per byte, matches the 0xE3069283 test vector)."""
+    crc = 0xFFFFFFFF
+    for byte in data:
+        crc = (crc >> 8) ^ _CRC32C_TABLE[(crc ^ byte) & 0xFF]
     return crc ^ 0xFFFFFFFF
 
 
@@ -72,14 +84,17 @@ def tfrecord_index(path: str) -> List[Tuple[int, int]]:
                     raise ValueError(f"not a TFRecord: {path} is too short")
                 raise ValueError(f"truncated record header at byte {start} of {path}")
             (length,) = struct.unpack("<Q", header)
-            # the header's masked crc32c distinguishes a genuine (possibly
-            # truncated) TFRecord from an arbitrary file whose first bytes
-            # decode as an absurd length
+            # the FIRST header's masked crc32c distinguishes a genuine
+            # (possibly truncated) TFRecord from an arbitrary file whose
+            # bytes decode as an absurd length; past the first record the
+            # same failure means in-file corruption and must surface
             crc_bytes = f.read(4)
             if len(crc_bytes) < 4 or struct.unpack("<I", crc_bytes)[0] != _masked_crc32c(header):
-                raise ValueError(
-                    f"not a TFRecord: bad header checksum at byte {start} of {path}"
-                )
+                if start == 0:
+                    raise ValueError(
+                        f"not a TFRecord: bad header checksum at byte 0 of {path}"
+                    )
+                raise ValueError(f"corrupt record header at byte {start} of {path}")
             # validate BEFORE seeking past the payload: a truncated shard
             # must surface as an error, never as a silent short index
             if start + 8 + 4 + length + 4 > file_size:

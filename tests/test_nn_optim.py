@@ -445,6 +445,16 @@ class TestDataPrepUtils(TestCase):
                 f.write("this is definitely not a tfrecord")
             with pytest.raises(ValueError, match="not a TFRecord"):
                 tfrecord_index(junk)
+            # MID-file header corruption is NOT 'not a TFRecord': it must
+            # surface (write_tfrecord_indexes only skips byte-0 failures)
+            rec2 = os.path.join(d, "train-001")
+            self._write_tfrecord(rec2, payloads)
+            first_size = 8 + 4 + len(payloads[0]) + 4
+            with open(rec2, "r+b") as f:
+                f.seek(first_size + 9)  # inside record 2's header crc
+                f.write(b"\xff\xff")
+            with pytest.raises(ValueError, match="corrupt record header"):
+                tfrecord_index(rec2)
 
     def test_merge_shards_to_hdf5(self):
         import tempfile

@@ -10,6 +10,93 @@ import heat_tpu as ht
 from .base import TestCase
 
 
+class TestUlyssesAttention(TestCase):
+    """All-to-all sequence parallelism (the second long-context schedule
+    next to ring attention): reshard to head-sharded, full-sequence local
+    attention, reshard back — exact vs the dense oracle."""
+
+    def _run(self, causal):
+        import jax.numpy as jnp
+
+        from heat_tpu.parallel import ulysses_attention
+        from heat_tpu.parallel.ring_attention import attention
+
+        comm = ht.get_comm()
+        if comm.size == 1:
+            pytest.skip("needs multi-device mesh")
+        rng = np.random.default_rng(3)
+        p = comm.size
+        n, h, d = p * 8, p * 2, 16  # sequence AND heads divisible
+        mk = lambda: jnp.asarray(rng.normal(size=(n, h, d)).astype(np.float32))
+        q, k, v = mk(), mk(), mk()
+        qs = ht.array(np.asarray(q), split=0).larray
+        ks = ht.array(np.asarray(k), split=0).larray
+        vs = ht.array(np.asarray(v), split=0).larray
+        out = ulysses_attention(qs, ks, vs, comm, causal=causal)
+        # oracle: heads as batch dim
+        expected = jnp.moveaxis(
+            attention(
+                jnp.moveaxis(q, 1, 0), jnp.moveaxis(k, 1, 0), jnp.moveaxis(v, 1, 0),
+                causal=causal,
+            ),
+            0,
+            1,
+        )
+        assert out.shape == (n, h, d)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected), rtol=2e-4, atol=2e-4)
+
+    def test_full(self):
+        self._run(causal=False)
+
+    def test_causal(self):
+        self._run(causal=True)
+
+    def test_matches_ring_attention(self):
+        """Both schedules are exact: per-head results must agree."""
+        import jax.numpy as jnp
+
+        from heat_tpu.parallel import ring_attention, ulysses_attention
+
+        comm = ht.get_comm()
+        if comm.size == 1:
+            pytest.skip("needs multi-device mesh")
+        rng = np.random.default_rng(4)
+        p = comm.size
+        n, h, d = p * 8, p, 8
+        mk = lambda: rng.normal(size=(n, h, d)).astype(np.float32)
+        q, k, v = mk(), mk(), mk()
+        qs = ht.array(q, split=0).larray
+        ks = ht.array(k, split=0).larray
+        vs = ht.array(v, split=0).larray
+        uly = np.asarray(ulysses_attention(qs, ks, vs, comm, causal=True))
+        for head in range(h):
+            ring = np.asarray(
+                ring_attention(
+                    ht.array(q[:, head], split=0).larray,
+                    ht.array(k[:, head], split=0).larray,
+                    ht.array(v[:, head], split=0).larray,
+                    comm,
+                    causal=True,
+                )
+            )
+            np.testing.assert_allclose(uly[:, head], ring, rtol=2e-4, atol=2e-4)
+
+    def test_validation(self):
+        import jax.numpy as jnp
+
+        from heat_tpu.parallel import ulysses_attention
+
+        comm = ht.get_comm()
+        if comm.size == 1:
+            pytest.skip("needs multi-device mesh")
+        z = jnp.zeros((comm.size * 4, comm.size, 4))
+        with pytest.raises(ValueError):  # 2-D input
+            ulysses_attention(z[:, 0], z[:, 0], z[:, 0], comm)
+        with pytest.raises(ValueError):  # heads not divisible
+            bad = jnp.zeros((comm.size * 4, comm.size + 1, 4))
+            ulysses_attention(bad, bad, bad, comm)
+
+
 class TestRingAttention(TestCase):
     def _run(self, causal):
         import jax.numpy as jnp
