@@ -61,8 +61,9 @@ def supports_hdf5() -> bool:
 
 
 def supports_netcdf() -> bool:
-    """Whether netCDF4 is available (reference ``io.py``)."""
-    return __HAS_NETCDF
+    """Whether netCDF I/O is available (reference ``io.py``): the netCDF4
+    library, or the h5py fallback for the netCDF-4/HDF5 data model."""
+    return __HAS_NETCDF or __HAS_HDF5
 
 
 def load(path: str, *args, **kwargs) -> DNDarray:
@@ -192,29 +193,98 @@ def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs
 
 
 def load_netcdf(path: str, variable: str, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
-    """Load a netCDF variable (reference ``io.py:268``); requires netCDF4."""
-    if not __HAS_NETCDF:
-        raise ImportError("netCDF4 is required for netCDF support (not available in this build)")
-    comm = sanitize_comm(comm)  # pragma: no cover
+    """Load a netCDF variable (reference ``io.py:268``).
+
+    Uses the ``netCDF4`` library when installed; otherwise falls back to
+    reading the file through h5py — netCDF-4 files ARE HDF5 files
+    (variables are datasets, dimensions are HDF5 dimension scales), so the
+    fallback covers the standard netCDF-4 data model and reuses the
+    parallel chunked-read path. Classic (netCDF-3) files need the real
+    library.
+    """
+    comm = sanitize_comm(comm)
     dtype = types.canonical_heat_type(dtype)
-    with nc.Dataset(path, "r") as handle:
-        arr = np.asarray(handle[variable][...], dtype=np.dtype(dtype.jax_type()))
-    return DNDarray(jnp.asarray(arr), dtype=dtype, split=split, device=device, comm=comm)
+    if __HAS_NETCDF:
+        with nc.Dataset(path, "r") as handle:
+            if variable not in handle.variables:
+                raise KeyError(f"variable {variable!r} not found in {path}")
+            arr = np.asarray(handle[variable][...], dtype=np.dtype(dtype.jax_type()))
+        return DNDarray(jnp.asarray(arr), dtype=dtype, split=split, device=device, comm=comm)
+    if not __HAS_HDF5:
+        raise ImportError("netCDF support needs netCDF4 or h5py installed")
+    _reject_classic_netcdf(path)
+    with h5py.File(path, "r") as probe:
+        if variable not in probe:
+            raise KeyError(f"variable {variable!r} not found in {path}")
+        # netCDF convention: a PURE dimension (no data) is a dimension
+        # scale whose NAME attr says so; coordinate variables are scales
+        # too but carry real data and must load fine
+        name_attr = probe[variable].attrs.get("NAME", b"")
+        if isinstance(name_attr, bytes) and name_attr.startswith(
+            b"This is a netCDF dimension but not a netCDF variable"
+        ):
+            raise KeyError(f"{variable!r} is a dimension, not a data variable")
+    return load_hdf5(path, variable, dtype=dtype, split=split, device=device, comm=comm)
+
+
+def _reject_classic_netcdf(path: str) -> None:
+    """Classic (netCDF-3) files are not HDF5 — name the actionable fix
+    instead of letting h5py fail with a cryptic signature error."""
+    with open(path, "rb") as f:
+        if f.read(3) == b"CDF":
+            raise ValueError(
+                f"{path} is a classic netCDF-3 file; the h5py fallback only "
+                "reads netCDF-4/HDF5 — install the netCDF4 library"
+            )
 
 
 def save_netcdf(data: DNDarray, path: str, variable: str, mode: str = "w", **kwargs) -> None:
-    """Save to netCDF (reference ``io.py:351``); requires netCDF4."""
-    if not __HAS_NETCDF:
-        raise ImportError("netCDF4 is required for netCDF support (not available in this build)")
-    arr = data.numpy()  # pragma: no cover
-    with nc.Dataset(path, mode) as handle:
-        dims = []
-        for i, s in enumerate(arr.shape):
-            name = f"dim_{i}"
-            handle.createDimension(name, s)
-            dims.append(name)
-        var = handle.createVariable(variable, arr.dtype, tuple(dims))
-        var[...] = arr
+    """Save to netCDF (reference ``io.py:351``).
+
+    With ``netCDF4`` installed the real library writes; otherwise a
+    netCDF-4-compatible HDF5 file is produced directly with h5py:
+    per-dimension datasets registered as HDF5 dimension scales and
+    attached to the variable — the structure the netCDF-4 data model
+    stores on disk, readable by netCDF tooling.
+    """
+    if not isinstance(data, DNDarray):
+        raise TypeError(f"data must be a DNDarray, not {type(data)}")
+    if __HAS_NETCDF:
+        arr = data.numpy()
+        with nc.Dataset(path, mode) as handle:
+            dims = []
+            for i, s in enumerate(arr.shape):
+                name = f"dim_{i}"
+                handle.createDimension(name, s)
+                dims.append(name)
+            var = handle.createVariable(variable, arr.dtype, tuple(dims))
+            var[...] = arr
+        return
+    if not __HAS_HDF5:
+        raise ImportError("netCDF support needs netCDF4 or h5py installed")
+    if mode not in ("w", "a", "r+"):
+        raise ValueError(f"unsupported mode {mode!r}")
+    # the variable write reuses save_hdf5 — including its rank-serialized,
+    # barrier-coordinated multi-host path — then process 0 attaches the
+    # netCDF-4 dimension-scale structure
+    save_hdf5(data, path, variable, mode=mode)
+    if jax.process_index() == 0:
+        with h5py.File(path, "r+") as handle:
+            var = handle[variable]
+            for i, s in enumerate(data.gshape):
+                dname = f"dim_{i}_{variable}" if f"dim_{i}" in handle else f"dim_{i}"
+                scale = handle.create_dataset(dname, data=np.arange(s, dtype=np.float64))
+                scale.make_scale(dname)
+                # netCDF4's phony-dimension marker: these are dimensions,
+                # not data variables (load_netcdf refuses to load them)
+                scale.attrs["NAME"] = np.bytes_(
+                    b"This is a netCDF dimension but not a netCDF variable. %10d" % s
+                )
+                var.dims[i].attach_scale(scale)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("heat_tpu_save_netcdf")
 
 
 def load_csv(

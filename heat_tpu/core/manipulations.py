@@ -121,6 +121,15 @@ def concatenate(arrays: Sequence[DNDarray], axis: int = 0) -> DNDarray:
         if not isinstance(a, DNDarray):
             raise TypeError(f"all inputs must be DNDarrays, found {type(a)}")
     axis = sanitize_axis(arrays[0].shape, axis)
+    first = arrays[0].shape
+    for a in arrays[1:]:
+        if a.ndim != len(first) or any(
+            d != axis and a.shape[d] != first[d] for d in range(a.ndim)
+        ):
+            raise ValueError(
+                f"all input array dimensions except axis {axis} must match "
+                f"exactly: {first} vs {a.shape}"
+            )
     splits = {a.split for a in arrays if a.split is not None}
     if len(splits) > 1:
         raise RuntimeError(f"DNDarrays given have differing split axes, found {splits}")
