@@ -5,7 +5,8 @@ The reference reads per-rank byte/row ranges through parallel HDF5
 single-controller JAX the controller reads and shards via ``device_put``;
 under multi-host each process reads only its ``comm.chunk`` slice and the
 global array is assembled with ``jax.make_array_from_single_device_arrays``.
-netCDF support is gated on the library being installed (not in this image).
+netCDF uses the netCDF4 library when installed, else an h5py fallback for
+the netCDF-4/HDF5 data model (netCDF-4 files ARE HDF5 files).
 """
 from __future__ import annotations
 
@@ -206,9 +207,11 @@ def load_netcdf(path: str, variable: str, dtype=types.float32, split=None, devic
     dtype = types.canonical_heat_type(dtype)
     if __HAS_NETCDF:
         with nc.Dataset(path, "r") as handle:
-            if variable not in handle.variables:
-                raise KeyError(f"variable {variable!r} not found in {path}")
-            arr = np.asarray(handle[variable][...], dtype=np.dtype(dtype.jax_type()))
+            try:  # __getitem__ resolves group-qualified names ('g/v') too
+                var = handle[variable]
+            except (KeyError, IndexError) as e:
+                raise KeyError(f"variable {variable!r} not found in {path}") from e
+            arr = np.asarray(var[...], dtype=np.dtype(dtype.jax_type()))
         return DNDarray(jnp.asarray(arr), dtype=dtype, split=split, device=device, comm=comm)
     if not __HAS_HDF5:
         raise ImportError("netCDF support needs netCDF4 or h5py installed")
@@ -267,24 +270,31 @@ def save_netcdf(data: DNDarray, path: str, variable: str, mode: str = "w", **kwa
     # the variable write reuses save_hdf5 — including its rank-serialized,
     # barrier-coordinated multi-host path — then process 0 attaches the
     # netCDF-4 dimension-scale structure
-    save_hdf5(data, path, variable, mode=mode)
-    if jax.process_index() == 0:
-        with h5py.File(path, "r+") as handle:
-            var = handle[variable]
-            for i, s in enumerate(data.gshape):
-                dname = f"dim_{i}_{variable}" if f"dim_{i}" in handle else f"dim_{i}"
-                scale = handle.create_dataset(dname, data=np.arange(s, dtype=np.float64))
-                scale.make_scale(dname)
-                # netCDF4's phony-dimension marker: these are dimensions,
-                # not data variables (load_netcdf refuses to load them)
-                scale.attrs["NAME"] = np.bytes_(
-                    b"This is a netCDF dimension but not a netCDF variable. %10d" % s
-                )
-                var.dims[i].attach_scale(scale)
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
+    save_hdf5(data, path, variable, mode=mode, **kwargs)
+    try:
+        if jax.process_index() == 0:
+            with h5py.File(path, "r+") as handle:
+                var = handle[variable]
+                for i, n_i in enumerate(data.gshape):
+                    dname = f"dim_{i}_{variable}" if f"dim_{i}" in handle else f"dim_{i}"
+                    # shape-only dataset: netCDF4's own phony dimensions
+                    # never materialize their fill storage either
+                    scale = handle.create_dataset(dname, shape=(n_i,), dtype=np.float32)
+                    scale.make_scale(dname)
+                    # netCDF4's phony-dimension marker: these are
+                    # dimensions, not data variables (load_netcdf refuses
+                    # to load them)
+                    scale.attrs["NAME"] = np.bytes_(
+                        b"This is a netCDF dimension but not a netCDF variable. %10d" % n_i
+                    )
+                    var.dims[i].attach_scale(scale)
+    finally:
+        # reach the barrier even if the attachment throws, or the other
+        # processes hang in it forever (same discipline as save_hdf5)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices("heat_tpu_save_netcdf")
+            multihost_utils.sync_global_devices("heat_tpu_save_netcdf")
 
 
 def load_csv(

@@ -119,3 +119,110 @@ class TestScalarBoolKey(TestCase):
             a = ht.array(x, split=split)
             np.testing.assert_array_equal(a[True, ...].numpy(), x[True, ...])
             np.testing.assert_array_equal(a[np.True_, ...].numpy(), x[np.True_, ...])
+
+
+class TestGetitemDepth(TestCase):
+    """Second wave: the reference's hairier getitem cases
+    (``test_dndarray.py`` + ``dndarray.py:652-908`` case analysis) on
+    padded non-divisible shapes."""
+
+    def setUp(self):
+        self.x = np.random.default_rng(7).normal(size=(9, 11)).astype(np.float32)
+
+    def _each(self):
+        for split in SPLITS:
+            yield split, ht.array(self.x, split=split)
+
+    def test_negative_strided_on_split_axis(self):
+        for split, a in self._each():
+            for key in [
+                (slice(None, None, -1), slice(None)),
+                (slice(7, 2, -2), slice(None)),
+                (slice(None), slice(None, None, -3)),
+                (slice(-1, None, -1), slice(-2, 1, -4)),
+            ]:
+                np.testing.assert_allclose(a[key].numpy(), self.x[key], err_msg=f"{split} {key}")
+
+    def test_newaxis_combinations(self):
+        for split, a in self._each():
+            np.testing.assert_allclose(a[None].numpy(), self.x[None])
+            np.testing.assert_allclose(a[:, None, :].numpy(), self.x[:, None, :])
+            np.testing.assert_allclose(a[..., None].numpy(), self.x[..., None])
+
+    def test_integer_array_with_slice(self):
+        idx = np.array([0, 3, 5, 8])
+        for split, a in self._each():
+            np.testing.assert_allclose(a[idx].numpy(), self.x[idx])
+            np.testing.assert_allclose(a[idx, 1:5].numpy(), self.x[idx, 1:5])
+            np.testing.assert_allclose(a[2:7, idx[:2]].numpy(), self.x[2:7, idx[:2]])
+            np.testing.assert_allclose(a[idx, idx].numpy(), self.x[idx, idx])
+
+    def test_negative_and_repeated_fancy(self):
+        idx = np.array([-1, 0, -2, 0, 3])
+        for split, a in self._each():
+            np.testing.assert_allclose(a[idx].numpy(), self.x[idx])
+
+    def test_bool_mask_variants(self):
+        m_rows = self.x[:, 0] > 0
+        m_full = self.x > 0.5
+        for split, a in self._each():
+            np.testing.assert_allclose(a[m_rows].numpy(), self.x[m_rows])
+            np.testing.assert_allclose(a[m_full].numpy(), self.x[m_full])
+            np.testing.assert_allclose(a[ht.array(m_rows)].numpy(), self.x[m_rows])
+
+    def test_scalar_row_and_metadata(self):
+        for split, a in self._each():
+            row = a[4]
+            np.testing.assert_allclose(row.numpy(), self.x[4])
+            col = a[:, 7]
+            np.testing.assert_allclose(col.numpy(), self.x[:, 7])
+            assert a[2:5].shape == (3, 11)
+
+
+class TestSetitemDepth(TestCase):
+    def setUp(self):
+        self.x = np.random.default_rng(8).normal(size=(9, 11)).astype(np.float32)
+
+    def _pair(self, split):
+        return self.x.copy(), ht.array(self.x.copy(), split=split)
+
+    def test_setitem_strided_and_negative(self):
+        for split in SPLITS:
+            w, a = self._pair(split)
+            w[::2, 1::3] = 5.0
+            a[::2, 1::3] = 5.0
+            np.testing.assert_allclose(a.numpy(), w, err_msg=f"{split}")
+            w[-2:, :] = -1.0
+            a[-2:, :] = -1.0
+            np.testing.assert_allclose(a.numpy(), w)
+
+    def test_setitem_fancy_and_bool(self):
+        idx = np.array([0, 4, 8])
+        for split in SPLITS:
+            w, a = self._pair(split)
+            w[idx] = 9.0
+            a[idx] = 9.0
+            np.testing.assert_allclose(a.numpy(), w)
+            m = w > 1.0
+            w[m] = 0.0
+            a[ht.array(m, split=split)] = 0.0
+            np.testing.assert_allclose(a.numpy(), w)
+
+    def test_setitem_broadcast_row(self):
+        v = np.arange(11, dtype=np.float32)
+        for split in SPLITS:
+            w, a = self._pair(split)
+            w[3] = v
+            a[3] = ht.array(v)
+            np.testing.assert_allclose(a.numpy(), w)
+            w[:, 2] = 4.0
+            a[:, 2] = 4.0
+            np.testing.assert_allclose(a.numpy(), w)
+
+    def test_setitem_slice_from_differently_split_value(self):
+        for split in SPLITS:
+            w, a = self._pair(split)
+            val = np.full((4, 11), 2.5, np.float32)
+            w[2:6] = val
+            a[2:6] = ht.array(val, split=0 if split != 0 else 1)
+            np.testing.assert_allclose(a.numpy(), w)

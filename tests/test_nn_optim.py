@@ -592,6 +592,31 @@ class TestTiling(TestCase):
         t00 = tiles[0, 0]
         assert t00.ndim == 2
 
+    def test_split_tiles_describe_real_layout(self):
+        """The tile metadata must agree with the ACTUAL shard layout
+        (comm.chunk / addressable shards) — tiles are views over the XLA
+        canonical layout, not free-floating bookkeeping. Swept over
+        divisible and non-divisible shapes and both split axes."""
+        comm = ht.get_comm()
+        for shape, split in [((16, 8), 0), ((9, 11), 0), ((11, 9), 1), ((7, 3), 1)]:
+            a = ht.zeros(shape, split=split)
+            tiles = ht.SplitTiles(a)
+            ends = np.asarray(tiles.tile_ends_g)
+            # tile boundaries along the split dim == chunk boundaries
+            for r in range(comm.size):
+                off, lshape, _ = comm.chunk(shape, split, rank=r)
+                assert ends[split][r] == off + lshape[split], (shape, split, r)
+            # tile ownership along the split dim maps tile r -> process r
+            locs = np.asarray(tiles.tile_locations)
+            take = [0] * len(shape)
+            for r in range(comm.size):
+                take[split] = r
+                assert locs[tuple(take)] == r
+            # trimmed physical shard matches the tile extent
+            for r, shard in enumerate(a.local_shards):
+                _, lshape, _ = comm.chunk(shape, split, rank=r)
+                assert tuple(shard.shape) == tuple(lshape)
+
     def test_unfold(self):
         x = np.arange(8, dtype=np.float32)
         a = ht.array(x, split=0)
