@@ -1,0 +1,41 @@
+"""Tall-skinny QR + matmul benchmark (BASELINE progression config 5:
+``linalg.qr + matmul on tall-skinny split=0 array``; reference protocol
+shape from the CAQR workloads ``heat/core/linalg/qr.py``)."""
+import sys
+import pathlib
+
+import numpy as np
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[2]))
+import heat_tpu as ht
+from heat_tpu.utils.profiling import Timer, force_sync
+
+
+def main(n=1 << 20, f=64, trials=5):
+    rng = np.random.default_rng(3)
+    data = rng.normal(size=(n, f)).astype(np.float32)
+    x = ht.array(data, split=0)
+
+    qr_times, mm_times = [], []
+    for _ in range(trials):
+        with Timer() as t:
+            q, r = ht.linalg.qr(x)
+            force_sync(r)
+        qr_times.append(t.elapsed)
+        with Timer() as t2:
+            g = ht.matmul(ht.linalg.transpose(x), x)  # (f, f) gram
+            force_sync(g)
+        mm_times.append(t2.elapsed)
+    tq, tm = float(np.median(qr_times)), float(np.median(mm_times))
+    qr_gflops = (2 * n * f * f) / 1e9
+    mm_gflops = (2 * n * f * f) / 1e9
+    print(f"tsqr   (n={n}, f={f}): median {tq:.4f}s ({qr_gflops / tq:.1f} GFLOP/s)")
+    print(f"matmul gram          : median {tm:.4f}s ({mm_gflops / tm:.1f} GFLOP/s)")
+    # residual sanity on a subsample
+    err = float(ht.linalg.norm(ht.matmul(q, r) - x).item()) / float(ht.linalg.norm(x).item())
+    print(f"relative residual |QR - X|/|X|: {err:.2e}")
+    assert err < 1e-2
+
+
+if __name__ == "__main__":
+    main(n=1 << 16, trials=2) if "--small" in sys.argv else main()

@@ -260,7 +260,7 @@ def save_netcdf(data: DNDarray, path: str, variable: str, mode: str = "w", **kwa
                 name = f"dim_{i}"
                 handle.createDimension(name, s)
                 dims.append(name)
-            var = handle.createVariable(variable, arr.dtype, tuple(dims))
+            var = handle.createVariable(variable, arr.dtype, tuple(dims), **kwargs)
             var[...] = arr
         return
     if not __HAS_HDF5:
@@ -271,6 +271,7 @@ def save_netcdf(data: DNDarray, path: str, variable: str, mode: str = "w", **kwa
     # barrier-coordinated multi-host path — then process 0 attaches the
     # netCDF-4 dimension-scale structure
     save_hdf5(data, path, variable, mode=mode, **kwargs)
+    err = None
     try:
         if jax.process_index() == 0:
             with h5py.File(path, "r+") as handle:
@@ -288,13 +289,23 @@ def save_netcdf(data: DNDarray, path: str, variable: str, mode: str = "w", **kwa
                         b"This is a netCDF dimension but not a netCDF variable. %10d" % n_i
                     )
                     var.dims[i].attach_scale(scale)
-    finally:
-        # reach the barrier even if the attachment throws, or the other
-        # processes hang in it forever (same discipline as save_hdf5)
-        if jax.process_count() > 1:
-            from jax.experimental import multihost_utils
+    except Exception as e:  # noqa: BLE001 - re-raised after the barrier
+        err = e
+    if jax.process_count() > 1:
+        # reach the barrier even on failure, then fail ALL processes
+        # together — the full save_hdf5 discipline, not just the hang fix
+        from jax.experimental import multihost_utils
 
-            multihost_utils.sync_global_devices("heat_tpu_save_netcdf")
+        multihost_utils.sync_global_devices("heat_tpu_save_netcdf")
+        statuses = np.asarray(
+            multihost_utils.process_allgather(np.asarray([0 if err is None else 1]))
+        ).ravel()
+        if err is None and statuses.any():
+            raise RuntimeError(
+                f"save_netcdf failed on process(es) {np.nonzero(statuses)[0].tolist()}"
+            )
+    if err is not None:
+        raise err
 
 
 def load_csv(

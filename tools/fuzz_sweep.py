@@ -450,6 +450,50 @@ def t_random():
     np.testing.assert_array_equal(a1, ht.random.rand(16).numpy())
 check("random/moments+state", t_random)
 
+# wave 4: distributed sort / percentile methods / netcdf round-trip
+def t_dsort_wave():
+    rng2 = np.random.default_rng(123)
+    for n in (17, 40, 63):
+        x = rng2.normal(size=n).astype(np.float32)
+        x[:: max(n // 7, 1)] = 0.5  # ties
+        for desc in (False, True):
+            v, i = ht.sort(ht.array(x, split=0), descending=desc)
+            import jax.numpy as jnp
+            ref_i = np.asarray(jnp.argsort(x, descending=desc, stable=True))
+            np.testing.assert_array_equal(v.numpy(), np.take_along_axis(x, ref_i, 0))
+            np.testing.assert_array_equal(i.numpy(), ref_i)
+check("dsort/values+indices", t_dsort_wave)
+
+def t_percentile_methods():
+    x = np.random.default_rng(7).normal(size=45).astype(np.float64)
+    a = ht.array(x, split=0)
+    for q in (12.5, [5.0, 50.0, 95.0]):
+        for m in ("linear", "lower", "higher", "midpoint", "nearest"):
+            np.testing.assert_allclose(
+                ht.percentile(a, q, interpolation=m).numpy(),
+                np.percentile(x, q, method=m),
+                rtol=1e-10,
+            )
+check("stat/percentile-methods", t_percentile_methods)
+
+def t_netcdf_roundtrip():
+    import os, tempfile
+    x = ht.random.randn(9, 4, split=0)
+    with tempfile.TemporaryDirectory() as d:
+        pth = os.path.join(d, "f.nc")
+        ht.save_netcdf(x, pth, "v")
+        np.testing.assert_allclose(
+            ht.load_netcdf(pth, "v", split=1).numpy(), x.numpy(), rtol=1e-6
+        )
+check("io/netcdf-roundtrip", t_netcdf_roundtrip)
+
+def t_redistribute_wave():
+    x = np.arange(28, dtype=np.float32).reshape(7, 4)
+    a = ht.array(x, split=0)
+    a.redistribute_(target_map=a.comm.lshape_map((7, 4), 1))
+    np.testing.assert_array_equal(a.numpy(), x)
+check("dndarray/redistribute-canonical", t_redistribute_wave)
+
 # DNDarray protocol methods
 def t_proto():
     x = ht.arange(12, dtype=ht.float32, split=0).reshape((3, 4))
