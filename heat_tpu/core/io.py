@@ -174,7 +174,9 @@ def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs
                         dset = handle[dataset]
                         for slices, chunk in local:
                             dset[slices] = chunk
-            except Exception as e:  # noqa: BLE001 - re-raised below
+            except BaseException as e:  # noqa: BLE001 - re-raised below;
+                # even KeyboardInterrupt must reach the barrier first or
+                # every other process hangs in sync forever
                 err = e
             multihost_utils.sync_global_devices(f"heat_tpu_save_hdf5_{p}")
         statuses = np.asarray(
@@ -289,7 +291,7 @@ def save_netcdf(data: DNDarray, path: str, variable: str, mode: str = "w", **kwa
                         b"This is a netCDF dimension but not a netCDF variable. %10d" % n_i
                     )
                     var.dims[i].attach_scale(scale)
-    except Exception as e:  # noqa: BLE001 - re-raised after the barrier
+    except BaseException as e:  # noqa: BLE001 - re-raised after the barrier
         err = e
     if jax.process_count() > 1:
         # reach the barrier even on failure, then fail ALL processes
