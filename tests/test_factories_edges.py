@@ -54,5 +54,60 @@ class TestFactoryEdges(TestCase):
         np.testing.assert_allclose(np.sin(x), np.sin(np.arange(6)), rtol=1e-6)
 
 
+
+
+class TestFactoryDtypeRules(TestCase):
+    """Reference dtype-inference rules (``factories.py:40-150``) across
+    splits, incl. the non-divisible padded layouts."""
+
+    def test_arange_dtype_inference(self):
+        # int args -> int; any float arg -> float (reference/torch rule)
+        assert ht.arange(10).dtype in (ht.int32, ht.int64)
+        assert ht.arange(10.0).dtype == ht.float32
+        assert ht.arange(0, 10, 0.5).dtype == ht.float32
+        np.testing.assert_allclose(
+            ht.arange(0, 10, 0.5, split=0).numpy(), np.arange(0, 10, 0.5), rtol=1e-6
+        )
+
+    def test_eye_shapes_and_split(self):
+        for args in [(5,), ((5, 9),), ((9, 5),)]:
+            want = np.eye(*args) if isinstance(args[0], int) else np.eye(*args[0])
+            for split in (None, 0, 1):
+                got = ht.eye(*args, split=split)
+                assert got.split == split
+                np.testing.assert_array_equal(got.numpy(), want)
+
+    def test_meshgrid_split(self):
+        a, b = np.arange(5, dtype=np.float32), np.arange(7, dtype=np.float32)
+        ga, gb = ht.meshgrid(ht.array(a, split=0), ht.array(b))
+        na, nb = np.meshgrid(a, b)
+        np.testing.assert_array_equal(ga.numpy(), na)
+        np.testing.assert_array_equal(gb.numpy(), nb)
+
+    def test_full_and_empty_padded(self):
+        f = ht.full((9, 5), 3.25, split=0)
+        assert not f.larray.sharding.is_fully_replicated or f.comm.size == 1
+        np.testing.assert_array_equal(f.numpy(), np.full((9, 5), 3.25, np.float32))
+        e = ht.empty((9, 5), split=1)
+        assert e.shape == (9, 5) and e.split == 1
+
+    def test_linspace_num_and_dtype(self):
+        for num in (1, 2, 7, 50):
+            np.testing.assert_allclose(
+                ht.linspace(-3, 3, num, split=0).numpy(),
+                np.linspace(-3, 3, num, dtype=np.float32),
+                rtol=1e-6,
+            )
+
+    def test_zeros_ones_like_preserve_split(self):
+        a = ht.array(np.ones((9, 4), np.float32), split=0)
+        z = ht.zeros_like(a)
+        o = ht.ones_like(a)
+        assert z.split == 0 and o.split == 0
+        assert z.dtype == a.dtype
+        np.testing.assert_array_equal(z.numpy(), np.zeros((9, 4)))
+        np.testing.assert_array_equal(o.numpy(), np.ones((9, 4)))
+
+
 if __name__ == "__main__":
     unittest.main()
