@@ -156,12 +156,12 @@ def lstsq(a: DNDarray, b: DNDarray, rcond: Optional[float] = None) -> DNDarray:
             ftype = jnp.promote_types(a.larray.dtype, jnp.float32)
             eps_cut = float(jnp.finfo(ftype).eps) * max(m, n)
             Q, R = qr(a)
-            diag = jnp.abs(jnp.diagonal(R.larray))
+            diag = jnp.abs(jnp.diagonal(R._logical()))
             if float(jnp.min(diag)) > eps_cut * float(jnp.max(diag)):
                 # well-conditioned: qᴴ b is replicated after the psum,
                 # R is a k x k replicated triangular solve
                 qhb = complex_math.conj(Q).T @ b
-                x = jax.scipy.linalg.solve_triangular(R.larray, qhb.larray, lower=False)
+                x = jax.scipy.linalg.solve_triangular(R._logical(), qhb._logical(), lower=False)
                 return DNDarray(x, split=None, device=a.device, comm=a.comm)
             # rank-deficient: match numpy's min-norm solution via the SVD
         p = pinv(a, rcond=rcond)
@@ -184,10 +184,14 @@ def pinv(a: DNDarray, rcond: Optional[float] = None) -> DNDarray:
     if rcond is None:
         ftype = jnp.promote_types(a.larray.dtype, jnp.float32)
         rcond = float(jnp.finfo(ftype).eps) * max(a.gshape)
-    cutoff = rcond * jnp.max(s.larray)
-    s_inv = jnp.where(s.larray > cutoff, 1.0 / s.larray, 0.0)
+    # logical views throughout: Vh inherits split=1 from a split-1 operand
+    # and its BUFFER carries column padding that must not leak into the
+    # result's extent (caught at world size 5 with n=64 -> padded 65)
+    sl = s._logical()
+    cutoff = rcond * jnp.max(sl)
+    s_inv = jnp.where(sl > cutoff, 1.0 / sl, 0.0)
     with jax.default_matmul_precision("highest"):
-        result = (Vh.larray.conj().T * s_inv[None, :]) @ U._logical().conj().T
+        result = (Vh._logical().conj().T * s_inv[None, :]) @ U._logical().conj().T
     return DNDarray(result, split=None, device=a.device, comm=a.comm)
 
 

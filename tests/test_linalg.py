@@ -139,6 +139,26 @@ class TestQR(TestCase):
 
 
 class TestSVD(TestCase):
+    def test_pinv_lstsq_padded_extents(self):
+        """pinv/lstsq on non-divisible split dims must return LOGICAL
+        extents (regression: Vh's padded buffer leaked a 65-column result
+        from a (6, 64) split=1 operand at world size 5)."""
+        rng = np.random.default_rng(11)
+        p = ht.get_comm().size
+        n = 8 * p + 1  # never divisible
+        A = rng.normal(size=(6, n)).astype(np.float32)
+        P = ht.linalg.pinv(ht.array(A, split=1))
+        assert P.shape == (n, 6), P.shape
+        np.testing.assert_allclose((A @ P.numpy() @ A), A, rtol=1e-2, atol=1e-3)
+        At = ht.array(A.T.copy(), split=0)  # (n, 6) padded rows
+        Pt = ht.linalg.pinv(At)
+        assert Pt.shape == (6, n)
+        b = rng.normal(size=(n, 1)).astype(np.float32)
+        x = ht.linalg.lstsq(At, ht.array(b, split=0))
+        assert x.shape == (6, 1)
+        ref = np.linalg.lstsq(A.T, b, rcond=None)[0]
+        np.testing.assert_allclose(x.numpy(), ref, rtol=1e-2, atol=1e-3)
+
     def test_tall_skinny(self):
         rng = np.random.default_rng(12)
         x = rng.random((64, 6)).astype(np.float32)

@@ -634,7 +634,7 @@ class TestDataToolRegressions(TestCase):
         dl = ht.utils.data.DataLoader(ds, batch_size=4)
         list(dl)
         list(dl)  # second epoch would shuffle if the flag were ignored
-        np.testing.assert_array_equal(np.asarray(ds.arrays[0].larray), X)
+        np.testing.assert_array_equal(ds.arrays[0].numpy(), X)
 
     def test_partial_dataset_producer_error_propagates(self):
         import os
