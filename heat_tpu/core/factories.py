@@ -169,12 +169,18 @@ def empty(shape, dtype=types.float32, split=None, device=None, comm=None, order=
 
 
 def full(shape, fill_value, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
-    """reference ``factories.py:789``"""
+    """reference ``factories.py:789``: dtype defaults to float32 — it is
+    never inferred from the fill value — except complex fills, which
+    default to complex64 (``factories.py:840-841``). Unlike the reference,
+    an explicitly passed dtype always wins (the reference's unconditional
+    complex override silently halves an explicit complex128)."""
     shape = sanitize_shape(shape)
     if dtype is None:
-        dtype = types.heat_type_of(fill_value)
-        if dtype == types.int64 and isinstance(fill_value, int):
-            dtype = types.float32 if isinstance(fill_value, bool) else dtype
+        dtype = (
+            types.complex64
+            if isinstance(fill_value, (complex, np.complexfloating))
+            else types.float32
+        )
     dtype = types.canonical_heat_type(dtype)
     comm = sanitize_comm(comm)
     split = sanitize_axis(shape, split)
@@ -207,8 +213,10 @@ def empty_like(a, dtype=None, split=None, device=None, comm=None, order="C") -> 
 
 
 def full_like(a, fill_value, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
-    shape, dtype_, split_, device_, comm_ = _like_meta(a, dtype, split, device, comm)
-    return full(shape, fill_value, dtype=dtype if dtype is not None else None, split=split_, device=device_, comm=comm_)
+    # dtype deliberately does NOT inherit a.dtype: the reference's full_like
+    # defaults to float32 (``factories.py:846-849``), via full()'s own default
+    shape, _, split_, device_, comm_ = _like_meta(a, dtype, split, device, comm)
+    return full(shape, fill_value, dtype=dtype, split=split_, device=device_, comm=comm_)
 
 
 def arange(*args, dtype=None, split=None, device=None, comm=None) -> DNDarray:

@@ -333,7 +333,15 @@ def _sorted_percentile(x: DNDarray, q_arr: jnp.ndarray, axis_s, method: str, kd:
     n = arr.shape[ax]
     ct = jnp.float64 if arr.dtype == jnp.float64 else jnp.float32
     q = q_arr.astype(ct)
-    pos = q / 100.0 * (n - 1)
+    # numpy's virtual-index arithmetic, exactly: q/100 is a float64 true
+    # division, THEN cast to the array's inexact dtype (ints promote to
+    # f64), then multiplied by (n-1) in that dtype. Evaluating q/100*(n-1)
+    # all in f32 hit XLA's reciprocal rewrite (30/100*90 -> 26.999998,
+    # selecting flat[26] where numpy takes flat[27], ADVICE r2); evaluating
+    # it all in f64 diverges the other way for f32 arrays (numpy's f32 cast
+    # makes 0.3 round UP, so 'higher' at q=30, n=91 takes flat[28]).
+    idx_t = ct if jnp.issubdtype(arr.dtype, jnp.floating) else jnp.float64
+    pos = (q_arr.astype(jnp.float64) / 100.0).astype(idx_t) * (n - 1)
     lo_i = jnp.clip(jnp.floor(pos).astype(jnp.int64), 0, n - 1)
     hi_i = jnp.clip(jnp.ceil(pos).astype(jnp.int64), 0, n - 1)
     take = lambda i: jnp.take(arr, i, axis=ax).astype(ct)
@@ -348,7 +356,9 @@ def _sorted_percentile(x: DNDarray, q_arr: jnp.ndarray, axis_s, method: str, kd:
         if method == "midpoint":
             res = (vlo + vhi) / 2
         else:  # linear
-            w = pos - jnp.floor(pos)
+            # gamma in the index dtype, cast to ct for the lerp (numpy casts
+            # gamma to the array dtype before _lerp)
+            w = (pos - jnp.floor(pos)).astype(ct)
             w = w.reshape((1,) * ax + q.shape + (1,) * (arr.ndim - 1 - ax))
             res = vlo + w * (vhi - vlo)
     # numpy layout: q-dims lead the reduced shape

@@ -306,7 +306,9 @@ def heat_type_of(obj) -> Type[datatype]:
     if isinstance(obj, (builtins.bool, np.bool_)):
         return bool
     if isinstance(obj, (builtins.int, np.integer)):
-        return int64 if np.dtype("int64") == np.result_type(obj) else int32
+        # type-based like the reference (``types.py:489``: builtins.int ->
+        # int32), independent of np.result_type's platform default
+        return int32
     if isinstance(obj, (builtins.float, np.floating)):
         return float32
     if isinstance(obj, (builtins.complex, np.complexfloating)):

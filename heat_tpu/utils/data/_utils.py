@@ -184,6 +184,11 @@ def merge_shards_to_hdf5(
                     f"shard {path} label dtype {labels.dtype} != {lab_ds.dtype}"
                 )
             n = images.shape[0]
+            if labels is not None and labels.shape[0] != n:
+                raise ValueError(
+                    f"shard {path} has {labels.shape[0]} labels for {n} images; "
+                    "a short shard would misalign every subsequent label row"
+                )
             img_ds.resize(total + n, axis=0)
             img_ds[total : total + n] = images
             if labels is not None:

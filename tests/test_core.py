@@ -200,6 +200,25 @@ class TestTypes(TestCase):
         # table (types.py:729-734): the VALUE never matters
         assert not ht.can_cast(5, ht.uint8)  # int32 -> uint8 unsafe
         assert ht.can_cast(1, ht.float64)
+        # Python int resolves to int32 (reference types.py:489), never the
+        # platform's int64 — so int->float32 is an intuitive cast
+        assert ht.heat_type_of(5) == ht.int32
+        assert ht.can_cast(1, ht.float32)
+        assert ht.can_cast(1, ht.int32, casting="no")
+
+    def test_full_dtype_never_inferred_from_fill(self):
+        # reference factories.py:789: dtype defaults to float32 regardless of
+        # the fill value (complex fills force complex64); inference from the
+        # fill would wrap 2**35 to garbage under an int32 Python-int mapping
+        assert ht.full((2,), 5).dtype == ht.float32
+        f = ht.full((2,), 2**35)
+        assert f.dtype == ht.float32
+        np.testing.assert_allclose(f.numpy(), np.float32(2**35))
+        assert ht.full((2,), 1 + 2j).dtype == ht.complex64
+        assert ht.full((2,), np.complex64(1 + 2j)).dtype == ht.complex64
+        assert ht.full((2,), 1 + 2j, dtype=ht.complex128).dtype == ht.complex128
+        assert ht.full((2,), 5, dtype=ht.int64).dtype == ht.int64
+        assert ht.full_like(ht.zeros((2, 2), dtype=ht.int32), 9).dtype == ht.float32
         assert not ht.can_cast(2.0e200, "u1")
         assert ht.can_cast(2 + 3j, ht.complex64)
         assert not ht.can_cast(2 + 3j, ht.float64)

@@ -489,6 +489,16 @@ class TestDataPrepUtils(TestCase):
             np.save(badp, rng.integers(0, 255, size=(2, 5, 5, 3)).astype(np.uint8))
             with pytest.raises(ValueError):
                 merge_shards_to_hdf5(files + [badp], os.path.join(d, "m2.h5"))
+            # label/image row-count mismatch inside a shard rejected (would
+            # misalign every subsequent label row)
+            shortp = os.path.join(d, "short.npz")
+            np.savez(
+                shortp,
+                images=rng.integers(0, 255, size=(4, 4, 4, 3)).astype(np.uint8),
+                labels=rng.integers(0, 5, size=3).astype(np.int64),
+            )
+            with pytest.raises(ValueError, match="labels for"):
+                merge_shards_to_hdf5(files + [shortp], os.path.join(d, "m3.h5"))
 
     def test_image_bytes_roundtrip(self):
         from heat_tpu.utils.data import decode_image_bytes, encode_image_bytes

@@ -57,6 +57,28 @@ class TestStatistics(TestCase):
                 ht.percentile(a, 30.0), np.percentile(x, 30.0).astype(np.float32), rtol=1e-4
             )
 
+    def test_percentile_index_precision(self):
+        # q/100*(n-1) evaluated in float32 gives 26.999998 for q=30, n=91,
+        # so 'lower'/'higher'/'nearest' picked flat[26] instead of flat[27];
+        # the virtual index must be computed in float64 (ADVICE r2)
+        x = np.sort(np.random.default_rng(7).random(91).astype(np.float32))
+        for split in (None, 0):
+            a = ht.array(x, split=split)
+            for method in ("lower", "higher", "nearest", "midpoint", "linear"):
+                self.assert_array_equal(
+                    ht.percentile(a, 30.0, interpolation=method),
+                    np.percentile(x, 30.0, method=method).astype(np.float32),
+                    rtol=1e-6,
+                )
+        # exact-index case across a sweep of (q, n) that are f32-hazardous
+        for n, q in ((91, 30.0), (11, 10.0), (21, 5.0), (1001, 30.0)):
+            y = np.arange(n, dtype=np.float32)
+            a = ht.array(y, split=0)
+            for method in ("lower", "higher", "nearest"):
+                assert float(ht.percentile(a, q, interpolation=method).item()) == float(
+                    np.percentile(y, q, method=method)
+                ), (n, q, method)
+
     def test_skew_kurtosis(self):
         from scipy import stats
 
