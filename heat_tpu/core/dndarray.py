@@ -255,11 +255,19 @@ class DNDarray:
             return self.__gshape
         counts, displs = self.counts_displs()
         pid = jax.process_index()
-        mine = [
-            i
-            for i, d in enumerate(self.__comm.mesh.devices.ravel())
-            if d.process_index == pid
-        ]
+        # Index devices by their coordinate along the mesh's SPLIT axis only
+        # (_split_ranks): on a multi-axis mesh (e.g. DASO's (slow, split))
+        # the raveled device order must not index counts/displs (length =
+        # split extent). A process owning devices at several slow positions
+        # sees the union of their split ranges (the slow axis replicates a
+        # split-sharded array).
+        mine = sorted(
+            {
+                r
+                for r, d in comm_module._split_ranks(self.__comm)
+                if d.process_index == pid
+            }
+        )
         if not mine:  # pragma: no cover - defensive
             mine = list(range(len(counts)))
         lo = displs[mine[0]]

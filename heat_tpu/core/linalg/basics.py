@@ -195,11 +195,35 @@ def vecdot(x1: DNDarray, x2: DNDarray, axis: Optional[int] = None, keepdim=None,
 
 def outer(a: DNDarray, b: DNDarray, out: Optional[DNDarray] = None, split: Optional[int] = None) -> DNDarray:
     """Outer product (reference ``basics.py:1372`` used a ring Send/Recv of
-    shards; a sharded broadcast-multiply under GSPMD here)."""
-    result = jnp.outer(a._logical(), b._logical())
+    shards to bound per-device temps).
+
+    One jitted sharded program here: with the output row-split, GSPMD
+    gathers only the second operand (O(m) per device) while each device
+    writes its own O(nm/P) output shard — the same bound as the
+    reference's ring, asserted in ``tests/test_distribution_proofs.py``."""
     if split is None:
         split = 0 if (a.split is not None or b.split is not None) else None
-    res = DNDarray(result, split=split, device=a.device, comm=a.comm)
+    if split is None:
+        result = jnp.outer(a._logical(), b._logical())
+        res = DNDarray(result, split=None, device=a.device, comm=a.comm)
+    else:
+        from .._movement import outer_padded
+
+        jt = types.promote_types(a.dtype, b.dtype).jax_type()
+        buf, out_shape = outer_padded(
+            a.larray.astype(jt),
+            a.gshape,
+            a.split,
+            b.larray.astype(jt),
+            b.gshape,
+            b.split,
+            split,
+            a.comm,
+        )
+        res = DNDarray._from_buffer(
+            buf, out_shape, types.canonical_heat_type(buf.dtype), split,
+            device=a.device, comm=a.comm,
+        )
     if out is not None:
         from .._operations import _write_out
 

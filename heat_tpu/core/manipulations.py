@@ -64,6 +64,27 @@ __all__ = [
 ]
 
 
+def _merge_unique_across_processes(merged: np.ndarray, axis: Optional[int]) -> np.ndarray:
+    """Allgather the per-process candidate sets (ragged along the unique
+    axis: sizes exchanged first, payloads padded to the max) and re-unique
+    — the reference's Allgatherv + final unique (``manipulations.py:3055``)."""
+    from jax.experimental import multihost_utils
+
+    ax = 0 if axis is None else axis
+    counts = np.asarray(
+        multihost_utils.process_allgather(np.asarray([merged.shape[ax]], np.int64))
+    ).reshape(-1)
+    cap = int(counts.max()) if counts.size else 0
+    pad = [(0, 0)] * merged.ndim
+    pad[ax] = (0, cap - merged.shape[ax])
+    gathered = np.asarray(multihost_utils.process_allgather(np.pad(merged, pad)))
+    parts = [
+        np.take(gathered[i], np.arange(int(counts[i])), axis=ax)
+        for i in range(gathered.shape[0])
+    ]
+    return np.unique(np.concatenate(parts, axis=ax), axis=axis)
+
+
 def _wrap(result: jnp.ndarray, like: DNDarray, split: Optional[int]) -> DNDarray:
     return DNDarray(
         result,
@@ -138,8 +159,31 @@ def concatenate(arrays: Sequence[DNDarray], axis: int = 0) -> DNDarray:
     for a in arrays[1:]:
         promoted = types.promote_types(promoted, a.dtype)
     jt = promoted.jax_type()
-    result = jnp.concatenate([a._logical().astype(jt) for a in arrays], axis=axis)
-    return _wrap(result, arrays[0], out_split)
+    out_shape = list(first)
+    out_shape[axis] = sum(a.shape[axis] for a in arrays)
+    if out_split is None:
+        result = jnp.concatenate([a._logical().astype(jt) for a in arrays], axis=axis)
+        return _wrap(result, arrays[0], None)
+    # distributed: one jitted program over the physical buffers; GSPMD
+    # emits the all-to-all exchange directly (the reference's split-case
+    # redistribution, manipulations.py:188) — proven bounded in
+    # tests/test_distribution_proofs.py
+    from ._movement import concatenate_padded
+
+    comm = arrays[0].comm
+    buf = concatenate_padded(
+        [a.larray for a in arrays],
+        [a.gshape for a in arrays],
+        [a.split for a in arrays],
+        axis,
+        tuple(out_shape),
+        out_split,
+        jt,
+        comm,
+    )
+    return DNDarray._from_buffer(
+        buf, tuple(out_shape), promoted, out_split, device=arrays[0].device, comm=comm
+    )
 
 
 def diag(a: DNDarray, offset: int = 0) -> DNDarray:
@@ -184,9 +228,10 @@ def expand_dims(a: DNDarray, axis: int) -> DNDarray:
 
 
 def flatten(a: DNDarray) -> DNDarray:
-    """Flatten to 1-D (reference ``manipulations.py``); result split 0."""
-    result = jnp.ravel(a._logical())
-    return _wrap(result, a, 0 if a.split is not None else None)
+    """Flatten to 1-D (reference ``manipulations.py``); result split 0.
+    Routes through the jitted reshape pipeline (bounded per-device memory,
+    see :mod:`heat_tpu.core._movement`)."""
+    return reshape(a, (a.size,))
 
 
 def flip(a: DNDarray, axis=None) -> DNDarray:
@@ -302,8 +347,18 @@ def reshape(a: DNDarray, *shape, new_split: Optional[int] = None, **kwargs) -> D
     if new_split is None:
         new_split = a.split if a.split is not None and a.split < len(shape) else (0 if a.split is not None else None)
     new_split = sanitize_axis(shape, new_split)
-    result = jnp.reshape(a._logical(), shape)
-    return _wrap(result, a, new_split)
+    if a.split is None and new_split is None:
+        return _wrap(jnp.reshape(a._logical(), shape), a, None)
+    # distributed: one jitted program (unpad -> reshape -> repad) with
+    # pinned in/out shardings — GSPMD emits the bounded collective-permute
+    # exchange (the reference's Alltoallv, manipulations.py:1821); proven
+    # in tests/test_distribution_proofs.py
+    from ._movement import reshape_padded
+
+    buf = reshape_padded(a.larray, a.gshape, a.split, shape, new_split, a.comm)
+    return DNDarray._from_buffer(
+        buf, tuple(shape), a.dtype, new_split, device=a.device, comm=a.comm
+    )
 
 
 def resplit(arr: DNDarray, axis: Optional[int] = None) -> DNDarray:
@@ -442,18 +497,35 @@ def tile(x: DNDarray, reps) -> DNDarray:
 
 
 def topk(a: DNDarray, k: int, dim: int = -1, largest: bool = True, sorted: bool = True, out=None):
-    """Top-k values and indices (reference ``manipulations.py:3834`` with a
-    custom MPI merge op; ``lax.top_k`` + XLA collectives here)."""
+    """Top-k values and indices (reference ``manipulations.py:3834``).
+
+    Along the split axis of a multi-device array this runs the
+    O(P*k)-traffic shard_map kernel (:mod:`heat_tpu.parallel.dtopk`) —
+    the reference's custom ``mpi_topk`` reduction — instead of
+    ``lax.top_k`` on the logical view, which GSPMD compiles to a full
+    all-gather. The reduced result is re-split like the reference's
+    ``factories.array(gres, split=a.split)``."""
     dim = sanitize_axis(a.shape, dim)
-    arr = a._logical()
-    moved = jnp.moveaxis(arr, dim, -1)
-    if largest:
-        values, indices = jax.lax.top_k(moved, k)
+    if k > a.shape[dim]:
+        raise ValueError(
+            f"selected index k={k} out of range for dimension of size {a.shape[dim]}"
+        )
+    if dim == a.split and a.comm.size > 1:
+        from ..parallel.dtopk import distributed_topk
+
+        values, indices = distributed_topk(
+            a.larray, a.gshape, dim, k, a.comm, largest=largest
+        )
     else:
-        values, indices = jax.lax.top_k(-moved, k)
-        values = -values
-    values = jnp.moveaxis(values, -1, dim)
-    indices = jnp.moveaxis(indices, -1, dim)
+        arr = a._logical()
+        moved = jnp.moveaxis(arr, dim, -1)
+        if largest:
+            values, indices = jax.lax.top_k(moved, k)
+        else:
+            values, indices = jax.lax.top_k(-moved, k)
+            values = -values
+        values = jnp.moveaxis(values, -1, dim)
+        indices = jnp.moveaxis(indices, -1, dim)
     split = a.split
     res_v = _wrap(values, a, split)
     res_i = DNDarray(indices.astype(jnp.int64), dtype=types.int64, split=split, device=a.device, comm=a.comm)
@@ -486,15 +558,53 @@ def unfold(a: DNDarray, axis: int, size: int, step: int = 1) -> DNDarray:
 
 
 def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False, axis: Optional[int] = None):
-    """Unique elements (reference ``manipulations.py:3055`` — local unique +
-    gather + re-unique; a single global jnp.unique here, eager-only since the
-    result shape is data-dependent)."""
+    """Unique elements (reference ``manipulations.py:3055``: local
+    ``torch.unique`` per rank, Allgatherv of the *deduplicated candidates*,
+    then a final re-unique — never a gather of the raw data).
+
+    Same shape here: each device's trimmed shard is deduplicated on-device
+    (eager — the result size is data-dependent, so this family cannot
+    jit), only the per-shard candidate sets travel to the host for the
+    final merge, and the inverse map is recovered with a replicated
+    ``searchsorted`` against the merged table instead of gathering the
+    input. Per-device temp stays O(shard); host temp is the candidate
+    union (worst case O(n), exactly the reference's Allgatherv bound)."""
     if axis is not None:
         axis = sanitize_axis(a.shape, axis)
-    if return_inverse:
-        vals, inverse = jnp.unique(a._logical(), return_inverse=True, axis=axis)
+    distributed = a.split is not None and a.comm.size > 1
+    flat_case = axis is None
+    rows_case = axis is not None and axis == a.split
+    local_first = distributed and (flat_case or (rows_case and not return_inverse))
+    if local_first:
+        cands = []
+        for shard in a.local_shards:
+            if shard.size == 0:
+                continue
+            local = jnp.unique(shard, axis=axis)
+            cands.append(np.asarray(local))
+        if cands:
+            merged = np.unique(np.concatenate(cands, axis=0 if flat_case else axis), axis=axis)
+        else:
+            eshape = (0,) if flat_case else tuple(
+                0 if d == axis else s for d, s in enumerate(a.shape)
+            )
+            merged = np.empty(eshape, dtype=np.dtype(a.larray.dtype))
+        if jax.process_count() > 1:
+            # exchange only the deduplicated candidate sets across hosts
+            # (the reference's Allgatherv of local uniques) — local_shards
+            # covers this process's devices only, and every process must
+            # agree on the result
+            merged = _merge_unique_across_processes(merged, axis if not flat_case else None)
+        vals = jnp.asarray(merged)
+        if return_inverse:
+            # merged is sorted: positions via searchsorted against the
+            # replicated table — O(U + shard) per device, no gather
+            inverse = jnp.searchsorted(vals, a._logical().ravel()).reshape(a.shape)
     else:
-        vals = jnp.unique(a._logical(), axis=axis)
+        if return_inverse:
+            vals, inverse = jnp.unique(a._logical(), return_inverse=True, axis=axis)
+        else:
+            vals = jnp.unique(a._logical(), axis=axis)
     split = 0 if a.split is not None else None
     res = DNDarray(vals, dtype=a.dtype, split=split, device=a.device, comm=a.comm)
     if return_inverse:

@@ -125,16 +125,23 @@ def distributed_sort(
     p = mesh.shape[SPLIT_AXIS]
     c = buf.shape[axis] // p
     idx_t = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
-    spec = P(*[SPLIT_AXIS if d == axis else None for d in range(buf.ndim)])
-    kernel = partial(
-        _transposition_kernel,
-        axis=axis,
-        axis_name=SPLIT_AXIS,
-        p=p,
-        c=c,
-        n=gshape[axis],
-        descending=descending,
-        idx_t=idx_t,
-    )
-    prog = shard_map(kernel, mesh=mesh, in_specs=spec, out_specs=(spec, spec))
-    return jax.jit(prog)(buf)
+    key = (tuple(buf.shape), str(buf.dtype), axis, gshape[axis], descending, mesh)
+    fn = _JIT_CACHE.get(key)
+    if fn is None:
+        spec = P(*[SPLIT_AXIS if d == axis else None for d in range(buf.ndim)])
+        kernel = partial(
+            _transposition_kernel,
+            axis=axis,
+            axis_name=SPLIT_AXIS,
+            p=p,
+            c=c,
+            n=gshape[axis],
+            descending=descending,
+            idx_t=idx_t,
+        )
+        prog = shard_map(kernel, mesh=mesh, in_specs=spec, out_specs=(spec, spec))
+        fn = _JIT_CACHE[key] = jax.jit(prog)
+    return fn(buf)
+
+
+_JIT_CACHE: dict = {}

@@ -1,0 +1,206 @@
+"""Bounded-memory flat redistribution — the TPU-native Alltoallv.
+
+A row-major reshape of a split-0 array is, in flat element order, a
+*contiguous-range redistribution*: input device r owns the flat range
+``[A_r, A_r + L_r)`` (its valid rows), output device d needs
+``[B_d, B_d + M_d)``. The reference moves exactly these ranges with one
+``Alltoallv`` (``/root/reference/heat/core/manipulations.py:1821``);
+XLA's v-collective-free SPMD model instead gets a static schedule:
+
+1. Trace time: intersect the input/output interval partitions. Each
+   nonempty intersection is an edge ``(src, dst, offsets, length)``; the
+   overlap graph of two interval partitions has max degree
+   ``ceil(max_block/min_block) + 1``, so a greedy bipartite edge coloring
+   yields that many *matchings* (Koenig's theorem bounds the optimum by
+   the degree).
+2. Run time (shard_map): self-edges are local slices; each color becomes
+   one ``lax.ppermute`` round moving a fixed-size piece (the round's
+   longest edge), masked into place with a ``dynamic_update_slice`` +
+   validity window.
+
+Per-device memory: input block + output block + one piece — O(n/P).
+Traffic: each element crosses the ICI exactly once, like Alltoallv.
+Rounds: 2-3 for realistic reshapes (blocks within 2x of each other).
+
+Used by :func:`heat_tpu.core._movement.reshape_padded` for the shapes
+where GSPMD's own reshape partitioner falls back to an all-gather
+(non-factorizable sharded dims); proven bounded in
+``tests/test_distribution_proofs.py``.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax, shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..core.communication import SPLIT_AXIS, MeshCommunication
+
+__all__ = ["flat_schedule", "reshape_flatmove_executable", "reshape_via_flatmove"]
+
+
+class Edge(NamedTuple):
+    src: int
+    dst: int
+    src_off: int  # offset inside the source's local flat block
+    dst_off: int  # offset inside the destination's local flat block
+    length: int
+
+
+def flat_schedule(
+    in_counts: Sequence[int], out_counts: Sequence[int]
+) -> Tuple[List[Edge], List[List[Edge]]]:
+    """(self_edges, rounds): matchings covering the interval overlaps."""
+    p = len(in_counts)
+    a = np.concatenate([[0], np.cumsum(in_counts)])
+    b = np.concatenate([[0], np.cumsum(out_counts)])
+    if a[-1] != b[-1]:
+        raise ValueError(f"count sums differ: {a[-1]} vs {b[-1]}")
+    edges: List[Edge] = []
+    d = 0
+    for r in range(p):
+        if in_counts[r] == 0:
+            continue
+        while d < p and b[d + 1] <= a[r]:
+            d += 1
+        dd = d
+        while dd < p and b[dd] < a[r + 1]:
+            lo = max(int(a[r]), int(b[dd]))
+            hi = min(int(a[r + 1]), int(b[dd + 1]))
+            if hi > lo:
+                edges.append(Edge(r, dd, lo - int(a[r]), lo - int(b[dd]), hi - lo))
+            dd += 1
+    self_edges = [e for e in edges if e.src == e.dst]
+    rest = [e for e in edges if e.src != e.dst]
+    # greedy bipartite edge coloring; interval structure keeps it near Delta
+    src_used: dict = {}
+    dst_used: dict = {}
+    colored: dict = {}
+    for e in rest:
+        c = 0
+        while c in src_used.get(e.src, ()) or c in dst_used.get(e.dst, ()):
+            c += 1
+        src_used.setdefault(e.src, set()).add(c)
+        dst_used.setdefault(e.dst, set()).add(c)
+        colored.setdefault(c, []).append(e)
+    rounds = [colored[c] for c in sorted(colored)]
+    return self_edges, rounds
+
+
+def _tables(edges: List[Edge], p: int):
+    soff = np.zeros(p, np.int32)
+    doff = np.zeros(p, np.int32)
+    dlen = np.zeros(p, np.int32)
+    for e in edges:
+        soff[e.src] = e.src_off
+        doff[e.dst] = e.dst_off
+        dlen[e.dst] = e.length
+    return jnp.asarray(soff), jnp.asarray(doff), jnp.asarray(dlen)
+
+
+def _flatmove_kernel(
+    x,
+    *,
+    axis_name: str,
+    p: int,
+    c_in: int,
+    c_out: int,
+    out_block: Tuple[int, ...],
+    self_edges: List[Edge],
+    rounds: List[List[Edge]],
+):
+    r = lax.axis_index(axis_name)
+    flat = x.reshape((c_in,))
+    max_u = max(
+        [e.length for e in self_edges] + [e.length for rnd in rounds for e in rnd]
+    )
+    # guard slices/updates against clamping: widen both ends by the piece
+    src = jnp.concatenate([flat, jnp.zeros((max_u,), flat.dtype)])
+    out = jnp.zeros((c_out + max_u,), flat.dtype)
+    idx = jnp.arange(c_out + max_u, dtype=jnp.int32)
+
+    def write(out, piece, u, doff, dlen):
+        tmp = lax.dynamic_update_slice(out, piece, (doff,))
+        mask = (idx >= doff) & (idx < doff + dlen)
+        return jnp.where(mask, tmp, out)
+
+    if self_edges:
+        u = max(e.length for e in self_edges)
+        soff, doff, dlen = _tables(self_edges, p)
+        piece = lax.dynamic_slice(src, (soff[r],), (u,))
+        out = write(out, piece, u, doff[r], dlen[r])
+    for rnd in rounds:
+        u = max(e.length for e in rnd)
+        soff, doff, dlen = _tables(rnd, p)
+        piece = lax.dynamic_slice(src, (soff[r],), (u,))
+        recv = lax.ppermute(piece, axis_name, [(e.src, e.dst) for e in rnd])
+        out = write(out, recv, u, doff[r], dlen[r])
+    return out[:c_out].reshape(out_block)
+
+
+def reshape_flatmove_executable(
+    buf_shape: Tuple[int, ...],
+    dtype,
+    gshape: Tuple[int, ...],
+    out_shape: Tuple[int, ...],
+    comm: MeshCommunication,
+):
+    """The cached jitted interval-exchange program for one reshape;
+    `.lower()`-able (used by the distribution-proof tests)."""
+    mesh = comm.mesh
+    p = mesh.shape[SPLIT_AXIS]
+    in_rows, out_rows = gshape[0], out_shape[0]
+    in_inner = int(np.prod(gshape[1:], dtype=np.int64)) if len(gshape) > 1 else 1
+    out_inner = int(np.prod(out_shape[1:], dtype=np.int64)) if len(out_shape) > 1 else 1
+    cr_in = buf_shape[0] // p
+    out_pshape = comm.padded_shape(tuple(out_shape), 0)
+    cr_out = out_pshape[0] // p
+    key = ("flatmove", tuple(buf_shape), str(dtype), tuple(gshape), tuple(out_shape), mesh)
+    fn = _JIT_CACHE.get(key)
+    if fn is not None:
+        return fn
+    in_counts = [
+        max(0, min(in_rows - r * cr_in, cr_in)) * in_inner for r in range(p)
+    ]
+    out_counts = [
+        max(0, min(out_rows - d * cr_out, cr_out)) * out_inner for d in range(p)
+    ]
+    self_edges, rounds = flat_schedule(in_counts, out_counts)
+    in_spec = P(*([SPLIT_AXIS] + [None] * (len(buf_shape) - 1)))
+    out_spec = P(*([SPLIT_AXIS] + [None] * (len(out_pshape) - 1)))
+    kernel = partial(
+        _flatmove_kernel,
+        axis_name=SPLIT_AXIS,
+        p=p,
+        c_in=int(np.prod(buf_shape, dtype=np.int64)) // p,
+        c_out=int(np.prod(out_pshape, dtype=np.int64)) // p,
+        out_block=(cr_out,) + tuple(out_pshape[1:]),
+        self_edges=self_edges,
+        rounds=rounds,
+    )
+    prog = shard_map(
+        kernel, mesh=mesh, in_specs=in_spec, out_specs=out_spec, check_vma=False
+    )
+    fn = _JIT_CACHE[key] = jax.jit(prog)
+    return fn
+
+
+def reshape_via_flatmove(
+    buf: jax.Array,
+    gshape: Tuple[int, ...],
+    out_shape: Tuple[int, ...],
+    comm: MeshCommunication,
+) -> jax.Array:
+    """Reshape a split-0 padded buffer to the split-0 padded buffer of
+    ``out_shape`` with the interval-exchange kernel. Pure collective
+    permutes; per-device memory O(n/P)."""
+    return reshape_flatmove_executable(
+        tuple(buf.shape), buf.dtype, tuple(gshape), tuple(out_shape), comm
+    )(buf)
+
+
+_JIT_CACHE: dict = {}

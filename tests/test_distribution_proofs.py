@@ -1,0 +1,418 @@
+"""Bounded-memory proofs for the data-movement family (VERDICT r2 item 1).
+
+``sort`` earned an HLO proof in round 2 that it is O(n/P) per device
+(``test_dsort.py``); this file extends the same discipline to the rest of
+the ``_logical()`` family the verdict flagged: reshape, flatten,
+concatenate, topk, outer, unique.
+
+Strategy per op:
+
+- reshape / flatten / concatenate / outer run as single jitted pipelines
+  (:mod:`heat_tpu.core._movement`) whose in/out shardings are the padded
+  canonical layouts. The tests lower EXACTLY those cached executables at
+  representative sizes on the 8-device mesh and assert the compiled HLO
+  contains no all-gather and no per-device buffer above c * n/P. (At tiny
+  sizes XLA legitimately chooses a gather — cheaper than a permute
+  schedule — so the proofs run at sizes where the asymptotics matter,
+  mirroring the reference's bounded Alltoallv at
+  ``/root/reference/heat/core/manipulations.py:1821`` (reshape) and
+  ``:188`` (concatenate), and the ring outer at
+  ``/root/reference/heat/core/linalg/basics.py:1372``.)
+- topk along the split axis runs the shard_map kernel
+  (:mod:`heat_tpu.parallel.dtopk`); its HLO must contain an all-gather of
+  only O(P*k) candidates — the reference's ``mpi_topk`` bound
+  (``manipulations.py:3834-4028``) — never of the operand.
+- unique is eager (data-dependent shapes); the proof instruments the
+  dedup calls and asserts no call ever sees more than one shard's
+  elements, matching the reference's local-unique-then-allgather shape
+  (``manipulations.py:3055``).
+"""
+from __future__ import annotations
+
+import re
+from unittest import mock
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from tests.base import TestCase
+
+_DTYPE_BYTES = {"f64": 8, "f32": 4, "f16": 2, "bf16": 2, "s64": 8, "s32": 4, "u64": 8, "u32": 4, "s8": 1, "u8": 1, "pred": 1}
+
+
+def _max_buffer_bytes(hlo: str) -> int:
+    """Largest single HLO buffer (bytes) in the per-device SPMD program."""
+    best = 0
+    for m in re.finditer(r"\b(f64|f32|f16|bf16|s64|s32|u64|u32|s8|u8|pred)\[([\d,]*)\]", hlo):
+        n = _DTYPE_BYTES[m.group(1)]
+        for d in m.group(2).split(",") if m.group(2) else []:
+            if d:
+                n *= int(d)
+        best = max(best, n)
+    return best
+
+
+def _assert_bounded(hlo: str, per_dev_bytes: int, c: float, what: str, allow_allgather: bool = False):
+    if not allow_allgather:
+        assert hlo.count("all-gather") == 0, f"{what}: all-gather in compiled HLO"
+    mb = _max_buffer_bytes(hlo)
+    assert mb <= c * per_dev_bytes, (
+        f"{what}: max per-device buffer {mb} B exceeds {c} * {per_dev_bytes} B"
+    )
+
+
+def _comm():
+    return ht.get_comm()
+
+
+def _skip_unless_8():
+    import jax
+
+    if len(jax.devices()) < 8 or _comm().size < 8:
+        pytest.skip("proofs need the 8-device mesh")
+
+
+class TestReshapeBounded(TestCase):
+    CASES = [
+        # (in_shape, in_split, out_shape, out_split) — all at >=384k elements
+        ((4000, 96), 0, (1000, 384), 0),
+        ((4000, 96), 0, (384000,), 0),
+        ((3999, 96), 0, (96, 3999), 0),   # padded in AND out, inner swap
+        ((384000,), 0, (250, 1536), 0),   # padded rows out
+        ((1000, 384), 1, (384000,), 0),   # split-1 input
+    ]
+
+    def test_hlo_no_allgather_bounded_buffers(self):
+        """Lower EXACTLY the executable production reshape would run
+        (GSPMD or the flatmove interval-exchange kernel) and assert it."""
+        _skip_unless_8()
+        import jax
+
+        from heat_tpu.core._movement import planned_reshape_executable
+
+        comm = _comm()
+        for in_shape, in_split, out_shape, out_split in self.CASES:
+            in_pshape = comm.padded_shape(in_shape, in_split)
+            out_pshape = comm.padded_shape(out_shape, out_split)
+            fn = planned_reshape_executable(
+                in_pshape, np.dtype(np.float32), in_shape, in_split, out_shape, out_split, comm
+            )
+            assert fn is not None, "expected a single-program plan for these cases"
+            spec = jax.ShapeDtypeStruct(in_pshape, np.float32)
+            hlo = fn.lower(spec).compile().as_text()
+            per_dev = 4 * max(int(np.prod(in_pshape)), int(np.prod(out_pshape))) // 8
+            _assert_bounded(hlo, per_dev, 2.0, f"reshape {in_shape}->{out_shape}")
+
+    def test_via0_route_values(self):
+        """A non-0-split reshape whose GSPMD program gathers must detour
+        through split-0 + the kernel; force the decision and check the
+        composite path end-to-end."""
+        from heat_tpu.core import _movement
+
+        comm = _comm()
+        if comm.size < 2:
+            pytest.skip("needs a multi-device mesh")
+        in_shape, out_shape = (12, 40), (40, 12)
+        in_pshape = comm.padded_shape(in_shape, 1)
+        dkey = (
+            "reshape_gathers", in_pshape, str(np.dtype(np.float32)), in_shape,
+            1, out_shape, 1, comm.mesh,
+        )
+        x = np.arange(480, dtype=np.float32).reshape(in_shape)
+        a = ht.array(x, split=1)
+        old_cutoff = _movement._KERNEL_CUTOFF_BYTES
+        _movement._KERNEL_CUTOFF_BYTES = 0
+        _movement._EXEC_CACHE[dkey] = True
+        try:
+            mode, fn = _movement.reshape_plan(
+                in_pshape, np.dtype(np.float32), in_shape, 1, out_shape, 1, comm
+            )
+            assert mode == "via0" and fn is None
+            r = ht.reshape(a, out_shape, new_split=1)
+        finally:
+            _movement._KERNEL_CUTOFF_BYTES = old_cutoff
+            _movement._EXEC_CACHE.pop(dkey, None)
+        assert r.split == 1
+        np.testing.assert_array_equal(r.numpy(), x.reshape(out_shape))
+
+    def test_values_across_shapes(self):
+        rng = np.random.default_rng(0)
+        for in_shape, in_split, out_shape, out_split in [
+            ((40, 7), 0, (7, 40), 0),
+            ((9, 4), 0, (36,), 0),
+            ((37,), 0, (37, 1), 0),
+            ((6, 5, 4), 1, (120,), 0),
+            ((11, 13), 1, (13, 11), 1),
+        ]:
+            x = rng.normal(size=in_shape).astype(np.float32)
+            a = ht.array(x, split=in_split)
+            r = ht.reshape(a, out_shape, new_split=out_split)
+            assert r.split == out_split
+            np.testing.assert_array_equal(r.numpy(), x.reshape(out_shape))
+        # flatten rides the same pipeline
+        x = rng.normal(size=(9, 5)).astype(np.float32)
+        np.testing.assert_array_equal(ht.flatten(ht.array(x, split=1)).numpy(), x.ravel())
+
+    def test_flatmove_kernel_values(self):
+        """The interval-exchange kernel itself, across divisibility and
+        inner-dimension regimes (including the ones GSPMD gathers on)."""
+        from heat_tpu.parallel.flatmove import reshape_via_flatmove
+
+        comm = _comm()
+        if comm.size < 2:
+            pytest.skip("needs a multi-device mesh")
+        rng = np.random.default_rng(5)
+        for in_shape, out_shape in [
+            ((40, 7), (7, 40)),
+            ((3999, 96) if comm.size == 8 else (399, 96), (96, 3999) if comm.size == 8 else (96, 399)),
+            ((9, 4), (36,)),
+            ((37,), (37, 1)),
+            ((100, 3), (12, 25)),
+            ((13,), (13,)),  # identity
+        ]:
+            x = rng.normal(size=in_shape).astype(np.float32)
+            a = ht.array(x, split=0)
+            buf = reshape_via_flatmove(a.larray, in_shape, out_shape, comm)
+            out_pshape = comm.padded_shape(out_shape, 0)
+            assert tuple(buf.shape) == tuple(out_pshape)
+            valid = np.asarray(buf)[tuple(slice(0, s) for s in out_shape)]
+            np.testing.assert_array_equal(valid, x.reshape(out_shape), err_msg=str(in_shape))
+
+    def test_flatmove_kernel_hlo(self):
+        """The kernel compiles to collective-permutes only, temps O(n/P)."""
+        _skip_unless_8()
+        import jax
+
+        from heat_tpu.parallel.flatmove import reshape_flatmove_executable
+
+        comm = _comm()
+        in_shape, out_shape = (3999, 96), (96, 3999)
+        in_pshape = comm.padded_shape(in_shape, 0)
+        fn = reshape_flatmove_executable(in_pshape, np.dtype(np.float32), in_shape, out_shape, comm)
+        hlo = fn.lower(jax.ShapeDtypeStruct(in_pshape, np.float32)).compile().as_text()
+        assert hlo.count("all-gather") == 0 and hlo.count("all-to-all") == 0
+        assert hlo.count("collective-permute") > 0
+        per_dev = 4 * max(int(np.prod(in_pshape)), int(np.prod(comm.padded_shape(out_shape, 0)))) // 8
+        _assert_bounded(hlo, per_dev, 4.0, "flatmove kernel")
+
+
+class TestConcatenateBounded(TestCase):
+    def test_hlo_no_allgather_bounded_buffers(self):
+        _skip_unless_8()
+        from heat_tpu.core._movement import concatenate_executable
+
+        comm = _comm()
+        import jax.numpy as jnp
+
+        for shapes, axis in [
+            ([(1000, 96), (1400, 96)], 0),
+            ([(999, 96), (1401, 96), (600, 96)], 0),
+            ([(96, 1000), (96, 1400)], 1),
+        ]:
+            split = axis
+            pshapes = [comm.padded_shape(s, split) for s in shapes]
+            out_shape = list(shapes[0])
+            out_shape[axis] = sum(s[axis] for s in shapes)
+            fn = concatenate_executable(
+                pshapes, [np.dtype(np.float32)] * len(shapes), shapes,
+                [split] * len(shapes), axis, tuple(out_shape), split,
+                jnp.float32, comm,
+            )
+            bufs = [ht.zeros(s, split=split).larray for s in shapes]
+            hlo = fn.lower(*bufs).compile().as_text()
+            out_pshape = comm.padded_shape(tuple(out_shape), split)
+            per_dev = 4 * int(np.prod(out_pshape)) // 8
+            _assert_bounded(hlo, per_dev, 2.0, f"concat {shapes} axis={axis}")
+
+    def test_values_and_padding(self):
+        rng = np.random.default_rng(1)
+        for shapes, axis, split in [
+            ([(9, 4), (11, 4)], 0, 0),
+            ([(5, 3), (2, 3), (6, 3)], 0, 0),
+            ([(4, 9), (4, 2)], 1, 1),
+            ([(7, 3), (6, 3)], 0, 1),  # split != concat axis
+        ]:
+            xs = [rng.normal(size=s).astype(np.float32) for s in shapes]
+            res = ht.concatenate([ht.array(x, split=split) for x in xs], axis=axis)
+            assert res.split == split
+            np.testing.assert_array_equal(res.numpy(), np.concatenate(xs, axis=axis))
+
+
+class TestTopkBounded(TestCase):
+    def test_kernel_traffic_is_candidates_only(self):
+        """GSPMD's lax.top_k on a sharded axis all-gathers the operand
+        (O(n) per device, shown below); the dtopk kernel's only gather is
+        the P*k candidate sets."""
+        _skip_unless_8()
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from heat_tpu.parallel.dtopk import distributed_topk
+
+        comm = _comm()
+        n, k = 1 << 16, 16
+        a = ht.arange(n, dtype=ht.float32, split=0)
+
+        # the naive route really is O(n)/device — pin the motivation
+        sh = NamedSharding(comm.mesh, P("split"))
+        naive = jax.jit(
+            lambda v: jax.lax.top_k(v, k)[0],
+            in_shardings=sh,
+            out_shardings=NamedSharding(comm.mesh, P(None)),
+        )
+        naive_hlo = naive.lower(a.larray).compile().as_text()
+        assert naive_hlo.count("all-gather") > 0
+
+        # the kernel: gathers bounded by P*k candidates, temps by the block
+        import functools
+
+        from jax import shard_map
+        from heat_tpu.parallel.dtopk import _topk_kernel
+
+        c = a.larray.shape[0] // 8
+        kernel = functools.partial(
+            _topk_kernel, axis=0, axis_name="split", c=c, n=n, k=k,
+            largest=True, idx_t=jnp.int64,
+        )
+        prog = jax.jit(
+            shard_map(
+                kernel, mesh=comm.mesh, in_specs=P("split"),
+                out_specs=(P(None), P(None)), check_vma=False,
+            )
+        )
+        hlo = prog.lower(a.larray).compile().as_text()
+        # every gather payload must be k-sized, not n-sized: with f32+s64
+        # keys the widest gathered tensor is 8 B * P * k
+        for m in re.finditer(r"all-gather[^\n]*?(f64|f32|s64|s32|pred)\[([\d,]*)\]", hlo):
+            elems = 1
+            for d in m.group(2).split(","):
+                if d:
+                    elems *= int(d)
+            assert elems <= 8 * k, f"topk gathered {elems} elements (> P*k = {8*k})"
+        # per-device temps stay at the local block (a few sort operands)
+        _assert_bounded(hlo, 16 * c, 2.0, "dtopk", allow_allgather=True)
+
+    def test_oracle_parity(self):
+        rng = np.random.default_rng(2)
+        for n in (64, 37, 9):
+            x = rng.normal(size=n).astype(np.float32)
+            x[::4] = x[0]  # ties
+            a = ht.array(x, split=0)
+            for k in (1, 3, min(8, n)):
+                for largest in (True, False):
+                    v, i = ht.topk(a, k, largest=largest)
+                    order = np.argsort(-x if largest else x, kind="stable")[:k]
+                    np.testing.assert_array_equal(v.numpy(), x[order])
+                    np.testing.assert_array_equal(i.numpy(), order)
+        # batched: topk along split dim of a 2-D array
+        x = rng.normal(size=(5, 33)).astype(np.float32)
+        a = ht.array(x, split=1)
+        v, i = ht.topk(a, 4, dim=1)
+        order = np.argsort(-x, axis=1, kind="stable")[:, :4]
+        np.testing.assert_array_equal(v.numpy(), np.take_along_axis(x, order, 1))
+        np.testing.assert_array_equal(i.numpy(), order)
+        # split dim 0 of a 2-D array
+        x = rng.normal(size=(33, 5)).astype(np.float32)
+        a = ht.array(x, split=0)
+        v, i = ht.topk(a, 4, dim=0)
+        order = np.argsort(-x, axis=0, kind="stable")[:4]
+        np.testing.assert_array_equal(v.numpy(), np.take_along_axis(x, order, 0))
+        np.testing.assert_array_equal(i.numpy(), order)
+
+    def test_nan_inf_and_k_bounds(self):
+        x = np.array([3.0, np.nan, -np.inf, 1.0, np.inf, -1.0, 0.0, 2.0, 5.0], np.float32)
+        a = ht.array(x, split=0)
+        v, i = ht.topk(a, 3)  # torch: NaN counts as largest
+        assert np.isnan(v.numpy()[0]) and v.numpy()[1] == np.inf
+        v2, _ = ht.topk(a, 3, largest=False)
+        np.testing.assert_array_equal(v2.numpy(), [-np.inf, -1.0, 0.0])
+        with pytest.raises(ValueError, match="out of range"):
+            ht.topk(a, 10)
+
+
+class TestOuterBounded(TestCase):
+    def test_hlo_gathers_only_second_operand(self):
+        _skip_unless_8()
+        from heat_tpu.core._movement import outer_executable
+
+        comm = _comm()
+        n, m = 1 << 15, 512
+        a = ht.zeros(n, split=0)
+        b = ht.zeros(m, split=0)
+        fn, out_shape = outer_executable(
+            tuple(a.larray.shape), a.larray.dtype, (n,), 0,
+            tuple(b.larray.shape), b.larray.dtype, (m,), 0, 0, comm,
+        )
+        hlo = fn.lower(a.larray, b.larray).compile().as_text()
+        # temps: own output shard (nm/P) + the gathered m-vector; never n*m
+        per_dev = 4 * (n * m // 8)
+        assert _max_buffer_bytes(hlo) <= 1.5 * per_dev
+        for g in re.finditer(r"all-gather[^\n]*?f32\[([\d,]*)\]", hlo):
+            elems = 1
+            for d in g.group(1).split(","):
+                if d:
+                    elems *= int(d)
+            assert elems <= 2 * m, f"outer gathered {elems} > O(m)"
+
+    def test_values(self):
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=9).astype(np.float32)
+        y = rng.normal(size=13).astype(np.float32)
+        for sa in (None, 0):
+            for sb in (None, 0):
+                r = ht.linalg.outer(ht.array(x, split=sa), ht.array(y, split=sb))
+                np.testing.assert_allclose(r.numpy(), np.outer(x, y), rtol=1e-6)
+        r = ht.linalg.outer(ht.array(x, split=0), ht.array(y, split=0), split=1)
+        assert r.split == 1
+        np.testing.assert_allclose(r.numpy(), np.outer(x, y), rtol=1e-6)
+
+
+class TestUniqueBounded(TestCase):
+    def test_dedup_never_sees_more_than_one_shard(self):
+        """The distributed path must dedupe per shard and merge candidates —
+        no call on the full logical array (reference shape:
+        local unique -> Allgatherv -> re-unique, manipulations.py:3055)."""
+        import heat_tpu.core.manipulations as manip
+
+        comm = _comm()
+        if comm.size < 2:
+            pytest.skip("needs a multi-device mesh")
+        n = 4096
+        x = np.tile(np.arange(64, dtype=np.int64), n // 64)
+        a = ht.array(x, split=0)
+        shard_cap = max(int(np.prod(s.shape)) for s in a.local_shards)
+        seen = []
+        real_unique = manip.jnp.unique
+
+        def spy(arr, *args, **kw):
+            seen.append(int(np.prod(arr.shape)))
+            return real_unique(arr, *args, **kw)
+
+        with mock.patch.object(manip.jnp, "unique", side_effect=spy):
+            res = manip.unique(a)
+        assert seen, "distributed unique did not run the local-first path"
+        assert max(seen) <= shard_cap, (
+            f"unique saw a {max(seen)}-element array; shard cap is {shard_cap}"
+        )
+        np.testing.assert_array_equal(np.sort(res.numpy()), np.arange(64))
+
+    def test_oracle_parity(self):
+        rng = np.random.default_rng(4)
+        x = rng.integers(0, 20, size=57).astype(np.int64)
+        a = ht.array(x, split=0)
+        res = ht.unique(a)
+        np.testing.assert_array_equal(np.sort(res.numpy()), np.unique(x))
+        # return_inverse reconstructs the input
+        vals, inv = ht.unique(a, return_inverse=True)
+        np.testing.assert_array_equal(vals.numpy()[inv.numpy()], x)
+        # unique rows along the split axis
+        rows = rng.integers(0, 3, size=(40, 3)).astype(np.int64)
+        res2 = ht.unique(ht.array(rows, split=0), axis=0)
+        np.testing.assert_array_equal(res2.numpy(), np.unique(rows, axis=0))
+        # float with NaN-free data, 2-D flat unique
+        xf = rng.normal(size=(9, 5)).astype(np.float32)
+        xf[0] = xf[1]
+        res3 = ht.unique(ht.array(xf, split=0))
+        np.testing.assert_array_equal(res3.numpy(), np.unique(xf))
