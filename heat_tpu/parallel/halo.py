@@ -40,14 +40,19 @@ def halo_exchange(x, halo_size: int, comm: MeshCommunication, axis_name: str = S
     """Return the global array of per-shard halo-extended blocks.
 
     For an (N, ...) array sharded on axis 0 over P devices, returns a
-    (P, N/P + 2*halo, ...) array whose i-th slice is shard i with its
-    neighbor halos attached (cyclic at the boundary, like the reference's
-    ``get_halo`` before boundary trimming).
+    (P, ceil(N/P) + 2*halo, ...) array whose i-th slice is shard i with
+    its neighbor halos attached. ANY logical N: a non-divisible extent is
+    tail-padded with zeros first (the same pad-and-trim contract as
+    dsort/TSQR), so end-of-sequence halos contain zeros rather than the
+    cyclic wrap — callers mask boundary halos either way (the reference
+    trims them in ``get_halo``, ``dndarray.py:333-441``).
     """
     mesh = comm.mesh
     p = mesh.shape[axis_name]
     if x.shape[0] % p:
-        raise ValueError(f"halo_exchange requires axis-0 divisible by mesh size {p}")
+        from ..core._movement import pad_to_divisible
+
+        x = pad_to_divisible(x, p, (0,), comm)
 
     def local(block):
         prev, nxt = exchange(block, halo_size, axis_name)

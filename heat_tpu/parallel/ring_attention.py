@@ -22,14 +22,25 @@ from ..core.communication import SPLIT_AXIS, MeshCommunication
 __all__ = ["ring_attention", "attention"]
 
 
-def attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, causal: bool = False) -> jnp.ndarray:
+def attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = False,
+    kv_len: Optional[int] = None,
+) -> jnp.ndarray:
     """Reference (non-distributed) scaled-dot-product attention over
-    (..., N, D) arrays; the oracle for :func:`ring_attention`."""
+    (..., N, D) arrays; the oracle for :func:`ring_attention`.
+    ``kv_len`` masks key positions >= kv_len (tail-padded sequences)."""
     d = q.shape[-1]
     s = jnp.einsum("...nd,...md->...nm", q, k) / jnp.sqrt(float(d))
+    n, m = s.shape[-2], s.shape[-1]
+    mask = jnp.ones((n, m), dtype=bool)
     if causal:
-        n, m = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((n, m), dtype=bool))
+        mask = jnp.tril(mask)
+    if kv_len is not None and kv_len < m:
+        mask = mask & (jnp.arange(m)[None, :] < kv_len)
+    if causal or (kv_len is not None and kv_len < m):
         s = jnp.where(mask, s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("...nm,...md->...nd", p, v)
@@ -42,16 +53,19 @@ def ring_attention(
     comm: MeshCommunication,
     causal: bool = False,
     axis_name: str = SPLIT_AXIS,
+    _valid_n: Optional[int] = None,
 ) -> jnp.ndarray:
     """Exact attention with the sequence axis sharded over the mesh.
 
     Inputs are (N, D) (or (H, N, D) with leading batch/head dims folded by
-    the caller) sharded on the sequence axis. Each step computes one
-    (q-block, k-block) tile and folds it into the online-softmax state
-    (m, l, o); K/V rotate around the ring so device i sees block
-    (i + step) % P at step ``step``. Communication is P-1 ppermutes of one
-    K/V block each — the memory- and bandwidth-optimal schedule for long
-    sequences.
+    the caller) sharded on the sequence axis; ANY logical N — a
+    non-divisible sequence is tail-padded, padded keys are masked in the
+    kernel, and padded query rows are trimmed from the output. Each step
+    computes one (q-block, k-block) tile and folds it into the
+    online-softmax state (m, l, o); K/V rotate around the ring so device i
+    sees block (i + step) % P at step ``step``. Communication is P-1
+    ppermutes of one K/V block each — the memory- and bandwidth-optimal
+    schedule for long sequences.
     """
     if q.ndim != 2:
         raise ValueError(f"expected (N, D) inputs, got {q.shape}; fold batch/head dims first")
@@ -59,8 +73,18 @@ def ring_attention(
     p = mesh.shape[axis_name]
     n, d = q.shape
     if n % p:
-        raise ValueError(f"mesh size {p} must divide the sequence length {n}")
+        # pad-and-trim: tail-pad the sequence to a P-divisible length, mask
+        # the padded KEY positions inside the kernel (a zero key row would
+        # otherwise contribute softmax weight), trim the padded Q rows off
+        # the output — the same treatment dsort/TSQR give padded buffers
+        from ..core._movement import pad_to_divisible
+
+        qp = pad_to_divisible(q, p, (0,), comm)
+        kp = pad_to_divisible(k, p, (0,), comm)
+        vp = pad_to_divisible(v, p, (0,), comm)
+        return ring_attention(qp, kp, vp, comm, causal=causal, axis_name=axis_name, _valid_n=n)[:n]
     scale = 1.0 / jnp.sqrt(float(d))
+    valid_n = n if _valid_n is None else _valid_n
 
     def local(qb, kb, vb):
         nq = qb.shape[0]
@@ -72,9 +96,12 @@ def ring_attention(
             kblk, vblk, m, l, o = carry
             src = (my + i) % p  # owner of the K/V block currently held
             s = (qb @ kblk.T) * scale  # (nq, nk)
+            k_pos = src * nk + jnp.arange(nk)
+            keep = k_pos[None, :] < valid_n
             if causal:
-                k_pos = src * nk + jnp.arange(nk)
-                s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, -jnp.inf)
+                keep = keep & (q_pos[:, None] >= k_pos[None, :])
+            if causal or valid_n < n:
+                s = jnp.where(keep, s, -jnp.inf)
             m_new = jnp.maximum(m, jnp.max(s, axis=1))
             # guard fully-masked rows (m_new = -inf)
             m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)

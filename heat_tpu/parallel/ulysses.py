@@ -12,11 +12,13 @@ Trade-offs (both exact): Ulysses moves 2x the activations but in just
 two bisection-bandwidth collectives and computes each head's attention
 unblocked (better MXU utilization, trivially supports any per-head
 attention variant); ring keeps memory strictly O(N/P) and overlaps
-compute with neighbor traffic. Ulysses requires ``H % P == 0``; ring
-has no head constraint. Pick per workload — both ride the same mesh.
+compute with neighbor traffic. Both accept ANY logical N (and H here):
+non-divisible extents are tail-padded, masked, and trimmed. Pick per
+workload — both ride the same mesh.
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 from jax import lax, shard_map
 from jax.sharding import PartitionSpec as P
@@ -37,9 +39,11 @@ def ulysses_attention(
 ) -> jnp.ndarray:
     """Exact attention over (N, H, D) arrays sharded on the sequence axis.
 
-    Requires ``N % P == 0`` and ``H % P == 0`` (each device owns whole
-    heads after the reshard). Returns the (N, H, D) output in the same
-    sequence sharding.
+    ANY logical N and H: non-divisible sequences/head counts are
+    tail-padded to the mesh size (padded keys masked inside the per-head
+    attention, padded heads computed-and-discarded), and the output is
+    trimmed back to (N, H, D) — the same pad-and-trim contract as
+    dsort/TSQR, so callers never carry the divisibility burden.
     """
     if q.ndim != 3:
         raise ValueError(f"expected (N, H, D) inputs, got {q.shape}")
@@ -47,11 +51,20 @@ def ulysses_attention(
         raise ValueError(f"q/k/v shapes differ: {q.shape}, {k.shape}, {v.shape}")
     mesh = comm.mesh
     p = mesh.shape[axis_name]
-    n, h, _ = q.shape
-    if n % p:
-        raise ValueError(f"mesh size {p} must divide the sequence length {n}")
-    if h % p:
-        raise ValueError(f"mesh size {p} must divide the head count {h}")
+    n, h, d = q.shape
+    if n % p or h % p:
+        from ..core._movement import pad_to_divisible
+
+        qp = pad_to_divisible(q, p, (0, 1), comm)
+        kp = pad_to_divisible(k, p, (0, 1), comm)
+        vp = pad_to_divisible(v, p, (0, 1), comm)
+        out = _ulysses_kernel(qp, kp, vp, mesh, p, causal, axis_name, valid_n=n)
+        return out[:n, :h]
+    return _ulysses_kernel(q, k, v, mesh, p, causal, axis_name, valid_n=n)
+
+
+def _ulysses_kernel(q, k, v, mesh, p, causal, axis_name, valid_n):
+    n = q.shape[0]
 
     def local(qb, kb, vb):  # blocks: (N/P, H, D)
         def seq_to_head(x):
@@ -60,10 +73,11 @@ def ulysses_attention(
             return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=0, tiled=True)
 
         qh, kh, vh = seq_to_head(qb), seq_to_head(kb), seq_to_head(vb)
-        # whole-sequence attention per local head, heads as the batch dim
+        # whole-sequence attention per local head, heads as the batch dim;
+        # padded key positions (>= valid_n) masked out
         o = attention(
             jnp.moveaxis(qh, 1, 0), jnp.moveaxis(kh, 1, 0), jnp.moveaxis(vh, 1, 0),
-            causal=causal,
+            causal=causal, kv_len=valid_n if valid_n < n else None,
         )  # (H/P, N, D)
         o = jnp.moveaxis(o, 0, 1)  # (N, H/P, D)
         # scatter sequence, gather heads -> (N/P, H, D)

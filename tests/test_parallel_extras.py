@@ -92,9 +92,43 @@ class TestUlyssesAttention(TestCase):
         z = jnp.zeros((comm.size * 4, comm.size, 4))
         with pytest.raises(ValueError):  # 2-D input
             ulysses_attention(z[:, 0], z[:, 0], z[:, 0], comm)
-        with pytest.raises(ValueError):  # heads not divisible
-            bad = jnp.zeros((comm.size * 4, comm.size + 1, 4))
-            ulysses_attention(bad, bad, bad, comm)
+
+    def test_pad_and_trim_non_divisible(self):
+        """Non-divisible N AND H must be tail-padded, masked, trimmed —
+        not raise (VERDICT r2 item 4); exercised at world sizes 5/8 by the
+        HEAT_TPU_TEST_DEVICES matrix."""
+        import jax.numpy as jnp
+
+        from heat_tpu.parallel import ulysses_attention
+        from heat_tpu.parallel.ring_attention import attention
+
+        comm = ht.get_comm()
+        if comm.size == 1:
+            pytest.skip("needs multi-device mesh")
+        rng = np.random.default_rng(7)
+        p = comm.size
+        # neither divides: sequence p*6+3, heads p+1
+        for n, h in [(p * 6 + 3, p + 1), (p * 4 + 1, 2 * p - 1)]:
+            d = 8
+            q, k, v = (rng.normal(size=(n, h, d)).astype(np.float32) for _ in range(3))
+            for causal in (False, True):
+                out = ulysses_attention(
+                    jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), comm, causal=causal
+                )
+                expected = jnp.moveaxis(
+                    attention(
+                        jnp.moveaxis(jnp.asarray(q), 1, 0),
+                        jnp.moveaxis(jnp.asarray(k), 1, 0),
+                        jnp.moveaxis(jnp.asarray(v), 1, 0),
+                        causal=causal,
+                    ),
+                    0, 1,
+                )
+                assert out.shape == (n, h, d)
+                np.testing.assert_allclose(
+                    np.asarray(out), np.asarray(expected), rtol=2e-4, atol=2e-4,
+                    err_msg=f"n={n} h={h} causal={causal}",
+                )
 
 
 class TestRingAttention(TestCase):
@@ -124,6 +158,30 @@ class TestRingAttention(TestCase):
 
     def test_causal(self):
         self._run(causal=True)
+
+    def test_pad_and_trim_non_divisible(self):
+        import jax.numpy as jnp
+
+        from heat_tpu.parallel import ring_attention
+        from heat_tpu.parallel.ring_attention import attention
+
+        comm = ht.get_comm()
+        if comm.size == 1:
+            pytest.skip("needs multi-device mesh")
+        rng = np.random.default_rng(8)
+        for n in (comm.size * 5 + 2, comm.size + 1, 2 * comm.size - 1):
+            d = 8
+            q, k, v = (
+                jnp.asarray(rng.normal(size=(n, d)).astype(np.float32)) for _ in range(3)
+            )
+            for causal in (False, True):
+                out = ring_attention(q, k, v, comm, causal=causal)
+                expected = attention(q, k, v, causal=causal)
+                assert out.shape == (n, d)
+                np.testing.assert_allclose(
+                    np.asarray(out), np.asarray(expected), rtol=2e-4, atol=2e-4,
+                    err_msg=f"n={n} causal={causal}",
+                )
 
     def test_validates(self):
         import jax.numpy as jnp
