@@ -79,6 +79,7 @@ class DataParallel:
         self._seed = seed
 
         self._jitted_steps = {}
+        self._last_loss = None  # previous step's device loss (dispatch fence)
 
         from ..optim.dp_optimizer import DataParallelOptimizer
 
@@ -107,6 +108,11 @@ class DataParallel:
     # -- forward --------------------------------------------------------------
     def __call__(self, inputs):
         """Forward pass on (possibly sharded) inputs."""
+        from ..core._dispatch import fence_cpu_collectives
+
+        # an in-flight train_step program must drain before another SPMD
+        # program dispatches (CPU collective rendezvous, _dispatch.py)
+        fence_cpu_collectives(self._last_loss)
         # _logical(): the padded buffer must never leak into user math —
         # a pad row would otherwise enter the forward as a phantom sample
         data = inputs._logical() if isinstance(inputs, DNDarray) else inputs
@@ -164,8 +170,13 @@ class DataParallel:
 
         return jax.jit(step, donate_argnums=(0, 1))
 
-    def train_step(self, loss_fn: Callable, batch, labels) -> float:
-        """One optimization step; requires an optimizer at construction."""
+    def train_step(self, loss_fn: Callable, batch, labels):
+        """One optimization step; requires an optimizer at construction.
+
+        Returns the loss as a DEVICE scalar — fetching it to host every
+        batch would serialize training on a device round-trip (~100 ms on
+        a tunneled chip); call ``float()``/``.item()`` only when the
+        number is actually needed."""
         if self._optimizer is None:
             raise RuntimeError("DataParallel was constructed without an optimizer")
         key = id(loss_fn)
@@ -173,10 +184,14 @@ class DataParallel:
             self._jitted_steps[key] = self._build_step(loss_fn)
         xb = batch._logical() if isinstance(batch, DNDarray) else batch
         yb = labels._logical() if isinstance(labels, DNDarray) else labels
+        from ..core._dispatch import fence_cpu_collectives
+
+        fence_cpu_collectives(self._last_loss)
         self.params, self._opt_state, loss = self._jitted_steps[key](
             self.params, self._opt_state, xb, yb
         )
-        return float(loss)
+        self._last_loss = loss
+        return loss
 
     # -- reference-API conveniences ------------------------------------------
     def eval(self):

@@ -50,9 +50,10 @@ class DataParallelOptimizer:
     def _bind(self, model) -> None:
         self._model = model
 
-    def step(self, loss_fn: Callable, batch, labels) -> float:
+    def step(self, loss_fn: Callable, batch, labels):
         """One step through the bound model (reference kept per-batch
-        bookkeeping in ``step``)."""
+        bookkeeping in ``step``). The loss is returned as a device scalar;
+        fetch with ``float()`` only when needed."""
         if self._model is None:
             raise RuntimeError("optimizer is not bound to a DataParallel model")
         loss = self._model.train_step(loss_fn, batch, labels)
@@ -118,6 +119,7 @@ class DASO:
         self.epoch = 0
         self._batch = 0
         self._pending = None  # (averaged replicas, apply_at_batch)
+        self._last_loss = None  # previous step's device loss (dispatch fence)
 
     # -- setup ----------------------------------------------------------------
     def _replica_sharding(self, leaf_ndim: int):
@@ -270,7 +272,11 @@ class DASO:
         if self._step_fn is None:
             self._step_fn = self._build_step(loss_and_grad_fn, len(batch))
 
+        from ..core._dispatch import fence_cpu_collectives
+
+        fence_cpu_collectives(self._last_loss)
         params, self._opt_state, loss = self._step_fn(params, self._opt_state, *batch)
+        self._last_loss = loss
 
         # apply a pending delayed global average (reference
         # ``_gs_rcv_update_params:502``: received params are averaged with
@@ -286,13 +292,21 @@ class DASO:
             skip = max(self.global_skip, 1)
             if self._batch % skip == 0:
                 averaged = self._avg_fn(params)
+                # the average is its own collective program; fence on it
+                # too, not just the step loss (CPU rendezvous, _dispatch.py)
+                self._last_loss = (loss, averaged)
                 if self.batches_to_wait > 0:
                     self._pending = (averaged, self._batch + self.batches_to_wait)
                 else:
                     params = averaged
 
         self._batch += 1
-        return params, float(loss)
+        # the loss stays a device scalar: float(loss) here would block on a
+        # device->host round-trip every batch (~100 ms on a tunneled chip —
+        # the reference's .item() is an MPI-local copy, ours is an RPC).
+        # Callers fetch lazily when they actually need the number; the
+        # whole step is transfer-free (asserted in test_nn_optim).
+        return params, loss
 
     def consolidated_params(self, params):
         """Average the replicas into a single parameter tree (end of

@@ -163,6 +163,41 @@ class TestDASO(TestCase):
         final = daso.consolidated_params(params)
         assert final["w"].shape == (4, 1)
 
+    def test_daso_step_is_transfer_free(self):
+        """The step path must never block on a device->host round-trip:
+        the loss comes back as a device scalar (the old float(loss) put a
+        ~100 ms RPC floor under every batch on the tunneled chip), and the
+        pending-average bookkeeping stays on device (VERDICT r2 item 8)."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from heat_tpu.parallel import make_hierarchical_mesh
+
+        if len(jax.devices()) < 4 or len(jax.devices()) % 2:
+            pytest.skip("needs an even device count >= 4")
+        mesh = make_hierarchical_mesh(n_slow=2)
+
+        def loss_and_grad(p, xb, yb):
+            return jax.value_and_grad(lambda p: jnp.mean((xb @ p["w"] - yb) ** 2))(p)
+
+        daso = ht.optim.DASO(optax.sgd(0.1), total_epochs=4, warmup_epochs=0, cooldown_epochs=0)
+        params = daso.init({"w": jnp.zeros((4, 1))}, mesh)
+        daso.global_skip = 2
+        daso.batches_to_wait = 1  # exercise the delayed-average path too
+        rng = np.random.default_rng(10)
+        X = jnp.asarray(rng.normal(size=(32, 4)).astype(np.float32))
+        y = jnp.asarray(rng.normal(size=(32, 1)).astype(np.float32))
+        # warm up the jit caches (compilation transfers constants)
+        params, loss = daso.step(loss_and_grad, params, X, y)
+        # device->device placement of the batch is legitimate; the step
+        # must never pull anything back to the HOST
+        with jax.transfer_guard_device_to_host("disallow"):
+            for _ in range(4):
+                params, loss = daso.step(loss_and_grad, params, X, y)
+        assert isinstance(loss, jax.Array)  # lazy: fetch only when wanted
+        assert np.isfinite(float(loss))
+
     def test_daso_replicas_diverge_then_sync(self):
         import jax
         import jax.numpy as jnp
