@@ -310,6 +310,90 @@ def save_netcdf(data: DNDarray, path: str, variable: str, mode: str = "w", **kwa
         raise err
 
 
+def _py_csv_range(path, offset, length, header_lines, sep, encoding):
+    """Rows owned by byte range [offset, offset+length): pure-Python
+    fallback for :func:`heat_tpu.native.csv_parse_range`, reading only its
+    range (plus the straddling tail line) — the reference's per-rank seek/
+    readline convention (``io.py:713-924``)."""
+    import io as _io
+
+    with open(path, "rb") as f:
+        for _ in range(header_lines):
+            if not f.readline():
+                break
+        data_start = f.tell()
+        f.seek(0, os.SEEK_END)
+        fsize = f.tell()
+        lo = max(offset, data_start)
+        hi = min(offset + length, fsize) if length >= 0 else fsize
+        if lo > data_start:
+            # a line starting before lo belongs to the previous range
+            f.seek(lo - 1)
+            f.readline()
+        else:
+            f.seek(data_start)
+        chunks = []
+        while f.tell() < hi:
+            line = f.readline()
+            if not line:
+                break
+            chunks.append(line)
+    text = b"".join(chunks).decode(encoding)
+    if not text.strip():
+        return np.empty((0, 0), dtype=np.float64)
+    return np.loadtxt(
+        _io.StringIO(text), delimiter=sep, dtype=np.float64, ndmin=2
+    )
+
+
+def _rebalance_csv_rows(local: np.ndarray, comm) -> tuple:
+    """Move byte-range-parsed rows to their canonical-chunk owners.
+
+    Byte ranges almost never split exactly at the canonical per-device row
+    boundaries, so each process exchanges only its BOUNDARY SURPLUS (rows
+    it parsed that belong to another process's devices) via one padded
+    allgather — O(max surplus) extra memory, not O(n) — and returns
+    ``(rows_for_my_devices, t_lo, n_rows)`` with this process holding
+    exactly the global row range its devices' chunks cover.
+    """
+    from jax.experimental import multihost_utils
+
+    from .communication import _split_ranks
+
+    nproc = jax.process_count()
+    pid = jax.process_index()
+    counts = np.asarray(
+        multihost_utils.process_allgather(np.asarray([local.shape[0]], np.int64))
+    ).reshape(-1)
+    offs = np.concatenate([[0], np.cumsum(counts)])
+    n = int(offs[-1])
+    cr = comm.padded_dim(n) // comm.size
+    mine = sorted({r for r, d in _split_ranks(comm) if d.process_index == pid})
+    t_lo = min(r * cr for r in mine)
+    t_hi = min(n, max((r + 1) * cr for r in mine))
+    t_lo = min(t_lo, t_hi)
+    o_lo = int(offs[pid])
+    own_idx = np.arange(o_lo, o_lo + local.shape[0])
+    keep = (own_idx >= t_lo) & (own_idx < t_hi)
+    surplus, surplus_idx = local[~keep], own_idx[~keep]
+    caps = np.asarray(
+        multihost_utils.process_allgather(np.asarray([len(surplus)], np.int64))
+    ).reshape(-1)
+    out = np.empty((t_hi - t_lo,) + local.shape[1:], dtype=local.dtype)
+    out[own_idx[keep] - t_lo] = local[keep]
+    cap = int(caps.max())
+    if cap > 0:
+        pad_rows = cap - len(surplus)
+        sp = np.pad(surplus, [(0, pad_rows)] + [(0, 0)] * (local.ndim - 1))
+        si = np.pad(surplus_idx, (0, pad_rows), constant_values=-1)
+        all_sp = np.asarray(multihost_utils.process_allgather(sp))
+        all_si = np.asarray(multihost_utils.process_allgather(si))
+        for q in range(nproc):
+            sel = (all_si[q] >= t_lo) & (all_si[q] < t_hi)
+            out[all_si[q][sel] - t_lo] = all_sp[q][sel]
+    return out, t_lo, n
+
+
 def load_csv(
     path: str,
     header_lines: int = 0,
@@ -320,8 +404,15 @@ def load_csv(
     device=None,
     comm=None,
 ) -> DNDarray:
-    """Load a CSV file (reference ``io.py:713`` read per-rank byte ranges;
-    the controller reads and shards here)."""
+    """Load a CSV file (reference ``io.py:713``).
+
+    Multi-host with ``split=0``: each process parses only its own byte
+    range of the file (native ``csv_parse_range`` or the Python seek
+    fallback) — row boundaries resolved by first-byte ownership exactly
+    like the reference's per-rank reads — and the global padded buffer is
+    assembled from the per-process shards; no process reads the whole
+    file. Single-host (all devices process-local): one parse, sharded by
+    the constructor."""
     if not isinstance(path, str):
         raise TypeError(f"path must be str, not {type(path)}")
     if not isinstance(sep, str):
@@ -329,6 +420,50 @@ def load_csv(
     if not isinstance(header_lines, int):
         raise TypeError(f"header_lines must be int, not {type(header_lines)}")
     dtype = types.canonical_heat_type(dtype)
+    comm_s = sanitize_comm(comm)
+    nproc = jax.process_count()
+    # byte-range ownership needs a single-byte separator and an encoding
+    # whose newline is the 0x0A byte; other inputs take the whole-file
+    # path below (every process parses the file — the pre-round-3 cost)
+    rangeable = len(sep) == 1 and encoding in ("utf-8", "ascii", "latin-1")
+    if nproc > 1 and split == 0 and rangeable:
+        from jax.experimental import multihost_utils
+
+        np_dtype = np.dtype(dtype.jax_type())
+        fsize = os.path.getsize(path)
+        per = -(-fsize // nproc)
+        off = jax.process_index() * per
+        from .. import native
+
+        local = native.csv_parse_range(path, off, per, header_lines, sep, np_dtype)
+        if local is None:
+            local = _py_csv_range(path, off, per, header_lines, sep, encoding).astype(np_dtype)
+        # empty ranges parse to (0, 0); they need the global column count
+        # before shard assembly (non-split dims must agree)
+        cols = int(
+            np.asarray(
+                multihost_utils.process_allgather(np.asarray([local.shape[1]], np.int64))
+            ).max()
+        )
+        if local.shape[0] == 0:
+            local = local.reshape(0, cols)
+        # exchange only boundary surplus rows, then stitch each process's
+        # devices' chunks directly — O(local) memory per process (the
+        # uneven assemble_local_shards path would allgather the whole set)
+        rows, t_lo, n_rows = _rebalance_csv_rows(local, comm_s)
+        gshape = (n_rows, cols)
+        garr = _assemble_from_chunks(
+            lambda slices: rows[
+                slices[0].start - t_lo : slices[0].stop - t_lo, slices[1]
+            ],
+            gshape,
+            0,
+            comm_s,
+            np_dtype,
+        )
+        return DNDarray._from_buffer(
+            garr, gshape, dtype, 0, devices.sanitize_device(device), comm_s
+        )
     data = None
     if encoding in ("utf-8", "ascii", "latin-1") and len(sep) == 1:
         from .. import native

@@ -35,6 +35,7 @@ __all__ = [
     "available",
     "csv_dims",
     "csv_parse",
+    "csv_parse_range",
     "idx_read",
     "FileStream",
 ]
@@ -129,6 +130,16 @@ def _bind_symbols(lib: ctypes.CDLL) -> None:
         ctypes.POINTER(ctypes.c_int64),
         ctypes.POINTER(ctypes.c_int64),
     ]
+    lib.ht_csv_open_range.restype = ctypes.c_void_p
+    lib.ht_csv_open_range.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.c_char,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64),
+    ]
     lib.ht_csv_parse_h.restype = ctypes.c_int64
     lib.ht_csv_parse_h.argtypes = [
         ctypes.c_void_p,
@@ -184,39 +195,21 @@ def csv_dims(path: str, header_lines: int = 0, sep: str = ",") -> Optional[Tuple
     return rows.value, cols.value
 
 
-def csv_parse(
-    path: str,
-    header_lines: int = 0,
-    sep: str = ",",
-    dtype: np.dtype = np.float32,
-    nthreads: int = 0,
-) -> Optional[np.ndarray]:
-    """Parse a numeric CSV into a numpy array; None → caller falls back."""
-    lib = _load()
-    if lib is None or len(sep) != 1:
-        return None
+def _csv_dtype_code(dtype: np.dtype):
     np_dtype = np.dtype(dtype)
-    cast_to = None
     if np_dtype == np.float32:
-        code = 0
-    elif np_dtype == np.float64:
-        code = 1
-    else:
-        # ints etc.: parse as f64 then cast — matching the reference, which
-        # parses every field with Python float() before the dtype cast
-        # (reference heat/core/io.py:800-806), including its >2**53
-        # rounding behavior
-        code, cast_to = 1, np_dtype
-        np_dtype = np.dtype(np.float64)
-    rows = ctypes.c_int64()
-    cols = ctypes.c_int64()
-    handle = lib.ht_csv_open(
-        path.encode(), header_lines, sep.encode(), ctypes.byref(rows), ctypes.byref(cols)
-    )
-    if not handle:
-        return None
+        return 0, np_dtype, None
+    if np_dtype == np.float64:
+        return 1, np_dtype, None
+    # ints etc.: parse as f64 then cast — matching the reference, which
+    # parses every field with Python float() before the dtype cast
+    # (reference heat/core/io.py:800-806), including its >2**53
+    # rounding behavior
+    return 1, np.dtype(np.float64), np_dtype
+
+
+def _csv_parse_handle(lib, handle, sep, rows, cols, code, np_dtype, cast_to, nthreads):
     try:
-        rows, cols = rows.value, cols.value
         if rows == 0 or cols == 0:
             return np.empty((rows, cols), dtype=cast_to or np_dtype)
         out = np.empty((rows, cols), dtype=np_dtype)
@@ -236,6 +229,62 @@ def csv_parse(
     if rc != 0:
         return None
     return out if cast_to is None else out.astype(cast_to)
+
+
+def csv_parse(
+    path: str,
+    header_lines: int = 0,
+    sep: str = ",",
+    dtype: np.dtype = np.float32,
+    nthreads: int = 0,
+) -> Optional[np.ndarray]:
+    """Parse a numeric CSV into a numpy array; None → caller falls back."""
+    lib = _load()
+    if lib is None or len(sep) != 1:
+        return None
+    code, np_dtype, cast_to = _csv_dtype_code(dtype)
+    rows = ctypes.c_int64()
+    cols = ctypes.c_int64()
+    handle = lib.ht_csv_open(
+        path.encode(), header_lines, sep.encode(), ctypes.byref(rows), ctypes.byref(cols)
+    )
+    if not handle:
+        return None
+    return _csv_parse_handle(
+        lib, handle, sep, rows.value, cols.value, code, np_dtype, cast_to, nthreads
+    )
+
+
+def csv_parse_range(
+    path: str,
+    offset: int,
+    length: int,
+    header_lines: int = 0,
+    sep: str = ",",
+    dtype: np.dtype = np.float32,
+    nthreads: int = 0,
+) -> Optional[np.ndarray]:
+    """Parse only the rows OWNED by byte range [offset, offset+length) —
+    a row belongs to the range containing its first byte and is parsed to
+    its end even across the boundary, so ranges partitioning the file give
+    disjoint covering row sets (the reference's per-rank convention,
+    ``heat/core/io.py:713-924``). ``length < 0`` means to EOF.
+    None → caller falls back to the Python range parser."""
+    lib = _load()
+    if lib is None or len(sep) != 1:
+        return None
+    code, np_dtype, cast_to = _csv_dtype_code(dtype)
+    rows = ctypes.c_int64()
+    cols = ctypes.c_int64()
+    handle = lib.ht_csv_open_range(
+        path.encode(), header_lines, sep.encode(), offset, length,
+        ctypes.byref(rows), ctypes.byref(cols),
+    )
+    if not handle:
+        return None
+    return _csv_parse_handle(
+        lib, handle, sep, rows.value, cols.value, code, np_dtype, cast_to, nthreads
+    )
 
 
 _IDX_DTYPES = {

@@ -81,6 +81,47 @@ void collect_lines(const char *data, size_t size, int64_t header_lines,
   }
 }
 
+// Collect the data lines OWNED by the byte range [offset, offset+length)
+// (file-absolute offsets; pass length < 0 for "to EOF").  Header lines are
+// skipped first.  Ownership follows the reference's per-rank byte-range
+// convention (reference heat/core/io.py:713-924): a line belongs to the
+// range containing its FIRST byte, and its owner parses it to the end even
+// when it straddles the range boundary — so ranges that partition the file
+// yield disjoint, covering row sets.
+void collect_lines_range(const char *data, size_t size, int64_t header_lines,
+                         int64_t offset, int64_t length,
+                         std::vector<Line> &lines) {
+  const char *p = data;
+  const char *limit = data + size;
+  for (int64_t h = 0; h < header_lines && p < limit; ++h) {
+    const char *nl = static_cast<const char *>(memchr(p, '\n', limit - p));
+    p = nl ? nl + 1 : limit;
+  }
+  if (offset < 0) offset = 0;
+  const char *lo = data + (static_cast<size_t>(offset) > size
+                               ? size
+                               : static_cast<size_t>(offset));
+  const char *hi = limit;
+  if (length >= 0 && static_cast<size_t>(offset) + static_cast<size_t>(length) < size)
+    hi = data + offset + length;
+  if (p < lo) {
+    // first owned line begins at the first byte after a '\n' at or past
+    // lo-1 (data[lo-1]=='\n' means a line starts exactly at lo)
+    const char *scan = lo - 1;
+    const char *nl = static_cast<const char *>(memchr(scan, '\n', limit - scan));
+    p = nl ? nl + 1 : limit;
+  }
+  while (p < limit && p < hi) {
+    const char *nl = static_cast<const char *>(memchr(p, '\n', limit - p));
+    const char *end = nl ? nl : limit;
+    const char *trimmed = end;
+    while (trimmed > p && (trimmed[-1] == '\r' || trimmed[-1] == ' '))
+      --trimmed;
+    if (trimmed > p) lines.push_back({p, trimmed});
+    p = nl ? nl + 1 : limit;
+  }
+}
+
 int64_t count_fields(const Line &ln, char sep) {
   int64_t n = 1;
   for (const char *p = ln.begin; p < ln.end; ++p)
@@ -176,6 +217,27 @@ void *ht_csv_open(const char *path, int64_t header_lines, char sep,
     return nullptr;
   }
   if (h->m.data) collect_lines(h->m.data, h->m.size, header_lines, h->lines);
+  h->cols = h->lines.empty() ? 0 : count_fields(h->lines.front(), sep);
+  *rows = static_cast<int64_t>(h->lines.size());
+  *cols = h->cols;
+  return h;
+}
+
+// Range variant of ht_csv_open: only the lines owned by byte range
+// [offset, offset+length) are indexed (length < 0 -> to EOF).  The handle
+// feeds the same ht_csv_parse_h / ht_csv_close.
+void *ht_csv_open_range(const char *path, int64_t header_lines, char sep,
+                        int64_t offset, int64_t length, int64_t *rows,
+                        int64_t *cols) {
+  if (!path || !rows || !cols) return nullptr;
+  CsvHandle *h = new CsvHandle();
+  if (!map_file(path, h->m)) {
+    delete h;
+    return nullptr;
+  }
+  if (h->m.data)
+    collect_lines_range(h->m.data, h->m.size, header_lines, offset, length,
+                        h->lines);
   h->cols = h->lines.empty() ? 0 : count_fields(h->lines.front(), sep);
   *rows = static_cast<int64_t>(h->lines.size());
   *cols = h->cols;

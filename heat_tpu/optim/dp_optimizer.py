@@ -291,9 +291,11 @@ class DASO:
         if self._n_groups > 1:
             skip = max(self.global_skip, 1)
             if self._batch % skip == 0:
+                # the average is its own collective program: drain the step
+                # program first, and fence on the average before the next
+                # dispatch (CPU rendezvous, _dispatch.py)
+                fence_cpu_collectives(loss)
                 averaged = self._avg_fn(params)
-                # the average is its own collective program; fence on it
-                # too, not just the step loss (CPU rendezvous, _dispatch.py)
                 self._last_loss = (loss, averaged)
                 if self.batches_to_wait > 0:
                     self._pending = (averaged, self._batch + self.batches_to_wait)

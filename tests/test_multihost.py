@@ -81,7 +81,90 @@ ht.save(y, os.path.join(tmp, "mh_out.h5"), "doubled")
 ht.random.seed(123)
 d = ht.random.rand(13, 4, split=0)
 s = float(d.sum().item())
-print(f"WORKER{pid} OK {s:.6f}")
+
+# --- chunked CSV: neither process parses the whole file (VERDICT r2 #6) ---
+from heat_tpu import native as hnative
+import heat_tpu.core.io as hio
+csv_rows = 101
+_range_calls = []
+_orig_range = hnative.csv_parse_range
+def _spy_range(path, off, per, *a, **k):
+    r = _orig_range(path, off, per, *a, **k)
+    _range_calls.append(None if r is None else r.shape[0])
+    return r
+hnative.csv_parse_range = _spy_range
+_py_calls = []
+_orig_py = hio._py_csv_range
+def _spy_py(*a, **k):
+    r = _orig_py(*a, **k)
+    _py_calls.append(r.shape[0])
+    return r
+hio._py_csv_range = _spy_py
+csv = ht.load_csv(os.path.join(tmp, "mh_rows.csv"), header_lines=1, split=0)
+assert csv.shape == (csv_rows, 3), csv.shape
+parsed = [n for n in _range_calls + _py_calls if n is not None]
+assert parsed and all(n < csv_rows for n in parsed), parsed
+csv_ref = np.loadtxt(os.path.join(tmp, "mh_rows.csv"), delimiter=",", skiprows=1, dtype=np.float64, ndmin=2).astype(np.float32)
+assert abs(float(csv.sum().item()) - float(csv_ref.sum())) < 1e-3
+w = np.arange(1, csv_rows * 3 + 1, dtype=np.float32).reshape(csv_rows, 3)
+chk = float((csv * ht.array(w, split=0)).sum().item())
+assert abs(chk - float((csv_ref * w).sum())) < 0.5, (chk, float((csv_ref * w).sum()))
+
+# --- distributed sort across the process boundary (shard_map ppermute) ---
+rng_l = np.random.default_rng(7)
+xs = rng_l.normal(size=37).astype(np.float32)
+sv, si = ht.sort(ht.array(xs, split=0))
+ev = np.sort(xs)
+wgt = np.arange(1, 38, dtype=np.float32)
+got_chk = float((sv * ht.array(wgt, split=0)).sum().item())
+assert abs(got_chk - float((ev * wgt).sum())) < 1e-2, (got_chk, float((ev * wgt).sum()))
+gi = float((si.astype(ht.float32) * ht.array(wgt, split=0)).sum().item())
+ei = float((np.argsort(xs, kind="stable") * wgt).sum())
+assert abs(gi - ei) < 1e-2, (gi, ei)
+
+# --- TSQR across processes + residual ---
+A = rng_l.normal(size=(33, 4)).astype(np.float32)
+a_q = ht.array(A, split=0)
+q, r = ht.linalg.qr(a_q)
+err = float(ht.linalg.norm(ht.matmul(q, r) - a_q).item())
+assert err < 1e-3, err
+
+# --- KMeans.fit: bit-identical centroids on both processes ---
+blobs = np.concatenate([
+    rng_l.normal(loc=-4, size=(40, 3)), rng_l.normal(loc=4, size=(40, 3))
+]).astype(np.float32)
+km = ht.cluster.KMeans(n_clusters=2, init="random", max_iter=10, random_state=5)
+km.fit(ht.array(blobs, split=0))
+cent = np.asarray(km.cluster_centers_._logical() if hasattr(km.cluster_centers_, "_logical") else km.cluster_centers_)
+import hashlib
+cent_hash = hashlib.sha256(np.ascontiguousarray(cent).tobytes()).hexdigest()[:16]
+
+# --- unique: candidate exchange across processes ---
+uvals = ht.unique(ht.array(np.tile(np.arange(9, dtype=np.int64), 5), split=0))
+got_u = np.sort(np.asarray(uvals._logical()))
+np.testing.assert_array_equal(got_u, np.arange(9))
+
+# --- DASO step on the process-spanning 2x4 mesh ---
+import optax, jax.numpy as jnp
+from heat_tpu.parallel import make_hierarchical_mesh
+hmesh = make_hierarchical_mesh(n_slow=2)
+daso = ht.optim.DASO(optax.sgd(0.1), total_epochs=4, warmup_epochs=0, cooldown_epochs=0)
+dparams = daso.init({"w": jnp.zeros((3,), jnp.float32)}, hmesh)
+daso.global_skip = 2; daso.batches_to_wait = 0
+xb = jnp.asarray(blobs)
+yb = jnp.asarray(np.sign(blobs.sum(1)).astype(np.float32))
+def lg(p, xb, yb):
+    import jax as _jax
+    return _jax.value_and_grad(lambda p: jnp.mean((xb @ p["w"] - yb) ** 2))(p)
+gaps = []
+for b in range(4):
+    dparams, dloss = daso.step(lg, dparams, xb, yb)
+    gaps.append(float(jnp.max(jnp.abs(dparams["w"][0] - dparams["w"][1]))))
+assert gaps[0] < 1e-6 and gaps[1] > 1e-7, gaps  # sync at 0, diverge at 1
+daso_final = daso.consolidated_params(dparams)
+daso_hash = hashlib.sha256(np.ascontiguousarray(np.asarray(daso_final["w"], dtype=np.float32)).tobytes()).hexdigest()[:16]
+
+print(f"WORKER{pid} OK {s:.6f} kmeans={cent_hash} daso={daso_hash}")
 """
 
 
@@ -95,6 +178,13 @@ def test_two_process_end_to_end(tmp_path):
     ref = np.arange(37 * 5, dtype=np.float32).reshape(37, 5)
     with h5py.File(tmp_path / "mh_2proc.h5", "w") as f:
         f.create_dataset("data", data=ref)
+
+    rng = np.random.default_rng(11)
+    csv_data = rng.normal(size=(101, 3)).astype(np.float64)
+    with open(tmp_path / "mh_rows.csv", "w") as f:
+        f.write("a,b,c\n")
+        for row in csv_data:
+            f.write(",".join(f"{v:.17g}" for v in row) + "\n")
 
     with socket.socket() as s:
         s.bind(("localhost", 0))
@@ -116,14 +206,15 @@ def test_two_process_end_to_end(tmp_path):
         )
         for i in range(2)
     ]
-    outs = [p.communicate(timeout=420)[0] for p in procs]
+    outs = [p.communicate(timeout=600)[0] for p in procs]
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out}"
         assert f"WORKER{i} OK" in out, out
 
-    # both processes drew the same global stream
-    sums = [out.strip().splitlines()[-1].split()[-1] for out in outs]
-    assert sums[0] == sums[1], sums
+    # same RNG stream, bit-identical KMeans centroids, identical DASO
+    # consolidated params on both processes
+    finals = [out.strip().splitlines()[-1].split() for out in outs]
+    assert finals[0][2:] == finals[1][2:], finals
 
     # the saved file carries the full doubled dataset
     with h5py.File(tmp_path / "mh_out.h5", "r") as f:

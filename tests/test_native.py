@@ -54,6 +54,45 @@ class TestNativeCSV:
             out = native.csv_parse(path, header_lines=2, sep=";", dtype=np.float64)
             np.testing.assert_array_equal(out, arr)
 
+    def test_range_ownership_partition(self):
+        """Byte ranges that partition the file must yield disjoint,
+        covering row sets — a row belongs to the range holding its first
+        byte (the reference's per-rank convention, io.py:713-924) — for
+        the native parser AND the Python fallback, at several range
+        counts, with headers and CRLF."""
+        from heat_tpu.core.io import _py_csv_range
+
+        rng = np.random.default_rng(6)
+        arr = rng.standard_normal((101, 3))
+        for crlf in (False, True):
+            with tempfile.TemporaryDirectory() as d:
+                path = os.path.join(d, "a.csv")
+                _write_csv(path, arr, header_lines=1, crlf=crlf)
+                fsize = os.path.getsize(path)
+                for nparts in (1, 2, 3, 5, 8):
+                    per = -(-fsize // nparts)
+                    nat, py = [], []
+                    for p in range(nparts):
+                        ln = native.csv_parse_range(
+                            path, p * per, per, header_lines=1, dtype=np.float64
+                        )
+                        assert ln is not None
+                        if nparts > 1:
+                            assert ln.shape[0] < arr.shape[0], (nparts, p)
+                        if ln.size:
+                            nat.append(ln)
+                        lp = _py_csv_range(path, p * per, per, 1, ",", "utf-8")
+                        if lp.size:
+                            py.append(lp)
+                    np.testing.assert_array_equal(np.concatenate(nat), arr)
+                    np.testing.assert_array_equal(np.concatenate(py), arr)
+        # range past EOF / inside the header -> empty
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "b.csv")
+            _write_csv(path, arr[:3], header_lines=2)
+            out = native.csv_parse_range(path, 0, 4, header_lines=2, dtype=np.float64)
+            assert out is not None and out.shape[0] == 0
+
     def test_float32_and_int_casts(self):
         arr = np.array([[1.5, -2.25], [3.0, 4.125]])
         with tempfile.TemporaryDirectory() as d:
