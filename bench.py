@@ -1,22 +1,40 @@
-"""Benchmark driver: the BOTH north-star workloads (BASELINE.md).
+"""Benchmark driver: ALL FIVE BASELINE.md progression configs.
 
-- KMeans throughput, reference protocol ``/root/reference/benchmarks/
-  kmeans/heat-cpu.py:20-26`` (k=8, 30 iterations, wall-clock) on
-  synthetic blobs, split=0 over all available devices.
-- cdist GB/s, reference protocol ``/root/reference/benchmarks/
-  distance_matrix/heat-cpu.py:20-34`` (SUSY-like n x 18, quadratic
-  expansion), reported as bytes of the materialized (n, n) f32 output
-  per second — an HBM-write roofline measure.
+1. factory/reduction smoke (zeros/arange + sum/mean) — correctness gate;
+2. statistical_moments: mean+std over axes {None, 0, 1}, reference
+   protocol ``/root/reference/benchmarks/statistical_moments/heat-cpu.py``;
+3. cdist GB/s, reference protocol ``/root/reference/benchmarks/
+   distance_matrix/heat-cpu.py:20-34`` (SUSY-like n x 18), reported as
+   bytes of the materialized (n, n) f32 output per second;
+4. KMeans throughput, reference protocol ``/root/reference/benchmarks/
+   kmeans/heat-cpu.py:20-26`` (k=8 on synthetic blobs);
+5. tall-skinny QR + gram matmul GFLOP/s (progression config 5), plus the
+   lasso 1-iter protocol (``/root/reference/benchmarks/lasso/heat-cpu.py``)
+   as coordinate-descent sweeps/s.
 
-``vs_baseline`` is the speedup over a single-CPU-process NumPy
-implementation of the identical computation (the BASELINE.json target is
->=8x that throughput). Prints exactly ONE JSON line; cdist numbers ride
-as extra keys of the same object.
+Every metric's ``*_vs_baseline`` is the speedup over a single-CPU-process
+NumPy implementation of the identical computation (BASELINE.json target:
+>=8x). All device timing uses chained programs + marginal (long-minus-
+short) differencing — the tunneled chip's block_until_ready does not
+synchronize and one host fetch costs ~100 ms, so per-trial sync timing
+would measure pure RPC (see the three failed designs in git history).
+
+Regression visibility: BENCH_HISTORY.json records the best value ever
+seen per metric; each run appends a ``vs_best`` map (current/best) to
+the output and updates the file. Run-to-run spread on the shared chip is
+~±20% — the r01->r02 kmeans "drop" (12424 -> 11169, -10%) is inside that
+band; genuine regressions show up as vs_best staying well below 1.0
+across rounds, not as one noisy sample.
+
+Prints exactly ONE JSON line; all metrics ride as keys of that object.
 """
 import json
+import os
 import time
 
 import numpy as np
+
+HISTORY_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_HISTORY.json")
 
 N = 1 << 19  # 524288 samples
 F = 32
@@ -87,19 +105,245 @@ def main():
         nb_best = min(nb_best, time.perf_counter() - t0)
     baseline_ips = nb_iters / nb_best
 
-    cdist = cdist_bench()
+    out = {
+        "metric": "kmeans_iters_per_sec",
+        "value": round(iters_per_sec, 3),
+        "unit": f"iters/s (n={N}, f={F}, k={K})",
+        "vs_baseline": round(iters_per_sec / baseline_ips, 3),
+        **smoke_check(),
+        **cdist_bench(),
+        **moments_bench(),
+        **qr_matmul_bench(),
+        **lasso_bench(),
+    }
+    out["vs_best"] = update_history(out)
+    print(json.dumps(out))
 
-    print(
-        json.dumps(
-            {
-                "metric": "kmeans_iters_per_sec",
-                "value": round(iters_per_sec, 3),
-                "unit": f"iters/s (n={N}, f={F}, k={K})",
-                "vs_baseline": round(iters_per_sec / baseline_ips, 3),
-                **cdist,
-            }
-        )
+
+def smoke_check():
+    """Progression config 1: factories + reductions, split=None, 1 chip."""
+    import heat_tpu as ht
+
+    z = ht.zeros((64, 8))
+    a = ht.arange(512, dtype=ht.float32)
+    ok = (
+        float(z.sum().item()) == 0.0
+        and float(a.sum().item()) == 511 * 512 / 2
+        and abs(float(a.mean().item()) - 255.5) < 1e-4
     )
+    return {"smoke_ok": bool(ok)}
+
+
+def _marginal(timed, short, long_, work_per_unit):
+    """Best-of-two positive marginal estimates (shared-chip spread)."""
+    estimates = []
+    for _ in range(3):
+        t_long = timed(long_)
+        dt = (t_long - timed(short)) / (long_ - short)
+        if dt > 0:
+            estimates.append(work_per_unit / dt)
+            if len(estimates) == 2:
+                break
+    if estimates:
+        return max(estimates)
+    return work_per_unit * long_ / t_long  # conservative whole-run rate
+
+
+def moments_bench():
+    """Progression config 2: mean+std over axes {None, 0, 1} on a random
+    split=0 array — one jitted sweep per trial, trials chained through a
+    device scalar (eps) so XLA cannot collapse repeats."""
+    import jax
+    import jax.numpy as jnp
+
+    n, f = 1 << 22, 32
+    rng = np.random.default_rng(2)
+    data = rng.normal(size=(n, f)).astype(np.float32)
+    xa = jnp.asarray(data)
+
+    @jax.jit
+    def sweep(x, eps):
+        xx = x + eps * jnp.float32(1e-30)
+        outs = []
+        for axis in (None, 0, 1):
+            outs.append(jnp.mean(xx, axis=axis))
+            outs.append(jnp.std(xx, axis=axis))
+        # fold everything into one scalar to chain the next trial
+        return sum(jnp.sum(o) for o in outs)
+
+    def timed(reps):
+        best = float("inf")
+        for _ in range(4):
+            s = jnp.float32(0)
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                s = sweep(xa, s) * jnp.float32(1e-30)
+            float(s)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    float(sweep(xa, jnp.float32(0)))  # warm compile
+    gb_per_sweep = n * f * 4 * 3 / 1e9  # one pass per axis, mean+std fused
+    gbps = _marginal(timed, 3, 23, gb_per_sweep)
+
+    sub = data[: n // 8]
+    t0 = time.perf_counter()
+    for axis in (None, 0, 1):
+        np.mean(sub, axis=axis)
+        np.std(sub, axis=axis)
+    base_gbps = (sub.nbytes * 3 / 1e9) / (time.perf_counter() - t0)
+    return {
+        "moments_gbps": round(gbps, 2),
+        "moments_unit": f"GB/s read, mean+std x axes(None,0,1) (n={n}, f={f})",
+        "moments_vs_baseline": round(gbps / base_gbps, 2),
+    }
+
+
+def qr_matmul_bench():
+    """Progression config 5: tall-skinny QR + gram matmul GFLOP/s."""
+    import jax
+    import jax.numpy as jnp
+
+    n, f = 1 << 20, 64
+    rng = np.random.default_rng(3)
+    data = rng.normal(size=(n, f)).astype(np.float32)
+    xa = jnp.asarray(data)
+
+    @jax.jit
+    def qr_trial(x, eps):
+        q, r = jnp.linalg.qr(x + eps * jnp.float32(1e-30))
+        return r[0, 0]
+
+    @jax.jit
+    def mm_trial(x, eps):
+        xx = x + eps * jnp.float32(1e-30)
+        return (xx.T @ xx)[0, 0]
+
+    def make_timed(trial):
+        def timed(reps):
+            best = float("inf")
+            for _ in range(4):
+                s = jnp.float32(0)
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    s = trial(xa, s) * jnp.float32(1e-30)
+                float(s)
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        return timed
+
+    float(qr_trial(xa, jnp.float32(0)))
+    float(mm_trial(xa, jnp.float32(0)))
+    flops = 2.0 * n * f * f / 1e9  # GFLOP per trial (both kernels)
+    qr_gflops = _marginal(make_timed(qr_trial), 2, 10, flops)
+    mm_gflops = _marginal(make_timed(mm_trial), 3, 23, flops)
+
+    sub = data[: n // 16]
+    t0 = time.perf_counter()
+    np.linalg.qr(sub)
+    base_qr = (2.0 * sub.shape[0] * f * f / 1e9) / (time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    sub.T @ sub
+    base_mm = (2.0 * sub.shape[0] * f * f / 1e9) / (time.perf_counter() - t0)
+    return {
+        "qr_gflops": round(qr_gflops, 2),
+        "qr_unit": f"GFLOP/s tall-skinny QR (n={n}, f={f})",
+        "qr_vs_baseline": round(qr_gflops / base_qr, 2),
+        "matmul_gflops": round(mm_gflops, 2),
+        "matmul_vs_baseline": round(mm_gflops / base_mm, 2),
+    }
+
+
+def lasso_bench():
+    """Lasso protocol: coordinate-descent sweeps/s (the reference times
+    1-iteration fits; a sweep = one fit iteration). The whole fit is one
+    device program (lax.while_loop), so sweeps/s comes from differencing
+    a long and a short max_iter."""
+    import jax.numpy as jnp
+
+    from heat_tpu.regression.lasso import _cd_fit
+
+    n, f = 1 << 19, 64
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    yv = (X @ rng.normal(size=f).astype(np.float32)).astype(np.float32)
+    Xb = np.concatenate([np.ones((n, 1), np.float32), X], axis=1)
+    Xa, ya = jnp.asarray(Xb), jnp.asarray(yv)
+    theta0 = jnp.zeros(f + 1, jnp.float32)
+    lam = jnp.float32(0.01)
+    tol = jnp.float32(0.0)  # run exactly max_iter sweeps
+
+    def timed(iters):
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            th, it = _cd_fit(Xa, ya, theta0, lam, tol, jnp.int32(iters))
+            np.asarray(th)  # host fetch = the only reliable fence
+            assert int(it) == iters
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    np.asarray(_cd_fit(Xa, ya, theta0, lam, tol, jnp.int32(1))[0])  # warm
+    sweeps_per_sec = _marginal(timed, 2, 22, 1.0)
+
+    sub = Xb[: n // 8]
+    ysub = yv[: n // 8]
+    t0 = time.perf_counter()
+    _numpy_cd_sweep(sub, ysub, np.zeros(f + 1, np.float32), 0.01)
+    # measured on n/8 rows -> full-size numpy rate is ~1/8 of this
+    base_sps_full = (1.0 / (time.perf_counter() - t0)) / 8.0
+    return {
+        "lasso_sweeps_per_sec": round(sweeps_per_sec, 2),
+        "lasso_unit": f"CD sweeps/s (n={n}, f={f + 1})",
+        "lasso_vs_baseline": round(sweeps_per_sec / base_sps_full, 2),
+    }
+
+
+def _numpy_cd_sweep(X, y, theta, lam):
+    n, m = X.shape
+    col_sq = (X * X).sum(0)
+    r = y - X @ theta
+    for j in range(m):
+        rho = X[:, j] @ (r + X[:, j] * theta[j])
+        soft = np.sign(rho) * max(abs(rho) - lam * n, 0.0)
+        numer = rho if j == 0 else soft
+        new_tj = numer / max(col_sq[j], 1e-30) if col_sq[j] > 0 else 0.0
+        r = r - X[:, j] * (new_tj - theta[j])
+        theta[j] = new_tj
+    return theta
+
+
+def update_history(out):
+    """Record per-metric best-so-far; return {metric: current/best}."""
+    metrics = {
+        "kmeans_iters_per_sec": out["value"],
+        "cdist_gbps": out.get("cdist_gbps"),
+        "moments_gbps": out.get("moments_gbps"),
+        "qr_gflops": out.get("qr_gflops"),
+        "matmul_gflops": out.get("matmul_gflops"),
+        "lasso_sweeps_per_sec": out.get("lasso_sweeps_per_sec"),
+    }
+    try:
+        with open(HISTORY_PATH) as fh:
+            hist = json.load(fh)
+    except (OSError, ValueError):
+        hist = {}
+    deltas = {}
+    for k, v in metrics.items():
+        if v is None:
+            continue
+        rec = hist.setdefault(k, {"best": v, "runs": []})
+        rec["runs"] = (rec.get("runs", []) + [v])[-20:]
+        if v > rec.get("best", 0):
+            rec["best"] = v
+        deltas[k] = round(v / rec["best"], 3)
+    try:
+        with open(HISTORY_PATH, "w") as fh:
+            json.dump(hist, fh, indent=1, sort_keys=True)
+    except OSError:
+        pass
+    return deltas
 
 
 def numpy_cdist(x):

@@ -383,14 +383,21 @@ def _rebalance_csv_rows(local: np.ndarray, comm) -> tuple:
     out[own_idx[keep] - t_lo] = local[keep]
     cap = int(caps.max())
     if cap > 0:
+        # one gather: surplus rows widened to f64 with their global index
+        # appended as the last column (exactly representable below 2^53)
         pad_rows = cap - len(surplus)
-        sp = np.pad(surplus, [(0, pad_rows)] + [(0, 0)] * (local.ndim - 1))
-        si = np.pad(surplus_idx, (0, pad_rows), constant_values=-1)
-        all_sp = np.asarray(multihost_utils.process_allgather(sp))
-        all_si = np.asarray(multihost_utils.process_allgather(si))
+        payload = np.concatenate(
+            [surplus.astype(np.float64), surplus_idx[:, None].astype(np.float64)],
+            axis=1,
+        )
+        payload = np.pad(payload, [(0, pad_rows), (0, 0)], constant_values=-1)
+        all_p = np.asarray(multihost_utils.process_allgather(payload))
         for q in range(nproc):
-            sel = (all_si[q] >= t_lo) & (all_si[q] < t_hi)
-            out[all_si[q][sel] - t_lo] = all_sp[q][sel]
+            qi = all_p[q, :, -1]
+            sel = (qi >= t_lo) & (qi < t_hi)
+            out[qi[sel].astype(np.int64) - t_lo] = all_p[q, sel, :-1].astype(
+                local.dtype
+            )
     return out, t_lo, n
 
 
@@ -447,6 +454,26 @@ def load_csv(
         )
         if local.shape[0] == 0:
             local = local.reshape(0, cols)
+        # The boundary-surplus exchange assumes every split rank lives on
+        # exactly one process and each process's ranks are contiguous —
+        # true for the standard 1-D world mesh. Replicated or interleaved
+        # layouts (hierarchical meshes) take the safe allgather assembly.
+        from .communication import _split_ranks, assemble_local_shards
+
+        rank_owners: dict = {}
+        proc_ranks: dict = {}
+        for r, d in _split_ranks(comm_s):
+            rank_owners.setdefault(r, set()).add(d.process_index)
+            proc_ranks.setdefault(d.process_index, set()).add(r)
+        clean = all(len(o) == 1 for o in rank_owners.values()) and all(
+            sorted(rs) == list(range(min(rs), max(rs) + 1))
+            for rs in proc_ranks.values()
+        )
+        if not clean:
+            buf, gshape = assemble_local_shards(local, 0, comm_s)
+            return DNDarray._from_buffer(
+                buf, gshape, dtype, 0, devices.sanitize_device(device), comm_s
+            )
         # exchange only boundary surplus rows, then stitch each process's
         # devices' chunks directly — O(local) memory per process (the
         # uneven assemble_local_shards path would allgather the whole set)

@@ -120,6 +120,22 @@ def average(x: DNDarray, axis=None, weights: Optional[DNDarray] = None, returned
         shape[axis_s] = -1
         w = w.reshape(shape)
     wsum = jnp.sum(jnp.broadcast_to(w, xa.shape), axis=axis_s)
+    # numpy parity: zero weight sums raise. Host-provided weights are
+    # checked for free on their (small) host copy; device-resident
+    # (DNDarray) weights pay one small fetch — average is an eager
+    # analytics entry point, not a training-loop op.
+    if not isinstance(weights, DNDarray) and isinstance(axis_s, (int, type(None))):
+        wnp = np.asarray(weights, dtype=np.float64).reshape(tuple(w.shape))
+        if axis_s is None:
+            zero = bool(wnp.sum() == 0)
+        elif wnp.shape[axis_s] == xa.shape[axis_s]:
+            zero = bool(np.any(wnp.sum(axis=axis_s) == 0))
+        else:  # weights broadcast along the reduced axis
+            zero = bool(np.any(wnp == 0))
+    else:
+        zero = bool(jnp.any(wsum == 0))
+    if zero:
+        raise ZeroDivisionError("Weights sum to zero, can't be normalized")
     result = jnp.sum(xa * w, axis=axis_s) / wsum
     split = _reduced_split(x.split, axis_s, x.ndim, False)
     res = DNDarray(result, dtype=types.canonical_heat_type(result.dtype), split=split, device=x.device, comm=x.comm)
@@ -245,9 +261,27 @@ def skew(x: DNDarray, axis=None, unbiased: bool = True) -> DNDarray:
     return DNDarray(g1, dtype=types.canonical_heat_type(g1.dtype), split=split, device=x.device, comm=x.comm)
 
 
+def _nan_propagating(op):
+    """Wrap a reduction so NaN wins (torch/numpy semantics): the sharded
+    cross-device max/min collective silently drops NaN (maximum(nan, x)
+    resolves to x in the all-reduce combiner), so an explicit isnan
+    reduction rides along — XLA fuses the sibling passes."""
+
+    def run(arr, axis=None, keepdims=False, **kw):
+        r = op(arr, axis=axis, keepdims=keepdims, **kw)
+        if jnp.issubdtype(arr.dtype, jnp.floating):
+            bad = jnp.any(jnp.isnan(arr), axis=axis, keepdims=keepdims)
+            r = jnp.where(bad, jnp.asarray(jnp.nan, r.dtype), r)
+        return r
+
+    return run
+
+
 def max(x: DNDarray, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
-    """Maximum along axis (reference ``statistics.py:781``)."""
-    return _reduce_op(jnp.max, x, axis=axis, out=out, keepdims=bool(keepdim or keepdims), neutral="min")
+    """Maximum along axis (reference ``statistics.py:781``); NaN wins."""
+    return _reduce_op(
+        _nan_propagating(jnp.max), x, axis=axis, out=out, keepdims=bool(keepdim or keepdims), neutral="min"
+    )
 
 
 def maximum(x1, x2, out=None) -> DNDarray:
@@ -291,8 +325,10 @@ def median(x: DNDarray, axis=None, keepdim: bool = False, keepdims=None) -> DNDa
 
 
 def min(x: DNDarray, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
-    """Minimum along axis (reference ``statistics.py:1114``)."""
-    return _reduce_op(jnp.min, x, axis=axis, out=out, keepdims=bool(keepdim or keepdims), neutral="max")
+    """Minimum along axis (reference ``statistics.py:1114``); NaN wins."""
+    return _reduce_op(
+        _nan_propagating(jnp.min), x, axis=axis, out=out, keepdims=bool(keepdim or keepdims), neutral="max"
+    )
 
 
 def minimum(x1, x2, out=None) -> DNDarray:
