@@ -134,6 +134,27 @@ def smoke_check():
     return {"smoke_ok": bool(ok)}
 
 
+def _chained_timed(trial, xa):
+    """best-of-4 timer for eps-chained device trials: ``trial(xa, s)``
+    returns a device scalar that seeds the next call, so the trials
+    serialize on device with ONE host sync at the end (the chip's
+    block_until_ready does not synchronize; see module docstring)."""
+    import jax.numpy as jnp
+
+    def timed(reps):
+        best = float("inf")
+        for _ in range(4):
+            s = jnp.float32(0)
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                s = trial(xa, s) * jnp.float32(1e-30)
+            float(s)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    return timed
+
+
 def _marginal(timed, short, long_, work_per_unit):
     """Best-of-two positive marginal estimates (shared-chip spread)."""
     estimates = []
@@ -171,20 +192,9 @@ def moments_bench():
         # fold everything into one scalar to chain the next trial
         return sum(jnp.sum(o) for o in outs)
 
-    def timed(reps):
-        best = float("inf")
-        for _ in range(4):
-            s = jnp.float32(0)
-            t0 = time.perf_counter()
-            for _ in range(reps):
-                s = sweep(xa, s) * jnp.float32(1e-30)
-            float(s)
-            best = min(best, time.perf_counter() - t0)
-        return best
-
     float(sweep(xa, jnp.float32(0)))  # warm compile
     gb_per_sweep = n * f * 4 * 3 / 1e9  # one pass per axis, mean+std fused
-    gbps = _marginal(timed, 3, 23, gb_per_sweep)
+    gbps = _marginal(_chained_timed(sweep, xa), 3, 23, gb_per_sweep)
 
     sub = data[: n // 8]
     t0 = time.perf_counter()
@@ -209,9 +219,14 @@ def qr_matmul_bench():
     data = rng.normal(size=(n, f)).astype(np.float32)
     xa = jnp.asarray(data)
 
+    from heat_tpu.core.linalg.qr import _cholqr2_with_fallback
+
     @jax.jit
     def qr_trial(x, eps):
-        q, r = jnp.linalg.qr(x + eps * jnp.float32(1e-30))
+        # the library's auto path for tall-skinny floats (CholeskyQR2 on
+        # the MXU with the on-device ill-conditioning fallback)
+        with jax.default_matmul_precision("highest"):
+            q, r = _cholqr2_with_fallback(x + eps * jnp.float32(1e-30))
         return r[0, 0]
 
     @jax.jit
@@ -219,25 +234,11 @@ def qr_matmul_bench():
         xx = x + eps * jnp.float32(1e-30)
         return (xx.T @ xx)[0, 0]
 
-    def make_timed(trial):
-        def timed(reps):
-            best = float("inf")
-            for _ in range(4):
-                s = jnp.float32(0)
-                t0 = time.perf_counter()
-                for _ in range(reps):
-                    s = trial(xa, s) * jnp.float32(1e-30)
-                float(s)
-                best = min(best, time.perf_counter() - t0)
-            return best
-
-        return timed
-
     float(qr_trial(xa, jnp.float32(0)))
     float(mm_trial(xa, jnp.float32(0)))
     flops = 2.0 * n * f * f / 1e9  # GFLOP per trial (both kernels)
-    qr_gflops = _marginal(make_timed(qr_trial), 2, 10, flops)
-    mm_gflops = _marginal(make_timed(mm_trial), 3, 23, flops)
+    qr_gflops = _marginal(_chained_timed(qr_trial, xa), 2, 10, flops)
+    mm_gflops = _marginal(_chained_timed(mm_trial, xa), 3, 23, flops)
 
     sub = data[: n // 16]
     t0 = time.perf_counter()

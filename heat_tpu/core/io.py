@@ -383,21 +383,17 @@ def _rebalance_csv_rows(local: np.ndarray, comm) -> tuple:
     out[own_idx[keep] - t_lo] = local[keep]
     cap = int(caps.max())
     if cap > 0:
-        # one gather: surplus rows widened to f64 with their global index
-        # appended as the last column (exactly representable below 2^53)
+        # rows travel in their NATIVE dtype (an f64 round-trip would
+        # silently round int64 values above 2^53); indices ride a second
+        # small int64 gather
         pad_rows = cap - len(surplus)
-        payload = np.concatenate(
-            [surplus.astype(np.float64), surplus_idx[:, None].astype(np.float64)],
-            axis=1,
-        )
-        payload = np.pad(payload, [(0, pad_rows), (0, 0)], constant_values=-1)
-        all_p = np.asarray(multihost_utils.process_allgather(payload))
+        sp = np.pad(surplus, [(0, pad_rows)] + [(0, 0)] * (local.ndim - 1))
+        si = np.pad(surplus_idx.astype(np.int64), (0, pad_rows), constant_values=-1)
+        all_sp = np.asarray(multihost_utils.process_allgather(sp))
+        all_si = np.asarray(multihost_utils.process_allgather(si))
         for q in range(nproc):
-            qi = all_p[q, :, -1]
-            sel = (qi >= t_lo) & (qi < t_hi)
-            out[qi[sel].astype(np.int64) - t_lo] = all_p[q, sel, :-1].astype(
-                local.dtype
-            )
+            sel = (all_si[q] >= t_lo) & (all_si[q] < t_hi)
+            out[all_si[q][sel] - t_lo] = all_sp[q][sel]
     return out, t_lo, n
 
 

@@ -137,6 +137,41 @@ class TestQR(TestCase):
         _, r_ref = np.linalg.qr(x)
         np.testing.assert_allclose(np.abs(r.numpy()), np.abs(r_ref), atol=1e-4)
 
+    def test_cholqr2_methods(self):
+        """auto routes tall-skinny floats to CholeskyQR2 (MXU matmuls);
+        ill-conditioned inputs must fall back on device and every method
+        keeps the QR contract."""
+        rng = np.random.default_rng(12)
+        x = rng.normal(size=(512, 16)).astype(np.float32)
+        for method in ("auto", "cholqr2", "householder"):
+            for split in (None, 0):
+                q, r = ht.linalg.qr(ht.array(x, split=split), method=method)
+                np.testing.assert_allclose(q.numpy() @ r.numpy(), x, atol=1e-4)
+                np.testing.assert_allclose(
+                    q.numpy().T @ q.numpy(), np.eye(16), atol=1e-4,
+                    err_msg=f"{method} split={split}",
+                )
+        # cond ~ 1e6 in f32: CholeskyQR2's Gram squares it past what
+        # Cholesky survives; the guard must still return orthogonal Q
+        u, _ = np.linalg.qr(rng.normal(size=(512, 16)))
+        v, _ = np.linalg.qr(rng.normal(size=(16, 16)))
+        bad = ((u * np.logspace(0, -6, 16)) @ v.T).astype(np.float32)
+        q, r = ht.linalg.qr(ht.array(bad, split=0), method="cholqr2")
+        np.testing.assert_allclose(q.numpy().T @ q.numpy(), np.eye(16), atol=1e-4)
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), bad, atol=1e-5)
+        with pytest.raises(ValueError):
+            ht.linalg.qr(ht.array(x, split=0), method="magic")
+        # wide input under forced cholqr2: Householder shapes, no crash
+        w = rng.normal(size=(4, 16)).astype(np.float32)
+        qw, rw = ht.linalg.qr(ht.array(w), method="cholqr2")
+        assert qw.shape == (4, 4) and rw.shape == (4, 16)
+        np.testing.assert_allclose(qw.numpy() @ rw.numpy(), w, atol=1e-4)
+        # distributed wide-per-block case (m=100, n=32 over 8 devices
+        # gives 13-row local blocks): must route safely too
+        t = rng.normal(size=(100, 32)).astype(np.float32)
+        qt, rt = ht.linalg.qr(ht.array(t, split=0), method="cholqr2")
+        np.testing.assert_allclose(qt.numpy() @ rt.numpy(), t, atol=1e-3)
+
 
 class TestSVD(TestCase):
     def test_pinv_lstsq_padded_extents(self):
