@@ -2,9 +2,10 @@
 
 The reference implements tiled CAQR over ``SquareDiagTiles`` with explicit
 tile sends (``qr.py:319-866``). The TPU-native algorithm is **TSQR**
-(communication-avoiding QR for tall-skinny matrices): one local Householder
-QR per shard on the MXU, an all-gather of the tiny R factors over ICI, one
-replicated merge QR, and a local back-multiply — expressed in ~40 lines of
+(communication-avoiding QR for tall-skinny matrices): one local QR per
+shard — CholeskyQR2 (MXU matmuls) for tall floating blocks, Householder
+otherwise — an all-gather of the tiny R factors over ICI, one replicated
+merge QR, and a local back-multiply, expressed in ~40 lines of
 ``shard_map``. Row counts that don't divide the mesh are zero-row padded
 (QR of [A; 0] has the same R and a zero-row-extended Q).
 """
@@ -42,7 +43,7 @@ def qr(
 
     ``method``: ``"auto"`` (default) runs **CholeskyQR2** for tall-skinny
     floating inputs — two Gram-matmul + Cholesky passes, entirely
-    MXU-resident, ~100x the FLOP rate of Householder QR on TPU — with a
+    MXU-resident, 13-18x the measured Householder rate on a v5e chip — with a
     device-side orthogonality check that falls back to Householder when
     the conditioning defeats it (CholQR2 is O(eps)-orthogonal for
     cond(A) <~ eps^-1/2; the check costs one extra (n, n) Gram).
@@ -132,7 +133,11 @@ def _qr_impl(a: DNDarray, calc_q: bool, method: str = "auto") -> QR_out:
     if a.split == 1:
         # column-split: the reduced factors are column-blocked; gather and
         # factor once (reference ``__split1_qr_loop`` did a per-block loop).
-        q, r = jnp.linalg.qr(a._logical().astype(ftype))
+        x = a._logical().astype(ftype)
+        if _use_cholqr2(method, m, n, x.dtype):
+            q, r = _cholqr2_with_fallback(x)
+        else:
+            q, r = jnp.linalg.qr(x)
         Q = DNDarray(q, split=1, device=a.device, comm=comm) if calc_q else None
         return QR_out(Q, DNDarray(r, split=1, device=a.device, comm=comm))
 

@@ -144,7 +144,7 @@ class TestQR(TestCase):
         rng = np.random.default_rng(12)
         x = rng.normal(size=(512, 16)).astype(np.float32)
         for method in ("auto", "cholqr2", "householder"):
-            for split in (None, 0):
+            for split in (None, 0, 1):
                 q, r = ht.linalg.qr(ht.array(x, split=split), method=method)
                 np.testing.assert_allclose(q.numpy() @ r.numpy(), x, atol=1e-4)
                 np.testing.assert_allclose(

@@ -1,0 +1,224 @@
+"""Deep manipulations coverage (reference ``test_manipulations.py`` is
+3,625 LoC; this extends the 208-LoC smoke file toward that per-case
+depth): mode/axis/split/dtype matrices for the shape movers, padded
+non-divisible extents everywhere, and error-contract pins.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from tests.base import TestCase
+
+
+class TestPadMatrix(TestCase):
+    def test_modes_and_widths(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(9, 5)).astype(np.float32)
+        for split in (None, 0, 1):
+            a = ht.array(x, split=split)
+            # reference/torch semantics: a flat (before, after) tuple pads
+            # the LAST dimension (reference manipulations.py:1138-1154)
+            for width, np_width in (
+                (1, 1),
+                ((2, 3), ((0, 0), (2, 3))),
+                (((1, 2), (3, 0)), ((1, 2), (3, 0))),
+            ):
+                got = ht.pad(a, width).numpy()
+                want = np.pad(x, np_width)
+                np.testing.assert_array_equal(got, want, err_msg=f"{split} {width}")
+            for mode in ("edge", "reflect", "wrap"):
+                got = ht.pad(a, ((2, 1), (0, 2)), mode=mode).numpy()
+                want = np.pad(x, ((2, 1), (0, 2)), mode=mode)
+                np.testing.assert_array_equal(got, want, err_msg=f"{split} {mode}")
+            got = ht.pad(a, 2, mode="constant", constant_values=7.5).numpy()
+            np.testing.assert_array_equal(got, np.pad(x, 2, constant_values=7.5))
+
+    def test_1d_and_int_dtypes(self):
+        x = np.arange(13, dtype=np.int64)
+        a = ht.array(x, split=0)
+        # 1-D: the flat tuple IS the last (only) dim — numpy agrees here
+        np.testing.assert_array_equal(ht.pad(a, (3, 4)).numpy(), np.pad(x, (3, 4)))
+        assert ht.pad(a, (3, 4)).dtype == ht.int64
+
+
+class TestRollMatrix(TestCase):
+    def test_shift_axis_matrix(self):
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(9, 6)).astype(np.float32)
+        for split in (None, 0, 1):
+            a = ht.array(x, split=split)
+            for shift, axis in [
+                (3, 0), (-2, 0), (4, 1), (-7, 1), (0, 0),
+                (5, None), (-5, None), ((2, 3), (0, 1)),
+            ]:
+                got = ht.roll(a, shift, axis=axis).numpy()
+                want = np.roll(x, shift, axis=axis)
+                np.testing.assert_array_equal(
+                    got, want, err_msg=f"split={split} shift={shift} axis={axis}"
+                )
+
+    def test_shift_exceeding_extent(self):
+        x = np.arange(7, dtype=np.float32)
+        a = ht.array(x, split=0)
+        np.testing.assert_array_equal(ht.roll(a, 23).numpy(), np.roll(x, 23))
+
+
+class TestRepeatTileUnfold(TestCase):
+    def test_repeat_forms(self):
+        rng = np.random.default_rng(2)
+        x = rng.integers(0, 9, size=(5, 4)).astype(np.int32)
+        for split in (None, 0, 1):
+            a = ht.array(x, split=split)
+            np.testing.assert_array_equal(ht.repeat(a, 3).numpy(), np.repeat(x, 3))
+            np.testing.assert_array_equal(
+                ht.repeat(a, 2, axis=0).numpy(), np.repeat(x, 2, axis=0)
+            )
+            np.testing.assert_array_equal(
+                ht.repeat(a, 2, axis=1).numpy(), np.repeat(x, 2, axis=1)
+            )
+
+    def test_tile_reps(self):
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        for split in (None, 0, 1):
+            a = ht.array(x, split=split)
+            for reps in (2, (2, 1), (1, 3), (2, 2, 2)):
+                np.testing.assert_array_equal(
+                    ht.tile(a, reps).numpy(), np.tile(x, reps), err_msg=str(reps)
+                )
+
+    def test_unfold_windows(self):
+        x = np.arange(40, dtype=np.float32).reshape(10, 4)
+        a = ht.array(x, split=0)
+        u = ht.unfold(a, 0, size=3, step=2)
+        # torch unfold semantics: windows become trailing dim
+        t = np.stack([x[i : i + 3] for i in range(0, 8, 2)], axis=0)
+        np.testing.assert_array_equal(u.numpy(), np.moveaxis(t, 1, -1))
+        with pytest.raises(ValueError):
+            ht.unfold(a, 0, size=11)
+        with pytest.raises(ValueError):
+            ht.unfold(a, 0, size=0)
+
+
+class TestStackSplitMatrix(TestCase):
+    def test_stack_axes_and_splits(self):
+        rng = np.random.default_rng(3)
+        xs = [rng.normal(size=(5, 4)).astype(np.float32) for _ in range(3)]
+        for split in (None, 0, 1):
+            arrs = [ht.array(x, split=split) for x in xs]
+            for axis in (0, 1, 2, -1):
+                np.testing.assert_array_equal(
+                    ht.stack(arrs, axis=axis).numpy(), np.stack(xs, axis=axis)
+                )
+
+    def test_split_sections_and_indices(self):
+        x = np.arange(36, dtype=np.float32).reshape(12, 3)
+        a = ht.array(x, split=0)
+        for sections in (2, 3, 4):
+            got = ht.split(a, sections, 0)
+            want = np.split(x, sections, 0)
+            assert len(got) == len(want)
+            for g, w in zip(got, want):
+                np.testing.assert_array_equal(g.numpy(), w)
+        got = ht.split(a, [3, 7], 0)
+        for g, w in zip(got, np.split(x, [3, 7], 0)):
+            np.testing.assert_array_equal(g.numpy(), w)
+        with pytest.raises(ValueError):
+            ht.split(a, 5, 0)  # 12 not divisible by 5
+
+    def test_dsplit_hsplit_vsplit(self):
+        x = np.arange(48, dtype=np.float32).reshape(4, 6, 2)
+        a = ht.array(x, split=0)
+        for g, w in zip(ht.vsplit(a, 2), np.vsplit(x, 2)):
+            np.testing.assert_array_equal(g.numpy(), w)
+        for g, w in zip(ht.hsplit(a, 3), np.hsplit(x, 3)):
+            np.testing.assert_array_equal(g.numpy(), w)
+        for g, w in zip(ht.dsplit(a, 2), np.dsplit(x, 2)):
+            np.testing.assert_array_equal(g.numpy(), w)
+
+
+class TestReshapeDepth(TestCase):
+    def test_minus_one_inference(self):
+        x = np.arange(60, dtype=np.float32)
+        a = ht.array(x, split=0)
+        assert ht.reshape(a, (-1, 5)).shape == (12, 5)
+        assert ht.reshape(a, (3, -1, 2)).shape == (3, 10, 2)
+        with pytest.raises(ValueError):
+            ht.reshape(a, (-1, -1))
+        with pytest.raises(ValueError):
+            ht.reshape(a, (7, 9))
+
+    def test_dtype_preservation(self):
+        for dt, ht_dt in ((np.int64, ht.int64), (np.float64, ht.float64), (np.bool_, ht.bool)):
+            x = np.ones((8, 3)).astype(dt)
+            r = ht.reshape(ht.array(x, split=0), (3, 8))
+            assert r.dtype == ht_dt
+            np.testing.assert_array_equal(r.numpy(), x.reshape(3, 8))
+
+    def test_3d_cross_split_moves(self):
+        rng = np.random.default_rng(4)
+        x = rng.normal(size=(6, 5, 4)).astype(np.float32)
+        for in_split in (0, 1, 2):
+            a = ht.array(x, split=in_split)
+            for out_shape, out_split in [((30, 4), 0), ((6, 20), 1), ((120,), 0), ((4, 5, 6), 2)]:
+                r = ht.reshape(a, out_shape, new_split=out_split)
+                assert r.split == out_split
+                np.testing.assert_array_equal(
+                    r.numpy(), x.reshape(out_shape),
+                    err_msg=f"{in_split}->{out_shape}/{out_split}",
+                )
+
+
+class TestTopkUniqueDepth(TestCase):
+    def test_topk_int_dtypes_and_duplicates(self):
+        x = np.array([5, 5, 5, 1, 9, 9, 3, 5], dtype=np.int64)
+        a = ht.array(x, split=0)
+        v, i = ht.topk(a, 4)
+        order = np.argsort(-x, kind="stable")[:4]
+        np.testing.assert_array_equal(v.numpy(), x[order])
+        np.testing.assert_array_equal(i.numpy(), order)
+        v2, i2 = ht.topk(a, 4, largest=False)
+        order2 = np.argsort(x, kind="stable")[:4]
+        np.testing.assert_array_equal(v2.numpy(), x[order2])
+
+    def test_topk_k_equals_n(self):
+        x = np.random.default_rng(5).normal(size=11).astype(np.float32)
+        v, i = ht.topk(ht.array(x, split=0), 11)
+        np.testing.assert_array_equal(v.numpy(), np.sort(x)[::-1])
+
+    def test_unique_dtypes_and_negative(self):
+        for dt in (np.int32, np.int64, np.float32):
+            x = np.array([3, -1, 3, 0, -1, 7, 0, 0], dtype=dt)
+            res = ht.unique(ht.array(x, split=0))
+            np.testing.assert_array_equal(np.sort(res.numpy()), np.unique(x))
+
+    def test_unique_bool_and_single(self):
+        res = ht.unique(ht.array(np.array([True, False, True]), split=0))
+        np.testing.assert_array_equal(np.sort(res.numpy()), [False, True])
+        res1 = ht.unique(ht.array(np.array([42.0], np.float32)))
+        np.testing.assert_array_equal(res1.numpy(), [42.0])
+
+
+class TestMoveaxesDepth(TestCase):
+    def test_moveaxis_split_tracking(self):
+        x = np.random.default_rng(6).normal(size=(4, 5, 6)).astype(np.float32)
+        a = ht.array(x, split=0)
+        m = ht.moveaxis(a, 0, 2)
+        np.testing.assert_array_equal(m.numpy(), np.moveaxis(x, 0, 2))
+        assert m.split == 2  # the split dim moved with its data
+        s = ht.swapaxes(a, 0, 1)
+        assert s.split == 1
+        np.testing.assert_array_equal(s.numpy(), np.swapaxes(x, 0, 1))
+
+    def test_flip_axes_combinations(self):
+        x = np.arange(24, dtype=np.float32).reshape(4, 6)
+        for split in (None, 0, 1):
+            a = ht.array(x, split=split)
+            for axis in (None, 0, 1, (0, 1)):
+                np.testing.assert_array_equal(
+                    ht.flip(a, axis=axis).numpy(), np.flip(x, axis=axis)
+                )
+            np.testing.assert_array_equal(ht.fliplr(a).numpy(), np.fliplr(x))
+            np.testing.assert_array_equal(ht.flipud(a).numpy(), np.flipud(x))
