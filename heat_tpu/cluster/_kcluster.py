@@ -20,7 +20,38 @@ from ..core.base import BaseEstimator, ClusteringMixin
 from ..core.dndarray import DNDarray
 from ..spatial.distance import _quadratic_expand
 
-__all__ = ["_KCluster"]
+__all__ = ["_KCluster", "_whole_fit"]
+
+
+def _whole_fit(step_fn: Callable, xa: jnp.ndarray, centers: jnp.ndarray, max_iter, tol):
+    """Shared whole-fit harness: ``lax.while_loop`` over fused iterations
+    with the shift test ON DEVICE, so a full fit is a single dispatch
+    (per-iteration host fetches would put an RPC floor under every step
+    on a tunneled chip). ``step_fn(xa, centers) -> (centers, labels,
+    shift)``; runs while ``i < max_iter and shift > tol``. Returns
+    ``(centers, labels, n_iter)``. Callers jit this (closing over their
+    step) — KMedians/KMedoids here; KMeans keeps its specialized variant
+    (extra valid-count masking state) with the same discipline.
+    """
+
+    def cond(state):
+        i, _, _, shift = state
+        return jnp.logical_and(i < max_iter, shift > tol)
+
+    def body(state):
+        i, c, _, _ = state
+        nc, labels, shift = step_fn(xa, c)
+        return (i + 1, nc, labels, shift)
+
+    n = xa.shape[0]
+    state0 = (
+        jnp.int32(0),
+        centers,
+        jnp.zeros((n,), jnp.int64 if jax.config.jax_enable_x64 else jnp.int32),
+        jnp.asarray(jnp.inf, centers.dtype),
+    )
+    i, c, labels, _ = jax.lax.while_loop(cond, body, state0)
+    return c, labels, i
 
 
 class _KCluster(BaseEstimator, ClusteringMixin):

@@ -34,6 +34,15 @@ def _median_step(xa: jnp.ndarray, centers: jnp.ndarray, k: int):
     return new_centers, labels, shift
 
 
+@partial(jax.jit, static_argnames=("k",))
+def _median_fit(xa: jnp.ndarray, centers: jnp.ndarray, k: int, max_iter, tol):
+    """Whole fit as ONE device program (shared harness; the eager loop
+    paid a host round-trip per iteration)."""
+    from ._kcluster import _whole_fit
+
+    return _whole_fit(lambda x, c: _median_step(x, c, k), xa, centers, max_iter, tol)
+
+
 class KMedians(_KCluster):
     """K-Medians (reference ``kmedians.py:12``)."""
 
@@ -58,16 +67,17 @@ class KMedians(_KCluster):
         """reference ``kmedians.py``"""
         if not isinstance(x, DNDarray):
             raise TypeError(f"input needs to be a DNDarray, but was {type(x)}")
+        if self.max_iter < 1:
+            raise ValueError(f"max_iter must be >= 1, got {self.max_iter}")
         k = self.n_clusters
         xa = x._logical().astype(jnp.promote_types(x.larray.dtype, jnp.float32))
         centers = self._initialize_cluster_centers(x).astype(xa.dtype)
 
-        labels = None
-        n_iter = 0
-        for n_iter in range(1, self.max_iter + 1):
-            centers, labels, shift = _median_step(xa, centers, k)
-            if self.tol is not None and float(shift) <= self.tol:
-                break
+        tol = -1.0 if self.tol is None else float(self.tol)
+        centers, labels, n_iter = _median_fit(
+            xa, centers, k, jnp.int32(self.max_iter), jnp.asarray(tol, xa.dtype)
+        )
+        n_iter = int(n_iter)
 
         self._cluster_centers = DNDarray(centers, split=None, device=x.device, comm=x.comm)
         self._labels = DNDarray(

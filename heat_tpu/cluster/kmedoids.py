@@ -39,6 +39,18 @@ def _medoid_step(xa: jnp.ndarray, centers: jnp.ndarray, k: int):
     return new_centers, labels, shift
 
 
+@partial(jax.jit, static_argnames=("k",))
+def _medoid_fit(xa: jnp.ndarray, centers: jnp.ndarray, k: int, max_iter):
+    """Whole fit as ONE device program (medoids converge when no center
+    moves: shift == 0); shared harness — the eager loop fetched shift to
+    host per step."""
+    from ._kcluster import _whole_fit
+
+    return _whole_fit(
+        lambda x, c: _medoid_step(x, c, k), xa, centers, max_iter, jnp.asarray(0.0, xa.dtype)
+    )
+
+
 class KMedoids(_KCluster):
     """K-Medoids with snap-to-point update (reference ``kmedoids.py:12``)."""
 
@@ -62,16 +74,14 @@ class KMedoids(_KCluster):
         """reference ``kmedoids.py``"""
         if not isinstance(x, DNDarray):
             raise TypeError(f"input needs to be a DNDarray, but was {type(x)}")
+        if self.max_iter < 1:
+            raise ValueError(f"max_iter must be >= 1, got {self.max_iter}")
         k = self.n_clusters
         xa = x._logical().astype(jnp.promote_types(x.larray.dtype, jnp.float32))
         centers = self._initialize_cluster_centers(x).astype(xa.dtype)
 
-        labels = None
-        n_iter = 0
-        for n_iter in range(1, self.max_iter + 1):
-            centers, labels, shift = _medoid_step(xa, centers, k)
-            if float(shift) == 0.0:
-                break
+        centers, labels, n_iter = _medoid_fit(xa, centers, k, jnp.int32(self.max_iter))
+        n_iter = int(n_iter)
 
         self._cluster_centers = DNDarray(centers, split=None, device=x.device, comm=x.comm)
         self._labels = DNDarray(

@@ -121,7 +121,10 @@ def _qr_impl(a: DNDarray, calc_q: bool, method: str = "auto") -> QR_out:
     comm = a.comm
     p = comm.size
 
-    if a.split is None or p == 1:
+    if a.split != 0 or p == 1:
+        # replicated, single-device, or column-split (for split=1 the
+        # reduced factors are column-blocked; gather and factor once —
+        # the reference's ``__split1_qr_loop`` did a per-block loop)
         x = a._logical().astype(ftype)
         if _use_cholqr2(method, m, n, x.dtype):
             q, r = _cholqr2_with_fallback(x)
@@ -129,17 +132,6 @@ def _qr_impl(a: DNDarray, calc_q: bool, method: str = "auto") -> QR_out:
             q, r = jnp.linalg.qr(x)
         Q = DNDarray(q, split=a.split, device=a.device, comm=comm) if calc_q else None
         return QR_out(Q, DNDarray(r, split=a.split, device=a.device, comm=comm))
-
-    if a.split == 1:
-        # column-split: the reduced factors are column-blocked; gather and
-        # factor once (reference ``__split1_qr_loop`` did a per-block loop).
-        x = a._logical().astype(ftype)
-        if _use_cholqr2(method, m, n, x.dtype):
-            q, r = _cholqr2_with_fallback(x)
-        else:
-            q, r = jnp.linalg.qr(x)
-        Q = DNDarray(q, split=1, device=a.device, comm=comm) if calc_q else None
-        return QR_out(Q, DNDarray(r, split=1, device=a.device, comm=comm))
 
     # split == 0: TSQR. The buffer is already tail-padded to a multiple of
     # the mesh size; zero the padding (QR of [A; 0] has the same R and a

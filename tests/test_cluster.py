@@ -161,6 +161,19 @@ class TestKMediansMedoids(TestCase):
         for c in centers:
             assert (np.abs(pts - c).sum(axis=1) < 1e-5).any()
 
+    def test_kcluster_max_iter_validation_and_n_iter(self):
+        """All three k-cluster fits reject max_iter < 1 (the while_loop
+        harness would otherwise return the zero-label placeholder) and
+        report an n_iter within bounds."""
+        pts, _ = make_blobs(seed=14)
+        x = ht.array(pts, split=0)
+        for cls in (ht.cluster.KMeans, ht.cluster.KMedians, ht.cluster.KMedoids):
+            with pytest.raises(ValueError, match="max_iter"):
+                cls(n_clusters=2, max_iter=0).fit(x)
+            est = cls(n_clusters=2, max_iter=5, random_state=0).fit(x)
+            assert 1 <= est.n_iter_ <= 5
+            assert est.labels_.numpy().max() <= 1
+
 
 class TestSpectralAndGraph(TestCase):
     def test_laplacian(self):
