@@ -34,6 +34,17 @@ def convolve(a: DNDarray, v: DNDarray, mode: str = "full") -> DNDarray:
         raise ValueError("mode 'same' cannot be used with even-sized kernel")
     promoted = types.promote_types(a.dtype, v.dtype)
     jt = promoted.jax_type()
+    if a.split is not None and a.comm.size > 1:
+        # one jitted sharded program: GSPMD emits the halo exchange
+        # (bounded; see core/_movement.convolve_padded)
+        from ._movement import convolve_padded
+
+        buf, out_shape = convolve_padded(
+            a.larray, a.gshape, a.split, v._logical(), mode, jt, a.comm
+        )
+        return DNDarray._from_buffer(
+            buf, out_shape, promoted, a.split, device=a.device, comm=a.comm
+        )
     result = jnp.convolve(a._logical().astype(jt), v._logical().astype(jt), mode=mode)
     return DNDarray(
         result,

@@ -3,9 +3,17 @@
 The reference's tile classes *drive communication*: ``SplitTiles`` indexes
 the Isend/Irecv mesh of ``resplit_`` and ``SquareDiagTiles`` the CAQR tile
 loops. On TPU resplit is one ``device_put`` and QR is TSQR, so no code
-path needs tiles to move data — but the classes remain useful (and
-API-required) as *metadata views*: global tile boundaries, per-process
-ownership, and tile indexing over the canonical XLA layout.
+path needs tiles to move data — the classes are instead *functional tile
+views* over the canonical XLA layout: global tile boundaries, per-process
+ownership, and tile ``__getitem__``/``__setitem__`` that read from and
+write through to the sharded device buffer (the reference's in-place
+tile assignment API; int and slice-of-tiles keys).
+
+Cost model: XLA arrays are immutable, so each tile write is a full-array
+functional update (and each read fetches through ``.numpy()``) — per-tile
+access costs O(n), not O(tile). Loops over many tiles should batch their
+updates into one DNDarray setitem; these views exist for parity and
+inspection, not as a high-throughput update path.
 """
 from __future__ import annotations
 
@@ -16,6 +24,26 @@ import numpy as np
 from .dndarray import DNDarray
 
 __all__ = ["SplitTiles", "SquareDiagTiles"]
+
+
+def _tile_range(ends, k) -> slice:
+    """Global element slice covered by tile index ``k`` (int or slice of
+    tile indices) given cumulative tile ``ends`` along one dimension."""
+    n_tiles = len(ends)
+    if isinstance(k, slice):
+        idxs = range(*k.indices(n_tiles))
+        if len(idxs) == 0:
+            return slice(0, 0)
+        first, last = idxs[0], idxs[-1]
+        start = 0 if first == 0 else int(ends[first - 1])
+        return slice(start, int(ends[last]))
+    k = int(k)
+    if k < 0:
+        k += n_tiles
+    if not 0 <= k < n_tiles:
+        raise IndexError(f"tile index {k} out of range for {n_tiles} tiles")
+    start = 0 if k == 0 else int(ends[k - 1])
+    return slice(start, int(ends[k]))
 
 
 class SplitTiles:
@@ -70,17 +98,24 @@ class SplitTiles:
         starts[:, 1:] = self.__tile_ends_g[:, :-1]
         return self.__tile_ends_g - starts
 
-    def __getitem__(self, key) -> Optional[np.ndarray]:
-        """The global slab of tile ``key`` (returns host data; the
-        reference returned the local torch view)."""
+    def _tile_slices(self, key) -> Tuple[slice, ...]:
         if not isinstance(key, tuple):
             key = (key,)
         slices = []
         for dim, k in enumerate(key):
-            ends = self.__tile_ends_g[dim]
-            start = 0 if k == 0 else int(ends[k - 1])
-            slices.append(slice(start, int(ends[k])))
-        return self.__arr.numpy()[tuple(slices)]
+            slices.append(_tile_range(self.__tile_ends_g[dim], k))
+        return tuple(slices)
+
+    def __getitem__(self, key) -> Optional[np.ndarray]:
+        """The global slab of tile ``key`` (returns host data; the
+        reference returned the local torch view)."""
+        return self.__arr.numpy()[self._tile_slices(key)]
+
+    def __setitem__(self, key, value) -> None:
+        """Write tile ``key`` through to the (device-resident, sharded)
+        array — the reference's in-place tile assignment
+        (``tiling.py:292-330``), routed through DNDarray setitem."""
+        self.__arr[self._tile_slices(key)] = value
 
 
 class SquareDiagTiles:
@@ -157,12 +192,19 @@ class SquareDiagTiles:
     def tile_rows_per_process(self) -> List[int]:
         return self.__tile_rows_per_process
 
-    def __getitem__(self, key) -> Optional[np.ndarray]:
+    def _tile_slices(self, key) -> Tuple[slice, slice]:
         if not isinstance(key, tuple):
             key = (key,)
         row, col = (key + (slice(None),))[:2] if len(key) < 2 else key
-        rs = self.__row_inds + [self.__arr.gshape[0]]
-        cs = self.__col_inds + [self.__arr.gshape[1]]
-        r_slice = slice(rs[row], rs[row + 1]) if isinstance(row, int) else slice(None)
-        c_slice = slice(cs[col], cs[col + 1]) if isinstance(col, int) else slice(None)
-        return self.__arr.numpy()[r_slice, c_slice]
+        r_ends = np.asarray(self.__row_inds[1:] + [self.__arr.gshape[0]])
+        c_ends = np.asarray(self.__col_inds[1:] + [self.__arr.gshape[1]])
+        return _tile_range(r_ends, row), _tile_range(c_ends, col)
+
+    def __getitem__(self, key) -> Optional[np.ndarray]:
+        return self.__arr.numpy()[self._tile_slices(key)]
+
+    def __setitem__(self, key, value) -> None:
+        """Write tile ``(row, col)`` through to the sharded array (the
+        reference's CAQR loops assigned tiles in place,
+        ``tiling.py:830-870``)."""
+        self.__arr[self._tile_slices(key)] = value

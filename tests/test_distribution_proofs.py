@@ -369,6 +369,45 @@ class TestOuterBounded(TestCase):
         np.testing.assert_allclose(r.numpy(), np.outer(x, y), rtol=1e-6)
 
 
+class TestConvolveBounded(TestCase):
+    def test_hlo_halo_exchange_only(self):
+        """The sharded convolution must lower to the neighbor halo
+        exchange (collective-permutes), never an operand gather — the
+        reference's explicit get_halo stencil bound (signal.py:16-148)."""
+        _skip_unless_8()
+        import jax
+        import jax.numpy as jnp
+
+        from heat_tpu.core._movement import convolve_executable
+
+        comm = _comm()
+        n, kv = 1 << 20, 31
+        in_pshape = comm.padded_shape((n,), 0)
+        for mode in ("full", "same", "valid"):
+            fn, out_shape = convolve_executable(
+                in_pshape, np.dtype(np.float32), (n,), 0, kv,
+                np.dtype(np.float32), mode, jnp.float32, comm,
+            )
+            hlo = fn.lower(
+                jax.ShapeDtypeStruct(in_pshape, np.float32),
+                jax.ShapeDtypeStruct((kv,), np.float32),
+            ).compile().as_text()
+            per_dev = 4 * max(int(np.prod(in_pshape)), int(np.prod(comm.padded_shape(out_shape, 0)))) // 8
+            _assert_bounded(hlo, per_dev, 2.0, f"convolve {mode}")
+            assert hlo.count("collective-permute") > 0
+
+    def test_values_match_eager(self):
+        rng = np.random.default_rng(9)
+        a = rng.normal(size=137).astype(np.float32)
+        v = rng.normal(size=9).astype(np.float32)
+        for mode in ("full", "same", "valid"):
+            got = ht.convolve(ht.array(a, split=0), ht.array(v), mode=mode)
+            assert got.split == 0
+            np.testing.assert_allclose(
+                got.numpy(), np.convolve(a, v, mode=mode), rtol=1e-4, atol=1e-5
+            )
+
+
 class TestUniqueBounded(TestCase):
     def test_dedup_never_sees_more_than_one_shard(self):
         """The distributed path must dedupe per shard and merge candidates —

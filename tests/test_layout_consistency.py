@@ -12,6 +12,7 @@ from __future__ import annotations
 import unittest
 
 import numpy as np
+import pytest
 
 import heat_tpu as ht
 from heat_tpu.core.communication import MeshCommunication, comm_context
@@ -131,6 +132,34 @@ class TestTilingMetadata(TestCase):
         self.assertGreaterEqual(t.tile_rows, 2)
         self.assertEqual(len(t.row_indices), t.tile_rows)
         self.assertEqual(len(t.col_indices), t.tile_columns)
+
+    def test_tile_setitem_writes_through(self):
+        """Tiles are functional views: assignment lands in the sharded
+        buffer (the reference's in-place tile writes), and getitem reads
+        it back — no longer metadata-only."""
+        x = ht.zeros((16, 12), split=0)
+        t = ht.tiling.SplitTiles(x)
+        block = t[0, 0]
+        t[0, 0] = np.full(block.shape, 5.0, np.float32)
+        np.testing.assert_array_equal(t[0, 0], 5.0)
+        # untouched tiles stay zero; global sum reflects only the write
+        assert float(x.sum().item()) == 5.0 * block.size
+
+        y = ht.zeros((32, 32), split=0)
+        st = ht.tiling.SquareDiagTiles(y, tiles_per_proc=2)
+        b = st[1, 1]
+        st[1, 1] = np.full(b.shape, 2.0, np.float32)
+        np.testing.assert_array_equal(st[1, 1], 2.0)
+        assert float(y.sum().item()) == 2.0 * b.size
+        # slice-of-tiles keys write exactly the covered range
+        st[0:1, 1] = np.full(st[0:1, 1].shape, 3.0, np.float32)
+        np.testing.assert_array_equal(st[0, 1], 3.0)
+        np.testing.assert_array_equal(st[1, 1], 2.0)  # untouched
+        t2 = ht.tiling.SplitTiles(ht.zeros((16, 12), split=0))
+        t2[0:2] = np.full(t2[0:2].shape, 4.0, np.float32)
+        np.testing.assert_array_equal(t2[0:2], 4.0)
+        with pytest.raises(IndexError):
+            t2[99]
 
 
 if __name__ == "__main__":
