@@ -31,6 +31,10 @@ def _tile_range(ends, k) -> slice:
     tile indices) given cumulative tile ``ends`` along one dimension."""
     n_tiles = len(ends)
     if isinstance(k, slice):
+        if k.step not in (None, 1):
+            raise IndexError(
+                "tile views cover contiguous tile ranges; slice step must be 1"
+            )
         idxs = range(*k.indices(n_tiles))
         if len(idxs) == 0:
             return slice(0, 0)

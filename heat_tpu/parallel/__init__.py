@@ -10,8 +10,16 @@ package exposes the reusable TPU equivalents as first-class helpers:
   ``shard_map`` (reference ``heat/core/dndarray.py:333-441``).
 - :mod:`heat_tpu.parallel.mesh` — mesh construction, including 2-D
   ICI×DCN meshes for hierarchical data parallelism (DASO-style).
+- :mod:`heat_tpu.parallel.dsort` / :mod:`~heat_tpu.parallel.dtopk` —
+  distributed sort (block odd-even transposition) and top-k (O(P·k)
+  candidate merge), both ppermute/bounded and HLO-proven.
+- :mod:`heat_tpu.parallel.flatmove` — the TPU-native Alltoallv:
+  interval-exchange redistribution behind the reshape pipeline.
 """
 from . import halo, mesh, ring
+from .dsort import distributed_sort
+from .dtopk import distributed_topk
+from .flatmove import reshape_via_flatmove
 from .halo import halo_exchange
 from .mesh import make_mesh, make_hierarchical_mesh
 from .ring import ring_map, ring_reduce

@@ -160,6 +160,10 @@ class TestTilingMetadata(TestCase):
         np.testing.assert_array_equal(t2[0:2], 4.0)
         with pytest.raises(IndexError):
             t2[99]
+        with pytest.raises(IndexError):  # non-contiguous tile slices refuse
+            t2[0:4:2]
+        with pytest.raises(IndexError):
+            t2[::-1] = 0.0
 
 
 if __name__ == "__main__":
