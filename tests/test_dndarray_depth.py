@@ -1,0 +1,211 @@
+"""DNDarray method-surface depth (reference ``test_dndarray.py`` ~2.2k
+LoC): properties, conversions, in-place variants, method-form ops, and
+error contracts on split/padded arrays.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from tests.base import TestCase
+
+
+class TestProperties(TestCase):
+    def test_size_numel_bytes(self):
+        x = ht.zeros((9, 5), dtype=ht.float32, split=0)  # padded on 8 devices
+        assert x.size == 45 and x.gnumel == 45
+        assert x.ndim == 2
+        assert x.nbytes == 45 * 4 and x.gnbytes == x.nbytes
+        # lnumel/lnbytes describe this process's share
+        assert 0 < x.lnumel <= x.size or x.comm.size == 1
+        assert x.lnbytes == x.lnumel * 4
+
+    def test_stride_row_major(self):
+        x = ht.zeros((4, 6, 2), split=0)
+        assert x.strides == (12 * 4, 2 * 4, 4)
+        assert x.stride == (12, 2, 1)
+
+    def test_real_imag(self):
+        z = np.array([1 + 2j, 3 - 4j], dtype=np.complex64)
+        a = ht.array(z, split=0)
+        np.testing.assert_array_equal(a.real.numpy(), z.real)
+        np.testing.assert_array_equal(a.imag.numpy(), z.imag)
+        assert a.real.dtype == ht.float32
+
+    def test_is_distributed_and_balanced(self):
+        a = ht.zeros((16, 2), split=0)
+        assert a.is_distributed() == (a.comm.size > 1)
+        assert a.balanced and a.is_balanced()
+        r = ht.zeros((16, 2))
+        assert not r.is_distributed()
+
+    def test_counts_displs_cover(self):
+        a = ht.zeros(23, split=0)
+        counts, displs = a.counts_displs()
+        assert sum(counts) == 23
+        assert displs[0] == 0
+        for i in range(1, len(counts)):
+            assert displs[i] == displs[i - 1] + counts[i - 1]
+
+
+class TestConversions(TestCase):
+    def test_astype_copy_semantics(self):
+        x = np.arange(10, dtype=np.float32)
+        a = ht.array(x, split=0)
+        b = a.astype(ht.int64)
+        assert b.dtype == ht.int64 and b.split == 0
+        np.testing.assert_array_equal(b.numpy(), x.astype(np.int64))
+        # astype keeps the padded layout really sharded
+        c = ht.array(np.arange(9, dtype=np.float32), split=0).astype(ht.float64)
+        assert c.shape == (9,)
+        np.testing.assert_array_equal(c.numpy(), np.arange(9.0))
+
+    def test_item_contract(self):
+        assert ht.array(np.array(3.5, np.float32)).item() == 3.5
+        assert ht.array(np.array([7], np.int64), split=0).item() == 7
+        with pytest.raises((ValueError, TypeError)):
+            ht.arange(5, split=0).item()
+
+    def test_tolist(self):
+        x = np.arange(6, dtype=np.int64).reshape(2, 3)
+        assert ht.array(x, split=0).tolist() == x.tolist()
+
+    def test_len_iter_contains(self):
+        x = np.arange(12, dtype=np.float32).reshape(4, 3)
+        a = ht.array(x, split=0)
+        assert len(a) == 4
+        rows = [np.asarray(r._logical() if hasattr(r, "_logical") else r) for r in a]
+        assert len(rows) == 4
+        np.testing.assert_array_equal(rows[2], x[2])
+
+    def test_bool_scalar_conversion(self):
+        assert bool(ht.array(np.array(True)))
+        assert float(ht.array(np.array(2.5, np.float32))) == 2.5
+        assert int(ht.array(np.array(7, np.int64))) == 7
+        with pytest.raises((ValueError, TypeError)):
+            bool(ht.arange(4, split=0))
+
+
+class TestMethodForms(TestCase):
+    def test_reduction_methods_match_functions(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(7, 9)).astype(np.float32)
+        a = ht.array(x, split=0)
+        np.testing.assert_allclose(a.sum().numpy(), x.sum(), rtol=1e-5)
+        np.testing.assert_allclose(a.mean(axis=0).numpy(), x.mean(axis=0), rtol=1e-5)
+        np.testing.assert_allclose(a.var(ddof=1).numpy(), x.var(ddof=1), rtol=1e-4)
+        np.testing.assert_allclose(a.std(axis=1).numpy(), x.std(axis=1), rtol=1e-4)
+        np.testing.assert_allclose(a.prod(axis=1).numpy(), x.prod(axis=1), rtol=1e-4)
+        assert int(a.argmax().item()) == int(x.argmax())
+        assert bool((a > -10).all().item())
+        assert not bool((a > 1e9).any().item())
+
+    def test_shape_methods(self):
+        x = np.arange(24, dtype=np.float32).reshape(4, 6)
+        a = ht.array(x, split=0)
+        np.testing.assert_array_equal(a.reshape(6, 4).numpy(), x.reshape(6, 4))
+        np.testing.assert_array_equal(a.flatten().numpy(), x.ravel())
+        np.testing.assert_array_equal(a.transpose().numpy(), x.T)
+        np.testing.assert_array_equal(a.flip(0).numpy(), np.flip(x, 0))
+        b = ht.array(x[None], split=1)
+        np.testing.assert_array_equal(b.squeeze(0).numpy(), x)
+
+    def test_elementwise_methods(self):
+        x = np.array([-1.7, 0.3, 2.5, -0.5], np.float32)
+        a = ht.array(x, split=0)
+        np.testing.assert_array_equal(a.abs().numpy(), np.abs(x))
+        np.testing.assert_array_equal(a.ceil().numpy(), np.ceil(x))
+        np.testing.assert_array_equal(a.floor().numpy(), np.floor(x))
+        np.testing.assert_array_equal(a.trunc().numpy(), np.trunc(x))
+        np.testing.assert_allclose(a.exp().numpy(), np.exp(x), rtol=1e-6)
+        np.testing.assert_allclose(a.round(1).numpy(), np.round(x, 1), atol=1e-6)
+
+    def test_cumops(self):
+        x = np.arange(1, 13, dtype=np.float32).reshape(3, 4)
+        a = ht.array(x, split=0)
+        np.testing.assert_allclose(a.cumsum(0).numpy(), np.cumsum(x, 0), rtol=1e-6)
+        np.testing.assert_allclose(a.cumprod(1).numpy(), np.cumprod(x, 1), rtol=1e-5)
+
+    def test_copy_independent(self):
+        a = ht.arange(8, dtype=ht.float32, split=0)
+        b = a.copy()
+        b[0] = 99.0
+        assert float(a[0].item()) == 0.0
+        assert float(b[0].item()) == 99.0
+
+    def test_fill_diagonal(self):
+        x = np.zeros((5, 5), np.float32)
+        a = ht.array(x.copy(), split=0)
+        a.fill_diagonal(3.0)
+        e = x.copy()
+        np.fill_diagonal(e, 3.0)
+        np.testing.assert_array_equal(a.numpy(), e)
+
+
+class TestResplitMethods(TestCase):
+    def test_resplit_roundtrip_padded(self):
+        x = np.random.default_rng(1).normal(size=(9, 7)).astype(np.float32)
+        a = ht.array(x, split=0)
+        for target in (1, None, 0):
+            a = a.resplit(target)
+            assert a.split == target
+            np.testing.assert_array_equal(a.numpy(), x)
+
+    def test_resplit_inplace(self):
+        x = np.arange(20, dtype=np.float32).reshape(4, 5)
+        a = ht.array(x, split=0)
+        r = a.resplit_(1)
+        assert r is a and a.split == 1
+        np.testing.assert_array_equal(a.numpy(), x)
+
+    def test_redistribute_canonical_ok_arbitrary_raises(self):
+        a = ht.arange(16, dtype=ht.float32, split=0)
+        m = a.lshape_map
+        a.redistribute_(lshape_map=m, target_map=m)  # identity map: fine
+        if a.comm.size > 1:
+            bad = np.asarray(m).copy()
+            if bad.shape[0] >= 2 and bad[0, 0] > 0:
+                bad[0, 0] -= 1
+                bad[1, 0] += 1
+                with pytest.raises(ValueError):
+                    a.redistribute_(lshape_map=m, target_map=bad)
+
+
+class TestArithmeticDunders(TestCase):
+    def test_binary_dunders(self):
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(6, 4)).astype(np.float32)
+        y = rng.normal(size=(6, 4)).astype(np.float32) + 2.0
+        a, b = ht.array(x, split=0), ht.array(y, split=0)
+        np.testing.assert_allclose((a + b).numpy(), x + y, rtol=1e-6)
+        np.testing.assert_allclose((a - b).numpy(), x - y, rtol=1e-6)
+        np.testing.assert_allclose((a * b).numpy(), x * y, rtol=1e-6)
+        np.testing.assert_allclose((a / b).numpy(), x / y, rtol=1e-5)
+        np.testing.assert_allclose((a**2).numpy(), x**2, rtol=1e-6)
+        np.testing.assert_allclose((3.0 + a).numpy(), 3.0 + x, rtol=1e-6)
+        np.testing.assert_allclose((3.0 - a).numpy(), 3.0 - x, rtol=1e-6)
+        np.testing.assert_allclose((a // b).numpy(), x // y, rtol=1e-5)
+        np.testing.assert_allclose((a % b).numpy(), x % y, rtol=1e-4, atol=1e-5)
+        np.testing.assert_array_equal((a < b).numpy(), x < y)
+        np.testing.assert_array_equal((a >= b).numpy(), x >= y)
+        np.testing.assert_array_equal((a == a).numpy(), np.ones_like(x, bool))
+        np.testing.assert_array_equal((-a).numpy(), -x)
+        np.testing.assert_array_equal((+a).numpy(), x)
+        np.testing.assert_array_equal(abs(a).numpy(), np.abs(x))
+
+    def test_inplace_dunders_keep_split(self):
+        x = np.arange(10, dtype=np.float32)
+        a = ht.array(x, split=0)
+        a += 1
+        a *= 2
+        assert a.split == 0
+        np.testing.assert_array_equal(a.numpy(), (x + 1) * 2)
+
+    def test_matmul_dunder(self):
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=(5, 4)).astype(np.float32)
+        y = rng.normal(size=(4, 3)).astype(np.float32)
+        got = (ht.array(x, split=0) @ ht.array(y)).numpy()
+        np.testing.assert_allclose(got, x @ y, rtol=1e-5)
