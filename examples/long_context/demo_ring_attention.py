@@ -19,37 +19,43 @@ from heat_tpu.parallel.ring_attention import attention, ring_attention
 def main():
     comm = ht.get_comm()
     p = comm.size
-    n, d = p * 256, 32  # sequence divisible over the ring
+    # ANY logical sequence length: non-divisible extents are tail-padded,
+    # masked inside the kernels, and trimmed from the output
+    n, d = p * 256 + 3, 32
     rng = np.random.default_rng(1)
 
-    q = ht.array(rng.normal(size=(n, d)).astype(np.float32), split=0)
-    k = ht.array(rng.normal(size=(n, d)).astype(np.float32), split=0)
-    v = ht.array(rng.normal(size=(n, d)).astype(np.float32), split=0)
+    import jax.numpy as jnp
 
-    out = ring_attention(q.larray, k.larray, v.larray, comm, causal=True)
+    # raw logical arrays — the kernels shard (and, for the non-divisible
+    # length, pad/mask/trim) themselves; note a DNDarray's `.larray` is
+    # the PADDED physical buffer, so pass `_logical()` if starting from one
+    q = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+
+    out = ring_attention(q, k, v, comm, causal=True)
     print("ring attention:", out.shape, "devices:", p)
+    assert out.shape == (n, d)
 
     # oracle: single-device materializing attention
-    ref = attention(
-        np.asarray(q.larray), np.asarray(k.larray), np.asarray(v.larray), causal=True
-    )
+    ref = attention(q, k, v, causal=True)
     err = float(np.abs(np.asarray(out) - np.asarray(ref)).max())
     print("max |ring - materializing|:", err)
     assert err < 1e-4
 
     # the second schedule: Ulysses all-to-all (multi-head, full-sequence
-    # local attention for H/P heads per device after one reshard)
+    # local attention for H/P heads per device after one reshard) — head
+    # count deliberately non-divisible too
     from heat_tpu.parallel import ulysses_attention
 
-    h = p * 2
-    qm = ht.array(rng.normal(size=(n, h, d)).astype(np.float32), split=0)
-    km = ht.array(rng.normal(size=(n, h, d)).astype(np.float32), split=0)
-    vm = ht.array(rng.normal(size=(n, h, d)).astype(np.float32), split=0)
-    uout = ulysses_attention(qm.larray, km.larray, vm.larray, comm, causal=True)
+    h = p * 2 + 1
+    qm = jnp.asarray(rng.normal(size=(n, h, d)).astype(np.float32))
+    km = jnp.asarray(rng.normal(size=(n, h, d)).astype(np.float32))
+    vm = jnp.asarray(rng.normal(size=(n, h, d)).astype(np.float32))
+    uout = ulysses_attention(qm, km, vm, comm, causal=True)
+    assert uout.shape == (n, h, d)
     uref = attention(
-        np.moveaxis(np.asarray(qm.larray), 1, 0),
-        np.moveaxis(np.asarray(km.larray), 1, 0),
-        np.moveaxis(np.asarray(vm.larray), 1, 0),
+        jnp.moveaxis(qm, 1, 0), jnp.moveaxis(km, 1, 0), jnp.moveaxis(vm, 1, 0),
         causal=True,
     )
     uerr = float(np.abs(np.asarray(uout) - np.moveaxis(np.asarray(uref), 0, 1)).max())

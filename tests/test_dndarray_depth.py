@@ -72,11 +72,11 @@ class TestConversions(TestCase):
         x = np.arange(6, dtype=np.int64).reshape(2, 3)
         assert ht.array(x, split=0).tolist() == x.tolist()
 
-    def test_len_iter_contains(self):
+    def test_len_and_iter(self):
         x = np.arange(12, dtype=np.float32).reshape(4, 3)
         a = ht.array(x, split=0)
         assert len(a) == 4
-        rows = [np.asarray(r._logical() if hasattr(r, "_logical") else r) for r in a]
+        rows = [np.asarray(r) for r in a]
         assert len(rows) == 4
         np.testing.assert_array_equal(rows[2], x[2])
 
