@@ -190,26 +190,40 @@ class DNDarray:
         sl = tuple(slice(0, s) for s in self.__gshape)
         return self.__array[sl]
 
+    def _iter_local_shards(self, dedup: bool = False):
+        """Yield ``(split_start, trimmed_shard)`` for each addressable
+        shard in split-start order — THE padded-shard trimming invariant
+        (valid extent = min(n - start, block)); every consumer of
+        process-local shard data routes through here so the formula lives
+        once. ``dedup`` skips replicated devices (multi-axis meshes) that
+        hold the same split coordinate."""
+        shards = sorted(
+            self.__array.addressable_shards,
+            key=lambda s: tuple(sl.start or 0 for sl in s.index),
+        )
+        split = self.__split
+        seen = set()
+        for s in shards:
+            start = 0 if split is None else (s.index[split].start or 0)
+            if dedup:
+                if start in seen:
+                    continue
+                seen.add(start)
+            if split is None or not self.padded:
+                yield start, s.data
+                continue
+            n = self.__gshape[split]
+            valid = max(0, min(n - start, s.data.shape[split]))
+            sl = [slice(None)] * self.ndim
+            sl[split] = slice(0, valid)
+            yield start, s.data[tuple(sl)]
+
     @property
     def local_shards(self) -> List[jax.Array]:
         """Per-device addressable shards, trimmed to their *valid* extent
         (TPU-native view of 'local' data): shard ``r``'s shape equals the
         reference's ``comm.chunk`` result even when the buffer is padded."""
-        shards = sorted(
-            self.__array.addressable_shards,
-            key=lambda s: tuple(sl.start or 0 for sl in s.index),
-        )
-        if self.__split is None or not self.padded:
-            return [s.data for s in shards]
-        n = self.__gshape[self.__split]
-        out = []
-        for s in shards:
-            start = s.index[self.__split].start or 0
-            valid = max(0, min(n - start, s.data.shape[self.__split]))
-            sl = [slice(None)] * self.ndim
-            sl[self.__split] = slice(0, valid)
-            out.append(s.data[tuple(sl)])
-        return out
+        return [data for _, data in self._iter_local_shards()]
 
     @property
     def comm(self) -> MeshCommunication:

@@ -1,7 +1,9 @@
 """Indexing operations (reference ``heat/core/indexing.py``)."""
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
+import numpy as np
 
 from . import types
 from .dndarray import DNDarray
@@ -17,15 +19,69 @@ def nonzero(x: DNDarray) -> DNDarray:
     The result is split=0 when the input was distributed; ``x[nonzero(x)]``
     recovers the nonzero values (coordinate-list indexing, handled by
     ``DNDarray.__getitem__``).
+
+    Distributed inputs are scanned PER SHARD (the reference's local
+    ``torch.nonzero`` + rank offset, ``indexing.py:16-78``): each device's
+    trimmed shard is searched on-device (eager — the result size is
+    data-dependent), coordinates get the shard's global offset, and only
+    the found coordinates travel — never the operand (``jnp.nonzero`` on
+    the logical view would gather it).
     """
     if not isinstance(x, DNDarray):
         raise TypeError(f"expected x to be a DNDarray, but was {type(x)}")
-    result = jnp.stack(jnp.nonzero(x._logical()), axis=1)
+    if x.split is not None and x.comm.size > 1:
+        # each physical shard carries its own global offset along the
+        # split dim — iterate via the shared trimmed-shard helper (do NOT
+        # re-derive offsets from a local enumeration, which breaks on
+        # multi-process meshes where this process owns a rank subrange)
+        parts = []
+        for start, shard in x._iter_local_shards(dedup=True):
+            if shard.size == 0:
+                continue
+            local = np.array(jnp.stack(jnp.nonzero(shard), axis=1))
+            local[:, x.split] += start
+            parts.append(local)
+        coords = (
+            np.concatenate(parts, axis=0)
+            if parts
+            else np.empty((0, x.ndim), np.int64)
+        )
+        if jax.process_count() > 1:
+            coords = _allgather_ordered_rows(coords)
+        if coords.shape[0] > 1:
+            # row-major order AND cross-process replica dedup in one step
+            # (nonzero coordinates are unique by construction, so unique
+            # only removes replica double-counts from process-spanning
+            # replicated meshes)
+            coords = np.unique(coords, axis=0)
+        result = jnp.asarray(coords, dtype=jnp.int64)
+    else:
+        result = jnp.stack(jnp.nonzero(x._logical()), axis=1)
     if x.ndim == 1:
         result = result.reshape(-1)
     split = 0 if x.split is not None else None
     return DNDarray(
         result.astype(jnp.int64), dtype=types.int64, split=split, device=x.device, comm=x.comm
+    )
+
+
+def _allgather_ordered_rows(rows: np.ndarray) -> np.ndarray:
+    """Concatenate each process's row block in process order (ragged:
+    sizes exchanged first, payloads padded to the max) — every process's
+    local_shards cover a contiguous rank range, so process-order concat
+    preserves global shard order."""
+    from jax.experimental import multihost_utils
+
+    counts = np.asarray(
+        multihost_utils.process_allgather(np.asarray([rows.shape[0]], np.int64))
+    ).reshape(-1)
+    cap = int(counts.max()) if counts.size else 0
+    if cap == 0:
+        return rows
+    padded = np.pad(rows, [(0, cap - rows.shape[0]), (0, 0)])
+    gathered = np.asarray(multihost_utils.process_allgather(padded))
+    return np.concatenate(
+        [gathered[q, : int(counts[q])] for q in range(gathered.shape[0])], axis=0
     )
 
 

@@ -437,6 +437,43 @@ class TestUniqueBounded(TestCase):
         )
         np.testing.assert_array_equal(np.sort(res.numpy()), np.arange(64))
 
+    def test_nonzero_never_gathers_operand(self):
+        """nonzero must scan per shard (reference: local torch.nonzero +
+        rank offset) — only found coordinates travel, not the operand."""
+        import heat_tpu.core.indexing as hidx
+
+        comm = _comm()
+        if comm.size < 2:
+            pytest.skip("needs a multi-device mesh")
+        x = np.zeros(4096, np.float32)
+        x[::97] = 1.0  # sparse nonzeros
+        a = ht.array(x, split=0)
+        shard_cap = max(int(np.prod(s.shape)) for s in a.local_shards)
+        seen = []
+        real = hidx.jnp.nonzero
+
+        def spy(arr, *args, **kw):
+            seen.append(int(np.prod(arr.shape)))
+            return real(arr, *args, **kw)
+
+        with mock.patch.object(hidx.jnp, "nonzero", side_effect=spy):
+            res = hidx.nonzero(a)
+        assert seen and max(seen) <= shard_cap
+        np.testing.assert_array_equal(res.numpy(), np.nonzero(x)[0])
+
+    def test_nonzero_oracle_matrix(self):
+        rng = np.random.default_rng(10)
+        for shape, split in [((37,), 0), ((9, 8), 0), ((8, 9), 1), ((5, 6, 4), 1)]:
+            x = (rng.random(size=shape) < 0.3).astype(np.float32)
+            got = ht.nonzero(ht.array(x, split=split)).numpy()
+            want = np.stack(np.nonzero(x), axis=1)
+            if len(shape) == 1:
+                want = want.reshape(-1)
+            np.testing.assert_array_equal(got, want, err_msg=f"{shape} split={split}")
+        # all-zero input
+        z = ht.nonzero(ht.array(np.zeros((6, 4), np.float32), split=0))
+        assert z.shape[0] == 0
+
     def test_oracle_parity(self):
         rng = np.random.default_rng(4)
         x = rng.integers(0, 20, size=57).astype(np.int64)

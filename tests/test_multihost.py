@@ -144,6 +144,11 @@ uvals = ht.unique(ht.array(np.tile(np.arange(9, dtype=np.int64), 5), split=0))
 got_u = np.sort(np.asarray(uvals._logical()))
 np.testing.assert_array_equal(got_u, np.arange(9))
 
+# --- nonzero: per-shard scan + ordered cross-process coordinate concat ---
+nz_x = np.zeros(45, np.float32); nz_x[::7] = 1.0
+nz = ht.nonzero(ht.array(nz_x, split=0))
+np.testing.assert_array_equal(np.asarray(nz._logical()), np.nonzero(nz_x)[0])
+
 # --- DASO step on the process-spanning 2x4 mesh ---
 import optax, jax.numpy as jnp
 from heat_tpu.parallel import make_hierarchical_mesh
