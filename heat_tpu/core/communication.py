@@ -391,6 +391,33 @@ def _assemble_from_chunks(read_chunk, gshape, split, comm, np_dtype):
     return jax.make_array_from_single_device_arrays(pshape, sharding, arrays)
 
 
+def ragged_process_allgather(arr: np.ndarray, axis: int = 0):
+    """Allgather per-process host arrays whose extent along ``axis`` may
+    differ: sizes are exchanged first, payloads padded to the max, and
+    each process's block trimmed on receipt. Returns the list of blocks
+    in process order. THE one implementation of this subtle protocol —
+    ``assemble_local_shards``'s uneven path, ``unique``'s candidate
+    merge, and ``nonzero``'s coordinate concat all route through it."""
+    from jax.experimental import multihost_utils
+
+    nproc = jax.process_count()
+    moved = np.moveaxis(np.asarray(arr), axis, 0)
+    counts = np.asarray(
+        multihost_utils.process_allgather(np.asarray([moved.shape[0]], np.int64))
+    ).reshape(-1)
+    cap = int(counts.max()) if counts.size else 0
+    if cap == 0:
+        return [np.moveaxis(moved, 0, axis) for _ in range(nproc)]
+    padded = np.zeros((cap,) + moved.shape[1:], moved.dtype)
+    padded[: moved.shape[0]] = moved
+    gathered = np.asarray(multihost_utils.process_allgather(padded)).reshape(
+        (nproc, cap) + padded.shape[1:]
+    )
+    return [
+        np.moveaxis(gathered[p, : int(counts[p])], 0, axis) for p in range(nproc)
+    ]
+
+
 def _split_ranks(comm: MeshCommunication):
     """(split_rank, device) for every mesh device.
 
@@ -459,14 +486,7 @@ def assemble_local_shards(local: np.ndarray, split: int, comm: MeshCommunication
             return local[tuple(local_slices)]
 
     else:
-        cap = max(sizes)
-        padded = np.zeros((cap,) + local.shape[:split] + local.shape[split + 1 :], local.dtype)
-        moved = np.moveaxis(local, split, 0)
-        padded[: moved.shape[0]] = moved
-        everything = multihost_utils.process_allgather(padded)  # (nproc, cap, ...)
-        everything = np.asarray(everything).reshape((nproc, cap) + padded.shape[1:])
-        full = np.concatenate([everything[p, : sizes[p]] for p in range(nproc)], axis=0)
-        full = np.moveaxis(full, 0, split)
+        full = np.concatenate(ragged_process_allgather(local, axis=split), axis=split)
 
         def read_chunk(slices):
             return full[slices]

@@ -202,6 +202,10 @@ class DNDarray:
             key=lambda s: tuple(sl.start or 0 for sl in s.index),
         )
         split = self.__split
+        if dedup and split is None:
+            # every replica would share key 0 and all but one shard would
+            # silently vanish; callers must handle replicated arrays
+            raise ValueError("dedup=True requires a split array")
         seen = set()
         for s in shards:
             start = 0 if split is None else (s.index[split].start or 0)

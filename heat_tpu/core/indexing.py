@@ -66,23 +66,12 @@ def nonzero(x: DNDarray) -> DNDarray:
 
 
 def _allgather_ordered_rows(rows: np.ndarray) -> np.ndarray:
-    """Concatenate each process's row block in process order (ragged:
-    sizes exchanged first, payloads padded to the max) — every process's
-    local_shards cover a contiguous rank range, so process-order concat
-    preserves global shard order."""
-    from jax.experimental import multihost_utils
+    """Concatenate each process's row block in process order (ragged
+    allgather) — every process's local shards cover a contiguous rank
+    range, so process-order concat preserves global shard order."""
+    from .communication import ragged_process_allgather
 
-    counts = np.asarray(
-        multihost_utils.process_allgather(np.asarray([rows.shape[0]], np.int64))
-    ).reshape(-1)
-    cap = int(counts.max()) if counts.size else 0
-    if cap == 0:
-        return rows
-    padded = np.pad(rows, [(0, cap - rows.shape[0]), (0, 0)])
-    gathered = np.asarray(multihost_utils.process_allgather(padded))
-    return np.concatenate(
-        [gathered[q, : int(counts[q])] for q in range(gathered.shape[0])], axis=0
-    )
+    return np.concatenate(ragged_process_allgather(rows, axis=0), axis=0)
 
 
 def where(cond: DNDarray, x=None, y=None) -> DNDarray:

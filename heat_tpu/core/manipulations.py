@@ -65,23 +65,12 @@ __all__ = [
 
 
 def _merge_unique_across_processes(merged: np.ndarray, axis: Optional[int]) -> np.ndarray:
-    """Allgather the per-process candidate sets (ragged along the unique
-    axis: sizes exchanged first, payloads padded to the max) and re-unique
-    — the reference's Allgatherv + final unique (``manipulations.py:3055``)."""
-    from jax.experimental import multihost_utils
+    """Allgather the per-process candidate sets (ragged) and re-unique —
+    the reference's Allgatherv + final unique (``manipulations.py:3055``)."""
+    from .communication import ragged_process_allgather
 
     ax = 0 if axis is None else axis
-    counts = np.asarray(
-        multihost_utils.process_allgather(np.asarray([merged.shape[ax]], np.int64))
-    ).reshape(-1)
-    cap = int(counts.max()) if counts.size else 0
-    pad = [(0, 0)] * merged.ndim
-    pad[ax] = (0, cap - merged.shape[ax])
-    gathered = np.asarray(multihost_utils.process_allgather(np.pad(merged, pad)))
-    parts = [
-        np.take(gathered[i], np.arange(int(counts[i])), axis=ax)
-        for i in range(gathered.shape[0])
-    ]
+    parts = ragged_process_allgather(merged, axis=ax)
     return np.unique(np.concatenate(parts, axis=ax), axis=axis)
 
 
@@ -546,6 +535,19 @@ def unfold(a: DNDarray, axis: int, size: int, step: int = 1) -> DNDarray:
     length = a.shape[axis]
     if size > length:
         raise ValueError(f"size {size} exceeds dimension {length}")
+    if a.split is not None and a.comm.size > 1:
+        # one jitted sharded program of static strided slices — GSPMD
+        # keeps it at O(n/P) per device with collective-permutes only
+        # (the vmap-of-dynamic-slice form all-gathers; HLO-proven in
+        # tests/test_distribution_proofs.py)
+        from ._movement import unfold_padded
+
+        buf, out_shape = unfold_padded(
+            a.larray, a.gshape, a.split, axis, size, step, a.comm
+        )
+        return DNDarray._from_buffer(
+            buf, out_shape, a.dtype, a.split, device=a.device, comm=a.comm
+        )
     n_windows = (length - size) // step + 1
     starts = jnp.arange(n_windows) * step
     moved = jnp.moveaxis(a._logical(), axis, 0)

@@ -408,6 +408,47 @@ class TestConvolveBounded(TestCase):
             )
 
 
+class TestUnfoldBounded(TestCase):
+    def test_hlo_strided_slices_bounded(self):
+        """unfold lowers to collective-permutes over static strided
+        slices (the vmap-of-dynamic-slice form all-gathers the operand)."""
+        _skip_unless_8()
+        import jax
+
+        from heat_tpu.core._movement import unfold_executable
+
+        comm = _comm()
+        n, size, step = 1 << 20, 8, 4
+        in_pshape = comm.padded_shape((n,), 0)
+        fn, out_shape = unfold_executable(
+            in_pshape, np.dtype(np.float32), (n,), 0, 0, size, step, comm
+        )
+        hlo = fn.lower(jax.ShapeDtypeStruct(in_pshape, np.float32)).compile().as_text()
+        out_pdev = 4 * int(np.prod(comm.padded_shape(out_shape, 0))) // 8
+        _assert_bounded(hlo, max(out_pdev, 4 * int(np.prod(in_pshape)) // 8), 2.0, "unfold")
+
+    def test_oracle_matrix(self):
+        rng = np.random.default_rng(11)
+        for shape, axis, size, step, split in [
+            ((37,), 0, 5, 2, 0),
+            ((10, 4), 0, 3, 2, 0),
+            ((4, 21), 1, 4, 3, 1),
+            ((9, 6), 0, 3, 1, 1),  # split != unfold axis
+        ]:
+            x = rng.normal(size=shape).astype(np.float32)
+            got = ht.unfold(ht.array(x, split=split), axis, size, step).numpy()
+            n_win = (shape[axis] - size) // step + 1
+            want = np.stack(
+                [np.take(x, range(s, s + size), axis=axis) for s in range(0, n_win * step, step)],
+                axis=axis,
+            )
+            # torch layout: window dim appended last
+            want = np.moveaxis(want, axis + 1, -1)
+            np.testing.assert_allclose(
+                got, want, rtol=1e-6, err_msg=f"{shape} axis={axis} size={size} step={step} split={split}"
+            )
+
+
 class TestUniqueBounded(TestCase):
     def test_dedup_never_sees_more_than_one_shard(self):
         """The distributed path must dedupe per shard and merge candidates —
