@@ -1,0 +1,41 @@
+"""Bounded LRU cache for compiled executables.
+
+The movement/kernel modules key jitted programs by (shape, dtype, mesh,
+schedule). A plain module dict never evicts, so shape-polymorphic
+workloads (e.g. a training loop over variable-length batches) grow the
+caches without bound and pin compiled executables plus their Mesh
+objects (round-3 ADVICE). This LRU keeps the hot executables — re-jitting
+an evicted shape only costs a retrace, and XLA's own persistent
+compilation cache still dedupes the compile."""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+__all__ = ["ExecutableCache"]
+
+
+class ExecutableCache(OrderedDict):
+    """OrderedDict with LRU eviction; drop-in for the module-level dicts."""
+
+    def __init__(self, maxsize: int = 256):
+        super().__init__()
+        self.maxsize = int(maxsize)
+
+    def get(self, key, default=None):
+        try:
+            value = super().__getitem__(key)
+        except KeyError:
+            return default
+        self.move_to_end(key)
+        return value
+
+    def __getitem__(self, key):
+        value = super().__getitem__(key)
+        self.move_to_end(key)
+        return value
+
+    def __setitem__(self, key, value):
+        super().__setitem__(key, value)
+        self.move_to_end(key)
+        while len(self) > self.maxsize:
+            self.popitem(last=False)

@@ -38,17 +38,23 @@ from typing import Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from ._cache import ExecutableCache
+
 __all__ = [
     "reshape_padded",
     "concatenate_padded",
     "outer_padded",
     "convolve_padded",
     "unfold_padded",
+    "roll_padded",
+    "flip_padded",
+    "pad_padded",
+    "diff_padded",
 ]
 
 # compiled-executable cache: jax.jit wrappers must be reused across calls
 # (a fresh jit() closure per call would re-trace every time)
-_EXEC_CACHE: dict = {}
+_EXEC_CACHE = ExecutableCache()  # bounded LRU (round-3 ADVICE)
 
 
 def _cached(key, build):
@@ -383,6 +389,134 @@ def unfold_padded(
     return fn(buf), out_shape
 
 
+def setitem_executable(
+    buf_shape: Tuple[int, ...],
+    dtype,
+    split: Optional[int],
+    key_struct: Tuple[Tuple, ...],
+    value_shape: Tuple[int, ...],
+    value_dtype,
+    comm,
+):
+    """Donated in-place scatter for basic-index ``__setitem__``.
+
+    The reference writes into the rank-local torch shard in place
+    (``dndarray.py:1359``) — O(touched elements) per call. The eager
+    ``at[].set`` + re-place path copied the whole buffer per call
+    (O(n·updates) for a loop of setitems). Here the update runs as ONE
+    cached jitted program with the buffer donated and both shardings
+    pinned: XLA updates in place, so a loop of scalar setitems costs
+    O(updates). Integer indices are traced operands — every scalar-row
+    update of the same structure reuses one executable.
+
+    ``key_struct`` elements: ``('i',)`` an integer index passed as an
+    operand; ``('s', start, stop, step)`` a static slice.
+    """
+    key = (
+        "setitem", tuple(buf_shape), str(dtype), split, key_struct,
+        tuple(value_shape), str(value_dtype), comm.mesh,
+    )
+
+    def build():
+        sh = comm.array_sharding(tuple(buf_shape), split)
+        n_ints = sum(1 for t in key_struct if t[0] == "i")
+        jt = jnp.dtype(dtype)
+
+        def pipeline(b, v, *ints):
+            it = iter(ints)
+            k = tuple(
+                next(it) if t[0] == "i" else slice(t[1], t[2], t[3])
+                for t in key_struct
+            )
+            return b.at[k].set(jnp.asarray(v, dtype=jt))
+
+        return jax.jit(
+            pipeline,
+            donate_argnums=0,
+            in_shardings=(sh,) + (None,) * (1 + n_ints),
+            out_shardings=sh,
+        )
+
+    return _cached(key, build)
+
+
+def getitem_executable(
+    buf_shape: Tuple[int, ...],
+    dtype,
+    split: Optional[int],
+    key_struct: Tuple[Tuple, ...],
+    out_gshape: Tuple[int, ...],
+    out_split: Optional[int],
+    comm,
+):
+    """Basic-index ``__getitem__`` as one pinned pipeline: input on the
+    canonical padded layout, output repadded onto ITS canonical layout.
+    The reference's rank-local case analysis (``dndarray.py:652-908``)
+    becomes one cached program per key structure; integer indices are
+    traced operands (every row fetch shares one executable). A basic
+    slice of a split array stays collective-permute/slice only — proven
+    in ``tests/test_indexing_proofs.py``.
+
+    ``key_struct`` tags: ``('i',)`` dynamic int on an unsplit dim (local
+    gather); ``('I',)`` dynamic int ON the split dim — lowered as a
+    one-hot contraction so GSPMD reduces locally and all-reduces O(row)
+    instead of gathering the operand (the reference's owner-Bcast,
+    ``dndarray.py:789``); ``('s', start, stop, step)`` static slice;
+    ``('n',)`` newaxis."""
+    out_pshape = _out_pshape(comm, out_gshape, out_split)
+    key = (
+        "getitem", tuple(buf_shape), str(dtype), split, key_struct,
+        tuple(out_gshape), out_split, comm.mesh,
+    )
+
+    def build():
+        in_sh = comm.array_sharding(tuple(buf_shape), split)
+        n_ints = sum(1 for t in key_struct if t[0] in ("i", "I"))
+        out_sh = comm.array_sharding(out_pshape, out_split)
+        # output axis at which a split-dim dynamic int lands: dims
+        # emitted by entries before it ('s'/'n' emit one, 'i' none)
+        split_axis_pos = 0
+        for t in key_struct:
+            if t[0] == "I":
+                break
+            if t[0] in ("s", "n"):
+                split_axis_pos += 1
+
+        def pipeline(b, *ints):
+            it = iter(ints)
+            k = []
+            dyn_split = None
+            for t in key_struct:
+                if t[0] == "i":
+                    k.append(next(it))
+                elif t[0] == "I":
+                    dyn_split = next(it)
+                    k.append(slice(None))
+                elif t[0] == "s":
+                    k.append(slice(t[1], t[2], t[3]))
+                else:
+                    k.append(None)
+            r = b[tuple(k)]
+            if dyn_split is not None:
+                extent = r.shape[split_axis_pos]
+                shape = [1] * r.ndim
+                shape[split_axis_pos] = extent
+                mask = (jnp.arange(extent) == dyn_split).reshape(shape)
+                # select-then-sum, NOT multiply: r * mask would turn
+                # inf/nan rows elsewhere in the array into nan (inf*0)
+                zero = jnp.zeros((), r.dtype)
+                r = jnp.where(mask, r, zero).sum(axis=split_axis_pos).astype(r.dtype)
+            return _repad(r, out_pshape)
+
+        return jax.jit(
+            pipeline,
+            in_shardings=(in_sh,) + (None,) * n_ints,
+            out_shardings=out_sh,
+        )
+
+    return _cached(key, build)
+
+
 def outer_executable(
     a_shape: Tuple[int, ...],
     a_dtype,
@@ -496,6 +630,169 @@ def convolve_padded(
         v.dtype, mode, jt, comm,
     )
     return fn(buf, v), out_shape
+
+
+def roll_executable(
+    buf_shape: Tuple[int, ...],
+    dtype,
+    gshape: Tuple[int, ...],
+    split: Optional[int],
+    shift,
+    axis,
+    comm,
+):
+    """Circular shift as one pinned pipeline. The reference sends each
+    rank's displaced block to its new owner (``manipulations.py:1989``);
+    with both shardings pinned GSPMD emits the equivalent
+    collective-permute schedule (proven in the proof suite)."""
+    key = ("roll", tuple(buf_shape), str(dtype), tuple(gshape), split, shift, axis, comm.mesh)
+
+    def build():
+        sh = comm.array_sharding(tuple(buf_shape), split)
+
+        def pipeline(a):
+            return _repad(jnp.roll(_unpad(a, gshape), shift, axis=axis), tuple(buf_shape))
+
+        return jax.jit(pipeline, in_shardings=sh, out_shardings=sh)
+
+    return _cached(key, build)
+
+
+def roll_padded(buf, gshape, split, shift, axis, comm):
+    return roll_executable(tuple(buf.shape), buf.dtype, tuple(gshape), split, shift, axis, comm)(buf)
+
+
+def flip_executable(
+    buf_shape: Tuple[int, ...],
+    dtype,
+    gshape: Tuple[int, ...],
+    split: Optional[int],
+    axis,
+    comm,
+):
+    """Axis reversal as one pinned pipeline: a split-axis flip reverses
+    the block partition — a pure collective-permute under GSPMD."""
+    key = ("flip", tuple(buf_shape), str(dtype), tuple(gshape), split, axis, comm.mesh)
+
+    def build():
+        sh = comm.array_sharding(tuple(buf_shape), split)
+
+        def pipeline(a):
+            return _repad(jnp.flip(_unpad(a, gshape), axis=axis), tuple(buf_shape))
+
+        return jax.jit(pipeline, in_shardings=sh, out_shardings=sh)
+
+    return _cached(key, build)
+
+
+def flip_padded(buf, gshape, split, axis, comm):
+    return flip_executable(tuple(buf.shape), buf.dtype, tuple(gshape), split, axis, comm)(buf)
+
+
+def pad_executable(
+    buf_shape: Tuple[int, ...],
+    dtype,
+    gshape: Tuple[int, ...],
+    split: Optional[int],
+    pad_width: Tuple[Tuple[int, int], ...],
+    mode: str,
+    constant_values,
+    comm,
+):
+    """``jnp.pad`` as one pinned pipeline. Padding at the *front* of the
+    split axis shifts every element's owner (the reference redistributes,
+    ``manipulations.py:1128``); pinned shardings make GSPMD emit the
+    bounded permute schedule. Returns ``(fn, out_shape)``."""
+    out_shape = tuple(int(s) + lo + hi for s, (lo, hi) in zip(gshape, pad_width))
+    pshape = _out_pshape(comm, out_shape, split)
+    key = (
+        "pad", tuple(buf_shape), str(dtype), tuple(gshape), split,
+        tuple(pad_width), mode, constant_values, comm.mesh,
+    )
+
+    def build():
+        in_sh = comm.array_sharding(tuple(buf_shape), split)
+        out_sh = comm.array_sharding(pshape, split)
+
+        def pipeline(a):
+            x = _unpad(a, gshape)
+            if mode == "constant":
+                r = jnp.pad(x, pad_width, mode=mode, constant_values=constant_values)
+            else:
+                r = jnp.pad(x, pad_width, mode=mode)
+            return _repad(r, pshape)
+
+        return jax.jit(pipeline, in_shardings=in_sh, out_shardings=out_sh)
+
+    return _cached(key, build), out_shape
+
+
+def pad_padded(buf, gshape, split, pad_width, mode, constant_values, comm):
+    fn, out_shape = pad_executable(
+        tuple(buf.shape), buf.dtype, tuple(gshape), split,
+        tuple(tuple(int(v) for v in p) for p in pad_width), mode, constant_values, comm,
+    )
+    return fn(buf), out_shape
+
+
+def diff_executable(
+    buf_shape: Tuple[int, ...],
+    dtype,
+    gshape: Tuple[int, ...],
+    split: Optional[int],
+    n: int,
+    axis: int,
+    pre_shape: Optional[Tuple[int, ...]],
+    app_shape: Optional[Tuple[int, ...]],
+    comm,
+):
+    """n-th discrete difference as one pinned pipeline — the split-axis
+    neighbor halo the reference hand-sends (``arithmetics.py:293``)
+    becomes one collective-permute per order. Returns ``(fn, out_shape)``.
+    ``prepend``/``append`` ride along as replicated operands."""
+    ext = int(gshape[axis])
+    if pre_shape is not None:
+        ext += int(pre_shape[axis])
+    if app_shape is not None:
+        ext += int(app_shape[axis])
+    out_shape = tuple(
+        (ext - n) if i == axis else int(s) for i, s in enumerate(gshape)
+    )
+    pshape = _out_pshape(comm, out_shape, split)
+    key = (
+        "diff", tuple(buf_shape), str(dtype), tuple(gshape), split, n, axis,
+        pre_shape, app_shape, comm.mesh,
+    )
+
+    def build():
+        in_shs = [comm.array_sharding(tuple(buf_shape), split)]
+        if pre_shape is not None:
+            in_shs.append(comm.array_sharding(tuple(pre_shape), None))
+        if app_shape is not None:
+            in_shs.append(comm.array_sharding(tuple(app_shape), None))
+        out_sh = comm.array_sharding(pshape, split)
+
+        def pipeline(a, *edges):
+            it = iter(edges)
+            pre = next(it) if pre_shape is not None else None
+            app = next(it) if app_shape is not None else None
+            r = jnp.diff(_unpad(a, gshape), n=n, axis=axis, prepend=pre, append=app)
+            return _repad(r, pshape)
+
+        return jax.jit(pipeline, in_shardings=tuple(in_shs), out_shardings=out_sh)
+
+    return _cached(key, build), out_shape
+
+
+def diff_padded(buf, gshape, split, n, axis, pre, app, comm):
+    fn, out_shape = diff_executable(
+        tuple(buf.shape), buf.dtype, tuple(gshape), split, n, axis,
+        None if pre is None else tuple(pre.shape),
+        None if app is None else tuple(app.shape),
+        comm,
+    )
+    args = [buf] + [e for e in (pre, app) if e is not None]
+    return fn(*args), out_shape
 
 
 def outer_padded(

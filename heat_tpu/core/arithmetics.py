@@ -219,7 +219,16 @@ def diff(a: DNDarray, n: int = 1, axis: int = -1, prepend=None, append=None) -> 
             arr = jnp.broadcast_to(arr, shape)
         return arr
 
-    result = jnp.diff(a._logical(), n=n, axis=axis, prepend=_edge(prepend), append=_edge(append))
+    pre, app = _edge(prepend), _edge(append)
+    if a.split is not None and a.comm.is_distributed():
+        from ._movement import diff_padded
+        from .dndarray import DNDarray as _D
+
+        buf, out_shape = diff_padded(a.larray, a.gshape, a.split, n, axis, pre, app, a.comm)
+        return _D._from_buffer(
+            buf, out_shape, types.canonical_heat_type(buf.dtype), a.split, a.device, a.comm
+        )
+    result = jnp.diff(a._logical(), n=n, axis=axis, prepend=pre, append=app)
     return DNDarray(
         result,
         dtype=types.canonical_heat_type(result.dtype),

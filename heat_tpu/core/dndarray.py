@@ -108,6 +108,7 @@ class DNDarray:
         self.__dtype = dtype
         self.__split = split
         self.__gshape = gshape
+        self.__lcounts = None
         self.__array = _place(array, self.__comm, split, gshape)
 
     @classmethod
@@ -131,7 +132,52 @@ class DNDarray:
         out._DNDarray__dtype = types.canonical_heat_type(dtype)
         out._DNDarray__split = split
         out._DNDarray__gshape = tuple(int(s) for s in gshape)
+        out._DNDarray__lcounts = None
         out._DNDarray__array = _place(buffer, out._DNDarray__comm, split, out._DNDarray__gshape)
+        return out
+
+    @classmethod
+    def _from_ragged(
+        cls,
+        buffer: jax.Array,
+        gshape: Tuple[int, ...],
+        dtype,
+        split: int,
+        lcounts: Tuple[int, ...],
+        device: Optional[Device] = None,
+        comm: Optional[MeshCommunication] = None,
+    ) -> "DNDarray":
+        """Wrap a *ragged-layout* physical buffer: device ``r`` holds
+        ``lcounts[r]`` valid split-axis rows at offset 0 of its block
+        (block size ``buffer.shape[split] // P``). This is the TPU
+        representation of the reference's unbalanced arrays
+        (``dndarray.py:1029``): raggedness is real, observable through
+        ``lshape_map``/``local_shards``/``counts_displs``, and any
+        *computation* first rebalances to the canonical ceil-div layout
+        (one bounded interval-exchange collective — see :meth:`larray`).
+        """
+        comm = sanitize_comm(comm)
+        lcounts = tuple(int(c) for c in lcounts)
+        gshape = tuple(int(s) for s in gshape)
+        p = comm.size
+        if len(lcounts) != p or sum(lcounts) != gshape[split]:
+            raise ValueError(
+                f"lcounts {lcounts} do not partition extent {gshape[split]} over {p} shards"
+            )
+        if buffer.shape[split] % p or buffer.shape[split] // p < max(lcounts, default=0):
+            raise ValueError(
+                f"buffer split dim {buffer.shape[split]} cannot hold blocks of {max(lcounts)}"
+            )
+        out = cls.__new__(cls)
+        out._DNDarray__comm = comm
+        out._DNDarray__device = devices.sanitize_device(device)
+        out._DNDarray__dtype = types.canonical_heat_type(dtype)
+        out._DNDarray__split = split
+        out._DNDarray__gshape = gshape
+        out._DNDarray__lcounts = lcounts
+        out._DNDarray__array = jax.device_put(
+            buffer, comm.array_sharding(buffer.shape, split)
+        )
         return out
 
     # ------------------------------------------------------------------ meta
@@ -146,7 +192,20 @@ class DNDarray:
         extent does not divide the mesh size (``pshape`` vs ``gshape``);
         use :meth:`_logical` for the exact logical array. Per-device shards
         are available via :attr:`local_shards`.
+
+        A ragged-layout array (after ``redistribute_`` to a non-canonical
+        map) is rebalanced in place first — on TPU all *computation*
+        happens in the canonical ceil-div layout; raggedness is a
+        transport state. The rebalance is logically invisible (it is
+        ``balance_()``) and costs one bounded interval exchange.
+
+        NOTE: basic-index ``__setitem__`` updates the buffer IN PLACE
+        (donated scatter — the torch-like mutation the reference performs
+        on its local tensor); a handle obtained from this property before
+        a setitem is invalidated by it. Re-read ``larray`` after mutating.
         """
+        if self.__lcounts is not None:
+            self.balance_()
         return self.__array
 
     @larray.setter
@@ -157,6 +216,7 @@ class DNDarray:
             value = jnp.asarray(value)
         gshape = tuple(value.shape)
         split = sanitize_axis(gshape, self.__split)
+        self.__lcounts = None
         self.__array = _place(value, self.__comm, split, gshape)
         self.__gshape = gshape
         self.__split = split
@@ -166,6 +226,7 @@ class DNDarray:
         """Replace the physical buffer in place (internal; buffer must be
         padded for the current split)."""
         gshape = self.__gshape if gshape is None else tuple(int(s) for s in gshape)
+        self.__lcounts = None
         self.__array = _place(buffer, self.__comm, self.__split, gshape)
         self.__gshape = gshape
         self.__dtype = types.canonical_heat_type(buffer.dtype)
@@ -176,19 +237,36 @@ class DNDarray:
         return tuple(self.__array.shape)
 
     @property
+    def _raw(self) -> jax.Array:
+        """The physical buffer exactly as stored — no rebalance, no trim.
+        Internal: for layout-preserving plumbing (copy, the ragged mover);
+        everything else wants :attr:`larray` or :meth:`_logical`."""
+        return self.__array
+
+    @property
+    def lcounts(self) -> Optional[Tuple[int, ...]]:
+        """Per-split-shard valid row counts when the array is in a ragged
+        (non-canonical) layout, else None. Set by ``redistribute_`` with a
+        non-canonical target map; cleared by ``balance_`` or any
+        computation (see :attr:`larray`)."""
+        return getattr(self, "_DNDarray__lcounts", None)
+
+    @property
     def padded(self) -> bool:
         """True when the buffer carries tail padding along the split axis."""
-        return tuple(self.__array.shape) != self.__gshape
+        return self.lcounts is not None or tuple(self.__array.shape) != self.__gshape
 
     def _logical(self) -> jax.Array:
         """The exact logical global array (buffer with tail padding sliced
-        off). Cheap no-op when not padded; otherwise an XLA slice that may
-        reshard — intended for data-movement ops, not hot elementwise paths.
+        off; a ragged array is rebalanced first). Cheap no-op when not
+        padded; otherwise an XLA slice that may reshard — intended for
+        data-movement ops, not hot elementwise paths.
         """
         if not self.padded:
             return self.__array
+        buf = self.larray  # rebalances a ragged layout in place
         sl = tuple(slice(0, s) for s in self.__gshape)
-        return self.__array[sl]
+        return buf[sl]
 
     def _iter_local_shards(self, dedup: bool = False):
         """Yield ``(split_start, trimmed_shard)`` for each addressable
@@ -202,6 +280,23 @@ class DNDarray:
             key=lambda s: tuple(sl.start or 0 for sl in s.index),
         )
         split = self.__split
+        lcounts = self.lcounts
+        if lcounts is not None:
+            # ragged layout: shard r holds lcounts[r] valid rows at local
+            # offset 0; its logical start is the running displacement
+            block = self.__array.shape[split] // self.__comm.size
+            _, displs = self.counts_displs()
+            seen = set()
+            for s in shards:
+                r = (s.index[split].start or 0) // block
+                if dedup:
+                    if r in seen:
+                        continue
+                    seen.add(r)
+                sl = [slice(None)] * self.ndim
+                sl[split] = slice(0, int(lcounts[r]))
+                yield int(displs[r]), s.data[tuple(sl)]
+            return
         if dedup and split is None:
             # every replica would share key 0 and all but one shard would
             # silently vanish; callers must handle replicated arrays
@@ -235,8 +330,9 @@ class DNDarray:
 
     @comm.setter
     def comm(self, comm):
+        buf = self.larray  # rebalance under the old comm first
         self.__comm = sanitize_comm(comm)
-        self.__array = _place(self.__array, self.__comm, self.__split)
+        self.__array = _place(buf, self.__comm, self.__split)
 
     @property
     def device(self) -> Device:
@@ -298,6 +394,11 @@ class DNDarray:
     def lshape_map(self) -> np.ndarray:
         """(size, ndim) map of every shard's shape — computed, not
         communicated (reference ``dndarray.py:569-600`` used an Allreduce)."""
+        lcounts = self.lcounts
+        if lcounts is not None:
+            out = np.tile(np.asarray(self.__gshape, dtype=np.int64), (self.__comm.size, 1))
+            out[:, self.__split] = lcounts
+            return out
         return self.__comm.lshape_map(self.gshape, self.__split)
 
     def create_lshape_map(self, force_check: bool = False) -> np.ndarray:
@@ -305,12 +406,13 @@ class DNDarray:
 
     @property
     def balanced(self) -> bool:
-        return True
+        return self.lcounts is None
 
     def is_balanced(self, force_check: bool = False) -> bool:
-        """XLA's ceil-div layout is always balanced (reference
-        ``dndarray.py:508``)."""
-        return True
+        """Whether the layout is the canonical ceil-div one (reference
+        ``dndarray.py:508``). False only after a ``redistribute_`` to a
+        non-canonical target map."""
+        return self.lcounts is None
 
     @property
     def ndim(self) -> int:
@@ -360,12 +462,12 @@ class DNDarray:
 
     @property
     def loc(self) -> LocalIndex:
-        return LocalIndex(self.__array)
+        return LocalIndex(self.larray)
 
     @property
     def lloc(self) -> LocalIndex:
         """Local-shard indexing view (reference ``dndarray.py:239``)."""
-        return LocalIndex(self.__array)
+        return LocalIndex(self.larray)
 
     @property
     def stride(self) -> Tuple[int, ...]:
@@ -397,7 +499,8 @@ class DNDarray:
         hs = self.halo_size
         if hs == 0 or self.__split is None:
             return None
-        counts, displs = self.counts_displs()
+        counts, displs = self.counts_displs()  # honors a ragged layout
+        log = self._logical()  # slices below are in logical coordinates
         slabs = []
         for i in range(1, len(counts)):
             # a halo crosses boundary i only when both neighbors hold >= hs
@@ -405,7 +508,7 @@ class DNDarray:
                 continue
             sl = [slice(None)] * self.ndim
             sl[self.__split] = slice(displs[i], displs[i] + hs)
-            slabs.append(self.__array[tuple(sl)])
+            slabs.append(log[tuple(sl)])
         return jnp.stack(slabs) if slabs else None
 
     @property
@@ -416,14 +519,15 @@ class DNDarray:
         hs = self.halo_size
         if hs == 0 or self.__split is None:
             return None
-        counts, displs = self.counts_displs()
+        counts, displs = self.counts_displs()  # honors a ragged layout
+        log = self._logical()
         slabs = []
         for i in range(1, len(counts)):
             if counts[i - 1] < hs or counts[i] < hs:
                 continue
             sl = [slice(None)] * self.ndim
             sl[self.__split] = slice(max(displs[i] - hs, 0), displs[i])
-            slabs.append(self.__array[tuple(sl)])
+            slabs.append(log[tuple(sl)])
         return jnp.stack(slabs) if slabs else None
 
     def counts_displs(self) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
@@ -455,6 +559,7 @@ class DNDarray:
         out._DNDarray__dtype = self.__dtype
         out._DNDarray__split = None
         out._DNDarray__gshape = self.__gshape
+        out._DNDarray__lcounts = None
         out._DNDarray__array = host
         return out
 
@@ -483,22 +588,22 @@ class DNDarray:
         )
 
     def redistribute_(self, lshape_map=None, target_map=None) -> "DNDarray":
-        """Move data to a target per-rank shape map (reference
-        ``dndarray.py:1029-1233``).
+        """Move data to a target per-shard shape map (reference
+        ``dndarray.py:1029-1233``, chained Send/Recv there).
 
-        The physical layout on TPU is always the canonical ceil-div block
-        layout of SOME split axis, so exactly the canonical maps are
-        representable:
+        Any map that partitions the split extent is accepted, like the
+        reference's — including skewed and empty shards:
 
-        - the canonical map of the current split: already there, no-op;
-        - the canonical map of a *different* split axis: performed exactly
-          (one resharding — the analogue of the reference's chained
-          Send/Recv, chosen by XLA);
-        - any other map: ``ValueError`` (the reference's arbitrary
-          unbalanced maps have no XLA representation — rebalance with
-          ``balance_()``/``resplit_()`` instead). The old behavior of
-          warning and silently doing nothing dropped the reference's
-          guarantee that the move happens.
+        - the current map (canonical or ragged): no-op;
+        - the canonical map of a *different* split axis: one resharding
+          (XLA chooses the collective);
+        - any other partition of the split extent: a ragged interval
+          exchange (:func:`heat_tpu.parallel.flatmove.ragged_move` —
+          colored ``ppermute`` rounds, per-device memory O(block)). The
+          result is a *ragged-layout* array: ``lshape_map`` /
+          ``local_shards`` / ``counts_displs`` reflect the target map
+          exactly; any subsequent computation rebalances first (see
+          :attr:`larray`).
 
         ``lshape_map`` (the current-layout hint in the reference, computed
         there with an Allreduce) is validated against the true metadata.
@@ -526,21 +631,60 @@ class DNDarray:
             raise ValueError("target_map entries must be non-negative")
         if np.array_equal(target, self.lshape_map):
             return self  # already in this layout (covers split=None too)
-        for axis in ([self.__split] if self.__split is not None else []) + [
-            k for k in range(self.ndim) if k != self.__split
+        split = self.__split
+        if split is not None:
+            non_split = [k for k in range(ndim) if k != split]
+            counts = target[:, split]
+            if (
+                all((target[:, k] == self.__gshape[k]).all() for k in non_split)
+                and int(counts.sum()) == self.__gshape[split]
+            ):
+                return self._ragged_redistribute(tuple(int(c) for c in counts))
+        for axis in ([split] if split is not None else []) + [
+            k for k in range(self.ndim) if k != split
         ]:
             if np.array_equal(target, self.__comm.lshape_map(self.gshape, axis)):
                 if axis != self.__split:
                     self.resplit_(axis)
                 return self
         raise ValueError(
-            "target_map is not the canonical layout of any split axis; "
-            "arbitrary unbalanced maps are not representable in the XLA "
-            "block layout — use balance_() or resplit_()"
+            "target_map neither partitions the split extent nor matches the "
+            "canonical layout of any split axis"
         )
 
+    def _ragged_redistribute(self, counts: Tuple[int, ...]) -> "DNDarray":
+        """In-place interval exchange from the current layout to per-shard
+        split-axis ``counts`` (sum equals the split extent)."""
+        from ..parallel.flatmove import ragged_move
+
+        split = self.__split
+        cur = tuple(int(c) for c in self.lshape_map[:, split])
+        if counts == cur:
+            return self
+        canonical = self.__comm.counts_displs_shape(self.__gshape, split)[0]
+        b_out = max(1, max(counts))
+        if counts == tuple(canonical):
+            # target IS the canonical map: land exactly on the canonical
+            # padded buffer and drop the ragged state
+            b_out = self.__comm.padded_dim(self.__gshape[split]) // self.__comm.size
+        buf = ragged_move(self.__array, split, cur, counts, b_out, self.__comm)
+        if counts == tuple(canonical):
+            self.__lcounts = None
+            self.__array = _place(buf, self.__comm, split, self.__gshape, force=True)
+        else:
+            self.__lcounts = counts
+            self.__array = jax.device_put(
+                buf, self.__comm.array_sharding(buf.shape, split)
+            )
+        return self
+
     def balance_(self) -> "DNDarray":
-        """Already balanced by construction (reference ``dndarray.py:470``)."""
+        """Rebalance to the canonical ceil-div layout (reference
+        ``dndarray.py:470``). No-op unless the array is in a ragged layout
+        from ``redistribute_``; then one bounded interval exchange."""
+        if self.lcounts is not None:
+            canonical, _, _ = self.__comm.counts_displs_shape(self.__gshape, self.__split)
+            self._ragged_redistribute(tuple(canonical))
         return self
 
     def get_halo(self, halo_size: int) -> None:
@@ -563,14 +707,20 @@ class DNDarray:
     def array_with_halos(self) -> jax.Array:
         """Global array (halos are implicit in the global view); kept for
         API parity with reference ``dndarray.py:445``."""
-        return self.__array
+        return self.larray
 
     # ------------------------------------------------------------ conversion
     def astype(self, dtype, copy: bool = True) -> "DNDarray":
         """Cast to a new heat type (reference ``dndarray.py:451``)."""
         dtype = types.canonical_heat_type(dtype)
-        casted = self.__array.astype(dtype.jax_type())
+        buf = self.larray
+        casted = buf.astype(dtype.jax_type())
         if copy:
+            if casted is buf:
+                # same-dtype astype returns the SAME array; a true copy is
+                # required because basic-index setitem donates its buffer
+                # (an aliasing "copy" would be deleted with the original)
+                casted = jnp.copy(casted)
             return DNDarray._from_buffer(
                 casted, self.__gshape, dtype, self.__split, self.__device, self.__comm
             )
@@ -581,8 +731,8 @@ class DNDarray:
     def numpy(self) -> np.ndarray:
         """Gather the logical global array to host memory (reference
         ``dndarray.py:991``). Tail padding is sliced off host-side."""
-        host = np.asarray(jax.device_get(self.__array))
-        if self.padded:
+        host = np.asarray(jax.device_get(self.larray))
+        if tuple(host.shape) != self.__gshape:
             host = host[tuple(slice(0, s) for s in self.__gshape)]
         return host
 
@@ -633,11 +783,10 @@ class DNDarray:
             raise ValueError("input array must be 2D")
         idx = jnp.arange(n)
         self.__array = _place(
-            self.__array.at[idx, idx].set(value),
+            self.larray.at[idx, idx].set(value),
             self.__comm,
             self.__split,
             self.__gshape,
-            force=True,
         )
         return self
 
@@ -649,8 +798,12 @@ class DNDarray:
         split (shifted over removed dims); a scalar index on the split axis
         replicates; advanced indexing on the split axis yields split=0.
         """
+        buf = self.larray  # rebalances a ragged layout first
         key_t, out_split = self.__translate_key(key)
-        result = self.__array[key_t]
+        fast = self.__basic_getitem(buf, key_t, out_split)
+        if fast is not None:
+            return fast
+        result = buf[key_t]
         if isinstance(result, jax.Array) and result.ndim == 0:
             out_split = None
         return DNDarray(
@@ -661,20 +814,171 @@ class DNDarray:
             comm=self.__comm,
         )
 
+    def __basic_getitem(self, buf, key_t, out_split):
+        """Basic-index fast path: one cached pinned pipeline per key
+        structure (ints become traced operands). Returns None when the key
+        is not basic (advanced/bool/scalar-bool) or the array is not
+        distributed — the caller then takes the eager path."""
+        if self.__split is None or not self.__comm.is_distributed():
+            return None
+        key_seq = list(key_t) if isinstance(key_t, tuple) else [key_t]
+        struct: List[Tuple] = []
+        ints: List[int] = []
+        in_dim = 0
+        for pos, k in enumerate(key_seq):
+            if k is None:
+                struct.append(("n",))
+                continue
+            if isinstance(k, (bool, np.bool_)):
+                return None
+            if isinstance(k, (int, np.integer)):
+                k = int(k)
+                if k < 0:  # dynamic gather clamps; wrap host-side
+                    k += self.__gshape[in_dim]
+                if not 0 <= k < self.__gshape[in_dim]:
+                    # traced indices clamp/zero instead of raising; keep
+                    # the reference's (numpy's) IndexError contract
+                    raise IndexError(
+                        f"index {k} is out of bounds for axis {in_dim} with "
+                        f"size {self.__gshape[in_dim]}"
+                    )
+                # split-dim ints lower as a one-hot contraction ('I') so
+                # GSPMD never gathers the operand
+                struct.append(("I",) if in_dim == self.__split else ("i",))
+                ints.append(k)
+                in_dim += 1
+            elif isinstance(k, slice):
+                if in_dim == self.__split:
+                    start, stop, step = k.indices(self.__gshape[in_dim])
+                    if step != 1:
+                        return self.__strided_split_getitem(
+                            buf, key_seq, pos, start, stop, step
+                        )
+                struct.append(("s", k.start, k.stop, k.step))
+                in_dim += 1
+            else:
+                return None
+        # shape of the logical result (independent of the int values)
+        static_key = tuple(
+            0 if t[0] in ("i", "I") else (slice(t[1], t[2], t[3]) if t[0] == "s" else None)
+            for t in struct
+        )
+        out_gshape = jax.eval_shape(
+            lambda b: b[static_key], jax.ShapeDtypeStruct(buf.shape, buf.dtype)
+        ).shape
+        if len(out_gshape) == 0:
+            return None  # scalar result: nothing to distribute
+        from ._movement import getitem_executable
+
+        fn = getitem_executable(
+            buf.shape, buf.dtype, self.__split, tuple(struct),
+            tuple(out_gshape), out_split, self.__comm,
+        )
+        return DNDarray._from_buffer(
+            fn(buf, *ints), out_gshape, self.__dtype, out_split,
+            self.__device, self.__comm,
+        )
+
+    def __strided_split_getitem(self, buf, key_seq, pos, start, stop, step):
+        """A step != 1 slice on the split axis: GSPMD's partitioner would
+        all-gather (strided selection breaks the interval structure), so
+        run the strided-take interval-exchange kernel
+        (:func:`heat_tpu.parallel.flatmove.strided_take`) — negative
+        steps as positive-take + pinned flip — then apply the remaining
+        key dims through the regular pipeline."""
+        from ..parallel.flatmove import strided_take
+
+        split = self.__split
+        m = len(range(start, stop, step))
+        if m == 0:
+            return None  # empty result: the eager path handles it exactly
+        if step > 0:
+            buf2, _ = strided_take(
+                buf, split, self.__gshape[split], start, stop, step, self.__comm
+            )
+        else:
+            first = start + step * (m - 1)
+            buf2, _ = strided_take(
+                buf, split, self.__gshape[split], first, start + 1, -step, self.__comm
+            )
+        mid_gshape = tuple(
+            m if d == split else s for d, s in enumerate(self.__gshape)
+        )
+        mid = DNDarray._from_buffer(
+            buf2, mid_gshape, self.__dtype, split, self.__device, self.__comm
+        )
+        if step < 0:
+            from ._movement import flip_padded
+
+            mid = DNDarray._from_buffer(
+                flip_padded(mid.larray, mid_gshape, split, split, self.__comm),
+                mid_gshape, self.__dtype, split, self.__device, self.__comm,
+            )
+        rest = list(key_seq)
+        rest[pos] = slice(None)
+        return mid[tuple(rest)]
+
     def __setitem__(self, key, value) -> None:
         """Global scatter-update (reference ``dndarray.py:1359-1676``).
 
         Keys are normalized to the logical extent, so only valid elements
-        are ever written; tail padding stays untouched."""
+        are ever written; tail padding stays untouched.
+
+        Basic-index keys (ints/slices) run as a cached donated jitted
+        scatter with pinned shardings — in-place on device, O(updates)
+        for a loop of setitems, matching the reference's local in-place
+        write (``dndarray.py:1359``). Advanced keys fall back to an eager
+        sharding-preserving update."""
+        buf = self.larray  # rebalances a ragged layout first
         key_t, _ = self.__translate_key(key)
         if isinstance(value, DNDarray):
             value = value._logical()
+        value = jnp.asarray(value, dtype=self.__dtype.jax_type())
+        struct: List[Tuple] = []
+        ints: List[int] = []
+        in_dim = 0
+        for k in key_t if isinstance(key_t, tuple) else (key_t,):
+            if k is None or isinstance(k, (bool, np.bool_)):
+                break  # newaxis / scalar-bool keys: rare, eager path
+            if isinstance(k, (int, np.integer)):
+                k = int(k)
+                if k < 0:
+                    k += self.__gshape[in_dim]
+                if not 0 <= k < self.__gshape[in_dim]:
+                    # a traced scatter index would silently DROP the
+                    # out-of-bounds update; keep the IndexError contract
+                    raise IndexError(
+                        f"index {k} is out of bounds for axis {in_dim} with "
+                        f"size {self.__gshape[in_dim]}"
+                    )
+                struct.append(("i",))
+                ints.append(k)
+                in_dim += 1
+            elif isinstance(k, slice):
+                struct.append(("s", k.start, k.stop, k.step))
+                in_dim += 1
+            else:
+                break
+        else:
+            from ._movement import setitem_executable
+
+            if value is buf:
+                # self-assignment (a[:] = a on an unpadded array): the
+                # donated argument must not alias an operand
+                value = jnp.copy(value)
+            fn = setitem_executable(
+                buf.shape, buf.dtype, self.__split, tuple(struct),
+                tuple(value.shape), value.dtype, self.__comm,
+            )
+            self.__array = fn(buf, value, *ints)
+            return
+        # advanced indexing: eager update keeps the operand's sharding, so
+        # _place is a metadata no-op (no forced device_put)
         self.__array = _place(
-            self.__array.at[key_t].set(jnp.asarray(value, dtype=self.__dtype.jax_type())),
+            buf.at[key_t].set(value),
             self.__comm,
             self.__split,
             self.__gshape,
-            force=True,
         )
 
     def __translate_key(self, key):
@@ -755,6 +1059,13 @@ class DNDarray:
                         k = _normalize_slice(k, n_split)
                 elif isinstance(k, (int, np.integer)):
                     out_split = None  # scalar on split axis -> replicated bcast
+                    if not -n_split <= int(k) < n_split:
+                        # validate HERE: wrapping an already-wrapped value
+                        # downstream would alias a valid index
+                        raise IndexError(
+                            f"index {int(k)} is out of bounds for axis "
+                            f"{split} with size {n_split}"
+                        )
                     if needs_norm and k < 0:
                         k = int(k) + n_split
                 else:

@@ -85,9 +85,11 @@ def _wrap(result: jnp.ndarray, like: DNDarray, split: Optional[int]) -> DNDarray
 
 
 def balance(array: DNDarray, copy: bool = False) -> DNDarray:
-    """Balanced copy (reference ``manipulations.py``); XLA layout is always
-    balanced, so this is (a copy of) the input."""
-    return array.copy() if copy else array
+    """Balanced version of ``array`` (reference ``manipulations.py:63``).
+    A ragged-layout array (after a non-canonical ``redistribute_``) is
+    rebalanced with one interval exchange; canonical arrays pass through."""
+    out = array.copy() if copy else array
+    return out.balance_()
 
 
 def broadcast_arrays(*arrays: DNDarray) -> List[DNDarray]:
@@ -224,7 +226,17 @@ def flatten(a: DNDarray) -> DNDarray:
 
 
 def flip(a: DNDarray, axis=None) -> DNDarray:
-    """Reverse element order along axis (reference ``manipulations.py``)."""
+    """Reverse element order along axis (reference ``manipulations.py``).
+
+    Distributed arrays run as one pinned pipeline: a split-axis flip
+    reverses the block partition, which GSPMD lowers to collective
+    permutes (proof-tested, no all-gather)."""
+    if a.split is not None and a.comm.is_distributed():
+        from ._movement import flip_padded
+
+        key_axis = axis if axis is None or isinstance(axis, int) else tuple(axis)
+        buf = flip_padded(a.larray, a.gshape, a.split, key_axis, a.comm)
+        return DNDarray._from_buffer(buf, a.gshape, a.dtype, a.split, a.device, a.comm)
     result = jnp.flip(a._logical(), axis=axis)
     return _wrap(result, a, a.split)
 
@@ -282,6 +294,23 @@ def pad(array: DNDarray, pad_width, mode: str = "constant", constant_values=0) -
             np_pad = [tuple(p) for p in pw]
             if len(np_pad) < array.ndim:
                 np_pad = [(0, 0)] * (array.ndim - len(np_pad)) + np_pad
+    if isinstance(np_pad, (int, np.integer)):
+        np_pad = [(int(np_pad), int(np_pad))] * array.ndim
+    np_pad = tuple(tuple(int(v) for v in p) for p in np_pad)
+    if (
+        array.split is not None
+        and array.comm.is_distributed()
+        and np.isscalar(constant_values)
+    ):
+        from ._movement import pad_padded
+
+        buf, out_shape = pad_padded(
+            array.larray, array.gshape, array.split, np_pad, mode, constant_values, array.comm
+        )
+        return DNDarray._from_buffer(
+            buf, out_shape, types.canonical_heat_type(buf.dtype), array.split,
+            array.device, array.comm,
+        )
     if mode == "constant":
         result = jnp.pad(array._logical(), np_pad, mode=mode, constant_values=constant_values)
     else:
@@ -359,7 +388,15 @@ def resplit(arr: DNDarray, axis: Optional[int] = None) -> DNDarray:
 
 def roll(x: DNDarray, shift, axis=None) -> DNDarray:
     """Circular shift (reference ``manipulations.py:1989`` — rank-to-rank
-    sends; a collective-permute under XLA)."""
+    sends there). Distributed arrays run as one pinned pipeline so the
+    shifted ownership compiles to collective permutes (proof-tested)."""
+    if x.split is not None and x.comm.is_distributed():
+        from ._movement import roll_padded
+
+        key_shift = shift if isinstance(shift, int) else tuple(int(s) for s in np.atleast_1d(shift))
+        key_axis = axis if axis is None or isinstance(axis, int) else tuple(axis)
+        buf = roll_padded(x.larray, x.gshape, x.split, key_shift, key_axis, x.comm)
+        return DNDarray._from_buffer(buf, x.gshape, x.dtype, x.split, x.device, x.comm)
     result = jnp.roll(x._logical(), shift, axis=axis)
     return _wrap(result, x, x.split)
 

@@ -9,9 +9,14 @@ __all__ = ["copy", "sanitize_memory_layout"]
 
 
 def copy(x: DNDarray) -> DNDarray:
-    """Deep copy (reference ``memory.py:13``)."""
+    """Deep copy (reference ``memory.py:13``). Preserves a ragged layout
+    exactly (the copy carries the same per-shard counts)."""
     if not isinstance(x, DNDarray):
         raise TypeError(f"input needs to be a DNDarray, but was {type(x)}")
+    if x.lcounts is not None:
+        return DNDarray._from_ragged(
+            jnp.copy(x._raw), x.gshape, x.dtype, x.split, x.lcounts, x.device, x.comm
+        )
     return DNDarray(
         jnp.copy(x.larray), gshape=x.gshape, dtype=x.dtype, split=x.split, device=x.device, comm=x.comm
     )

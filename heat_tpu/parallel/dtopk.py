@@ -37,6 +37,8 @@ from jax.sharding import PartitionSpec as P
 from ..core.communication import SPLIT_AXIS, MeshCommunication
 from .dsort import _sort_block
 
+from ..core._cache import ExecutableCache
+
 __all__ = ["distributed_topk"]
 
 
@@ -105,4 +107,4 @@ def distributed_topk(
     return fn(buf)
 
 
-_JIT_CACHE: dict = {}
+_JIT_CACHE = ExecutableCache()  # bounded LRU (round-3 ADVICE)

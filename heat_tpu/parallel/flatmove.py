@@ -40,7 +40,17 @@ from jax.sharding import PartitionSpec as P
 
 from ..core.communication import SPLIT_AXIS, MeshCommunication
 
-__all__ = ["flat_schedule", "reshape_flatmove_executable", "reshape_via_flatmove"]
+from ..core._cache import ExecutableCache
+
+__all__ = [
+    "flat_schedule",
+    "reshape_flatmove_executable",
+    "reshape_via_flatmove",
+    "ragged_move_executable",
+    "ragged_move",
+    "strided_take_executable",
+    "strided_take",
+]
 
 
 class Edge(NamedTuple):
@@ -102,6 +112,47 @@ def _tables(edges: List[Edge], p: int):
     return jnp.asarray(soff), jnp.asarray(doff), jnp.asarray(dlen)
 
 
+def _exchange(
+    flat,
+    *,
+    axis_name: str,
+    p: int,
+    c_out: int,
+    self_edges: List[Edge],
+    rounds: List[List[Edge]],
+):
+    """Run the colored interval exchange on a 1-D local block: self-edges
+    as local dynamic slices, each color as one ``ppermute`` round. Returns
+    the 1-D output block of ``c_out`` elements."""
+    r = lax.axis_index(axis_name)
+    max_u = max(
+        [e.length for e in self_edges] + [e.length for rnd in rounds for e in rnd],
+        default=1,
+    )
+    # guard slices/updates against clamping: widen both ends by the piece
+    src = jnp.concatenate([flat, jnp.zeros((max_u,), flat.dtype)])
+    out = jnp.zeros((c_out + max_u,), flat.dtype)
+    idx = jnp.arange(c_out + max_u, dtype=jnp.int32)
+
+    def write(out, piece, doff, dlen):
+        tmp = lax.dynamic_update_slice(out, piece, (doff,))
+        mask = (idx >= doff) & (idx < doff + dlen)
+        return jnp.where(mask, tmp, out)
+
+    if self_edges:
+        u = max(e.length for e in self_edges)
+        soff, doff, dlen = _tables(self_edges, p)
+        piece = lax.dynamic_slice(src, (soff[r],), (u,))
+        out = write(out, piece, doff[r], dlen[r])
+    for rnd in rounds:
+        u = max(e.length for e in rnd)
+        soff, doff, dlen = _tables(rnd, p)
+        piece = lax.dynamic_slice(src, (soff[r],), (u,))
+        recv = lax.ppermute(piece, axis_name, [(e.src, e.dst) for e in rnd])
+        out = write(out, recv, doff[r], dlen[r])
+    return out[:c_out]
+
+
 def _flatmove_kernel(
     x,
     *,
@@ -113,33 +164,50 @@ def _flatmove_kernel(
     self_edges: List[Edge],
     rounds: List[List[Edge]],
 ):
-    r = lax.axis_index(axis_name)
-    flat = x.reshape((c_in,))
-    max_u = max(
-        [e.length for e in self_edges] + [e.length for rnd in rounds for e in rnd]
+    out = _exchange(
+        x.reshape((c_in,)),
+        axis_name=axis_name,
+        p=p,
+        c_out=c_out,
+        self_edges=self_edges,
+        rounds=rounds,
     )
-    # guard slices/updates against clamping: widen both ends by the piece
-    src = jnp.concatenate([flat, jnp.zeros((max_u,), flat.dtype)])
-    out = jnp.zeros((c_out + max_u,), flat.dtype)
-    idx = jnp.arange(c_out + max_u, dtype=jnp.int32)
+    return out.reshape(out_block)
 
-    def write(out, piece, u, doff, dlen):
-        tmp = lax.dynamic_update_slice(out, piece, (doff,))
-        mask = (idx >= doff) & (idx < doff + dlen)
-        return jnp.where(mask, tmp, out)
 
-    if self_edges:
-        u = max(e.length for e in self_edges)
-        soff, doff, dlen = _tables(self_edges, p)
-        piece = lax.dynamic_slice(src, (soff[r],), (u,))
-        out = write(out, piece, u, doff[r], dlen[r])
-    for rnd in rounds:
-        u = max(e.length for e in rnd)
-        soff, doff, dlen = _tables(rnd, p)
-        piece = lax.dynamic_slice(src, (soff[r],), (u,))
-        recv = lax.ppermute(piece, axis_name, [(e.src, e.dst) for e in rnd])
-        out = write(out, recv, u, doff[r], dlen[r])
-    return out[:c_out].reshape(out_block)
+def _ragged_kernel(
+    x,
+    *,
+    axis_name: str,
+    p: int,
+    split: int,
+    b_out: int,
+    self_edges: List[Edge],
+    rounds: List[List[Edge]],
+):
+    """Interval exchange of whole split-axis hyperplanes: transpose the
+    split axis to the front so each device's valid rows form a contiguous
+    flat prefix, exchange, transpose back."""
+    shape = x.shape
+    outer = int(np.prod(shape[:split], dtype=np.int64)) if split else 1
+    b_in = shape[split]
+    inner = (
+        int(np.prod(shape[split + 1 :], dtype=np.int64))
+        if split + 1 < len(shape)
+        else 1
+    )
+    unit = outer * inner
+    flat = jnp.moveaxis(x.reshape((outer, b_in, inner)), 1, 0).reshape((b_in * unit,))
+    out_flat = _exchange(
+        flat,
+        axis_name=axis_name,
+        p=p,
+        c_out=b_out * unit,
+        self_edges=self_edges,
+        rounds=rounds,
+    )
+    out = jnp.moveaxis(out_flat.reshape((b_out, outer, inner)), 0, 1)
+    return out.reshape(shape[:split] + (b_out,) + shape[split + 1 :])
 
 
 def reshape_flatmove_executable(
@@ -189,6 +257,225 @@ def reshape_flatmove_executable(
     return fn
 
 
+def ragged_move_executable(
+    buf_shape: Tuple[int, ...],
+    dtype,
+    split: int,
+    in_counts: Sequence[int],
+    out_counts: Sequence[int],
+    b_out: int,
+    comm: MeshCommunication,
+):
+    """Cached jitted program redistributing split-axis hyperplanes between
+    two *arbitrary* interval partitions (the reference's ragged
+    ``redistribute_`` target maps, ``/root/reference/heat/core/dndarray.py:
+    1029-1233``, chained Send/Recv there — colored ``ppermute`` rounds
+    here).
+
+    Device ``r`` holds ``in_counts[r]`` valid rows at offset 0 of its
+    ``buf_shape[split] // P``-row block; the output buffer has ``b_out``
+    rows per device with ``out_counts[d]`` valid rows at offset 0. Counts
+    may be zero or skewed; per-device memory stays O(block + piece).
+    ``.lower()``-able for the distribution-proof tests.
+    """
+    mesh = comm.mesh
+    p = mesh.shape[SPLIT_AXIS]
+    in_counts = tuple(int(c) for c in in_counts)
+    out_counts = tuple(int(c) for c in out_counts)
+    if len(in_counts) != p or len(out_counts) != p:
+        raise ValueError(f"count maps must have length {p}")
+    b_in = buf_shape[split] // p
+    if max(in_counts, default=0) > b_in or max(out_counts, default=0) > int(b_out):
+        raise ValueError("a count exceeds its per-device block size")
+    key = (
+        "ragged",
+        tuple(buf_shape),
+        str(dtype),
+        split,
+        in_counts,
+        out_counts,
+        int(b_out),
+        mesh,
+    )
+    fn = _JIT_CACHE.get(key)
+    if fn is not None:
+        return fn
+    ndim = len(buf_shape)
+    outer = int(np.prod(buf_shape[:split], dtype=np.int64)) if split else 1
+    inner = (
+        int(np.prod(buf_shape[split + 1 :], dtype=np.int64))
+        if split + 1 < ndim
+        else 1
+    )
+    unit = outer * inner
+    self_edges, rounds = flat_schedule(
+        [c * unit for c in in_counts], [c * unit for c in out_counts]
+    )
+    spec = P(*[SPLIT_AXIS if i == split else None for i in range(ndim)])
+    kernel = partial(
+        _ragged_kernel,
+        axis_name=SPLIT_AXIS,
+        p=p,
+        split=split,
+        b_out=int(b_out),
+        self_edges=self_edges,
+        rounds=rounds,
+    )
+    prog = shard_map(kernel, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False)
+    fn = _JIT_CACHE[key] = jax.jit(prog)
+    return fn
+
+
+def ragged_move(
+    buf: jax.Array,
+    split: int,
+    in_counts: Sequence[int],
+    out_counts: Sequence[int],
+    b_out: int,
+    comm: MeshCommunication,
+) -> jax.Array:
+    """Move a split-``split`` padded buffer between arbitrary interval
+    partitions (see :func:`ragged_move_executable`)."""
+    return ragged_move_executable(
+        tuple(buf.shape), buf.dtype, split, in_counts, out_counts, b_out, comm
+    )(buf)
+
+
+def _t_interval(lo: int, hi: int, start: int, step: int, m: int) -> Tuple[int, int]:
+    """Indices t in [0, m) with lo <= start + step*t < hi (t0, t1)."""
+    if step > 0:
+        t0 = max(0, -(-(lo - start) // step))
+        t1 = min(m, (hi - 1 - start) // step + 1) if hi > start else 0
+    else:
+        t0 = max(0, -(-(start - (hi - 1)) // (-step)))
+        t1 = min(m, (start - lo) // (-step) + 1) if start >= lo else 0
+    return t0, max(t0, t1)
+
+
+def _strided_kernel(
+    x,
+    *,
+    axis_name: str,
+    p: int,
+    split: int,
+    step: int,
+    b_out: int,
+    offs: Tuple[int, ...],
+    self_edges: List[Edge],
+    rounds: List[List[Edge]],
+):
+    """Local strided compaction then interval exchange: device r gathers
+    its selected rows (off_r + step*k within its block) to a contiguous
+    prefix, then the colored ppermute rounds redistribute the selected
+    extent onto the canonical layout."""
+    shape = x.shape
+    outer = int(np.prod(shape[:split], dtype=np.int64)) if split else 1
+    b_in = shape[split]
+    inner = (
+        int(np.prod(shape[split + 1 :], dtype=np.int64))
+        if split + 1 < len(shape)
+        else 1
+    )
+    unit = outer * inner
+    r = lax.axis_index(axis_name)
+    rows = jnp.moveaxis(x.reshape((outer, b_in, inner)), 1, 0)  # (b_in, outer, inner)
+    k = jnp.arange(b_in, dtype=jnp.int32)
+    idx = jnp.clip(jnp.asarray(offs, jnp.int32)[r] + step * k, 0, b_in - 1)
+    compact = rows[idx]  # local gather; garbage beyond count_r is masked by the exchange
+    out_flat = _exchange(
+        compact.reshape((b_in * unit,)),
+        axis_name=axis_name,
+        p=p,
+        c_out=b_out * unit,
+        self_edges=self_edges,
+        rounds=rounds,
+    )
+    out = jnp.moveaxis(out_flat.reshape((b_out, outer, inner)), 0, 1)
+    return out.reshape(shape[:split] + (b_out,) + shape[split + 1 :])
+
+
+def strided_take_executable(
+    buf_shape: Tuple[int, ...],
+    dtype,
+    split: int,
+    n_logical: int,
+    start: int,
+    stop: int,
+    step: int,
+    comm: MeshCommunication,
+):
+    """A strided slice ``[start:stop:step]`` ALONG the split axis as one
+    bounded program (selected rows land on their canonical layout).
+    GSPMD's partitioner all-gathers for step != 1 (the selection breaks
+    the interval structure); the reference instead computes rank-local
+    selections and chains sends (``dndarray.py:652-908``). Here: local
+    strided gather to a contiguous prefix, then the interval-exchange
+    rounds. Returns ``(fn, m)`` with ``m`` the selected extent."""
+    if step <= 0:
+        # t-ascending visits devices in descending order for step<0 and
+        # the interval schedule assumes rank-ascending concatenation; the
+        # caller composes positive-step take + flip instead
+        raise ValueError("strided_take requires step > 0")
+    mesh = comm.mesh
+    p = mesh.shape[SPLIT_AXIS]
+    m = len(range(start, stop, step))
+    b_in = buf_shape[split] // p
+    key = ("stake", tuple(buf_shape), str(dtype), split, n_logical, start, stop, step, mesh)
+    fn = _JIT_CACHE.get(key)
+    if fn is not None:
+        return fn, m
+    ndim = len(buf_shape)
+    outer = int(np.prod(buf_shape[:split], dtype=np.int64)) if split else 1
+    inner = (
+        int(np.prod(buf_shape[split + 1 :], dtype=np.int64))
+        if split + 1 < ndim
+        else 1
+    )
+    unit = outer * inner
+    in_counts, offs = [], []
+    for r in range(p):
+        lo, hi = r * b_in, min(r * b_in + b_in, n_logical)
+        t0, t1 = _t_interval(lo, hi, start, step, m) if hi > lo else (0, 0)
+        in_counts.append(t1 - t0)
+        offs.append((start + step * t0) - lo if t1 > t0 else 0)
+    b_out = max(1, -(-m // p))
+    out_counts = [max(0, min(m - r * b_out, b_out)) for r in range(p)]
+    self_edges, rounds = flat_schedule(
+        [c * unit for c in in_counts], [c * unit for c in out_counts]
+    )
+    spec = P(*[SPLIT_AXIS if i == split else None for i in range(ndim)])
+    kernel = partial(
+        _strided_kernel,
+        axis_name=SPLIT_AXIS,
+        p=p,
+        split=split,
+        step=step,
+        b_out=b_out,
+        offs=tuple(offs),
+        self_edges=self_edges,
+        rounds=rounds,
+    )
+    prog = shard_map(kernel, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False)
+    fn = _JIT_CACHE[key] = jax.jit(prog)
+    return fn, m
+
+
+def strided_take(
+    buf: jax.Array,
+    split: int,
+    n_logical: int,
+    start: int,
+    stop: int,
+    step: int,
+    comm: MeshCommunication,
+) -> Tuple[jax.Array, int]:
+    """Apply :func:`strided_take_executable`; returns ``(buffer, m)``."""
+    fn, m = strided_take_executable(
+        tuple(buf.shape), buf.dtype, split, n_logical, start, stop, step, comm
+    )
+    return fn(buf), m
+
+
 def reshape_via_flatmove(
     buf: jax.Array,
     gshape: Tuple[int, ...],
@@ -203,4 +490,4 @@ def reshape_via_flatmove(
     )(buf)
 
 
-_JIT_CACHE: dict = {}
+_JIT_CACHE = ExecutableCache()  # bounded LRU (round-3 ADVICE)

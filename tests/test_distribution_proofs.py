@@ -533,3 +533,96 @@ class TestUniqueBounded(TestCase):
         xf[0] = xf[1]
         res3 = ht.unique(ht.array(xf, split=0))
         np.testing.assert_array_equal(res3.numpy(), np.unique(xf))
+
+
+class TestMoverLongTailBounded(TestCase):
+    """Roll / flip / pad / diff as pinned pipelines (VERDICT r3 item 2):
+    lower EXACTLY the production executables at scale; assert no
+    all-gather and O(n/P) per-device buffers — the reference's explicit
+    rank-to-rank send bounds (``manipulations.py:1989`` roll,
+    ``manipulations.py:1128`` pad, ``arithmetics.py:293`` diff)."""
+
+    N = 400_003  # non-divisible on purpose
+    C = 8
+
+    def _pshape(self):
+        return _comm().padded_shape((self.N, self.C), 0)
+
+    def _lower(self, fn):
+        import jax
+
+        return fn.lower(
+            jax.ShapeDtypeStruct(self._pshape(), np.float32)
+        ).compile().as_text()
+
+    def _per_dev(self):
+        p = self._pshape()
+        return 4 * int(np.prod(p)) // 8
+
+    def test_roll_split_axis(self):
+        _skip_unless_8()
+        from heat_tpu.core._movement import roll_executable
+
+        fn = roll_executable(
+            self._pshape(), np.dtype(np.float32), (self.N, self.C), 0, 12345, 0, _comm()
+        )
+        hlo = self._lower(fn)
+        _assert_bounded(hlo, self._per_dev(), 2.0, "roll split-axis")
+        assert "collective-permute" in hlo
+
+    def test_flip_split_axis(self):
+        _skip_unless_8()
+        from heat_tpu.core._movement import flip_executable
+
+        fn = flip_executable(
+            self._pshape(), np.dtype(np.float32), (self.N, self.C), 0, 0, _comm()
+        )
+        hlo = self._lower(fn)
+        _assert_bounded(hlo, self._per_dev(), 2.0, "flip split-axis")
+        assert "collective-permute" in hlo
+
+    def test_pad_split_axis(self):
+        _skip_unless_8()
+        from heat_tpu.core._movement import pad_executable
+
+        fn, out_shape = pad_executable(
+            self._pshape(), np.dtype(np.float32), (self.N, self.C), 0,
+            ((50, 20), (0, 0)), "constant", 0, _comm(),
+        )
+        assert out_shape == (self.N + 70, self.C)
+        hlo = self._lower(fn)
+        _assert_bounded(hlo, self._per_dev(), 2.0, "pad split-axis")
+
+    def test_diff_split_axis(self):
+        _skip_unless_8()
+        from heat_tpu.core._movement import diff_executable
+
+        fn, out_shape = diff_executable(
+            self._pshape(), np.dtype(np.float32), (self.N, self.C), 0, 1, 0,
+            None, None, _comm(),
+        )
+        assert out_shape == (self.N - 1, self.C)
+        hlo = self._lower(fn)
+        _assert_bounded(hlo, self._per_dev(), 2.0, "diff split-axis")
+        assert "collective-permute" in hlo
+
+    def test_values_match_numpy(self):
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=(41, 5)).astype(np.float32)
+        for split in (0, 1):
+            a = ht.array(x, split=split)
+            np.testing.assert_array_equal(ht.roll(a, 7, axis=0).numpy(), np.roll(x, 7, axis=0))
+            np.testing.assert_array_equal(ht.roll(a, -3, axis=split).numpy(), np.roll(x, -3, axis=split))
+            np.testing.assert_array_equal(ht.flip(a, 0).numpy(), np.flip(x, 0))
+            np.testing.assert_array_equal(
+                ht.pad(a, [(2, 3), (1, 0)]).numpy(), np.pad(x, [(2, 3), (1, 0)])
+            )
+            np.testing.assert_allclose(
+                ht.diff(a, axis=0).numpy(), np.diff(x, axis=0), rtol=1e-6
+            )
+            np.testing.assert_allclose(
+                ht.diff(a, n=2, axis=split, prepend=0.0).numpy(),
+                np.diff(x, n=2, axis=split, prepend=0.0),
+                rtol=1e-5,
+                atol=1e-5,  # second differences cancel; relative error spikes near 0
+            )

@@ -160,17 +160,20 @@ class TestResplitMethods(TestCase):
         assert r is a and a.split == 1
         np.testing.assert_array_equal(a.numpy(), x)
 
-    def test_redistribute_canonical_ok_arbitrary_raises(self):
+    def test_redistribute_canonical_and_ragged(self):
         a = ht.arange(16, dtype=ht.float32, split=0)
         m = a.lshape_map
         a.redistribute_(lshape_map=m, target_map=m)  # identity map: fine
         if a.comm.size > 1:
-            bad = np.asarray(m).copy()
-            if bad.shape[0] >= 2 and bad[0, 0] > 0:
-                bad[0, 0] -= 1
-                bad[1, 0] += 1
-                with pytest.raises(ValueError):
-                    a.redistribute_(lshape_map=m, target_map=bad)
+            # arbitrary maps are now real moves (round-4 ragged support;
+            # see tests/test_redistribute.py for the full battery)
+            skew = np.asarray(m).copy()
+            if skew.shape[0] >= 2 and skew[0, 0] > 0:
+                skew[0, 0] -= 1
+                skew[1, 0] += 1
+                a.redistribute_(lshape_map=m, target_map=skew)
+                np.testing.assert_array_equal(a.lshape_map, skew)
+                np.testing.assert_array_equal(a.numpy(), np.arange(16, dtype=np.float32))
 
 
 class TestArithmeticDunders(TestCase):

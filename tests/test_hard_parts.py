@@ -91,8 +91,10 @@ class TestDistributionHardParts(TestCase):
     def test_redistribute_canonical_maps(self):
         """redistribute_ (reference dndarray.py:1029-1233): exact for
         canonical maps — same split is a no-op, another split's canonical
-        map performs the resharding — and a hard error for arbitrary maps
-        (no silent warn-and-skip)."""
+        map performs the resharding — and a real ragged move for
+        arbitrary partitions of the split extent (round 4; full battery
+        in tests/test_redistribute.py). Maps that are not a partition
+        stay hard errors."""
         x = np.arange(48, dtype=np.float32).reshape(12, 4)
         a = ht.array(x, split=0)
         comm = a.comm
@@ -111,13 +113,17 @@ class TestDistributionHardParts(TestCase):
         if comm.size > 1:
             with pytest.raises(ValueError):
                 a.redistribute_(lshape_map=comm.lshape_map((12, 4), 0))
-        # arbitrary unbalanced map: ValueError, not a warning
-        bad = comm.lshape_map((12, 4), 1).copy()
+        # arbitrary partition of the split extent: a real ragged move
+        skew = comm.lshape_map((12, 4), 1).copy()
         if comm.size > 1:
-            bad[0, 1] += 1
-            bad[1, 1] -= 1
-            with pytest.raises(ValueError):
-                a.redistribute_(target_map=bad)
+            skew[0, 1] += 1
+            skew[1, 1] -= 1
+            a.redistribute_(target_map=skew)
+            np.testing.assert_array_equal(a.lshape_map, skew)
+            assert not a.balanced
+            np.testing.assert_array_equal(a.numpy(), x)
+            a.balance_()
+            assert a.balanced
         with pytest.raises(ValueError):
             a.redistribute_(target_map=np.full((comm.size, 2), -1))
         with pytest.raises(ValueError):

