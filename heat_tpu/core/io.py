@@ -215,9 +215,10 @@ def load_netcdf(path: str, variable: str, dtype=types.float32, split=None, devic
                 raise KeyError(f"variable {variable!r} not found in {path}") from e
             arr = np.asarray(var[...], dtype=np.dtype(dtype.jax_type()))
         return DNDarray(jnp.asarray(arr), dtype=dtype, split=split, device=device, comm=comm)
+    if _is_classic_netcdf(path):
+        return _load_netcdf3(path, variable, dtype, split, device, comm)
     if not __HAS_HDF5:
         raise ImportError("netCDF support needs netCDF4 or h5py installed")
-    _reject_classic_netcdf(path)
     with h5py.File(path, "r") as probe:
         if variable not in probe:
             raise KeyError(f"variable {variable!r} not found in {path}")
@@ -232,31 +233,81 @@ def load_netcdf(path: str, variable: str, dtype=types.float32, split=None, devic
     return load_hdf5(path, variable, dtype=dtype, split=split, device=device, comm=comm)
 
 
-def _reject_classic_netcdf(path: str) -> None:
-    """Classic (netCDF-3) files are not HDF5 — name the actionable fix
-    instead of letting h5py fail with a cryptic signature error."""
-    with open(path, "rb") as f:
-        if f.read(3) == b"CDF":
-            raise ValueError(
-                f"{path} is a classic netCDF-3 file; the h5py fallback only "
-                "reads netCDF-4/HDF5 — install the netCDF4 library"
-            )
+def _is_classic_netcdf(path: str) -> bool:
+    from ._netcdf3 import is_classic_netcdf
+
+    try:
+        return is_classic_netcdf(path)
+    except OSError:
+        return False
 
 
-def save_netcdf(data: DNDarray, path: str, variable: str, mode: str = "w", **kwargs) -> None:
+def _load_netcdf3(path, variable, dtype, split, device, comm):
+    """Classic (CDF-1/2) load through the dependency-free parser
+    (:mod:`heat_tpu.core._netcdf3`), chunked on the first dimension into
+    the shared multi-host assembly — the reference's parallel
+    ``nc.Dataset`` read of the same files (``io.py:268``). Classic files
+    are row-major with row-granular byte ranges, so a ``split != 0``
+    load reads row stripes (bounded memory) and slices columns in
+    memory — the same IO the netCDF4 C library performs for column
+    hyperslabs of classic files."""
+    from ._netcdf3 import NetCDF3File
+
+    reader = NetCDF3File(path)
+    if variable not in reader.vars:
+        raise KeyError(f"variable {variable!r} not found in {path}")
+    gshape = reader.shape(variable)
+    np_dtype = np.dtype(dtype.jax_type())
+    if split is not None and gshape:
+        from .stride_tricks import sanitize_axis
+
+        split = sanitize_axis(gshape, split)
+    if split is None or not gshape or comm.size == 1:
+        arr = np.asarray(reader.read(variable)).astype(np_dtype)
+        return DNDarray(
+            jnp.asarray(arr), dtype=dtype, split=split, device=device, comm=comm
+        )
+    row_bytes = max(
+        1,
+        int(np.prod(gshape[1:], dtype=np.int64)) * reader.vars[variable].dtype.itemsize,
+    )
+    stripe = max(1, (4 << 20) // row_bytes)
+
+    def read_chunk(slices):
+        r0 = slices[0].start or 0
+        r1 = slices[0].stop if slices[0].stop is not None else gshape[0]
+        rest = tuple(slices[1:])
+        parts = []
+        for s in range(r0, r1, stripe):
+            rows = reader.read(variable, s, min(s + stripe, r1))
+            parts.append(np.asarray(rows)[(slice(None),) + rest].astype(np_dtype))
+        return parts[0] if len(parts) == 1 else np.concatenate(parts, axis=0)
+
+    buf = _assemble_from_chunks(read_chunk, gshape, split, comm, np_dtype)
+    return DNDarray._from_buffer(buf, gshape, dtype, split, device, comm)
+
+
+def save_netcdf(
+    data: DNDarray, path: str, variable: str, mode: str = "w", format: str = "NETCDF4", **kwargs
+) -> None:
     """Save to netCDF (reference ``io.py:351``).
 
     With ``netCDF4`` installed the real library writes; otherwise a
     netCDF-4-compatible HDF5 file is produced directly with h5py:
     per-dimension datasets registered as HDF5 dimension scales and
     attached to the variable — the structure the netCDF-4 data model
-    stores on disk, readable by netCDF tooling.
+    stores on disk, readable by netCDF tooling. ``format`` beginning
+    with ``"NETCDF3"`` writes the classic CDF format through the
+    dependency-free writer (:mod:`heat_tpu.core._netcdf3`) — CDF-2
+    (64-bit offsets) for ``"NETCDF3_64BIT"``, else CDF-1.
     """
     if not isinstance(data, DNDarray):
         raise TypeError(f"data must be a DNDarray, not {type(data)}")
-    if __HAS_NETCDF:
+    if __HAS_NETCDF:  # pragma: no cover - not in this image
+        # the real library handles every format (incl. classic) with full
+        # attribute/mode support; the pure writer below is the fallback
         arr = data.numpy()
-        with nc.Dataset(path, mode) as handle:
+        with nc.Dataset(path, mode, format=format) as handle:
             dims = []
             for i, s in enumerate(arr.shape):
                 name = f"dim_{i}"
@@ -264,6 +315,34 @@ def save_netcdf(data: DNDarray, path: str, variable: str, mode: str = "w", **kwa
                 dims.append(name)
             var = handle.createVariable(variable, arr.dtype, tuple(dims), **kwargs)
             var[...] = arr
+        return
+    if format.upper().startswith("NETCDF3"):
+        from ._netcdf3 import write_netcdf3
+
+        if mode != "w":
+            raise ValueError("classic netCDF-3 save supports mode='w' only")
+        err = None
+        try:
+            if jax.process_index() == 0:
+                version = 2 if "64BIT" in format.upper() else 1
+                write_netcdf3(path, variable, data.numpy(), version=version)
+            else:
+                data.numpy()  # participate in the gather collectives
+        except BaseException as e:  # noqa: BLE001 - re-raised after the barrier
+            err = e
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("heat_tpu_save_netcdf3")
+            statuses = np.asarray(
+                multihost_utils.process_allgather(np.asarray([0 if err is None else 1]))
+            ).ravel()
+            if err is None and statuses.any():
+                raise RuntimeError(
+                    f"save_netcdf failed on process(es) {np.nonzero(statuses)[0].tolist()}"
+                )
+        if err is not None:
+            raise err
         return
     if not __HAS_HDF5:
         raise ImportError("netCDF support needs netCDF4 or h5py installed")
