@@ -19,7 +19,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 from jax import shard_map
 
-from .. import types
+from .. import sanitation, types
 from .._operations import _mask_padding
 from ..communication import SPLIT_AXIS
 from ..dndarray import DNDarray
@@ -56,6 +56,12 @@ def qr(
         raise ValueError(f"qr requires a 2-D array, got {a.ndim}-D")
     if method not in ("auto", "householder", "cholqr2"):
         raise ValueError(f"unknown qr method {method!r}")
+    if tiles_per_proc != 1:
+        sanitation.warn_parity_noop(
+            "qr", "tiles_per_proc", "the TSQR/CholQR2 schedule has no tile knob"
+        )
+    if overwrite_a:
+        sanitation.warn_parity_noop("qr", "overwrite_a", "XLA owns buffer reuse")
     # full f32 accumulation on the MXU: the reference's torch QR is exact
     # f32; bf16 matmul passes would break the Q@R residual at ~1e-2.
     with jax.default_matmul_precision("highest"):
@@ -89,18 +95,21 @@ def _cholqr2_with_fallback(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
         return tuple(jnp.linalg.qr(x))
 
     def chol_pass(v):
-        g = v.T @ v
-        lt = jnp.linalg.cholesky(g)  # lower; R = lt.T
+        # conjugate transpose: the Gram of a complex input must be
+        # Hermitian or the fast path can never pass its own orthogonality
+        # guard (r3 ADVICE); .conj() is a no-op for real dtypes
+        g = v.conj().T @ v
+        lt = jnp.linalg.cholesky(g)  # lower; R = lt^H
         q = jax.lax.linalg.triangular_solve(
-            lt, v, left_side=False, lower=True, transpose_a=True
-        )  # solves q @ lt.T = v
-        return q, lt.T
+            lt, v, left_side=False, lower=True, transpose_a=True, conjugate_a=True
+        )  # solves q @ lt^H = v
+        return q, lt.conj().T
 
     q1, r1 = chol_pass(x)
     q2, r2 = chol_pass(q1)
     r = r2 @ r1
     eye = jnp.eye(x.shape[1], dtype=x.dtype)
-    ortho_err = jnp.max(jnp.abs(q2.T @ q2 - eye))
+    ortho_err = jnp.max(jnp.abs(q2.conj().T @ q2 - eye))
     tol = 10 * jnp.finfo(x.dtype).eps * x.shape[1]
     bad = (
         jnp.any(~jnp.isfinite(r))

@@ -82,7 +82,15 @@ def ring_attention(
         qp = pad_to_divisible(q, p, (0,), comm)
         kp = pad_to_divisible(k, p, (0,), comm)
         vp = pad_to_divisible(v, p, (0,), comm)
-        return ring_attention(qp, kp, vp, comm, causal=causal, axis_name=axis_name, _valid_n=n)[:n]
+        # NOTE (r3 ADVICE): the trimmed output CANNOT carry the canonical
+        # split sharding — JAX rejects uneven NamedShardings, which is why
+        # the padded buffer exists at all. Callers chaining sharded kernels
+        # should keep sequences P-divisible (or re-pad with
+        # pad_to_divisible) and trim once at the end; this convenience trim
+        # leaves placement to the compiler.
+        return ring_attention(
+            qp, kp, vp, comm, causal=causal, axis_name=axis_name, _valid_n=n
+        )[:n]
     scale = 1.0 / jnp.sqrt(float(d))
     valid_n = n if _valid_n is None else _valid_n
 

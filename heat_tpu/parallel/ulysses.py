@@ -58,8 +58,12 @@ def ulysses_attention(
         qp = pad_to_divisible(q, p, (0, 1), comm)
         kp = pad_to_divisible(k, p, (0, 1), comm)
         vp = pad_to_divisible(v, p, (0, 1), comm)
-        out = _ulysses_kernel(qp, kp, vp, mesh, p, causal, axis_name, valid_n=n)
-        return out[:n, :h]
+        # NOTE (r3 ADVICE): the trim cannot carry the canonical sequence
+        # sharding (JAX rejects uneven NamedShardings — the reason the
+        # padded-buffer design exists). Chain sharded kernels on
+        # P-divisible shapes and trim once at the end; this convenience
+        # trim leaves placement to the compiler.
+        return _ulysses_kernel(qp, kp, vp, mesh, p, causal, axis_name, valid_n=n)[:n, :h]
     return _ulysses_kernel(q, k, v, mesh, p, causal, axis_name, valid_n=n)
 
 

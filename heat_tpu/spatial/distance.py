@@ -152,6 +152,12 @@ def manhattan(X: DNDarray, Y: Optional[DNDarray] = None, expand: bool = False, u
     with identical results; XLA fuses the broadcast form either way, so the
     flag is accepted for API parity and has no effect here.
     """
+    if expand:
+        from ..core import sanitation
+
+        sanitation.warn_parity_noop(
+            "manhattan", "expand", "XLA fuses the broadcast form either way"
+        )
     return _dist(X, Y, _manhattan, use_ring=use_ring)
 
 
