@@ -730,11 +730,82 @@ class DNDarray:
 
     def numpy(self) -> np.ndarray:
         """Gather the logical global array to host memory (reference
-        ``dndarray.py:991``). Tail padding is sliced off host-side."""
-        host = np.asarray(jax.device_get(self.larray))
-        if tuple(host.shape) != self.__gshape:
-            host = host[tuple(slice(0, s) for s in self.__gshape)]
-        return host
+        ``dndarray.py:991``). Tail padding is sliced off host-side.
+
+        Multi-host, a split array is assembled with ONE ragged process
+        allgather of the valid local blocks (every process must call —
+        collective, like the reference's ``resplit(None)`` gather)."""
+        buf = self.larray
+        if getattr(buf, "is_fully_addressable", True):
+            host = np.asarray(jax.device_get(buf))
+            if tuple(host.shape) != self.__gshape:
+                host = host[tuple(slice(0, s) for s in self.__gshape)]
+            return host
+        if self.__split is None:
+            # replicated: any local device holds the full array
+            return np.asarray(jax.device_get(buf.addressable_shards[0].data))
+        split = self.__split
+        shards = [
+            (start, np.asarray(jax.device_get(shard)))
+            for start, shard in self._iter_local_shards(dedup=True)
+            if shard.shape[split] > 0  # empty trims carry no data
+        ]
+        starts = [s for s, _ in shards]
+        sizes = [d.shape[split] for _, d in shards]
+        contiguous = all(
+            starts[i] + sizes[i] == starts[i + 1] for i in range(len(shards) - 1)
+        )
+        # fast path: each process owns one contiguous split range and
+        # process order equals split order (process-major meshes — the
+        # default); a permuted mesh takes the place-by-offset fallback
+        # (the alignment guard assemble_local_shards applies, comm:489).
+        # The decision must be GLOBAL — ranks disagreeing on the path
+        # would dispatch different collective sequences — so the local
+        # contiguity flag rides along with the range start.
+        from jax.experimental import multihost_utils
+
+        lo = starts[0] if starts else self.__gshape[split]
+        meta = np.asarray(
+            multihost_utils.process_allgather(
+                np.asarray([lo, int(contiguous)], np.int64)
+            )
+        ).reshape(-1, 2)
+        aligned = bool(meta[:, 1].all()) and bool(
+            (np.diff(meta[:, 0]) > 0).all()
+            # strictly increasing: EQUAL starts mean a replication axis
+            # spans processes (each holds the full range) — concatenating
+            # replicas would multiply the extent; the coverage-mask
+            # fallback handles that layout
+        )
+        np_dtype = np.dtype(self.__dtype.jax_type())
+        if aligned:
+            if shards:
+                local = np.concatenate([d for _, d in shards], axis=split)
+            else:  # pragma: no cover - a process with no valid rows
+                shape = list(self.__gshape)
+                shape[split] = 0
+                local = np.zeros(shape, np_dtype)
+            blocks = comm_module.ragged_process_allgather(local, axis=split)
+            return np.concatenate(blocks, axis=split)
+        # fallback (permuted device order): place local shards at their
+        # logical offsets and merge across processes by coverage mask
+        out = np.zeros(self.__gshape, np_dtype)
+        covered = np.zeros(self.__gshape[split], bool)
+        for start, d in shards:
+            sl = [slice(None)] * self.ndim
+            sl[split] = slice(start, start + d.shape[split])
+            out[tuple(sl)] = d
+            covered[start : start + d.shape[split]] = True
+        all_out = np.asarray(multihost_utils.process_allgather(out))
+        all_cov = np.asarray(multihost_utils.process_allgather(covered))
+        for p_i in range(all_out.shape[0]):
+            mask = all_cov[p_i] & ~covered
+            if mask.any():
+                sl = [slice(None)] * self.ndim
+                sl[split] = mask
+                out[tuple(sl)] = all_out[p_i][tuple(sl)]
+                covered |= all_cov[p_i]
+        return out
 
     def __array__(self, dtype=None):
         out = self.numpy()
@@ -866,8 +937,10 @@ class DNDarray:
         out_gshape = jax.eval_shape(
             lambda b: b[static_key], jax.ShapeDtypeStruct(buf.shape, buf.dtype)
         ).shape
-        if len(out_gshape) == 0:
-            return None  # scalar result: nothing to distribute
+        if len(out_gshape) == 0 or 0 in out_gshape:
+            # scalar or empty result: nothing to distribute (XLA refuses
+            # pinned shardings on zero-size outputs)
+            return None
         from ._movement import getitem_executable
 
         fn = getitem_executable(
@@ -1028,6 +1101,22 @@ class DNDarray:
             fill = (slice(None),) * (self.ndim - n_specified)
             key = key[:e] + fill + key[e + 1 :]
             n_specified = self.ndim  # ellipsis expansion covers every dim
+        # numpy's IndexError contract on EVERY path: static jnp indexing
+        # clamps out-of-bounds scalars instead of raising
+        dim = 0
+        for k in key:
+            c = _consumed(k)
+            if c and dim + c > self.ndim:
+                raise IndexError(
+                    f"too many indices for array with {self.ndim} dimensions"
+                )
+            if isinstance(k, (int, np.integer)) and not isinstance(k, (bool, np.bool_)):
+                d = self.__gshape[dim]
+                if not -d <= int(k) < d:
+                    raise IndexError(
+                        f"index {int(k)} is out of bounds for axis {dim} with size {d}"
+                    )
+            dim += c
         if split is None:
             return key, None
         needs_norm = self.padded

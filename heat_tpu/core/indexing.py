@@ -20,27 +20,20 @@ def nonzero(x: DNDarray) -> DNDarray:
     recovers the nonzero values (coordinate-list indexing, handled by
     ``DNDarray.__getitem__``).
 
-    Distributed inputs are scanned PER SHARD (the reference's local
-    ``torch.nonzero`` + rank offset, ``indexing.py:16-78``): each device's
-    trimmed shard is searched on-device (eager — the result size is
-    data-dependent), coordinates get the shard's global offset, and only
-    the found coordinates travel — never the operand (``jnp.nonzero`` on
-    the logical view would gather it).
+    Distributed inputs run ONE compiled shard_map scan (the reference's
+    local ``torch.nonzero`` + rank offset, ``indexing.py:16-78``): every
+    device compacts its hits' coordinates to the front of an O(block)
+    buffer in parallel (:mod:`heat_tpu.parallel.dscan` — round 3's host
+    loop over shards serialized P dispatches), and only the found
+    coordinates travel — never the operand (``jnp.nonzero`` on the
+    logical view would gather it).
     """
     if not isinstance(x, DNDarray):
         raise TypeError(f"expected x to be a DNDarray, but was {type(x)}")
     if x.split is not None and x.comm.size > 1:
-        # each physical shard carries its own global offset along the
-        # split dim — iterate via the shared trimmed-shard helper (do NOT
-        # re-derive offsets from a local enumeration, which breaks on
-        # multi-process meshes where this process owns a rank subrange)
-        parts = []
-        for start, shard in x._iter_local_shards(dedup=True):
-            if shard.size == 0:
-                continue
-            local = np.array(jnp.stack(jnp.nonzero(shard), axis=1))
-            local[:, x.split] += start
-            parts.append(local)
+        from ..parallel.dscan import nonzero_scan
+
+        parts = nonzero_scan(x.larray, x.split, x.gshape[x.split], x.comm)
         coords = (
             np.concatenate(parts, axis=0)
             if parts

@@ -17,3 +17,11 @@ if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", "
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "multihost: also executed inside the real 2-process jax.distributed "
+        "run (tests/test_multihost.py::test_two_process_pytest_subset)",
+    )
