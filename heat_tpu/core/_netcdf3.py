@@ -48,6 +48,11 @@ _TYPE_CODES = {
 }
 
 
+# the classic header stores vsize as a signed 32-bit int; even CDF-2
+# only widens the begin offset
+_MAX_VSIZE = 2**31
+
+
 def is_classic_netcdf(path: str) -> bool:
     with open(path, "rb") as f:
         head = f.read(4)
@@ -224,7 +229,9 @@ def write_netcdf3(
     version: int = 1,
 ) -> None:
     """Write ``data`` as a single fixed variable in CDF-1/2 format."""
-    data = np.ascontiguousarray(data)
+    data = np.asarray(data)
+    if data.ndim:  # ascontiguousarray would promote 0-d to 1-d
+        data = np.ascontiguousarray(data)
     code = _TYPE_CODES.get(
         np.dtype("S1") if data.dtype.kind == "S" else np.dtype(data.dtype)
     )
@@ -238,7 +245,7 @@ def write_netcdf3(
             data = data.astype(np.float64)
             code = 6
     be = data.astype(_TYPES[code], copy=False)
-    if be.nbytes >= 2**31:
+    if be.nbytes >= _MAX_VSIZE:
         # the classic header stores vsize as a signed 32-bit int (CDF-2
         # only widens the begin offset); fail clearly instead of a cryptic
         # struct.error after a partial header write

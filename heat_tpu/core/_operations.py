@@ -144,6 +144,15 @@ def _binary_op(
         )
     anchor = t1 if isinstance(t1, DNDarray) else t2
     device, comm = anchor.device, anchor.comm
+    if (
+        isinstance(t1, DNDarray)
+        and isinstance(t2, DNDarray)
+        and t1.comm != t2.comm
+    ):
+        # the reference raises on mismatched communicators
+        # (_operations.py binary path); relying on a sharding clash to
+        # fail is world-size-dependent
+        raise ValueError("operands live on different communicators")
     promoted = types.result_type(t1, t2)
 
     a = _as_dndarray(t1, device, comm)

@@ -235,7 +235,9 @@ _NP_TO_HEAT = {np.dtype(t._jax_type): t for t in _HEAT_TYPES}
 # python builtins / strings
 _EXTRA_CANONICAL = {
     builtins.bool: bool,
-    builtins.int: int64,
+    # the TYPE `int` maps to int32 exactly like the reference
+    # (``types.py:489``) — consistent with heat_type_of's scalar rule
+    builtins.int: int32,
     builtins.float: float32,
     builtins.complex: complex64,
     complexfloating: complex64,

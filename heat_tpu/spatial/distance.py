@@ -20,7 +20,7 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 
-from ..core import types
+from ..core import sanitation, types
 from ..core.dndarray import DNDarray
 from ..core.linalg.basics import _wrap_result
 
@@ -153,8 +153,6 @@ def manhattan(X: DNDarray, Y: Optional[DNDarray] = None, expand: bool = False, u
     flag is accepted for API parity and has no effect here.
     """
     if expand:
-        from ..core import sanitation
-
         sanitation.warn_parity_noop(
             "manhattan", "expand", "XLA fuses the broadcast form either way"
         )

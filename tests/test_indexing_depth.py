@@ -1,0 +1,299 @@
+"""Indexing depth sweep (VERDICT r3 item 6): the reference's hardest
+~1000 lines are its ``__getitem__``/``__setitem__`` rank-local case
+analysis (``/root/reference/heat/core/dndarray.py:652-1676``), guarded by
+a 1,639-line test file. This sweeps the same case matrix against the
+numpy oracle:
+
+    key family   x  split in {None, 0, 1}  x  padded / unpadded extents
+
+Key families: scalar int (incl. negative), slice (bounded, open, step,
+negative step, empty), ellipsis, newaxis, scalar bool, boolean masks
+(1-D and full-shape), integer-array / coordinate-list advanced indexing,
+mixed tuples — for reads AND writes, plus split-propagation rules and
+the error contract (IndexError / shape mismatches).
+
+The bounded-distribution proofs for these paths live in
+``tests/test_indexing_proofs.py``; this file is about case coverage.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from tests.base import TestCase
+
+# extents: divisible by any test mesh (16) and maximally-ragged (odd)
+EXTENTS = [(16, 6), (13, 5)]
+SPLITS = [None, 0, 1]
+
+
+def _mk(shape, split, seed=0):
+    x = np.random.default_rng(seed).normal(size=shape).astype(np.float32)
+    return ht.array(x, split=split), x
+
+
+GETITEM_KEYS = [
+    # scalars
+    ("int0", lambda n, m: np.s_[0]),
+    ("int_mid", lambda n, m: np.s_[n // 2]),
+    ("int_last", lambda n, m: np.s_[n - 1]),
+    ("int_neg", lambda n, m: np.s_[-1]),
+    ("int_neg_mid", lambda n, m: np.s_[-(n // 2) - 1]),
+    ("int_both", lambda n, m: np.s_[n // 3, m // 2]),
+    ("int_col", lambda n, m: np.s_[:, 1]),
+    ("int_col_neg", lambda n, m: np.s_[:, -2]),
+    # slices
+    ("sl_all", lambda n, m: np.s_[:]),
+    ("sl_front", lambda n, m: np.s_[: n // 2]),
+    ("sl_back", lambda n, m: np.s_[n // 2 :]),
+    ("sl_mid", lambda n, m: np.s_[1 : n - 1]),
+    ("sl_neg_bounds", lambda n, m: np.s_[-(n - 1) : -1]),
+    ("sl_step2", lambda n, m: np.s_[::2]),
+    ("sl_step3_off", lambda n, m: np.s_[1 :: 3]),
+    ("sl_revstep", lambda n, m: np.s_[::-2]),
+    ("sl_rev", lambda n, m: np.s_[::-1]),
+    ("sl_empty", lambda n, m: np.s_[5:5]),
+    ("sl_beyond", lambda n, m: np.s_[: n + 10]),
+    ("sl_both_axes", lambda n, m: np.s_[1:-1, 1:-1]),
+    ("sl_col_step", lambda n, m: np.s_[:, ::2]),
+    # ellipsis / newaxis / scalar bool
+    ("ellipsis", lambda n, m: np.s_[...]),
+    ("ellipsis_col", lambda n, m: np.s_[..., 0]),
+    ("row_ellipsis", lambda n, m: np.s_[0, ...]),
+    ("newaxis_front", lambda n, m: np.s_[None]),
+    ("newaxis_mid", lambda n, m: np.s_[:, None, :]),
+    ("bool_true", lambda n, m: True),
+    ("bool_false", lambda n, m: False),
+    # advanced
+    ("arr_rows", lambda n, m: np.asarray([0, n - 1, n // 2])),
+    ("arr_rows_neg", lambda n, m: np.asarray([-1, 0, -2])),
+    ("arr_rows_dup", lambda n, m: np.asarray([1, 1, 2, 1])),
+    ("arr_both", lambda n, m: (np.asarray([0, n - 1]), np.asarray([0, m - 1]))),
+    ("mask_rows", lambda n, m: np.arange(n) % 3 == 0),
+    ("mask_none", lambda n, m: np.zeros(n, bool)),
+    ("mask_all", lambda n, m: np.ones(n, bool)),
+    ("mixed_arr_slice", lambda n, m: np.s_[np.asarray([0, 2]), 1:]),
+    ("mixed_slice_arr", lambda n, m: np.s_[1:, np.asarray([0, m - 1])]),
+]
+
+
+class TestGetitemSweep(TestCase):
+    def test_case_matrix(self):
+        for shape in EXTENTS:
+            n, m = shape
+            for split in SPLITS:
+                a, x = _mk(shape, split, seed=n)
+                for name, mk in GETITEM_KEYS:
+                    key = mk(n, m)
+                    want = x[key]
+                    got = a[key]
+                    np.testing.assert_array_equal(
+                        got.numpy() if isinstance(got, ht.DNDarray) else np.asarray(got),
+                        want,
+                        err_msg=f"{name} shape={shape} split={split}",
+                    )
+
+    def test_full_shape_bool_mask(self):
+        for shape in EXTENTS:
+            for split in SPLITS:
+                a, x = _mk(shape, split, seed=3)
+                mask = x > 0.3
+                np.testing.assert_array_equal(a[mask].numpy(), x[mask])
+
+    def test_dndarray_keys(self):
+        """DNDarray keys (incl. distributed masks and coordinate lists)."""
+        for split in SPLITS:
+            a, x = _mk((13, 5), split, seed=4)
+            mask = ht.array(x[:, 0] > 0, split=0 if split is not None else None)
+            np.testing.assert_array_equal(a[mask].numpy(), x[x[:, 0] > 0])
+            rows = ht.array(np.asarray([0, 5, 12]))
+            np.testing.assert_array_equal(a[rows].numpy(), x[[0, 5, 12]])
+            # (k, ndim) coordinate-list key — the nonzero() contract
+            coords = ht.array(np.asarray([[0, 0], [12, 4], [3, 2]]))
+            np.testing.assert_array_equal(
+                a[coords].numpy(), x[[0, 12, 3], [0, 4, 2]]
+            )
+
+    def test_nonzero_roundtrip(self):
+        for split in SPLITS:
+            a, x = _mk((13, 5), split, seed=5)
+            nz = ht.nonzero(a > 0.5)
+            vals = (a > 0.5)[nz]
+            self.assertEqual(int(vals.sum()), int((x > 0.5).sum()))
+
+    def test_split_propagation_rules(self):
+        a, _ = _mk((13, 5), 0, seed=6)
+        self.assertEqual(a[2:9].split, 0)  # slice keeps split
+        self.assertIsNone(a[3].split)  # scalar on split axis replicates
+        self.assertEqual(a[:, 2].split, 0)  # split survives column pick
+        self.assertEqual(a[np.asarray([1, 2])].split, 0)  # advanced -> 0
+        b, _ = _mk((13, 5), 1, seed=6)
+        self.assertEqual(b[3].split, 0)  # row pick shifts split left
+        self.assertIsNone(b[:, 3].split)  # scalar on split axis replicates
+        self.assertEqual(b[2:9].split, 1)
+
+    def test_scalar_results(self):
+        for split in SPLITS:
+            a, x = _mk((13, 5), split, seed=7)
+            self.assertAlmostEqual(float(a[4, 3]), float(x[4, 3]), places=5)
+            self.assertAlmostEqual(float(a[-1, -1]), float(x[-1, -1]), places=5)
+
+    def test_1d_cases(self):
+        for split in (None, 0):
+            for n in (16, 13):
+                a, x = _mk((n,), split, seed=8)
+                for key in (0, n - 1, -1, np.s_[2:9], np.s_[::2], np.s_[::-1],
+                            np.asarray([0, n - 1]), np.arange(n) % 2 == 0):
+                    got = a[key]
+                    np.testing.assert_array_equal(
+                        got.numpy() if isinstance(got, ht.DNDarray) else np.asarray(got),
+                        x[key],
+                        err_msg=f"1d n={n} split={split} key={key}",
+                    )
+
+    def test_error_contract(self):
+        a, _ = _mk((13, 5), 0, seed=9)
+        for bad in (13, -14, (0, 7), (0, -6)):
+            with pytest.raises(IndexError):
+                a[bad]
+        b, _ = _mk((13, 5), 1, seed=9)
+        with pytest.raises(IndexError):
+            b[0, 5]
+        c, _ = _mk((13, 5), None, seed=9)
+        with pytest.raises(IndexError):
+            c[42]
+
+
+SETITEM_CASES = [
+    # (name, key factory, value factory given the selected numpy view)
+    ("row_scalar", lambda n, m: np.s_[2], lambda sel: 7.5),
+    ("row_neg_scalar", lambda n, m: np.s_[-2], lambda sel: -1.0),
+    ("row_vector", lambda n, m: np.s_[3], lambda sel: np.arange(sel.shape[-1], dtype=np.float32)),
+    ("col_scalar", lambda n, m: np.s_[:, 1], lambda sel: 0.25),
+    ("col_neg", lambda n, m: np.s_[:, -1], lambda sel: 1.5),
+    ("slice_scalar", lambda n, m: np.s_[2:9], lambda sel: 3.0),
+    ("slice_array", lambda n, m: np.s_[2:5], lambda sel: np.full(sel.shape, 2.0, np.float32)),
+    ("step_slice", lambda n, m: np.s_[::2], lambda sel: -0.5),
+    ("rev_slice", lambda n, m: np.s_[::-1], lambda sel: np.full(sel.shape, 4.0, np.float32)),
+    ("element", lambda n, m: np.s_[4, 2], lambda sel: 9.0),
+    ("both_slices", lambda n, m: np.s_[1:-1, 1:-1], lambda sel: 0.0),
+    ("ellipsis_col", lambda n, m: np.s_[..., 0], lambda sel: 6.0),
+    ("adv_rows", lambda n, m: np.asarray([0, 5, 7]), lambda sel: 1.25),
+    ("adv_rows_arr", lambda n, m: np.asarray([1, 2]), lambda sel: np.full(sel.shape, -2.0, np.float32)),
+    ("mask_rows", lambda n, m: np.arange(n) % 4 == 1, lambda sel: 0.75),
+    ("empty_slice", lambda n, m: np.s_[5:5], lambda sel: 1e9),
+]
+
+
+class TestSetitemSweep(TestCase):
+    def test_case_matrix(self):
+        for shape in EXTENTS:
+            n, m = shape
+            for split in SPLITS:
+                for name, mk_key, mk_val in SETITEM_CASES:
+                    a, x = _mk(shape, split, seed=10 + n)
+                    x = x.copy()
+                    key = mk_key(n, m)
+                    val = mk_val(np.asarray(x[key]))
+                    a[key] = val
+                    x[key] = val
+                    np.testing.assert_array_equal(
+                        a.numpy(), x, err_msg=f"{name} shape={shape} split={split}"
+                    )
+
+    def test_full_mask_write(self):
+        for split in SPLITS:
+            a, x = _mk((13, 5), split, seed=20)
+            x = x.copy()
+            m = x < 0
+            a[m] = 0.0
+            x[m] = 0.0
+            np.testing.assert_array_equal(a.numpy(), x)
+
+    def test_dndarray_value(self):
+        for split in SPLITS:
+            a, x = _mk((13, 5), split, seed=21)
+            x = x.copy()
+            v = ht.array(np.full((5,), 3.5, np.float32))
+            a[4] = v
+            x[4] = 3.5
+            np.testing.assert_array_equal(a.numpy(), x)
+            # distributed value into a slice
+            v2, y2 = _mk((3, 5), split if split == 0 else None, seed=22)
+            a[0:3] = v2
+            x[0:3] = y2
+            np.testing.assert_array_equal(a.numpy(), x)
+
+    def test_broadcast_writes(self):
+        for split in SPLITS:
+            a, x = _mk((13, 5), split, seed=23)
+            x = x.copy()
+            col = np.arange(5, dtype=np.float32)
+            a[2:7] = col  # broadcasts (5,) across rows
+            x[2:7] = col
+            a[:, 2] = 1.5
+            x[:, 2] = 1.5
+            np.testing.assert_array_equal(a.numpy(), x)
+
+    def test_dtype_coercion(self):
+        a = ht.array(np.arange(13, dtype=np.int32), split=0)
+        a[3] = 7.9  # float into int array: truncates like the dtype
+        self.assertEqual(int(a[3]), 7)
+        b = ht.array(np.zeros(13, np.float32), split=0)
+        b[4] = 2  # int into float
+        self.assertEqual(float(b[4]), 2.0)
+
+    def test_setitem_error_contract(self):
+        a, _ = _mk((13, 5), 0, seed=24)
+        with pytest.raises(IndexError):
+            a[13] = 1.0
+        with pytest.raises(IndexError):
+            a[-14] = 1.0
+        with pytest.raises((ValueError, TypeError)):
+            a[2] = np.zeros(4, np.float32)  # wrong value shape
+
+    def test_padding_never_written(self):
+        """Writes through ANY key leave the buffer's tail padding region
+        untouched by logical values — reductions stay exact after heavy
+        setitem traffic."""
+        p = self.comm.size
+        n = p + 1  # maximally padded
+        for split in (0, 1):
+            shape = (n, n)
+            a, x = _mk(shape, split, seed=25)
+            x = x.copy()
+            a[:] = 1.0
+            x[:] = 1.0
+            a[n - 1] = 2.0
+            x[n - 1] = 2.0
+            a[:, n - 1] = 3.0
+            x[:, n - 1] = 3.0
+            np.testing.assert_allclose(float(a.sum()), x.sum(), rtol=1e-6)
+            np.testing.assert_array_equal(a.numpy(), x)
+
+
+class TestIterationAndViews(TestCase):
+    def test_iteration_matches_rows(self):
+        a, x = _mk((6, 3), 0, seed=30)
+        rows = [r.numpy() for r in a]
+        np.testing.assert_array_equal(np.stack(rows), x)
+
+    def test_len_and_contains_shape(self):
+        a, _ = _mk((13, 5), 0, seed=31)
+        self.assertEqual(len(a), 13)
+        with pytest.raises(TypeError):
+            len(ht.array(np.float32(3.0)))
+
+    def test_chained_indexing(self):
+        a, x = _mk((13, 5), 0, seed=32)
+        np.testing.assert_array_equal(a[2:10][3].numpy(), x[2:10][3])
+        np.testing.assert_array_equal(a[::2][1:].numpy(), x[::2][1:])
+        np.testing.assert_array_equal(a[:, 1][4:].numpy(), x[:, 1][4:])
+
+    def test_getitem_preserves_dtype(self):
+        for dt in (np.int64, np.float64, np.int8, np.uint8):
+            x = np.arange(26, dtype=dt).reshape(13, 2)
+            a = ht.array(x, split=0)
+            self.assertEqual(a[3:7].numpy().dtype, dt)
+            self.assertEqual(a[::2].numpy().dtype, dt)

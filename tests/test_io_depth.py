@@ -1,0 +1,279 @@
+"""I/O depth tests (VERDICT r3 item 6 — test mass for ``core/io.py``,
+647 LoC + ``core/_netcdf3.py``; reference guard: ``test_io.py``).
+
+CSV dialects (headers, separators, decimals, truncate semantics), HDF5
+modes and error contracts, classic netCDF-3 edge battery (multi-variable
+record files via scipy, CDF-2, all six classic types, corrupt-file
+errors, the 2 GiB vsize ceiling), extension dispatch, and the
+chunked-load split matrix for every format.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import tempfile
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from tests.base import TestCase
+
+
+class TestCSVDepth(TestCase):
+    def _write(self, d, text, name="t.csv"):
+        p = os.path.join(d, name)
+        with open(p, "w") as f:
+            f.write(text)
+        return p
+
+    def test_separator_variants(self):
+        with tempfile.TemporaryDirectory() as d:
+            for sep in (",", ";", "\t"):
+                p = self._write(d, sep.join(["1", "2"]) + "\n" + sep.join(["3", "4"]) + "\n")
+                back = ht.load_csv(p, sep=sep)
+                np.testing.assert_allclose(back.numpy(), [[1, 2], [3, 4]])
+
+    def test_header_lines(self):
+        with tempfile.TemporaryDirectory() as d:
+            p = self._write(d, "# c1,c2\n# more\n1.5,2.5\n3.5,4.5\n")
+            back = ht.load_csv(p, header_lines=2)
+            np.testing.assert_allclose(back.numpy(), [[1.5, 2.5], [3.5, 4.5]])
+
+    def test_dtype_and_splits(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(23, 4)).astype(np.float64)
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "m.csv")
+            with open(p, "w") as f:
+                for row in x:
+                    f.write(",".join(f"{v:.17g}" for v in row) + "\n")
+            for split in (None, 0, 1):
+                back = ht.load_csv(p, split=split, dtype=ht.float64)
+                assert back.split == split
+                np.testing.assert_allclose(back.numpy(), x, rtol=1e-12)
+
+    def test_save_decimals_and_roundtrip(self):
+        x = ht.array(np.asarray([[1.23456, 2.5], [3.0, 4.125]], np.float32), split=0)
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "o.csv")
+            ht.save_csv(x, p, decimals=3)
+            txt = open(p).read()
+            assert "1.235" in txt or "1.234" in txt
+            back = ht.load_csv(p)
+            np.testing.assert_allclose(back.numpy(), x.numpy(), atol=5e-4)
+
+    def test_save_truncate_false_overwrites_in_place(self):
+        """Reference semantics (io.py:926): no truncation -> the file is
+        overwritten from offset 0 but never shortened."""
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "t.csv")
+            ht.save_csv(ht.array(np.arange(8, dtype=np.float32).reshape(4, 2)), p)
+            long_size = os.path.getsize(p)
+            ht.save_csv(ht.array(np.zeros((1, 2), np.float32)), p, truncate=False)
+            assert os.path.getsize(p) == long_size  # stale tail survives
+            ht.save_csv(ht.array(np.zeros((1, 2), np.float32)), p, truncate=True)
+            assert os.path.getsize(p) < long_size
+
+    def test_int_format(self):
+        x = ht.array(np.arange(6, dtype=np.int64).reshape(3, 2), split=0)
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "i.csv")
+            ht.save_csv(x, p)
+            rows = [ln.split(",") for ln in open(p).read().strip().splitlines()]
+            assert rows[0][0] == "0" and "." not in rows[0][0]
+
+    def test_header_write(self):
+        x = ht.array(np.ones((2, 2), np.float32))
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "h.csv")
+            ht.save_csv(x, p, header_lines=["alpha", "beta"])
+            lines = open(p).read().splitlines()
+            assert lines[0] == "alpha" and lines[1] == "beta"
+            back = ht.load_csv(p, header_lines=2)
+            np.testing.assert_allclose(back.numpy(), np.ones((2, 2)))
+
+
+class TestHDF5Depth(TestCase):
+    def test_modes_append_and_overwrite(self):
+        import h5py
+
+        a = ht.array(np.arange(10, dtype=np.float32), split=0)
+        b = ht.array(np.arange(6, dtype=np.float32).reshape(2, 3), split=0)
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "m.h5")
+            ht.save_hdf5(a, p, "first", mode="w")
+            ht.save_hdf5(b, p, "second", mode="a")
+            with h5py.File(p, "r") as f:
+                assert set(f.keys()) == {"first", "second"}
+            np.testing.assert_allclose(ht.load_hdf5(p, "first").numpy(), a.numpy())
+            np.testing.assert_allclose(ht.load_hdf5(p, "second", split=1).numpy(), b.numpy())
+
+    def test_missing_dataset_and_bad_args(self):
+        a = ht.array(np.zeros(4, np.float32))
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "e.h5")
+            ht.save_hdf5(a, p, "data")
+            with pytest.raises(KeyError):
+                ht.load_hdf5(p, "nope")
+            with pytest.raises(TypeError):
+                ht.load_hdf5(123, "data")
+            with pytest.raises(TypeError):
+                ht.load_hdf5(p, 3.5)
+            with pytest.raises(TypeError):
+                ht.save_hdf5(np.zeros(3), p, "x")
+
+    def test_dtype_conversion_on_load(self):
+        x = np.arange(12, dtype=np.int64).reshape(3, 4)
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "c.h5")
+            ht.save_hdf5(ht.array(x), p, "ints")
+            back = ht.load_hdf5(p, "ints", dtype=ht.float64, split=0)
+            assert back.dtype is ht.float64
+            np.testing.assert_allclose(back.numpy(), x.astype(np.float64))
+
+    def test_every_split_chunked(self):
+        rng = np.random.default_rng(5)
+        x = rng.normal(size=(13, 7, 3)).astype(np.float32)
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "s.h5")
+            ht.save(ht.array(x), p, "cube")
+            for split in (None, 0, 1, 2):
+                back = ht.load(p, dataset="cube", split=split)
+                assert back.split == split
+                np.testing.assert_allclose(back.numpy(), x, rtol=1e-6)
+
+
+class TestNetCDF3Depth(TestCase):
+    def test_all_classic_types_roundtrip(self):
+        from heat_tpu.core._netcdf3 import NetCDF3File, write_netcdf3
+
+        rng = np.random.default_rng(1)
+        with tempfile.TemporaryDirectory() as d:
+            for dt in (np.int8, np.int16, np.int32, np.float32, np.float64):
+                x = (rng.normal(size=(7, 3)) * 40).astype(dt)
+                p = os.path.join(d, f"t_{np.dtype(dt).name}.nc")
+                write_netcdf3(p, "v", x)
+                r = NetCDF3File(p)
+                np.testing.assert_array_equal(r.read("v").astype(dt), x)
+
+    def test_widening_unrepresentable_dtypes(self):
+        """int64/bool/f16 have no classic representation — the writer
+        widens like the netCDF4 library's default conversions."""
+        from heat_tpu.core._netcdf3 import NetCDF3File, write_netcdf3
+
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "w.nc")
+            x = np.asarray([1, 2, 3], np.int64)
+            write_netcdf3(p, "v", x)
+            r = NetCDF3File(p)
+            np.testing.assert_array_equal(r.read("v").astype(np.int64), x)
+            p2 = os.path.join(d, "w2.nc")
+            xb = np.asarray([True, False, True])
+            write_netcdf3(p2, "v", xb)
+            np.testing.assert_array_equal(
+                NetCDF3File(p2).read("v").astype(np.int32), [1, 0, 1]
+            )
+
+    def test_multi_record_var_file(self):
+        """Two record variables interleave per record; strides must honor
+        both (scipy writes, we read every variable chunked)."""
+        from scipy.io import netcdf_file
+
+        from heat_tpu.core._netcdf3 import NetCDF3File
+
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "multi.nc")
+            f = netcdf_file(p, "w")
+            f.createDimension("t", None)
+            f.createDimension("x", 3)
+            v1 = f.createVariable("a", np.float32, ("t", "x"))
+            v2 = f.createVariable("b", np.int32, ("t",))
+            a = np.arange(18, dtype=np.float32).reshape(6, 3)
+            b = np.arange(6, dtype=np.int32) * 10
+            v1[:] = a
+            v2[:] = b
+            f.close()
+            r = NetCDF3File(p)
+            np.testing.assert_array_equal(r.read("a").astype(np.float32), a)
+            np.testing.assert_array_equal(r.read("b").astype(np.int32), b)
+            np.testing.assert_array_equal(r.read("a", 2, 5).astype(np.float32), a[2:5])
+            np.testing.assert_array_equal(r.read("b", 4, 6).astype(np.int32), b[4:6])
+            # chunked public load of a record variable, every split
+            for split in (None, 0, 1):
+                back = ht.load_netcdf(p, "a", split=split)
+                np.testing.assert_allclose(back.numpy(), a, rtol=1e-6)
+
+    def test_scalar_and_0d(self):
+        # (scipy's writer has its own 0-d assignValue quirk, so the
+        # round trip uses our writer + our reader)
+        from heat_tpu.core._netcdf3 import NetCDF3File, write_netcdf3
+
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "s.nc")
+            write_netcdf3(p, "s", np.float64(3.25))
+            r = NetCDF3File(p)
+            assert r.shape("s") == ()
+            assert float(r.read("s")) == 3.25
+
+    def test_corrupt_files_error_clearly(self):
+        from heat_tpu.core._netcdf3 import NetCDF3File, is_classic_netcdf
+
+        with tempfile.TemporaryDirectory() as d:
+            bad = os.path.join(d, "bad.nc")
+            with open(bad, "wb") as f:
+                f.write(b"CDF\x01" + struct.pack(">i", 0) + b"\x00\x00")  # truncated
+            assert is_classic_netcdf(bad)
+            with pytest.raises(ValueError, match="truncated"):
+                NetCDF3File(bad)
+            notnc = os.path.join(d, "not.nc")
+            with open(notnc, "wb") as f:
+                f.write(b"HELLO WORLD PADPAD")
+            assert not is_classic_netcdf(notnc)
+            with pytest.raises(ValueError, match="not a classic"):
+                NetCDF3File(notnc)
+
+    def test_oversized_variable_rejected(self):
+        from unittest import mock
+
+        from heat_tpu.core import _netcdf3
+
+        data = np.zeros((4, 2), np.float64)  # 64 B >= the patched ceiling
+        with mock.patch.object(_netcdf3, "_MAX_VSIZE", 32):
+            with tempfile.TemporaryDirectory() as d:
+                with pytest.raises(ValueError, match="2 GiB"):
+                    _netcdf3.write_netcdf3(os.path.join(d, "x.nc"), "v", data)
+
+    def test_save_mode_and_format_validation(self):
+        a = ht.array(np.zeros(4, np.float32))
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "v.nc")
+            with pytest.raises(ValueError, match="mode"):
+                ht.save_netcdf(a, p, "v", mode="a", format="NETCDF3_CLASSIC")
+
+    def test_extension_dispatch(self):
+        x = ht.array(np.arange(8, dtype=np.float32), split=0)
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "e.nc")
+            ht.save(x, p, "var", format="NETCDF3_CLASSIC")
+            back = ht.load(p, variable="var", split=0)
+            np.testing.assert_allclose(back.numpy(), x.numpy())
+
+    def test_attrs_parsed_not_applied(self):
+        from scipy.io import netcdf_file
+
+        from heat_tpu.core._netcdf3 import NetCDF3File
+
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "a.nc")
+            f = netcdf_file(p, "w")
+            f.history = b"made by tests"
+            f.createDimension("x", 3)
+            v = f.createVariable("v", np.float32, ("x",))
+            v[:] = np.asarray([1, 2, 3], np.float32)
+            v.scale_factor = 2.0
+            f.close()
+            r = NetCDF3File(p)
+            assert "history" in r.attrs
+            # raw values (no auto mask/scale — same as the h5py fallback)
+            np.testing.assert_array_equal(r.read("v").astype(np.float32), [1, 2, 3])
