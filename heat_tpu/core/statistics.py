@@ -163,7 +163,10 @@ def bucketize(input: DNDarray, boundaries, out_int32: bool = False, right: bool 
     """Index of the bucket each value falls into (reference
     ``statistics.py:393``)."""
     b = boundaries._logical() if isinstance(boundaries, DNDarray) else jnp.asarray(boundaries)
-    side = "left" if right else "right"
+    # torch semantics: right=False -> first i with x <= b[i] (searchsorted
+    # 'left'), right=True -> first i with x < b[i] ('right'); the flag was
+    # inverted until the round-4 depth sweep compared against torch
+    side = "right" if right else "left"
     idx_type = types.int32 if out_int32 else types.int64
     jt = idx_type.jax_type()
     return _local_op(lambda t: jnp.searchsorted(b, t, side=side).astype(jt), input, out=out, no_cast=True, out_dtype=idx_type)
@@ -425,6 +428,10 @@ def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear
     kd = bool(keepdim or keepdims)
     axis_s = sanitize_axis(x.shape, axis)
     q_arr = q._logical() if isinstance(q, DNDarray) else jnp.asarray(q)
+    q_host = np.asarray(q_arr)
+    # negated all-form so NaN q fails too, like numpy
+    if q_host.size and not np.all((q_host >= 0) & (q_host <= 100)):
+        raise ValueError("percentiles must be in the range [0, 100]")
     method = {"lower": "lower", "higher": "higher", "midpoint": "midpoint", "nearest": "nearest", "linear": "linear"}[interpolation]
     if (axis_s is None or isinstance(axis_s, int)) and not types.issubdtype(
         x.dtype, types.complexfloating

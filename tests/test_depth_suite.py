@@ -126,15 +126,17 @@ class TestStatisticsDepth(TestCase):
                     np.digitize(vals, bins, right=right),
                     err_msg=f"right={right}",
                 )
-            # torch.bucketize(right=False) counts boundaries <= v, i.e.
-            # numpy searchsorted side='right'
+            # torch.bucketize(right=False): first i with v <= b[i] ==
+            # numpy searchsorted side='left' (verified against torch
+            # directly in test_statistics_depth; this test had the flag
+            # inverted until round 4)
             np.testing.assert_array_equal(
                 ht.bucketize(a, ht.array(bins)).numpy(),
-                np.searchsorted(bins, vals, side="right"),
+                np.searchsorted(bins, vals, side="left"),
             )
             np.testing.assert_array_equal(
                 ht.bucketize(a, ht.array(bins), right=True).numpy(),
-                np.searchsorted(bins, vals, side="left"),
+                np.searchsorted(bins, vals, side="right"),
             )
 
     def test_histc_edges(self):

@@ -1,0 +1,255 @@
+"""Statistics depth sweep (VERDICT r3 item 6 — ``core/statistics.py``,
+450 LoC; reference guard: ``test_statistics.py``, 1,067 LoC).
+
+Axis x split x keepdims matrices for every moment family, the
+weighted-average battery, bincount/bucketize/digitize vs numpy/torch
+semantics, histc/histogram, cov variants, nan-propagation contracts,
+and argmax/argmin tie-breaking — all against numpy oracles on padded
+(non-divisible) extents so reduction masks are load-bearing.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from tests.base import TestCase
+
+SHAPE = (13, 5)  # never divides the test meshes
+
+
+def _mk(split, seed=0, shape=SHAPE):
+    x = np.random.default_rng(seed).normal(size=shape).astype(np.float32)
+    return ht.array(x, split=split), x
+
+
+class TestMomentMatrices(TestCase):
+    def test_mean_var_std_matrix(self):
+        for split in (None, 0, 1):
+            a, x = _mk(split, 1)
+            for axis in (None, 0, 1):
+                np.testing.assert_allclose(
+                    np.asarray(ht.mean(a, axis=axis).numpy() if axis is not None else float(ht.mean(a, axis=axis))),
+                    np.mean(x, axis=axis), rtol=2e-5,
+                    err_msg=f"mean split={split} axis={axis}",
+                )
+                for ddof in (0, 1):
+                    got = ht.var(a, axis=axis, ddof=ddof)
+                    got = got.numpy() if hasattr(got, "numpy") else np.asarray(got)
+                    np.testing.assert_allclose(
+                        np.squeeze(got), np.var(x, axis=axis, ddof=ddof), rtol=5e-5,
+                        err_msg=f"var split={split} axis={axis} ddof={ddof}",
+                    )
+                    got = ht.std(a, axis=axis, ddof=ddof)
+                    got = got.numpy() if hasattr(got, "numpy") else np.asarray(got)
+                    np.testing.assert_allclose(
+                        np.squeeze(got), np.std(x, axis=axis, ddof=ddof), rtol=5e-5,
+                    )
+
+    def test_skew_kurtosis_matrix(self):
+        from scipy import stats as sps
+
+        for split in (None, 0):
+            a, x = _mk(split, 2, shape=(41,))
+            np.testing.assert_allclose(
+                float(ht.skew(a, unbiased=False)), sps.skew(x, bias=True), rtol=1e-3
+            )
+            np.testing.assert_allclose(
+                float(ht.kurtosis(a, unbiased=False, Fischer=True)),
+                sps.kurtosis(x, fisher=True, bias=True),
+                rtol=1e-3,
+            )
+            # Fischer=False reports Pearson (+3)
+            np.testing.assert_allclose(
+                float(ht.kurtosis(a, unbiased=False, Fischer=False)),
+                sps.kurtosis(x, fisher=True, bias=True) + 3.0,
+                rtol=1e-3,
+            )
+
+    def test_min_max_keepdims(self):
+        for split in (None, 0, 1):
+            a, x = _mk(split, 3)
+            for axis in (0, 1):
+                got = ht.max(a, axis=axis, keepdims=True).numpy()
+                np.testing.assert_allclose(got, x.max(axis=axis, keepdims=True))
+                got = ht.min(a, axis=axis, keepdims=True).numpy()
+                np.testing.assert_allclose(got, x.min(axis=axis, keepdims=True))
+
+    def test_argminmax_ties_take_first(self):
+        x = np.asarray([3.0, 1.0, 1.0, 2.0, 1.0] * 3, np.float32)
+        for split in (None, 0):
+            a = ht.array(x, split=split)
+            self.assertEqual(int(ht.argmin(a)), int(np.argmin(x)))
+            self.assertEqual(int(ht.argmax(a)), int(np.argmax(x)))
+        m = np.asarray([[2, 1, 1], [1, 1, 2]], np.float32)
+        b = ht.array(m, split=0)
+        np.testing.assert_array_equal(ht.argmin(b, axis=1).numpy(), np.argmin(m, axis=1))
+        np.testing.assert_array_equal(ht.argmax(b, axis=0).numpy(), np.argmax(m, axis=0))
+
+
+class TestAverage(TestCase):
+    def test_weighted_matrix(self):
+        for split in (None, 0, 1):
+            a, x = _mk(split, 4)
+            for axis in (None, 0, 1):
+                got = ht.average(a, axis=axis)
+                got = float(got) if axis is None else got.numpy()
+                np.testing.assert_allclose(got, np.average(x, axis=axis), rtol=2e-5)
+            w0 = np.random.default_rng(5).random(13).astype(np.float32) + 0.1
+            got = ht.average(a, axis=0, weights=ht.array(w0)).numpy()
+            np.testing.assert_allclose(got, np.average(x, axis=0, weights=w0), rtol=2e-5)
+
+    def test_returned_gives_weight_sums(self):
+        a, x = _mk(0, 6)
+        w = np.random.default_rng(7).random(13).astype(np.float32) + 0.5
+        avg, wsum = ht.average(a, axis=0, weights=ht.array(w), returned=True)
+        navg, nsum = np.average(x, axis=0, weights=w, returned=True)
+        np.testing.assert_allclose(avg.numpy(), navg, rtol=2e-5)
+        np.testing.assert_allclose(np.broadcast_to(wsum.numpy(), nsum.shape), nsum, rtol=2e-5)
+
+    def test_zero_weights_raise(self):
+        a, _ = _mk(0, 8)
+        with pytest.raises((ZeroDivisionError, ValueError, FloatingPointError)):
+            bad = ht.average(a, axis=0, weights=ht.zeros(13))
+            np.asarray(bad.numpy())  # force evaluation if lazy
+
+
+class TestBinningFamily(TestCase):
+    def test_bincount_matrix(self):
+        rng = np.random.default_rng(9)
+        x = rng.integers(0, 9, size=61).astype(np.int64)
+        for split in (None, 0):
+            a = ht.array(x, split=split)
+            np.testing.assert_array_equal(ht.bincount(a).numpy(), np.bincount(x))
+            np.testing.assert_array_equal(
+                ht.bincount(a, minlength=15).numpy(), np.bincount(x, minlength=15)
+            )
+            w = rng.random(61).astype(np.float32)
+            np.testing.assert_allclose(
+                ht.bincount(a, weights=ht.array(w, split=split)).numpy(),
+                np.bincount(x, weights=w),
+                rtol=1e-5,
+            )
+
+    def test_digitize_bucketize(self):
+        bins = np.asarray([0.0, 1.0, 2.5, 4.0], np.float32)
+        x = np.asarray([-1.0, 0.0, 0.5, 1.0, 3.0, 4.0, 9.0], np.float32)
+        for split in (None, 0):
+            a = ht.array(x, split=split)
+            for right in (False, True):
+                np.testing.assert_array_equal(
+                    ht.digitize(a, ht.array(bins), right=right).numpy(),
+                    np.digitize(x, bins, right=right),
+                )
+            # bucketize follows torch: boundaries index, right flips strictness
+            torch = pytest.importorskip("torch")  # not a package dependency
+
+            for right in (False, True):
+                np.testing.assert_array_equal(
+                    ht.bucketize(a, ht.array(bins), right=right).numpy(),
+                    torch.bucketize(torch.tensor(x), torch.tensor(bins), right=right).numpy(),
+                )
+
+    def test_histc_histogram(self):
+        rng = np.random.default_rng(10)
+        x = (rng.random(101) * 10).astype(np.float32)
+        torch = pytest.importorskip("torch")  # not a package dependency
+        for split in (None, 0):
+            a = ht.array(x, split=split)
+            got = ht.histc(a, bins=7, min=1.0, max=9.0).numpy()
+            want = torch.histc(torch.tensor(x), bins=7, min=1.0, max=9.0).numpy()
+            np.testing.assert_allclose(got, want)
+            hist, edges = ht.histogram(a, bins=8)
+            nhist, nedges = np.histogram(x, bins=8)
+            np.testing.assert_allclose(hist.numpy(), nhist)
+            np.testing.assert_allclose(edges.numpy(), nedges, rtol=1e-6)
+
+
+class TestCov(TestCase):
+    def test_cov_matrix(self):
+        rng = np.random.default_rng(11)
+        m = rng.normal(size=(4, 33)).astype(np.float32)
+        for split in (None, 1):
+            a = ht.array(m, split=split)
+            np.testing.assert_allclose(ht.cov(a).numpy(), np.cov(m), rtol=1e-4)
+            np.testing.assert_allclose(
+                ht.cov(a, bias=True).numpy(), np.cov(m, bias=True), rtol=1e-4
+            )
+            np.testing.assert_allclose(
+                ht.cov(a, ddof=0).numpy(), np.cov(m, ddof=0), rtol=1e-4
+            )
+        at = ht.array(m.T.copy(), split=0)
+        np.testing.assert_allclose(
+            ht.cov(at, rowvar=False).numpy(), np.cov(m.T, rowvar=False), rtol=1e-4
+        )
+
+    def test_cov_two_operands(self):
+        rng = np.random.default_rng(12)
+        x = rng.normal(size=17).astype(np.float32)
+        y = rng.normal(size=17).astype(np.float32)
+        got = ht.cov(ht.array(x, split=0), ht.array(y, split=0)).numpy()
+        np.testing.assert_allclose(got, np.cov(x, y), rtol=1e-4)
+
+
+class TestNanContracts(TestCase):
+    def test_nan_propagates_in_min_max(self):
+        x = np.asarray([1.0, np.nan, 3.0, -4.0] * 4, np.float32)
+        for split in (None, 0):
+            a = ht.array(x, split=split)
+            assert np.isnan(float(ht.max(a)))
+            assert np.isnan(float(ht.min(a)))
+
+    def test_nan_variants_skip(self):
+        x = np.asarray([1.0, np.nan, 3.0, -4.0] * 4, np.float32)
+        for split in (None, 0):
+            a = ht.array(x, split=split)
+            np.testing.assert_allclose(float(ht.nanmax(a)), np.nanmax(x))
+            np.testing.assert_allclose(float(ht.nanmin(a)), np.nanmin(x))
+            np.testing.assert_allclose(float(ht.nanmean(a)), np.nanmean(x), rtol=1e-6)
+
+    def test_all_nan_axis(self):
+        x = np.full((3, 4), np.nan, np.float32)
+        x[1] = 1.0
+        a = ht.array(x, split=0)
+        got = ht.nanmean(a, axis=1).numpy()
+        assert np.isnan(got[0]) and got[1] == 1.0 and np.isnan(got[2])
+
+
+class TestMedianPercentileDepth(TestCase):
+    def test_median_axis_keepdim_matrix(self):
+        rng = np.random.default_rng(13)
+        x = rng.normal(size=(12, 7)).astype(np.float32)  # even AND odd extents
+        for split in (None, 0, 1):
+            a = ht.array(x, split=split)
+            for axis in (None, 0, 1):
+                for kd in (False, True):
+                    got = ht.median(a, axis=axis, keepdim=kd)
+                    got = got.numpy() if hasattr(got, "numpy") else np.asarray(got)
+                    np.testing.assert_allclose(
+                        got, np.median(x, axis=axis, keepdims=kd), rtol=1e-6,
+                        err_msg=f"split={split} axis={axis} kd={kd}",
+                    )
+
+    def test_percentile_vector_q_on_axes(self):
+        rng = np.random.default_rng(14)
+        x = rng.normal(size=(19, 4)).astype(np.float32)
+        q = [0.0, 25.0, 50.0, 99.0, 100.0]
+        for split in (None, 0):
+            a = ht.array(x, split=split)
+            np.testing.assert_allclose(
+                ht.percentile(a, q, axis=0).numpy(),
+                np.percentile(x, q, axis=0).astype(np.float32),
+                rtol=1e-5, atol=1e-5,
+            )
+
+    def test_percentile_interpolations_single_element(self):
+        a = ht.array(np.asarray([7.5], np.float32), split=0)
+        for m in ("linear", "lower", "higher", "nearest", "midpoint"):
+            self.assertEqual(float(ht.percentile(a, 62.0, interpolation=m)), 7.5)
+
+    def test_invalid_q_raises(self):
+        a, _ = _mk(0, 15)
+        with pytest.raises(ValueError):
+            ht.percentile(a, 130.0)
+        with pytest.raises(ValueError):
+            ht.percentile(a, -2.0)
