@@ -239,8 +239,13 @@ def projection(a: DNDarray, b: DNDarray) -> DNDarray:
 
 
 def cross(a: DNDarray, b: DNDarray, axisa: int = -1, axisb: int = -1, axisc: int = -1, axis: int = -1) -> DNDarray:
-    """Cross product (reference ``basics.py:47``)."""
-    result = jnp.cross(a._logical(), b._logical(), axisa=axisa, axisb=axisb, axisc=axisc)
+    """Cross product (reference ``basics.py:47``; numpy axis semantics —
+    ``axis`` overrides ``axisa``/``axisb``/``axisc``)."""
+    result = jnp.cross(
+        a._logical(), b._logical(),
+        axisa=axisa, axisb=axisb, axisc=axisc,
+        axis=None if axis == -1 else axis,
+    )
     split = a.split if a.split is not None else b.split
     if split is not None and result.ndim != a.ndim:
         split = None

@@ -38,8 +38,16 @@ def qr(
 ) -> QR_out:
     """QR decomposition of a 2-D DNDarray (reference ``qr.py:17``).
 
-    ``tiles_per_proc``/``overwrite_a`` are accepted for API parity; the TSQR
-    schedule has no tuning knob to expose and XLA owns buffer reuse.
+    ``tiles_per_proc`` tunes the factorization tree exactly as in the
+    reference's CAQR (``qr.py:319-866``): each process's local block is
+    factored as ``tiles_per_proc`` square-ish row tiles (geometry from
+    :class:`~heat_tpu.core.tiling.SquareDiagTiles`, the same tile map the
+    reference's tile loops walk) whose small R factors merge locally
+    before the global ICI merge — a two-level TSQR tree. ``1`` (default)
+    factors each local block whole, which is optimal when the block fits
+    HBM comfortably; larger values bound the peak Householder working set
+    per tile. ``overwrite_a`` is accepted for API parity only; XLA owns
+    buffer reuse.
 
     ``method``: ``"auto"`` (default) runs **CholeskyQR2** for tall-skinny
     floating inputs — two Gram-matmul + Cholesky passes, entirely
@@ -56,16 +64,14 @@ def qr(
         raise ValueError(f"qr requires a 2-D array, got {a.ndim}-D")
     if method not in ("auto", "householder", "cholqr2"):
         raise ValueError(f"unknown qr method {method!r}")
-    if tiles_per_proc != 1:
-        sanitation.warn_parity_noop(
-            "qr", "tiles_per_proc", "the TSQR/CholQR2 schedule has no tile knob"
-        )
+    if not isinstance(tiles_per_proc, int) or tiles_per_proc < 1:
+        raise ValueError(f"tiles_per_proc must be a positive int, got {tiles_per_proc}")
     if overwrite_a:
         sanitation.warn_parity_noop("qr", "overwrite_a", "XLA owns buffer reuse")
     # full f32 accumulation on the MXU: the reference's torch QR is exact
     # f32; bf16 matmul passes would break the Q@R residual at ~1e-2.
     with jax.default_matmul_precision("highest"):
-        return _qr_impl(a, calc_q, method)
+        return _qr_impl(a, calc_q, method, tiles_per_proc)
 
 
 def _use_cholqr2(method: str, m: int, n: int, dtype) -> bool:
@@ -124,7 +130,26 @@ def _cholqr2_with_fallback(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     )
 
 
-def _qr_impl(a: DNDarray, calc_q: bool, method: str = "auto") -> QR_out:
+def _tile_geometry(a: DNDarray, tiles_per_proc: int, mi: int) -> Tuple[int, int]:
+    """(n_tiles, tile_rows) of the local TSQR level for ``tiles_per_proc``.
+
+    The row-tile edge comes from SquareDiagTiles — the same square-tile
+    decomposition the reference's CAQR loops walk
+    (`/root/reference/heat/core/tiling.py:331`, `qr.py:319-866`) — so the
+    knob maps onto the identical geometry.
+    """
+    if tiles_per_proc <= 1 or mi <= 1:
+        return 1, mi
+    from ..tiling import SquareDiagTiles
+
+    ri = SquareDiagTiles(a, tiles_per_proc).row_indices
+    tile_rows = ri[1] - ri[0] if len(ri) > 1 else mi
+    return max(1, -(-mi // tile_rows)), tile_rows
+
+
+def _qr_impl(
+    a: DNDarray, calc_q: bool, method: str = "auto", tiles_per_proc: int = 1
+) -> QR_out:
     ftype = jnp.promote_types(a.larray.dtype, jnp.float32)
     m, n = a.gshape
     comm = a.comm
@@ -150,16 +175,35 @@ def _qr_impl(a: DNDarray, calc_q: bool, method: str = "auto") -> QR_out:
         arr = _mask_padding(arr, a.gshape, 0, 0)
     mp = arr.shape[0]
     mesh = comm.mesh
+    mi = mp // p
+
+    n_tiles, tile_rows = _tile_geometry(a, tiles_per_proc, mi)
+
+    def _factor_block(blk, rows):
+        # the local factorization takes the MXU-resident CholeskyQR2 when
+        # the block is tall enough (guarded by the same on-device fallback)
+        if _use_cholqr2(method, rows, n, blk.dtype):
+            return _cholqr2_with_fallback(blk)
+        return jnp.linalg.qr(blk)
+
+    def _local_factor(block):
+        """(mi, n) local shard -> local (q1, r1) via the tile tree."""
+        if n_tiles <= 1:
+            return _factor_block(block, mi)
+        pad = n_tiles * tile_rows - mi
+        blk = jnp.pad(block, ((0, pad), (0, 0)))
+        q_t, r_t = jax.vmap(lambda v: _factor_block(v, tile_rows))(
+            blk.reshape(n_tiles, tile_rows, n)
+        )  # (t, tile_rows, k0), (t, k0, n)
+        k0 = r_t.shape[1]
+        qm, r1 = jnp.linalg.qr(r_t.reshape(n_tiles * k0, n))  # local merge
+        k1 = qm.shape[1]
+        q1 = jnp.einsum("tik,tkj->tij", q_t, qm.reshape(n_tiles, k0, k1))
+        return q1.reshape(n_tiles * tile_rows, k1)[:mi], r1
 
     def _tsqr_local(block):
-        # block: (mp/p, n) local shard; the local factorization takes the
-        # MXU-resident CholeskyQR2 when the block is tall enough (guarded
-        # by the same on-device fallback)
-        block = block.reshape(mp // p, n)
-        if _use_cholqr2(method, mp // p, n, block.dtype):
-            q1, r1 = _cholqr2_with_fallback(block)
-        else:
-            q1, r1 = jnp.linalg.qr(block)  # (mi, kk), (kk, n)
+        block = block.reshape(mi, n)
+        q1, r1 = _local_factor(block)  # (mi, kk), (kk, n)
         kk = r1.shape[0]
         rs = jax.lax.all_gather(r1, SPLIT_AXIS)  # (p, kk, n)
         q2, r2 = jnp.linalg.qr(rs.reshape(p * kk, n))  # merge factor

@@ -3,11 +3,14 @@
 The reference's tile classes *drive communication*: ``SplitTiles`` indexes
 the Isend/Irecv mesh of ``resplit_`` and ``SquareDiagTiles`` the CAQR tile
 loops. On TPU resplit is one ``device_put`` and QR is TSQR, so no code
-path needs tiles to move data — the classes are instead *functional tile
-views* over the canonical XLA layout: global tile boundaries, per-process
-ownership, and tile ``__getitem__``/``__setitem__`` that read from and
-write through to the sharded device buffer (the reference's in-place
-tile assignment API; int and slice-of-tiles keys).
+path needs tiles to move data. ``SquareDiagTiles`` still drives the QR
+schedule: ``qr(tiles_per_proc=)`` reads its row decomposition to shape
+the local level of the two-level TSQR tree (``linalg/qr.py``). Both
+classes are additionally *functional tile views* over the canonical XLA
+layout: global tile boundaries, per-process ownership, and tile
+``__getitem__``/``__setitem__`` that read from and write through to the
+sharded device buffer (the reference's in-place tile assignment API; int
+and slice-of-tiles keys).
 
 Cost model: XLA arrays are immutable, so each tile write is a full-array
 functional update (and each read fetches through ``.numpy()``) — per-tile
@@ -127,8 +130,9 @@ class SquareDiagTiles:
 
     Computes the CAQR tile decomposition metadata: per-process row/column
     tile counts and global tile boundary indices. Data movement never uses
-    these on TPU (QR is TSQR), but the indexing scheme is preserved for
-    API parity and inspection.
+    these on TPU (QR is TSQR), but ``qr(tiles_per_proc=)`` consumes the
+    row decomposition to shape its local factorization tree, and the
+    indexing scheme is preserved for API parity and inspection.
     """
 
     def __init__(self, arr: DNDarray, tiles_per_proc: int = 1):

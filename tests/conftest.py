@@ -23,5 +23,5 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "multihost: also executed inside the real 2-process jax.distributed "
-        "run (tests/test_multihost.py::test_two_process_pytest_subset)",
+        "runs (tests/test_multihost.py::test_multi_process_pytest_subset)",
     )

@@ -1,0 +1,323 @@
+"""Elementwise & reduction depth wave (reference ``test_arithmetics.py`` /
+``test_rounding.py`` / ``test_exponential.py`` / ``test_trigonometrics.py``
+/ ``test_statistics.py`` case matrices): sign conventions of the division
+family, the diff n/prepend/append matrix, nan-aware reductions, tuple
+axes, and numerical identities — all numpy-oracled across splits.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from tests.base import TestCase
+
+SPLITS1 = (None, 0)
+
+
+class TestDivisionFamilySigns(TestCase):
+    """floordiv/mod follow Python (floor) semantics, fmod follows C
+    (trunc) semantics — the reference inherits exactly this split from
+    torch (``arithmetics.py:88,96,111``)."""
+
+    def test_floordiv_mod_negative_operands(self):
+        x = np.array([7, -7, 7, -7, 0, 5], dtype=np.int32)
+        y = np.array([3, 3, -3, -3, 3, -2], dtype=np.int32)
+        for split in SPLITS1:
+            a, b = ht.array(x, split=split), ht.array(y, split=split)
+            np.testing.assert_array_equal(ht.floordiv(a, b).numpy(), x // y)
+            np.testing.assert_array_equal(ht.mod(a, b).numpy(), x % y)
+
+    def test_fmod_trunc_semantics(self):
+        x = np.array([7.0, -7.0, 7.5, -7.5], dtype=np.float32)
+        y = np.array([3.0, 3.0, -2.0, -2.0], dtype=np.float32)
+        a, b = ht.array(x, split=0), ht.array(y, split=0)
+        np.testing.assert_allclose(ht.fmod(a, b).numpy(), np.fmod(x, y))
+
+    def test_float_floordiv_mod(self):
+        x = np.array([5.5, -5.5, 0.5], dtype=np.float32)
+        y = np.array([2.0, 2.0, -0.25], dtype=np.float32)
+        a, b = ht.array(x, split=0), ht.array(y, split=0)
+        np.testing.assert_allclose(ht.floordiv(a, b).numpy(), x // y)
+        np.testing.assert_allclose(ht.mod(a, b).numpy(), x % y)
+
+    def test_copysign_hypot(self):
+        x = np.array([1.5, -2.5, 3.0], dtype=np.float32)
+        y = np.array([-1.0, 1.0, -0.0], dtype=np.float32)
+        a, b = ht.array(x, split=0), ht.array(y, split=0)
+        np.testing.assert_allclose(ht.copysign(a, b).numpy(), np.copysign(x, y))
+        np.testing.assert_allclose(ht.hypot(a, b).numpy(), np.hypot(x, y), rtol=1e-6)
+
+    def test_div_by_zero_float(self):
+        x = np.array([1.0, -1.0, 0.0], dtype=np.float32)
+        z = np.zeros(3, dtype=np.float32)
+        got = ht.div(ht.array(x, split=0), ht.array(z, split=0)).numpy()
+        assert np.isposinf(got[0]) and np.isneginf(got[1]) and np.isnan(got[2])
+
+
+class TestDiffMatrix(TestCase):
+    def test_n_axis_matrix(self):
+        """Reference ``arithmetics.py:293`` hand-rolls split-axis neighbor
+        sends for diff; every (n, axis, split) cell must equal numpy."""
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(9, 7)).astype(np.float32)
+        for split in (None, 0, 1):
+            a = ht.array(x, split=split)
+            for n in (1, 2, 3):
+                for axis in (0, 1, -1):
+                    got = ht.diff(a, n=n, axis=axis)
+                    np.testing.assert_allclose(
+                        got.numpy(), np.diff(x, n=n, axis=axis), rtol=1e-5, atol=1e-5,
+                        err_msg=f"split={split} n={n} axis={axis}",
+                    )
+
+    def test_prepend_append(self):
+        x = np.arange(8, dtype=np.float32) ** 2
+        a = ht.array(x, split=0)
+        got = ht.diff(a, prepend=ht.array(np.array([0.0], np.float32)))
+        np.testing.assert_allclose(got.numpy(), np.diff(x, prepend=[0.0]))
+        got = ht.diff(a, append=ht.array(np.array([100.0], np.float32)))
+        np.testing.assert_allclose(got.numpy(), np.diff(x, append=[100.0]))
+
+    def test_n_zero_identity(self):
+        x = np.arange(5, dtype=np.float32)
+        got = ht.diff(ht.array(x, split=0), n=0)
+        np.testing.assert_array_equal(got.numpy(), x)
+
+
+class TestNanAwareReductions(TestCase):
+    def test_nansum_nanprod(self):
+        x = np.array([[1.0, np.nan, 2.0], [np.nan, 3.0, 4.0]], dtype=np.float32)
+        for split in (None, 0, 1):
+            a = ht.array(x, split=split)
+            np.testing.assert_allclose(np.asarray(ht.nansum(a).numpy()), np.nansum(x))
+            np.testing.assert_allclose(np.asarray(ht.nanprod(a).numpy()), np.nanprod(x))
+            np.testing.assert_allclose(ht.nansum(a, axis=0).numpy(), np.nansum(x, axis=0))
+            np.testing.assert_allclose(ht.nansum(a, axis=1).numpy(), np.nansum(x, axis=1))
+
+    def test_nanmean_nanmax_nanmin(self):
+        x = np.array([[1.0, np.nan, 5.0], [2.0, 3.0, np.nan]], dtype=np.float32)
+        for split in (None, 0, 1):
+            a = ht.array(x, split=split)
+            np.testing.assert_allclose(np.asarray(ht.nanmean(a).numpy()), np.nanmean(x))
+            np.testing.assert_allclose(np.asarray(ht.nanmax(a).numpy()), np.nanmax(x))
+            np.testing.assert_allclose(np.asarray(ht.nanmin(a).numpy()), np.nanmin(x))
+            np.testing.assert_allclose(ht.nanmean(a, axis=1).numpy(), np.nanmean(x, axis=1))
+
+    def test_all_nan_slice(self):
+        x = np.array([np.nan, np.nan], dtype=np.float32)
+        a = ht.array(x, split=0)
+        assert np.asarray(ht.nansum(a).numpy()) == 0.0
+
+    def test_maximum_minimum_nan_propagation(self):
+        x = np.array([1.0, np.nan, 3.0], dtype=np.float32)
+        y = np.array([2.0, 2.0, np.nan], dtype=np.float32)
+        a, b = ht.array(x, split=0), ht.array(y, split=0)
+        np.testing.assert_array_equal(
+            np.isnan(ht.maximum(a, b).numpy()), np.isnan(np.maximum(x, y))
+        )
+        np.testing.assert_array_equal(
+            np.isnan(ht.minimum(a, b).numpy()), np.isnan(np.minimum(x, y))
+        )
+
+
+class TestTupleAxesReductions(TestCase):
+    def test_sum_mean_var_tuple_axes(self):
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(4, 5, 6)).astype(np.float32)
+        for split in (None, 0, 1, 2):
+            a = ht.array(x, split=split)
+            for axes in ((0, 1), (1, 2), (0, 2), (0, 1, 2)):
+                np.testing.assert_allclose(
+                    ht.sum(a, axis=axes).numpy(), x.sum(axis=axes), rtol=1e-4, atol=1e-4,
+                    err_msg=f"sum split={split} axes={axes}",
+                )
+                np.testing.assert_allclose(
+                    ht.mean(a, axis=axes).numpy(), x.mean(axis=axes), rtol=1e-4, atol=1e-4,
+                )
+                np.testing.assert_allclose(
+                    ht.var(a, axis=axes).numpy(), x.var(axis=axes), rtol=1e-3, atol=1e-4,
+                )
+
+    def test_min_max_tuple_axes_keepdims(self):
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(3, 4, 5)).astype(np.float32)
+        for split in (None, 0, 2):
+            a = ht.array(x, split=split)
+            np.testing.assert_allclose(
+                ht.max(a, axis=(0, 2)).numpy(), x.max(axis=(0, 2))
+            )
+            np.testing.assert_allclose(
+                ht.min(a, axis=(1, 2), keepdims=True).numpy(),
+                x.min(axis=(1, 2), keepdims=True),
+            )
+
+
+class TestRoundingDepth(TestCase):
+    def test_round_decimals(self):
+        x = np.array([1.2345, -6.789, 0.5, 2.5, 1234.5678], dtype=np.float64)
+        a = ht.array(x, split=0)
+        for dec in (0, 1, 2, -1, -2):
+            np.testing.assert_allclose(
+                ht.round(a, decimals=dec).numpy(), np.round(x, dec), err_msg=f"dec={dec}"
+            )
+
+    def test_modf_parts(self):
+        x = np.array([1.75, -2.25, 0.0, 3.0], dtype=np.float32)
+        frac, whole = ht.modf(ht.array(x, split=0))
+        nf, nw = np.modf(x)
+        np.testing.assert_allclose(frac.numpy(), nf)
+        np.testing.assert_allclose(whole.numpy(), nw)
+
+    def test_nan_to_num_args(self):
+        x = np.array([np.nan, np.inf, -np.inf, 1.0], dtype=np.float32)
+        a = ht.array(x, split=0)
+        np.testing.assert_allclose(ht.nan_to_num(a).numpy(), np.nan_to_num(x))
+        got = ht.nan_to_num(a, nan=-1.0, posinf=99.0, neginf=-99.0).numpy()
+        np.testing.assert_allclose(got, np.nan_to_num(x, nan=-1.0, posinf=99.0, neginf=-99.0))
+
+    def test_sign_sgn_zero_and_negatives(self):
+        x = np.array([-3.0, -0.0, 0.0, 5.0], dtype=np.float32)
+        a = ht.array(x, split=0)
+        np.testing.assert_array_equal(ht.sign(a).numpy(), np.sign(x))
+        np.testing.assert_array_equal(ht.sgn(a).numpy(), np.sign(x))
+
+    def test_fabs_vs_abs_int(self):
+        x = np.array([-3, -1, 0, 2], dtype=np.int32)
+        assert ht.abs(ht.array(x, split=0)).dtype == ht.int32
+        f = ht.fabs(ht.array(x, split=0))
+        assert f.dtype in (ht.float32, ht.float64)
+        np.testing.assert_array_equal(f.numpy(), np.fabs(x).astype(f.numpy().dtype))
+
+
+class TestExponentialIdentities(TestCase):
+    def test_log_exp_family(self):
+        x = np.array([0.1, 0.5, 1.0, 2.0, 10.0], dtype=np.float32)
+        for split in SPLITS1:
+            a = ht.array(x, split=split)
+            np.testing.assert_allclose(ht.log(ht.exp(a)).numpy(), x, rtol=1e-5)
+            np.testing.assert_allclose(ht.exp2(a).numpy(), np.exp2(x), rtol=1e-6)
+            np.testing.assert_allclose(ht.expm1(a).numpy(), np.expm1(x), rtol=1e-6)
+            np.testing.assert_allclose(ht.log1p(a).numpy(), np.log1p(x), rtol=1e-6)
+            np.testing.assert_allclose(ht.log2(a).numpy(), np.log2(x), rtol=1e-6)
+            np.testing.assert_allclose(ht.log10(a).numpy(), np.log10(x), rtol=1e-6)
+            np.testing.assert_allclose(ht.cbrt(a).numpy(), np.cbrt(x), rtol=1e-6)
+            np.testing.assert_allclose(ht.rsqrt(a).numpy(), 1 / np.sqrt(x), rtol=1e-6)
+            np.testing.assert_allclose(ht.square(a).numpy(), x * x, rtol=1e-6)
+
+    def test_logaddexp_extremes(self):
+        """logaddexp must not overflow where naive exp would."""
+        x = np.array([-1000.0, 0.0, 1000.0], dtype=np.float32)
+        y = np.array([-1000.0, 1.0, 999.0], dtype=np.float32)
+        a, b = ht.array(x, split=0), ht.array(y, split=0)
+        np.testing.assert_allclose(
+            ht.logaddexp(a, b).numpy(), np.logaddexp(x, y), rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            ht.logaddexp2(a, b).numpy(), np.logaddexp2(x, y), rtol=1e-6
+        )
+
+
+class TestTrigDepth(TestCase):
+    def test_atan2_quadrants(self):
+        pts = np.array(
+            [[1, 1], [-1, 1], [-1, -1], [1, -1], [0, 1], [1, 0], [0, -1], [-1, 0]],
+            dtype=np.float32,
+        )
+        y, x = pts[:, 0].copy(), pts[:, 1].copy()
+        got = ht.atan2(ht.array(y, split=0), ht.array(x, split=0))
+        np.testing.assert_allclose(got.numpy(), np.arctan2(y, x), rtol=1e-6, atol=1e-7)
+
+    def test_sinc_at_zero(self):
+        x = np.array([-1.0, -0.5, 0.0, 0.5, 1.0], dtype=np.float32)
+        got = ht.sinc(ht.array(x, split=0))
+        np.testing.assert_allclose(got.numpy(), np.sinc(x), rtol=1e-5, atol=1e-7)
+
+    def test_deg_rad_roundtrip(self):
+        x = np.array([0.0, 45.0, 90.0, 180.0, 360.0, -90.0], dtype=np.float32)
+        a = ht.array(x, split=0)
+        np.testing.assert_allclose(ht.deg2rad(a).numpy(), np.deg2rad(x), rtol=1e-6)
+        np.testing.assert_allclose(
+            ht.rad2deg(ht.deg2rad(a)).numpy(), x, rtol=1e-5, atol=1e-4
+        )
+
+    def test_inverse_domain_edges(self):
+        x = np.array([-1.0, -0.5, 0.0, 0.5, 1.0], dtype=np.float32)
+        a = ht.array(x, split=0)
+        np.testing.assert_allclose(ht.asin(a).numpy(), np.arcsin(x), rtol=1e-6)
+        np.testing.assert_allclose(ht.acos(a).numpy(), np.arccos(x), rtol=1e-6, atol=1e-6)
+        out = ht.atanh(a).numpy()
+        with np.errstate(divide="ignore"):
+            want = np.arctanh(x)
+        np.testing.assert_allclose(out[1:4], want[1:4], rtol=1e-5)
+        assert np.isinf(out[0]) and np.isinf(out[4])
+
+
+class TestStatisticsWave2(TestCase):
+    def test_median_axis_matrix(self):
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=(6, 9)).astype(np.float32)
+        for split in (None, 0, 1):
+            a = ht.array(x, split=split)
+            np.testing.assert_allclose(
+                np.asarray(ht.median(a).numpy()), np.median(x), rtol=1e-5
+            )
+            np.testing.assert_allclose(
+                ht.median(a, axis=0).numpy(), np.median(x, axis=0), rtol=1e-5
+            )
+            np.testing.assert_allclose(
+                ht.median(a, axis=1, keepdim=True).numpy(),
+                np.median(x, axis=1, keepdims=True), rtol=1e-5,
+            )
+
+    def test_percentile_interpolations(self):
+        rng = np.random.default_rng(4)
+        x = rng.normal(size=37).astype(np.float64)
+        a = ht.array(x, split=0)
+        for q in (0, 10, 50, 90, 100):
+            for method in ("linear", "lower", "higher", "nearest", "midpoint"):
+                got = np.asarray(ht.percentile(a, q, interpolation=method).numpy())
+                want = np.percentile(x, q, method=method)
+                np.testing.assert_allclose(got, want, rtol=1e-12, err_msg=f"{q} {method}")
+
+    def test_average_returned_weights(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        w = np.array([1.0, 2.0, 3.0], dtype=np.float32)
+        a = ht.array(x, split=0)
+        hw = ht.array(w)
+        avg, wsum = ht.average(a, axis=0, weights=hw, returned=True)
+        na, nw = np.average(x, axis=0, weights=w, returned=True)
+        np.testing.assert_allclose(avg.numpy(), na, rtol=1e-6)
+        np.testing.assert_allclose(wsum.numpy(), nw, rtol=1e-6)
+
+    def test_histogram_density_and_range(self):
+        rng = np.random.default_rng(5)
+        x = rng.normal(size=101).astype(np.float32)
+        a = ht.array(x, split=0)
+        hist, edges = ht.histogram(a, bins=7)
+        nh, ne = np.histogram(x, bins=7)
+        np.testing.assert_array_equal(np.asarray(hist.numpy()), nh)
+        np.testing.assert_allclose(np.asarray(edges.numpy()), ne, rtol=1e-5)
+        hist, edges = ht.histogram(a, bins=5, range=(-1.0, 1.0))
+        nh, ne = np.histogram(x, bins=5, range=(-1.0, 1.0))
+        np.testing.assert_array_equal(np.asarray(hist.numpy()), nh)
+
+    def test_cov_two_operand(self):
+        rng = np.random.default_rng(6)
+        m = rng.normal(size=(3, 8)).astype(np.float32)
+        y = rng.normal(size=(2, 8)).astype(np.float32)
+        got = ht.cov(ht.array(m, split=1), ht.array(y, split=1))
+        want = np.cov(m, y)
+        np.testing.assert_allclose(got.numpy(), want, rtol=1e-4, atol=1e-5)
+
+    def test_argminmax_axis_matrix(self):
+        rng = np.random.default_rng(7)
+        x = rng.normal(size=(7, 5)).astype(np.float32)
+        for split in (None, 0, 1):
+            a = ht.array(x, split=split)
+            np.testing.assert_array_equal(
+                np.asarray(ht.argmax(a).numpy()), np.argmax(x)
+            )
+            np.testing.assert_array_equal(ht.argmax(a, axis=0).numpy(), np.argmax(x, axis=0))
+            np.testing.assert_array_equal(ht.argmin(a, axis=1).numpy(), np.argmin(x, axis=1))

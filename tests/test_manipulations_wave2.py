@@ -1,0 +1,319 @@
+"""Manipulations depth, wave 2 (toward the reference's 3,625-LoC
+``test_manipulations.py``): concatenate split-pair matrices, sort depth
+with duplicates and integer dtypes, the reshape × new_split matrix,
+unique(return_inverse) on distributed inputs, resplit transitions, and
+rot90/diag/diagonal offset sweeps — all against the numpy oracle.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from tests.base import TestCase
+
+SPLITS2 = (None, 0, 1)
+
+
+class TestConcatenateMatrix(TestCase):
+    def test_axis0_split_pairs(self):
+        """Reference ``manipulations.py:188`` enumerates (s0, s1) split
+        pairs by hand; matching pairs must concatenate without error and
+        equal numpy for every pair and both axes."""
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(5, 4)).astype(np.float32)
+        y = rng.normal(size=(3, 4)).astype(np.float32)
+        want = np.concatenate([x, y], axis=0)
+        for split in SPLITS2:
+            got = ht.concatenate([ht.array(x, split=split), ht.array(y, split=split)], axis=0)
+            np.testing.assert_array_equal(got.numpy(), want, err_msg=f"split={split}")
+            assert got.split == split
+
+    def test_axis1_split_pairs(self):
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(4, 5)).astype(np.float32)
+        y = rng.normal(size=(4, 2)).astype(np.float32)
+        want = np.concatenate([x, y], axis=1)
+        for split in SPLITS2:
+            got = ht.concatenate([ht.array(x, split=split), ht.array(y, split=split)], axis=1)
+            np.testing.assert_array_equal(got.numpy(), want, err_msg=f"split={split}")
+
+    def test_three_arrays_and_promotion(self):
+        """Multi-operand concat + dtype promotion (int32 ∪ float32)."""
+        a = np.arange(6, dtype=np.int32).reshape(2, 3)
+        b = np.arange(9, dtype=np.float32).reshape(3, 3)
+        c = np.arange(3, dtype=np.int64).reshape(1, 3)
+        want = np.concatenate([a.astype(np.float64), b.astype(np.float64), c.astype(np.float64)], axis=0)
+        got = ht.concatenate(
+            [ht.array(a, split=0), ht.array(b, split=0), ht.array(c, split=0)], axis=0
+        )
+        np.testing.assert_allclose(got.numpy().astype(np.float64), want)
+
+    def test_replicated_with_distributed(self):
+        """split=None operand concatenated with a split operand follows the
+        reference's rule: the result takes the distributed split."""
+        x = np.arange(8, dtype=np.float32).reshape(4, 2)
+        y = np.arange(4, dtype=np.float32).reshape(2, 2)
+        got = ht.concatenate([ht.array(x, split=0), ht.array(y, split=None)], axis=0)
+        np.testing.assert_array_equal(got.numpy(), np.concatenate([x, y]))
+
+    def test_error_contracts(self):
+        with pytest.raises(ValueError):
+            ht.concatenate([ht.zeros((2, 3), split=0), ht.zeros((2, 4), split=0)], axis=0)
+        with pytest.raises((ValueError, IndexError)):
+            ht.concatenate([ht.zeros((2, 3)), ht.zeros((2, 3))], axis=5)
+
+    def test_negative_axis_and_empty(self):
+        x = np.ones((2, 3), dtype=np.float32)
+        e = np.ones((0, 3), dtype=np.float32)
+        got = ht.concatenate([ht.array(x, split=0), ht.array(e, split=0)], axis=0)
+        np.testing.assert_array_equal(got.numpy(), x)
+        got = ht.concatenate([ht.array(x, split=0), ht.array(x, split=0)], axis=-1)
+        np.testing.assert_array_equal(got.numpy(), np.concatenate([x, x], axis=-1))
+
+
+class TestSortDepth(TestCase):
+    def test_duplicates_and_ints(self):
+        """Distributed sort (ppermute odd-even blocks, ``parallel/dsort``)
+        must handle heavy duplicates and integer dtypes identically to
+        numpy's stable sort."""
+        rng = np.random.default_rng(2)
+        x = rng.integers(0, 4, size=37).astype(np.int32)
+        for split in (None, 0):
+            v, i = ht.sort(ht.array(x, split=split))
+            np.testing.assert_array_equal(v.numpy(), np.sort(x, kind="stable"))
+            # indices must be a valid permutation reproducing the values
+            np.testing.assert_array_equal(x[i.numpy()], np.sort(x, kind="stable"))
+
+    def test_descending_matrix(self):
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=(9, 7)).astype(np.float32)
+        for split in SPLITS2:
+            a = ht.array(x, split=split)
+            for axis in (0, 1, -1):
+                v, i = ht.sort(a, axis=axis, descending=True)
+                np.testing.assert_array_equal(
+                    v.numpy(), -np.sort(-x, axis=axis), err_msg=f"{split} {axis}"
+                )
+                np.testing.assert_array_equal(
+                    np.take_along_axis(x, i.numpy(), axis=axis if axis >= 0 else x.ndim - 1),
+                    -np.sort(-x, axis=axis),
+                )
+
+    def test_sorted_input_is_fixed_point(self):
+        x = np.arange(23, dtype=np.float32)
+        v, i = ht.sort(ht.array(x, split=0))
+        np.testing.assert_array_equal(v.numpy(), x)
+        np.testing.assert_array_equal(i.numpy(), np.arange(23))
+
+    def test_out_kwarg(self):
+        x = np.array([3.0, 1.0, 2.0], dtype=np.float32)
+        a = ht.array(x, split=0)
+        out = ht.zeros(3, split=0)
+        res, idx = ht.sort(a, out=out)
+        np.testing.assert_array_equal(out.numpy(), np.sort(x))
+
+
+class TestReshapeMatrix(TestCase):
+    def test_shape_split_matrix(self):
+        """reshape is the reference's Alltoallv reshuffle
+        (``manipulations.py:1821``); here the flatmove interval-exchange
+        kernel. Sweep target shapes × input splits × new_split."""
+        x = np.arange(24, dtype=np.float32)
+        shapes = [(24,), (4, 6), (6, 4), (2, 3, 4), (2, 12)]
+        for split in (None, 0):
+            a = ht.array(x.reshape(4, 6), split=split)
+            for shp in shapes:
+                got = ht.reshape(a, shp)
+                np.testing.assert_array_equal(got.numpy(), x.reshape(shp), err_msg=f"{split} {shp}")
+
+    def test_new_split_matrix(self):
+        x = np.arange(36, dtype=np.float32).reshape(6, 6)
+        a = ht.array(x, split=0)
+        for shp, new_split in [((4, 9), 0), ((4, 9), 1), ((36,), 0), ((3, 3, 4), 2)]:
+            got = ht.reshape(a, shp, new_split=new_split)
+            assert got.split == new_split, f"{shp} {new_split}"
+            np.testing.assert_array_equal(got.numpy(), x.reshape(shp))
+
+    def test_size_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            ht.reshape(ht.zeros((4, 6), split=0), (5, 5))
+
+    def test_reshape_method_and_minus_one(self):
+        x = np.arange(30, dtype=np.int32)
+        a = ht.array(x, split=0)
+        got = a.reshape((5, -1))
+        np.testing.assert_array_equal(got.numpy(), x.reshape(5, 6))
+        got = a.reshape(-1, 10)
+        np.testing.assert_array_equal(got.numpy(), x.reshape(3, 10))
+
+
+class TestUniqueReturnInverse(TestCase):
+    def test_flat_distributed(self):
+        rng = np.random.default_rng(4)
+        x = rng.integers(-5, 6, size=41).astype(np.int64)
+        for split in (None, 0):
+            vals, inv = ht.unique(ht.array(x, split=split), return_inverse=True)
+            nv, ni = np.unique(x, return_inverse=True)
+            np.testing.assert_array_equal(np.sort(vals.numpy()), nv)
+            # the inverse must reconstruct the input through the table
+            np.testing.assert_array_equal(vals.numpy()[inv.numpy()], x)
+
+    def test_2d_flat_and_axis(self):
+        x = np.array([[1, 2, 1], [3, 2, 1], [1, 2, 1]], dtype=np.int32)
+        for split in SPLITS2:
+            a = ht.array(x, split=split)
+            vals = ht.unique(a)
+            np.testing.assert_array_equal(np.sort(vals.numpy()), np.unique(x))
+        got = ht.unique(ht.array(x, split=0), axis=0)
+        np.testing.assert_array_equal(
+            np.sort(got.numpy(), axis=0), np.unique(x, axis=0)
+        )
+
+    def test_floats_with_nan_free_duplicates(self):
+        x = np.array([0.5, 0.25, 0.5, -0.5, 0.25, 0.0], dtype=np.float32)
+        vals, inv = ht.unique(ht.array(x, split=0), return_inverse=True)
+        np.testing.assert_array_equal(vals.numpy()[inv.numpy()], x)
+        assert len(vals.numpy()) == 4
+
+
+class TestResplitTransitions(TestCase):
+    def test_all_transitions_2d(self):
+        """The reference's resplit (``manipulations.py:3329``): every
+        (from, to) split pair must preserve values; on TPU each is one
+        device_put/GSPMD reshard."""
+        rng = np.random.default_rng(5)
+        x = rng.normal(size=(9, 7)).astype(np.float32)
+        for s_from in SPLITS2:
+            for s_to in SPLITS2:
+                a = ht.array(x, split=s_from)
+                b = ht.resplit(a, s_to)
+                assert b.split == s_to, f"{s_from}->{s_to}"
+                np.testing.assert_array_equal(b.numpy(), x, err_msg=f"{s_from}->{s_to}")
+                # out-of-place: the source keeps its split
+                assert a.split == s_from
+
+    def test_inplace_resplit_3d(self):
+        x = np.arange(60, dtype=np.float32).reshape(3, 4, 5)
+        for s_to in (None, 0, 1, 2):
+            a = ht.array(x, split=1)
+            a.resplit_(s_to)
+            assert a.split == s_to
+            np.testing.assert_array_equal(a.numpy(), x)
+
+
+class TestRot90DiagDepth(TestCase):
+    def test_rot90_k_sweep(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        for split in SPLITS2:
+            a = ht.array(x, split=split)
+            for k in (-1, 0, 1, 2, 3, 4):
+                np.testing.assert_array_equal(
+                    ht.rot90(a, k).numpy(), np.rot90(x, k), err_msg=f"{split} {k}"
+                )
+        np.testing.assert_array_equal(
+            ht.rot90(ht.array(x, split=0), 1, axes=(1, 0)).numpy(), np.rot90(x, 1, axes=(1, 0))
+        )
+
+    def test_diag_construct_and_extract(self):
+        v = np.arange(1, 6, dtype=np.float32)
+        for split in (None, 0):
+            hv = ht.array(v, split=split)
+            for off in (-2, 0, 3):
+                np.testing.assert_array_equal(ht.diag(hv, off).numpy(), np.diag(v, off))
+        m = np.arange(20, dtype=np.float32).reshape(4, 5)
+        for split in SPLITS2:
+            hm = ht.array(m, split=split)
+            for off in (-3, -1, 0, 1, 4):
+                np.testing.assert_array_equal(
+                    ht.diag(hm, off).numpy(), np.diag(m, off), err_msg=f"{split} {off}"
+                )
+
+    def test_diagonal_3d(self):
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        got = ht.diagonal(ht.array(x, split=0), dim1=1, dim2=2)
+        want = np.diagonal(x, axis1=1, axis2=2)
+        np.testing.assert_array_equal(got.numpy(), want)
+
+
+class TestBroadcastDepth(TestCase):
+    def test_broadcast_arrays_shapes(self):
+        a = np.arange(3, dtype=np.float32)
+        b = np.arange(12, dtype=np.float32).reshape(4, 3)
+        c = np.float32(5.0).reshape(())
+        outs = ht.broadcast_arrays(ht.array(a), ht.array(b, split=0), ht.array(c))
+        na, nb, nc = np.broadcast_arrays(a, b, c)
+        np.testing.assert_array_equal(outs[0].numpy(), na)
+        np.testing.assert_array_equal(outs[1].numpy(), nb)
+        np.testing.assert_array_equal(outs[2].numpy(), nc)
+
+    def test_broadcast_to_splits(self):
+        x = np.arange(5, dtype=np.float32)
+        for shape in ((3, 5), (2, 3, 5)):
+            got = ht.broadcast_to(ht.array(x), shape)
+            np.testing.assert_array_equal(got.numpy(), np.broadcast_to(x, shape))
+        with pytest.raises(ValueError):
+            ht.broadcast_to(ht.array(x), (5, 3))
+
+
+class TestStackDstack(TestCase):
+    def test_stack_axis_sweep(self):
+        rng = np.random.default_rng(6)
+        x = rng.normal(size=(4, 5)).astype(np.float32)
+        y = rng.normal(size=(4, 5)).astype(np.float32)
+        z = rng.normal(size=(4, 5)).astype(np.float32)
+        for split in SPLITS2:
+            hs = [ht.array(v, split=split) for v in (x, y, z)]
+            for axis in (0, 1, 2, -1):
+                got = ht.stack(hs, axis=axis)
+                np.testing.assert_array_equal(
+                    got.numpy(), np.stack([x, y, z], axis=axis), err_msg=f"{split} {axis}"
+                )
+
+    def test_row_column_stack_1d(self):
+        a = np.arange(4, dtype=np.float32)
+        b = a + 10
+        np.testing.assert_array_equal(
+            ht.row_stack([ht.array(a, split=0), ht.array(b, split=0)]).numpy(),
+            np.vstack([a, b]),
+        )
+        np.testing.assert_array_equal(
+            ht.column_stack([ht.array(a, split=0), ht.array(b, split=0)]).numpy(),
+            np.column_stack([a, b]),
+        )
+
+
+class TestFlattenRavelOrder(TestCase):
+    def test_flatten_matches_ravel_row_major(self):
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        for split in (None, 0, 1, 2):
+            f = ht.flatten(ht.array(x, split=split))
+            np.testing.assert_array_equal(f.numpy(), x.ravel())
+            r = ht.ravel(ht.array(x, split=split))
+            np.testing.assert_array_equal(r.numpy(), x.ravel())
+            if split is not None:
+                assert f.split == 0
+
+
+class TestSqueezeExpandDepth(TestCase):
+    def test_squeeze_axis_forms(self):
+        x = np.arange(6, dtype=np.float32).reshape(1, 2, 1, 3)
+        for split in (None, 1, 3):
+            a = ht.array(x, split=split)
+            np.testing.assert_array_equal(ht.squeeze(a).numpy(), np.squeeze(x))
+            np.testing.assert_array_equal(ht.squeeze(a, 0).numpy(), np.squeeze(x, 0))
+            np.testing.assert_array_equal(ht.squeeze(a, (0, 2)).numpy(), np.squeeze(x, (0, 2)))
+            np.testing.assert_array_equal(ht.squeeze(a, -2).numpy(), np.squeeze(x, -2))
+        with pytest.raises(ValueError):
+            ht.squeeze(ht.array(x), 1)
+
+    def test_expand_dims_sweep(self):
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        for split in SPLITS2:
+            a = ht.array(x, split=split)
+            for axis in (0, 1, 2, -1, -3):
+                got = ht.expand_dims(a, axis)
+                np.testing.assert_array_equal(
+                    got.numpy(), np.expand_dims(x, axis), err_msg=f"{split} {axis}"
+                )

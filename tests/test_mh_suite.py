@@ -7,10 +7,10 @@ real *marked pytest subset*: every test here
 
 - runs in the normal single-process suite (8 virtual devices), and
 - is executed AGAIN by ``tests/test_multihost.py::
-  test_two_process_pytest_subset`` inside TWO real OS processes joined
-  through ``jax.distributed.initialize`` (4 local devices each), with
-  per-test junit aggregation across ranks — failures are attributable to
-  a test node id, not a script line.
+  test_multi_process_pytest_subset`` inside 2 and 4 real OS processes
+  joined through ``jax.distributed.initialize`` (4 and 2 local devices
+  each), with per-test junit aggregation across ranks — failures are
+  attributable to a test node id, not a script line.
 
 Everything goes through the public API and the ``numpy()`` oracle, which
 multi-host performs a ragged process allgather — so every assertion
