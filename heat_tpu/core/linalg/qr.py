@@ -64,8 +64,15 @@ def qr(
         raise ValueError(f"qr requires a 2-D array, got {a.ndim}-D")
     if method not in ("auto", "householder", "cholqr2"):
         raise ValueError(f"unknown qr method {method!r}")
-    if not isinstance(tiles_per_proc, int) or tiles_per_proc < 1:
-        raise ValueError(f"tiles_per_proc must be a positive int, got {tiles_per_proc}")
+    # reference contract (`qr.py:79-82`): TypeError for non-integral input
+    # (integer-likes such as np.integer are fine), ValueError only for < 1
+    import numbers
+
+    if not isinstance(tiles_per_proc, numbers.Integral) or isinstance(tiles_per_proc, bool):
+        raise TypeError(f"tiles_per_proc must be an int, got {type(tiles_per_proc)}")
+    tiles_per_proc = int(tiles_per_proc)
+    if tiles_per_proc < 1:
+        raise ValueError(f"tiles_per_proc must be positive, got {tiles_per_proc}")
     if overwrite_a:
         sanitation.warn_parity_noop("qr", "overwrite_a", "XLA owns buffer reuse")
     # full f32 accumulation on the MXU: the reference's torch QR is exact
@@ -86,19 +93,10 @@ def _use_cholqr2(method: str, m: int, n: int, dtype) -> bool:
     )
 
 
-def _cholqr2_with_fallback(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """CholeskyQR2 (Fukaya et al.): Q,R from two Gram+Cholesky passes.
-
-    All the FLOPs are (m, n) x (n, n) matmuls — MXU work — instead of the
-    sequential Householder reflections ``jnp.linalg.qr`` lowers to. A
-    final on-device orthogonality test routes ill-conditioned inputs to
-    Householder inside one ``lax.cond`` (no host round-trip).
-    """
-
-    if x.shape[0] < x.shape[1]:
-        # wide input: reduced-QR shapes differ from CholQR2's (and the
-        # Gram is singular anyway) — Householder directly
-        return tuple(jnp.linalg.qr(x))
+def _cholqr2_core(x: jnp.ndarray):
+    """CholeskyQR2 passes only: (q, r, bad) with no control flow, so it
+    stays cheap under ``jax.vmap`` (a vmapped ``lax.cond`` degrades to
+    ``select`` and would execute BOTH branches per tile)."""
 
     def chol_pass(v):
         # conjugate transpose: the Gram of a complex input must be
@@ -122,11 +120,43 @@ def _cholqr2_with_fallback(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
         | jnp.any(~jnp.isfinite(q2))
         | (ortho_err > tol)
     )
+    return q2, r, bad
+
+
+def _cholqr2_with_fallback(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """CholeskyQR2 (Fukaya et al.): Q,R from two Gram+Cholesky passes.
+
+    All the FLOPs are (m, n) x (n, n) matmuls — MXU work — instead of the
+    sequential Householder reflections ``jnp.linalg.qr`` lowers to. A
+    final on-device orthogonality test routes ill-conditioned inputs to
+    Householder inside one ``lax.cond`` (no host round-trip).
+    """
+
+    if x.shape[0] < x.shape[1]:
+        # wide input: reduced-QR shapes differ from CholQR2's (and the
+        # Gram is singular anyway) — Householder directly
+        return tuple(jnp.linalg.qr(x))
+
+    q2, r, bad = _cholqr2_core(x)
     return jax.lax.cond(
         bad,
         lambda v: tuple(jnp.linalg.qr(v)),
         lambda v: (q2, r),
         x,
+    )
+
+
+def _cholqr2_batched_with_fallback(tiles: jnp.ndarray):
+    """Tile-batched CholeskyQR2 with ONE fallback decision for the whole
+    batch: the vmapped body carries no ``cond`` (which would select-execute
+    both branches per tile); a single scalar ``any(bad)`` predicate routes
+    the entire batch to Householder only when some tile needs it."""
+    q2, r, bad = jax.vmap(_cholqr2_core)(tiles)
+    return jax.lax.cond(
+        jnp.any(bad),
+        lambda ts: tuple(jax.vmap(jnp.linalg.qr)(ts)),
+        lambda ts: (q2, r),
+        tiles,
     )
 
 
@@ -192,9 +222,14 @@ def _qr_impl(
             return _factor_block(block, mi)
         pad = n_tiles * tile_rows - mi
         blk = jnp.pad(block, ((0, pad), (0, 0)))
-        q_t, r_t = jax.vmap(lambda v: _factor_block(v, tile_rows))(
-            blk.reshape(n_tiles, tile_rows, n)
-        )  # (t, tile_rows, k0), (t, k0, n)
+        tiles = blk.reshape(n_tiles, tile_rows, n)
+        if _use_cholqr2(method, tile_rows, n, blk.dtype) and tile_rows >= n:
+            # one batch-level fallback cond — NOT vmap(_factor_block),
+            # whose per-tile cond would select-execute both branches
+            q_t, r_t = _cholqr2_batched_with_fallback(tiles)
+        else:
+            q_t, r_t = jax.vmap(jnp.linalg.qr)(tiles)
+        # q_t: (t, tile_rows, k0), r_t: (t, k0, n)
         k0 = r_t.shape[1]
         qm, r1 = jnp.linalg.qr(r_t.reshape(n_tiles * k0, n))  # local merge
         k1 = qm.shape[1]

@@ -1,0 +1,171 @@
+"""Spatial distance depth wave (reference ``test_distance.py``): metric
+correctness against scipy-style numpy oracles across split pairs, ring vs
+GSPMD schedule equivalence, the chunked exact path, symmetry/identity
+axioms, and kNN behavior with ties and k edge values.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from tests.base import TestCase
+
+
+def _np_cdist(x, y):
+    return np.sqrt(((x[:, None, :] - y[None, :, :]) ** 2).sum(-1))
+
+
+def _np_manhattan(x, y):
+    return np.abs(x[:, None, :] - y[None, :, :]).sum(-1)
+
+
+class TestMetricOracles(TestCase):
+    def test_euclidean_split_pairs(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(11, 4)).astype(np.float32)
+        y = rng.normal(size=(7, 4)).astype(np.float32)
+        want = _np_cdist(x, y)
+        for sx in (None, 0):
+            for sy in (None, 0):
+                got = ht.spatial.cdist(ht.array(x, split=sx), ht.array(y, split=sy))
+                np.testing.assert_allclose(
+                    got.numpy(), want, rtol=1e-4, atol=1e-4, err_msg=f"{sx} {sy}"
+                )
+
+    def test_quadratic_expansion_matches_exact(self):
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(9, 6)).astype(np.float32)
+        y = rng.normal(size=(13, 6)).astype(np.float32)
+        exact = ht.spatial.cdist(ht.array(x, split=0), ht.array(y)).numpy()
+        quad = ht.spatial.cdist(
+            ht.array(x, split=0), ht.array(y), quadratic_expansion=True
+        ).numpy()
+        np.testing.assert_allclose(quad, exact, rtol=1e-3, atol=1e-3)
+
+    def test_manhattan_oracle(self):
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(8, 3)).astype(np.float32)
+        y = rng.normal(size=(5, 3)).astype(np.float32)
+        got = ht.spatial.manhattan(ht.array(x, split=0), ht.array(y))
+        np.testing.assert_allclose(got.numpy(), _np_manhattan(x, y), rtol=1e-5, atol=1e-5)
+
+    def test_rbf_kernel_values(self):
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=(6, 2)).astype(np.float32)
+        for sigma in (0.5, 1.0, 2.0):
+            got = ht.spatial.rbf(ht.array(x, split=0), sigma=sigma).numpy()
+            d2 = _np_cdist(x, x) ** 2
+            want = np.exp(-d2 / (2 * sigma * sigma))
+            np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4, err_msg=str(sigma))
+
+
+class TestMetricAxioms(TestCase):
+    def test_self_distance_zero_diagonal_and_symmetry(self):
+        rng = np.random.default_rng(4)
+        x = rng.normal(size=(10, 5)).astype(np.float32)
+        d = ht.spatial.cdist(ht.array(x, split=0)).numpy()
+        np.testing.assert_allclose(np.diag(d), 0.0, atol=1e-3)
+        np.testing.assert_allclose(d, d.T, rtol=1e-4, atol=1e-4)
+
+    def test_triangle_inequality_sample(self):
+        rng = np.random.default_rng(5)
+        x = rng.normal(size=(12, 3)).astype(np.float64)
+        d = ht.spatial.cdist(ht.array(x, split=0)).numpy()
+        for i in (0, 3, 7):
+            for j in (1, 5, 11):
+                for k in (2, 6, 9):
+                    assert d[i, j] <= d[i, k] + d[k, j] + 1e-6
+
+    def test_translation_invariance(self):
+        rng = np.random.default_rng(6)
+        x = rng.normal(size=(7, 4)).astype(np.float32)
+        shift = np.full((1, 4), 100.0, dtype=np.float32)
+        d0 = ht.spatial.cdist(ht.array(x, split=0)).numpy()
+        d1 = ht.spatial.cdist(ht.array(x + shift, split=0)).numpy()
+        np.testing.assert_allclose(d0, d1, rtol=1e-3, atol=1e-2)
+
+
+class TestRingSchedule(TestCase):
+    def test_ring_matches_gspmd_all_metrics(self):
+        """The ppermute ring schedule must agree with the GSPMD path
+        (reference ring, ``distance.py:209-486``)."""
+        rng = np.random.default_rng(7)
+        x = rng.normal(size=(16, 5)).astype(np.float32)
+        y = rng.normal(size=(24, 5)).astype(np.float32)
+        hx, hy = ht.array(x, split=0), ht.array(y, split=0)
+        for fn, kwargs in [
+            (ht.spatial.cdist, {}),
+            (ht.spatial.cdist, {"quadratic_expansion": True}),
+            (ht.spatial.manhattan, {}),
+            (ht.spatial.rbf, {"sigma": 1.5}),
+        ]:
+            a = fn(hx, hy, **kwargs)
+            b = fn(hx, hy, use_ring=True, **kwargs)
+            np.testing.assert_allclose(
+                a.numpy(), b.numpy(), rtol=1e-4, atol=1e-4, err_msg=str(kwargs)
+            )
+            assert b.split == 0
+
+    def test_ring_non_divisible_rows(self):
+        rng = np.random.default_rng(8)
+        x = rng.normal(size=(11, 3)).astype(np.float32)
+        y = rng.normal(size=(13, 3)).astype(np.float32)
+        got = ht.spatial.cdist(
+            ht.array(x, split=0), ht.array(y, split=0), use_ring=True
+        ).numpy()
+        np.testing.assert_allclose(got, _np_cdist(x, y), rtol=1e-4, atol=1e-4)
+
+
+class TestErrorContracts(TestCase):
+    def test_feature_mismatch(self):
+        with pytest.raises(ValueError):
+            ht.spatial.cdist(ht.zeros((4, 3), split=0), ht.zeros((4, 5), split=0))
+
+    def test_split1_rejected_with_guidance(self):
+        with pytest.raises(NotImplementedError):
+            ht.spatial.cdist(ht.zeros((4, 4), split=1))
+
+    def test_non_2d_rejected(self):
+        with pytest.raises(NotImplementedError):
+            ht.spatial.cdist(ht.zeros((4, 4, 2), split=0))
+
+    def test_dtype_promotion_to_float(self):
+        x = np.arange(12, dtype=np.int32).reshape(4, 3)
+        got = ht.spatial.cdist(ht.array(x, split=0))
+        assert got.dtype in (ht.float32, ht.float64)
+        np.testing.assert_allclose(
+            got.numpy(), _np_cdist(x.astype(np.float64), x.astype(np.float64)),
+            rtol=1e-4, atol=1e-4,
+        )
+
+
+class TestNearestNeighbors(TestCase):
+    def test_knn_indices_match_bruteforce(self):
+        rng = np.random.default_rng(9)
+        x = rng.normal(size=(9, 4)).astype(np.float32)
+        y = rng.normal(size=(20, 4)).astype(np.float32)
+        for k in (1, 3, 5):
+            dists, idx = ht.spatial.nearest_neighbors(ht.array(x, split=0), ht.array(y), k)
+            d = _np_cdist(x, y) ** 2  # kernel returns SQUARED distances
+            want_idx = np.argsort(d, axis=1, kind="stable")[:, :k]
+            want_d = np.take_along_axis(d, want_idx, axis=1)
+            np.testing.assert_allclose(
+                np.sort(np.asarray(dists.numpy()), axis=1), want_d, rtol=1e-3, atol=1e-3
+            )
+            # indices give the same distances (ties may reorder)
+            got_d = np.take_along_axis(d, np.asarray(idx.numpy()).astype(int), axis=1)
+            np.testing.assert_allclose(
+                np.sort(got_d, axis=1), want_d, rtol=1e-3, atol=1e-3
+            )
+
+    def test_k_equals_m(self):
+        rng = np.random.default_rng(10)
+        x = rng.normal(size=(4, 2)).astype(np.float32)
+        y = rng.normal(size=(6, 2)).astype(np.float32)
+        dists, idx = ht.spatial.nearest_neighbors(ht.array(x, split=0), ht.array(y), 6)
+        assert np.asarray(idx.numpy()).shape == (4, 6)
+        np.testing.assert_array_equal(
+            np.sort(np.asarray(idx.numpy()), axis=1), np.tile(np.arange(6), (4, 1))
+        )

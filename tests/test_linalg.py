@@ -325,11 +325,19 @@ class TestTiledTSQR(TestCase):
         assert _tile_geometry(a, 1, 8) == (1, 8)
 
     def test_tiles_per_proc_validates(self):
+        """Reference contract: TypeError for non-integral, ValueError for
+        < 1; integer-likes (np.integer) are accepted."""
         a = ht.zeros((16, 4), split=0)
         with pytest.raises(ValueError):
             ht.linalg.qr(a, tiles_per_proc=0)
         with pytest.raises(ValueError):
             ht.linalg.qr(a, tiles_per_proc=-2)
+        with pytest.raises(TypeError):
+            ht.linalg.qr(a, tiles_per_proc=2.5)
+        with pytest.raises(TypeError):
+            ht.linalg.qr(a, tiles_per_proc="2")
+        q, r = ht.linalg.qr(a, tiles_per_proc=np.int64(2))  # integer-like ok
+        assert r.shape == (4, 4)
 
     def test_forced_methods_with_tiles(self):
         rng = np.random.default_rng(13)

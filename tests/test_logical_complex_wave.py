@@ -1,0 +1,205 @@
+"""Logical / relational / complex-math depth wave (reference
+``test_logical.py`` / ``test_relational.py`` / ``test_complex_math.py``):
+predicate families over special float values, tolerance contracts of
+allclose/isclose, reduction semantics of all/any on split arrays, the
+relational broadcast matrix, and the complex accessor quartet.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from tests.base import TestCase
+
+SPECIALS = np.array(
+    [0.0, -0.0, 1.0, -1.0, np.inf, -np.inf, np.nan], dtype=np.float32
+)
+
+
+class TestPredicateFamily(TestCase):
+    def test_special_values_matrix(self):
+        for split in (None, 0):
+            a = ht.array(SPECIALS, split=split)
+            np.testing.assert_array_equal(ht.isnan(a).numpy(), np.isnan(SPECIALS))
+            np.testing.assert_array_equal(ht.isinf(a).numpy(), np.isinf(SPECIALS))
+            np.testing.assert_array_equal(ht.isfinite(a).numpy(), np.isfinite(SPECIALS))
+            np.testing.assert_array_equal(ht.isposinf(a).numpy(), np.isposinf(SPECIALS))
+            np.testing.assert_array_equal(ht.isneginf(a).numpy(), np.isneginf(SPECIALS))
+            np.testing.assert_array_equal(ht.signbit(a).numpy(), np.signbit(SPECIALS))
+
+    def test_signbit_negative_zero(self):
+        """signbit distinguishes -0.0 from 0.0 — sign() cannot."""
+        a = ht.array(np.array([-0.0, 0.0], dtype=np.float32), split=0)
+        got = ht.signbit(a).numpy()
+        np.testing.assert_array_equal(got, [True, False])
+
+    def test_predicates_on_ints(self):
+        x = np.array([-2, 0, 3], dtype=np.int32)
+        a = ht.array(x, split=0)
+        np.testing.assert_array_equal(ht.isnan(a).numpy(), np.isnan(x))
+        np.testing.assert_array_equal(ht.isfinite(a).numpy(), np.isfinite(x))
+        assert ht.isnan(a).dtype == ht.bool
+
+
+class TestAllAnyDepth(TestCase):
+    def test_axis_keepdims_matrix(self):
+        x = np.array([[1, 0, 2], [3, 4, 0]], dtype=np.int32)
+        for split in (None, 0, 1):
+            a = ht.array(x, split=split)
+            np.testing.assert_array_equal(np.asarray(ht.all(a).numpy()), np.all(x))
+            np.testing.assert_array_equal(np.asarray(ht.any(a).numpy()), np.any(x))
+            np.testing.assert_array_equal(ht.all(a, axis=0).numpy(), np.all(x, axis=0))
+            np.testing.assert_array_equal(ht.any(a, axis=1).numpy(), np.any(x, axis=1))
+            np.testing.assert_array_equal(
+                ht.all(a, axis=1, keepdims=True).numpy(), np.all(x, axis=1, keepdims=True)
+            )
+
+    def test_empty_reductions(self):
+        """all([]) is True, any([]) is False (vacuous truth)."""
+        a = ht.array(np.empty((0,), dtype=np.float32))
+        assert bool(np.asarray(ht.all(a).numpy())) is True
+        assert bool(np.asarray(ht.any(a).numpy())) is False
+
+    def test_float_truthiness(self):
+        x = np.array([0.5, -0.0, np.nan], dtype=np.float32)
+        a = ht.array(x, split=0)
+        # nan is truthy, -0.0 is falsy — numpy semantics
+        np.testing.assert_array_equal(np.asarray(ht.any(a).numpy()), np.any(x))
+        np.testing.assert_array_equal(np.asarray(ht.all(a).numpy()), np.all(x))
+
+
+class TestCloseContracts(TestCase):
+    def test_isclose_tolerance_asymmetry(self):
+        """isclose(a, b) uses |a-b| <= atol + rtol*|b| — asymmetric in its
+        operands (numpy contract the reference inherits)."""
+        a = np.array([1.0, 1.001, 100.0], dtype=np.float64)
+        b = np.array([1.0005, 1.0, 100.2], dtype=np.float64)
+        for rtol, atol in [(1e-3, 0.0), (0.0, 1e-3), (1e-5, 1e-8)]:
+            got = ht.isclose(
+                ht.array(a, split=0), ht.array(b, split=0), rtol=rtol, atol=atol
+            ).numpy()
+            np.testing.assert_array_equal(got, np.isclose(a, b, rtol=rtol, atol=atol))
+
+    def test_equal_nan_flag(self):
+        a = np.array([np.nan, 1.0], dtype=np.float32)
+        got = ht.isclose(ht.array(a, split=0), ht.array(a, split=0)).numpy()
+        np.testing.assert_array_equal(got, [False, True])
+        got = ht.isclose(ht.array(a, split=0), ht.array(a, split=0), equal_nan=True).numpy()
+        np.testing.assert_array_equal(got, [True, True])
+
+    def test_allclose_is_scalar_bool(self):
+        a = ht.ones((6, 3), split=0)
+        b = a + 1e-9
+        assert ht.allclose(a, b) in (True, np.True_)
+        assert not ht.allclose(a, a + 1.0)
+
+    def test_allclose_mismatched_splits(self):
+        """allclose across differently-split operands still answers (the
+        binary-op machinery redistributes, reference sanitize_distribution)."""
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        assert ht.allclose(ht.array(x, split=0), ht.array(x, split=None))
+
+
+class TestLogicalConnectives(TestCase):
+    def test_connective_matrix(self):
+        x = np.array([True, True, False, False])
+        y = np.array([True, False, True, False])
+        for split in (None, 0):
+            a, b = ht.array(x, split=split), ht.array(y, split=split)
+            np.testing.assert_array_equal(ht.logical_and(a, b).numpy(), x & y)
+            np.testing.assert_array_equal(ht.logical_or(a, b).numpy(), x | y)
+            np.testing.assert_array_equal(ht.logical_xor(a, b).numpy(), x ^ y)
+            np.testing.assert_array_equal(ht.logical_not(a).numpy(), ~x)
+
+    def test_nonbool_inputs_coerce(self):
+        x = np.array([0.0, 1.5, np.nan], dtype=np.float32)
+        y = np.array([2, 0, 1], dtype=np.int32)
+        got = ht.logical_and(ht.array(x, split=0), ht.array(y, split=0)).numpy()
+        np.testing.assert_array_equal(got, np.logical_and(x, y))
+        assert got.dtype == np.bool_
+
+
+class TestRelationalDepth(TestCase):
+    def test_broadcast_matrix(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        row = np.arange(4, dtype=np.float32) * 2
+        for split in (None, 0, 1):
+            a = ht.array(x, split=split)
+            r = ht.array(row)
+            for hop, nop in [
+                (ht.eq, np.equal), (ht.ne, np.not_equal),
+                (ht.lt, np.less), (ht.le, np.less_equal),
+                (ht.gt, np.greater), (ht.ge, np.greater_equal),
+            ]:
+                np.testing.assert_array_equal(
+                    hop(a, r).numpy(), nop(x, row), err_msg=f"{split} {nop.__name__}"
+                )
+                # scalar operand
+                np.testing.assert_array_equal(hop(a, 5.0).numpy(), nop(x, 5.0))
+
+    def test_equal_global_bool(self):
+        """ht.equal collapses to ONE python bool over the whole array
+        (reference ``relational.py:80`` Allreduce(LAND))."""
+        x = np.arange(10, dtype=np.float32)
+        a = ht.array(x, split=0)
+        assert ht.equal(a, ht.array(x, split=0)) is True or ht.equal(a, ht.array(x, split=0)) == True  # noqa: E712
+        y = x.copy(); y[7] += 1
+        assert not ht.equal(a, ht.array(y, split=0))
+
+    def test_nan_compares_false(self):
+        x = np.array([np.nan, 1.0], dtype=np.float32)
+        a = ht.array(x, split=0)
+        np.testing.assert_array_equal(ht.eq(a, a).numpy(), [False, True])
+        np.testing.assert_array_equal(ht.ne(a, a).numpy(), [True, False])
+
+
+class TestComplexMathDepth(TestCase):
+    def _data(self):
+        return np.array(
+            [1 + 1j, -1 + 1j, -1 - 1j, 1 - 1j, 3 + 0j, 0 + 2j, 0 + 0j],
+            dtype=np.complex64,
+        )
+
+    def test_angle_quadrants_and_deg(self):
+        z = self._data()
+        for split in (None, 0):
+            a = ht.array(z, split=split)
+            np.testing.assert_allclose(ht.angle(a).numpy(), np.angle(z), rtol=1e-6, atol=1e-7)
+            got = ht.angle(a, deg=True).numpy()
+            np.testing.assert_allclose(got, np.degrees(np.angle(z)), rtol=1e-6, atol=1e-5)
+
+    def test_conjugate_and_accessors(self):
+        z = self._data()
+        for split in (None, 0):
+            a = ht.array(z, split=split)
+            np.testing.assert_allclose(ht.conjugate(a).numpy(), np.conj(z), rtol=1e-6)
+            np.testing.assert_allclose(ht.real(a).numpy(), z.real, rtol=1e-6)
+            np.testing.assert_allclose(ht.imag(a).numpy(), z.imag, rtol=1e-6)
+            assert ht.real(a).dtype == ht.float32
+            assert ht.imag(a).dtype == ht.float32
+
+    def test_complex_arithmetic_roundtrip(self):
+        z = self._data()
+        a = ht.array(z, split=0)
+        # |z|^2 == z * conj(z)
+        got = (a * ht.conjugate(a)).numpy()
+        np.testing.assert_allclose(got.real, np.abs(z) ** 2, rtol=1e-6)
+        np.testing.assert_allclose(got.imag, np.zeros_like(z.real), atol=1e-6)
+        # abs of complex is the modulus, dtype drops to real
+        m = ht.abs(a)
+        np.testing.assert_allclose(m.numpy(), np.abs(z), rtol=1e-6)
+
+    def test_complex128_accessors(self):
+        z = self._data().astype(np.complex128)
+        a = ht.array(z, split=0)
+        assert a.dtype == ht.complex128
+        np.testing.assert_allclose(ht.real(a).numpy(), z.real)
+        assert ht.real(a).dtype == ht.float64
+
+    def test_conj_alias_and_method(self):
+        z = self._data()
+        a = ht.array(z, split=0)
+        if hasattr(ht, "conj"):
+            np.testing.assert_allclose(ht.conj(a).numpy(), np.conj(z), rtol=1e-6)

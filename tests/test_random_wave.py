@@ -1,0 +1,194 @@
+"""Random depth wave (reference ``test_random.py``: distribution moments
++ reproducibility across splits): statistical sanity of every
+distribution, the split/padding-invariant stream guarantee on awkward
+shapes, state machine contracts, and permutation properties.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from tests.base import TestCase
+
+
+class TestStreamInvariance(TestCase):
+    def test_split_invariance_shape_matrix(self):
+        """Same seed -> the SAME global stream for every split, including
+        padded non-leading split dims (reference ``random.py:55-201``
+        maps counters to global element offsets)."""
+        for shape, splits in [
+            ((9, 5), (None, 0, 1)),
+            ((64,), (None, 0)),
+            ((3, 4, 7), (None, 0, 1, 2)),
+            ((17, 2), (None, 0, 1)),
+        ]:
+            draws = []
+            for split in splits:
+                ht.random.seed(1234)
+                draws.append(ht.random.rand(*shape, split=split).numpy())
+            for d in draws[1:]:
+                np.testing.assert_array_equal(draws[0], d, err_msg=str(shape))
+
+    def test_dtype_streams_independent_of_split(self):
+        for dt in (ht.float32, ht.float64):
+            ht.random.seed(7)
+            a = ht.random.randn(11, 3, dtype=dt, split=0).numpy()
+            ht.random.seed(7)
+            b = ht.random.randn(11, 3, dtype=dt, split=1).numpy()
+            np.testing.assert_array_equal(a, b)
+
+    def test_sequential_draws_differ(self):
+        ht.random.seed(42)
+        a = ht.random.rand(100, split=0).numpy()
+        b = ht.random.rand(100, split=0).numpy()
+        assert not np.array_equal(a, b)
+
+    def test_counter_advances_in_state(self):
+        ht.random.seed(0)
+        s0 = ht.random.get_state()
+        ht.random.rand(50, split=0)
+        s1 = ht.random.get_state()
+        assert s1[2] > s0[2]
+
+
+class TestStateMachine(TestCase):
+    def test_set_state_reproduces(self):
+        ht.random.seed(99)
+        ht.random.rand(10)
+        state = ht.random.get_state()
+        a = ht.random.randn(20, split=0).numpy()
+        ht.random.set_state(state)
+        b = ht.random.randn(20, split=0).numpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_state_contract_errors(self):
+        with pytest.raises(TypeError):
+            ht.random.set_state("Threefry")
+        with pytest.raises(ValueError):
+            ht.random.set_state(("Philox", 0, 0))
+        with pytest.raises(TypeError):
+            ht.random.set_state(("Threefry", 0))
+        ht.random.set_state(("Threefry", 5, 10))  # 3-tuple form is legal
+        assert ht.random.get_state()[1] == 5
+
+    def test_seed_none_randomizes(self):
+        ht.random.seed()
+        a = ht.random.rand(8).numpy()
+        ht.random.seed()
+        b = ht.random.rand(8).numpy()
+        # astronomically unlikely to collide
+        assert not np.array_equal(a, b)
+
+
+class TestDistributionMoments(TestCase):
+    def test_uniform_bounds_and_moments(self):
+        ht.random.seed(3)
+        x = ht.random.rand(200_0, split=0).numpy()
+        assert (x >= 0).all() and (x < 1).all()
+        assert abs(x.mean() - 0.5) < 0.02
+        assert abs(x.var() - 1 / 12) < 0.01
+
+    def test_uniform_low_high(self):
+        ht.random.seed(4)
+        x = ht.random.uniform(-4.0, 2.0, size=(2000,), split=0).numpy()
+        assert (x >= -4).all() and (x < 2).all()
+        assert abs(x.mean() + 1.0) < 0.1
+
+    def test_normal_moments_and_kundu_sanity(self):
+        ht.random.seed(5)
+        x = ht.random.randn(4000, split=0).numpy()
+        assert abs(x.mean()) < 0.06
+        assert abs(x.std() - 1.0) < 0.05
+        # skewness of a normal sample ~ 0
+        sk = ((x - x.mean()) ** 3).mean() / x.std() ** 3
+        assert abs(sk) < 0.15
+
+    def test_normal_mean_std_args(self):
+        ht.random.seed(6)
+        x = ht.random.normal(10.0, 0.5, shape=(3000,), split=0).numpy()
+        assert abs(x.mean() - 10.0) < 0.05
+        assert abs(x.std() - 0.5) < 0.03
+
+    def test_randint_bounds_dtype_and_coverage(self):
+        ht.random.seed(8)
+        x = ht.random.randint(0, 10, size=(3000,), split=0)
+        xn = x.numpy()
+        assert xn.min() == 0 and xn.max() == 9  # high is exclusive
+        assert set(np.unique(xn)) == set(range(10))
+        # roughly uniform
+        counts = np.bincount(xn, minlength=10)
+        assert counts.min() > 3000 / 10 * 0.6
+
+    def test_randint_single_arg_and_negative_range(self):
+        ht.random.seed(9)
+        x = ht.random.randint(5, size=(500,), split=0).numpy()
+        assert x.min() >= 0 and x.max() <= 4
+        y = ht.random.randint(-3, 4, size=(500,), split=0).numpy()
+        assert y.min() >= -3 and y.max() <= 3
+
+    def test_random_sample_shapeless(self):
+        ht.random.seed(10)
+        s = ht.random.random_sample()
+        v = float(np.asarray(s.numpy()))
+        assert 0.0 <= v < 1.0
+
+
+class TestPermutations(TestCase):
+    def test_randperm_is_permutation(self):
+        for n in (8, 13, 64):
+            ht.random.seed(11)
+            p = ht.random.randperm(n, split=0).numpy()
+            np.testing.assert_array_equal(np.sort(p), np.arange(n))
+
+    def test_randperm_not_identity(self):
+        ht.random.seed(12)
+        p = ht.random.randperm(50, split=0).numpy()
+        assert not np.array_equal(p, np.arange(50))
+
+    def test_permutation_of_int_and_array(self):
+        ht.random.seed(13)
+        p = ht.random.permutation(9)
+        np.testing.assert_array_equal(np.sort(p.numpy()), np.arange(9))
+        x = np.arange(20, dtype=np.float32) * 2
+        ht.random.seed(13)
+        q = ht.random.permutation(ht.array(x, split=0))
+        np.testing.assert_array_equal(np.sort(q.numpy()), np.sort(x))
+
+    def test_permutation_rows_of_2d(self):
+        """numpy contract: permutation of a 2-D array shuffles rows only."""
+        x = np.arange(12, dtype=np.float32).reshape(4, 3)
+        ht.random.seed(14)
+        p = ht.random.permutation(ht.array(x, split=0)).numpy()
+        got_rows = {tuple(r) for r in p}
+        want_rows = {tuple(r) for r in x}
+        assert got_rows == want_rows
+
+    def test_split_invariant_permutation(self):
+        ht.random.seed(15)
+        a = ht.random.randperm(31, split=0).numpy()
+        ht.random.seed(15)
+        b = ht.random.randperm(31, split=None).numpy()
+        np.testing.assert_array_equal(a, b)
+
+
+class TestDtypeSurface(TestCase):
+    def test_float_dtypes(self):
+        for dt in (ht.float32, ht.float64):
+            x = ht.random.rand(10, dtype=dt, split=0)
+            assert x.dtype == dt
+        with pytest.raises(ValueError):
+            ht.random.rand(4, dtype=ht.int32)
+
+    def test_randint_dtypes(self):
+        x = ht.random.randint(0, 100, size=(10,), dtype=ht.int32, split=0)
+        assert x.dtype == ht.int32
+        x = ht.random.randint(0, 100, size=(10,), dtype=ht.int64, split=0)
+        assert x.dtype == ht.int64
+
+    def test_randn_sharding_is_real(self):
+        x = ht.random.randn(16, 4, split=0)
+        assert x.split == 0
+        if x.comm.size > 1:
+            assert not x.larray.sharding.is_fully_replicated

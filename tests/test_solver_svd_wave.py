@@ -1,0 +1,178 @@
+"""Solver/SVD numerical depth wave (reference ``test_solver.py``; SVD is
+beyond-reference — ``/root/reference/heat/core/linalg/svd.py`` is a stub):
+CG against direct solutions across conditioning, Lanczos invariants
+(orthonormality, tridiagonal similarity), the four Moore-Penrose
+conditions for pinv, lstsq vs the numpy oracle, and rsvd error bounds.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from tests.base import TestCase
+
+
+def _spd(n, seed, cond=10.0):
+    rng = np.random.default_rng(seed)
+    q, _ = np.linalg.qr(rng.normal(size=(n, n)))
+    vals = np.linspace(1.0, cond, n)
+    return (q * vals) @ q.T
+
+
+class TestCGDepth(TestCase):
+    def test_matches_direct_solve_matrix(self):
+        for n, split in [(12, 0), (12, None), (9, 0), (16, 1)]:
+            A = _spd(n, seed=n).astype(np.float32)
+            x_true = np.arange(1, n + 1, dtype=np.float32) / n
+            b = (A @ x_true).astype(np.float32)
+            got = ht.linalg.cg(
+                ht.array(A, split=split),
+                ht.array(b, split=0 if split is not None else None),
+                ht.zeros(n, split=0 if split is not None else None),
+            )
+            np.testing.assert_allclose(
+                got.numpy(), x_true, rtol=1e-3, atol=1e-4,
+                err_msg=f"n={n} split={split}",
+            )
+
+    def test_identity_system_one_step(self):
+        n = 8
+        b = np.arange(n, dtype=np.float32)
+        got = ht.linalg.cg(ht.eye(n, split=0), ht.array(b, split=0), ht.zeros(n, split=0))
+        np.testing.assert_allclose(got.numpy(), b, rtol=1e-5, atol=1e-6)
+
+    def test_warm_start_consistency(self):
+        """CG from x0 = exact solution stays at the solution."""
+        n = 10
+        A = _spd(n, seed=1).astype(np.float32)
+        x_true = np.ones(n, dtype=np.float32)
+        b = (A @ x_true).astype(np.float32)
+        got = ht.linalg.cg(ht.array(A, split=0), ht.array(b, split=0), ht.array(x_true, split=0))
+        np.testing.assert_allclose(got.numpy(), x_true, rtol=1e-4, atol=1e-5)
+
+    def test_moderately_ill_conditioned(self):
+        n = 14
+        A = _spd(n, seed=2, cond=1e3).astype(np.float64)
+        x_true = np.sin(np.arange(n)).astype(np.float64)
+        b = A @ x_true
+        got = ht.linalg.cg(ht.array(A, split=0), ht.array(b, split=0), ht.zeros(n, dtype=ht.float64, split=0))
+        np.testing.assert_allclose(got.numpy(), x_true, rtol=1e-5, atol=1e-6)
+
+
+class TestLanczosDepth(TestCase):
+    def test_invariants(self):
+        """V orthonormal, T tridiagonal, and A ~ V T V^T on the Krylov
+        subspace (full m=n run reproduces A's eigenvalues)."""
+        n, m = 10, 10
+        A = _spd(n, seed=3).astype(np.float32)
+        ha = ht.array(A, split=0)
+        V, T = ht.linalg.lanczos(ha, m)
+        Vn, Tn = V.numpy(), T.numpy()
+        assert Vn.shape == (n, m) and Tn.shape == (m, m)
+        np.testing.assert_allclose(Vn.T @ Vn, np.eye(m), atol=2e-2)
+        # T is tridiagonal: everything beyond the first off-diagonals ~ 0
+        mask = np.abs(np.subtract.outer(np.arange(m), np.arange(m))) > 1
+        np.testing.assert_allclose(Tn[mask], 0.0, atol=1e-5)
+        # eigenvalues of T approximate eigenvalues of A
+        ev_a = np.sort(np.linalg.eigvalsh(A))
+        ev_t = np.sort(np.linalg.eigvalsh(Tn))
+        np.testing.assert_allclose(ev_t, ev_a, rtol=5e-2, atol=5e-2)
+
+    def test_extreme_eigenvalue_convergence(self):
+        """m << n Lanczos already nails the extreme eigenvalues."""
+        n = 32
+        A = _spd(n, seed=4, cond=100.0).astype(np.float64)
+        V, T = ht.linalg.lanczos(ht.array(A, split=0), 12)
+        ev_t = np.linalg.eigvalsh(T.numpy())
+        ev_a = np.linalg.eigvalsh(A)
+        np.testing.assert_allclose(ev_t.max(), ev_a.max(), rtol=1e-3)
+        # the small end of the spectrum converges slower; 5% is already
+        # meaningful for m=12 of n=32 at cond=100
+        np.testing.assert_allclose(ev_t.min(), ev_a.min(), rtol=5e-2)
+
+
+class TestSVDDepth(TestCase):
+    def test_reconstruction_matrix(self):
+        rng = np.random.default_rng(5)
+        for shape in [(24, 6), (6, 24), (16, 16)]:
+            x = rng.normal(size=shape).astype(np.float32)
+            for split in (None, 0):
+                u, s, vt = ht.linalg.svd(ht.array(x, split=split))
+                un, sn, vtn = u.numpy(), s.numpy(), vt.numpy()
+                np.testing.assert_allclose(
+                    (un * sn) @ vtn, x, atol=1e-4, err_msg=f"{shape} {split}"
+                )
+                # singular values match numpy's, descending
+                np.testing.assert_allclose(sn, np.linalg.svd(x, compute_uv=False), rtol=1e-4, atol=1e-4)
+                assert (np.diff(sn) <= 1e-6).all()
+
+    def test_compute_uv_false(self):
+        rng = np.random.default_rng(6)
+        x = rng.normal(size=(20, 5)).astype(np.float32)
+        s = ht.linalg.svd(ht.array(x, split=0), compute_uv=False)
+        np.testing.assert_allclose(
+            s.numpy(), np.linalg.svd(x, compute_uv=False), rtol=1e-4, atol=1e-4
+        )
+
+    def test_low_rank_exact(self):
+        """Exact rank-k input: singular values beyond k vanish."""
+        rng = np.random.default_rng(7)
+        a = rng.normal(size=(30, 3)).astype(np.float32)
+        b = rng.normal(size=(3, 8)).astype(np.float32)
+        x = a @ b
+        u, s, vt = ht.linalg.svd(ht.array(x, split=0))
+        sn = s.numpy()
+        assert (sn[3:] < 1e-3 * sn[0]).all()
+
+    def test_rsvd_error_bound(self):
+        """rsvd with oversampling captures a rapidly-decaying spectrum."""
+        rng = np.random.default_rng(8)
+        u0, _ = np.linalg.qr(rng.normal(size=(48, 48)))
+        v0, _ = np.linalg.qr(rng.normal(size=(12, 12)))
+        vals = 2.0 ** -np.arange(12)
+        x = (u0[:, :12] * vals) @ v0.T
+        x = x.astype(np.float32)
+        u, s, vt = ht.linalg.rsvd(ht.array(x, split=0), rank=6, n_oversamples=6)
+        approx = (u.numpy() * s.numpy()) @ vt.numpy()
+        err = np.linalg.norm(x - approx) / np.linalg.norm(x)
+        assert err < 5e-2, err
+
+
+class TestLstsqPinv(TestCase):
+    def test_lstsq_overdetermined(self):
+        rng = np.random.default_rng(9)
+        A = rng.normal(size=(40, 5)).astype(np.float32)
+        b = rng.normal(size=40).astype(np.float32)
+        got = ht.linalg.lstsq(ht.array(A, split=0), ht.array(b, split=0))
+        want = np.linalg.lstsq(A, b, rcond=None)[0]
+        np.testing.assert_allclose(got.numpy(), want, rtol=1e-3, atol=1e-4)
+
+    def test_lstsq_exact_system(self):
+        A = np.eye(6, dtype=np.float32) * 2
+        b = np.arange(6, dtype=np.float32)
+        got = ht.linalg.lstsq(ht.array(A, split=0), ht.array(b, split=0))
+        np.testing.assert_allclose(got.numpy(), b / 2, rtol=1e-5, atol=1e-6)
+
+    def test_pinv_moore_penrose_conditions(self):
+        """All four MP conditions: A A+ A = A, A+ A A+ = A+, and both
+        products Hermitian."""
+        rng = np.random.default_rng(10)
+        for shape in [(12, 5), (5, 12)]:
+            A = rng.normal(size=shape).astype(np.float32)
+            P = ht.linalg.pinv(ht.array(A, split=0)).numpy()
+            np.testing.assert_allclose(A @ P @ A, A, atol=2e-4)
+            np.testing.assert_allclose(P @ A @ P, P, atol=2e-4)
+            np.testing.assert_allclose(A @ P, (A @ P).T, atol=2e-4)
+            np.testing.assert_allclose(P @ A, (P @ A).T, atol=2e-4)
+
+    def test_pinv_rcond_truncates(self):
+        """A tiny singular value is truncated under a loose rcond: the
+        pinv norm stays bounded instead of exploding."""
+        u, _ = np.linalg.qr(np.random.default_rng(11).normal(size=(8, 8)))
+        vals = np.array([1.0, 1.0, 1.0, 1e-8, 1e-8, 1e-8, 1e-8, 1e-8])
+        A = (u * vals) @ u.T
+        A = A.astype(np.float32)
+        P = ht.linalg.pinv(ht.array(A, split=0), rcond=1e-3).numpy()
+        assert np.linalg.norm(P, 2) < 10.0
