@@ -12,6 +12,7 @@ merge QR, and a local back-multiply, expressed in ~40 lines of
 from __future__ import annotations
 
 import collections
+import numbers
 from typing import Optional, Tuple
 
 import jax
@@ -66,8 +67,6 @@ def qr(
         raise ValueError(f"unknown qr method {method!r}")
     # reference contract (`qr.py:79-82`): TypeError for non-integral input
     # (integer-likes such as np.integer are fine), ValueError only for < 1
-    import numbers
-
     if not isinstance(tiles_per_proc, numbers.Integral) or isinstance(tiles_per_proc, bool):
         raise TypeError(f"tiles_per_proc must be an int, got {type(tiles_per_proc)}")
     tiles_per_proc = int(tiles_per_proc)
@@ -217,24 +216,38 @@ def _qr_impl(
         return jnp.linalg.qr(blk)
 
     def _local_factor(block):
-        """(mi, n) local shard -> local (q1, r1) via the tile tree."""
+        """(mi, n) local shard -> local (q1, r1) via the tile tree.
+
+        Full tiles factor as one batch; a ragged tail tile factors
+        separately at its TRUE row count — zero-padding it would make its
+        Gram singular and deterministically trip the batch-level CholQR2
+        fallback (review finding), killing the fast path for every
+        non-divisible mi.
+        """
         if n_tiles <= 1:
             return _factor_block(block, mi)
-        pad = n_tiles * tile_rows - mi
-        blk = jnp.pad(block, ((0, pad), (0, 0)))
-        tiles = blk.reshape(n_tiles, tile_rows, n)
-        if _use_cholqr2(method, tile_rows, n, blk.dtype) and tile_rows >= n:
+        n_full, rem = divmod(mi, tile_rows)
+        tiles = block[: n_full * tile_rows].reshape(n_full, tile_rows, n)
+        if _use_cholqr2(method, tile_rows, n, block.dtype) and tile_rows >= n:
             # one batch-level fallback cond — NOT vmap(_factor_block),
             # whose per-tile cond would select-execute both branches
             q_t, r_t = _cholqr2_batched_with_fallback(tiles)
         else:
             q_t, r_t = jax.vmap(jnp.linalg.qr)(tiles)
-        # q_t: (t, tile_rows, k0), r_t: (t, k0, n)
+        # q_t: (nf, tile_rows, k0), r_t: (nf, k0, n)
         k0 = r_t.shape[1]
-        qm, r1 = jnp.linalg.qr(r_t.reshape(n_tiles * k0, n))  # local merge
+        rs = r_t.reshape(n_full * k0, n)
+        if rem:
+            q_tail, r_tail = _factor_block(block[n_full * tile_rows :], rem)
+            rs = jnp.concatenate([rs, r_tail], axis=0)
+        qm, r1 = jnp.linalg.qr(rs)  # local merge
         k1 = qm.shape[1]
-        q1 = jnp.einsum("tik,tkj->tij", q_t, qm.reshape(n_tiles, k0, k1))
-        return q1.reshape(n_tiles * tile_rows, k1)[:mi], r1
+        q1 = jnp.einsum(
+            "tik,tkj->tij", q_t, qm[: n_full * k0].reshape(n_full, k0, k1)
+        ).reshape(n_full * tile_rows, k1)
+        if rem:
+            q1 = jnp.concatenate([q1, q_tail @ qm[n_full * k0 :]], axis=0)
+        return q1, r1
 
     def _tsqr_local(block):
         block = block.reshape(mi, n)

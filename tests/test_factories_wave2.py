@@ -1,0 +1,192 @@
+"""Factories depth, wave 2 (reference ``test_factories.py``, ~1,000 LoC):
+the array() constructor matrix (nested lists, scalars, copy semantics,
+dtype inference, ndmin-like edge shapes), asarray aliasing, arange
+float-step accumulation, linspace/logspace grids, and is_split
+consistency checks on a single process.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from tests.base import TestCase
+
+
+class TestArrayConstructorMatrix(TestCase):
+    def test_python_nested_lists(self):
+        for data, npdt in [
+            ([1, 2, 3], np.int32),
+            ([[1.5, 2.5], [3.5, 4.5]], np.float32),
+            ([[[1], [2]], [[3], [4]]], np.int32),
+            ([True, False, True], np.bool_),
+        ]:
+            a = ht.array(data)
+            want = np.array(data)
+            assert tuple(a.shape) == want.shape
+            np.testing.assert_array_equal(
+                a.numpy().astype(want.dtype), want, err_msg=str(data)
+            )
+
+    def test_scalar_input(self):
+        a = ht.array(3.5)
+        assert a.ndim == 0
+        assert float(np.asarray(a.numpy())) == 3.5
+        b = ht.array(7)
+        assert b.ndim == 0 and int(np.asarray(b.numpy())) == 7
+
+    def test_dtype_inference_matrix(self):
+        """Python ints -> int32, floats -> float32, bools -> bool
+        (reference scalar-mapping semantics, ``types.py:canonical``)."""
+        assert ht.array([1, 2]).dtype in (ht.int32, ht.int64)
+        assert ht.array([1.0, 2.0]).dtype == ht.float32
+        assert ht.array([True]).dtype == ht.bool
+        assert ht.array(np.array([1, 2], dtype=np.int64)).dtype == ht.int64
+        assert ht.array(np.array([1.0], dtype=np.float64)).dtype == ht.float64
+        assert ht.array(np.array([1 + 2j], dtype=np.complex64)).dtype == ht.complex64
+
+    def test_explicit_dtype_overrides(self):
+        a = ht.array([1, 2, 3], dtype=ht.float64)
+        assert a.dtype == ht.float64
+        np.testing.assert_array_equal(a.numpy(), [1.0, 2.0, 3.0])
+
+    def test_from_existing_dndarray(self):
+        x = ht.arange(6, split=0)
+        y = ht.array(x)
+        np.testing.assert_array_equal(y.numpy(), x.numpy())
+        z = ht.array(x, dtype=ht.float32)
+        assert z.dtype == ht.float32
+
+    def test_copy_independence(self):
+        src = np.arange(4, dtype=np.float32)
+        a = ht.array(src, split=0)
+        src[0] = 99.0
+        assert a.numpy()[0] == 0.0  # constructor snapshot, not a view
+
+    def test_empty_inputs(self):
+        a = ht.array([])
+        assert a.shape == (0,)
+        b = ht.array(np.empty((0, 3), dtype=np.float32), split=0)
+        assert b.shape == (0, 3)
+        assert b.numpy().shape == (0, 3)
+
+    def test_split_out_of_range_raises(self):
+        with pytest.raises((ValueError, IndexError)):
+            ht.array(np.zeros((2, 2)), split=5)
+
+    def test_is_split_single_process_identity(self):
+        """is_split on one process: the local shard IS the global array."""
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        a = ht.array(x, is_split=0)
+        assert a.split == 0
+        np.testing.assert_array_equal(a.numpy(), x)
+
+
+class TestAsarray(TestCase):
+    def test_asarray_passthrough(self):
+        x = ht.arange(5, split=0)
+        assert ht.asarray(x) is x
+
+    def test_asarray_casts(self):
+        y = ht.asarray(np.arange(3, dtype=np.int64))
+        assert isinstance(y, ht.DNDarray)
+        assert y.dtype == ht.int64
+        z = ht.asarray([1.0, 2.0], dtype=ht.float64)
+        assert z.dtype == ht.float64
+
+
+class TestArangeDepth(TestCase):
+    def test_forms_matrix(self):
+        cases = [
+            ((10,), {}),
+            ((2, 10), {}),
+            ((2, 10, 3), {}),
+            ((10, 2, -2), {}),
+            ((0,), {}),
+            ((5, 5), {}),
+        ]
+        for args, kwargs in cases:
+            for split in (None, 0):
+                got = ht.arange(*args, split=split, **kwargs)
+                want = np.arange(*args)
+                np.testing.assert_array_equal(
+                    got.numpy().astype(want.dtype), want, err_msg=f"{args} {split}"
+                )
+
+    def test_float_step(self):
+        got = ht.arange(0, 1, 0.125, split=0)
+        want = np.arange(0, 1, 0.125)
+        np.testing.assert_allclose(got.numpy(), want, rtol=1e-6)
+        assert got.dtype in (ht.float32, ht.float64)
+
+    def test_negative_range_empty(self):
+        got = ht.arange(5, 2)
+        assert got.shape == (0,)
+
+
+class TestGridFactories(TestCase):
+    def test_linspace_matrix(self):
+        for num in (1, 2, 7, 50):
+            for endpoint in (True, False):
+                got = ht.linspace(-2.0, 3.0, num, endpoint=endpoint, split=0)
+                want = np.linspace(-2.0, 3.0, num, endpoint=endpoint)
+                np.testing.assert_allclose(
+                    got.numpy(), want, rtol=1e-5, err_msg=f"{num} {endpoint}"
+                )
+
+    def test_logspace_base_matrix(self):
+        for base in (2.0, 10.0, np.e):
+            got = ht.logspace(0.0, 3.0, 13, base=base, split=0)
+            want = np.logspace(0.0, 3.0, 13, base=base)
+            np.testing.assert_allclose(got.numpy(), want, rtol=1e-4, err_msg=str(base))
+
+    def test_meshgrid_indexing_modes(self):
+        x = np.arange(3, dtype=np.float32)
+        y = np.arange(4, dtype=np.float32)
+        for indexing in ("xy", "ij"):
+            got = ht.meshgrid(ht.array(x), ht.array(y), indexing=indexing)
+            want = np.meshgrid(x, y, indexing=indexing)
+            assert len(got) == len(want)
+            for g, w in zip(got, want):
+                np.testing.assert_array_equal(g.numpy(), w, err_msg=indexing)
+
+    def test_meshgrid_single_and_empty(self):
+        (g,) = ht.meshgrid(ht.arange(4))
+        np.testing.assert_array_equal(g.numpy(), np.arange(4))
+        assert ht.meshgrid() == []
+
+    def test_eye_rectangular(self):
+        for shape in (4, (3, 5), (5, 3)):
+            got = ht.eye(shape, split=0)
+            want = np.eye(shape) if isinstance(shape, int) else np.eye(*shape)
+            np.testing.assert_array_equal(got.numpy(), want, err_msg=str(shape))
+
+
+class TestFullDepth(TestCase):
+    def test_fill_value_forms(self):
+        """Reference contract (``factories.py:789-792``): full() defaults
+        to float32 — the dtype is NEVER inferred from the fill value."""
+        got = ht.full((2, 2), 5)
+        assert got.dtype == ht.float32
+        assert got.numpy().tolist() == [[5.0, 5.0], [5.0, 5.0]]
+        got = ht.full((3,), np.float64(2.5), split=0)
+        np.testing.assert_array_equal(got.numpy(), np.full(3, 2.5, dtype=np.float32))
+        assert ht.full((2,), True).dtype == ht.float32
+        assert ht.full((2,), 1, dtype=ht.bool).dtype == ht.bool
+
+    def test_full_like_inherits_shape_not_dtype(self):
+        """Reference full_like defaults to float32, NOT a.dtype
+        (``factories.py:846-849``) — shape/split inherit, dtype does not."""
+        a = ht.zeros((6, 3), dtype=ht.int32, split=1)
+        b = ht.full_like(a, 9)
+        assert b.split == 1 and b.shape == (6, 3)
+        assert b.dtype == ht.float32
+        c = ht.full_like(a, 9, dtype=ht.int32)
+        assert c.dtype == ht.int32
+        np.testing.assert_array_equal(c.numpy(), np.full((6, 3), 9))
+
+    def test_empty_like_shape_only(self):
+        a = ht.ones((4, 2), split=0)
+        b = ht.empty_like(a)
+        assert b.shape == (4, 2) and b.split == 0

@@ -1,0 +1,195 @@
+"""IO depth, wave 2 (reference ``test_io.py``): CSV option matrix
+(separators, headers, decimals, truncate-overwrite semantics), HDF5
+dataset/mode/dtype matrices, netCDF variable handling, and the load/save
+extension dispatchers.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from tests.base import TestCase
+
+
+class TestCSVOptionMatrix(TestCase):
+    def test_separator_matrix(self, tmp_path=None):
+        import tempfile
+
+        rng = np.random.default_rng(0)
+        x = rng.integers(0, 100, size=(11, 4)).astype(np.float32)
+        with tempfile.TemporaryDirectory() as td:
+            for sep in (",", ";", "\t"):
+                p = os.path.join(td, f"sep_{ord(sep)}.csv")
+                ht.save_csv(ht.array(x, split=0), p, sep=sep)
+                got = ht.load_csv(p, sep=sep, split=0)
+                np.testing.assert_allclose(got.numpy(), x, rtol=1e-5)
+
+    def test_header_lines_roundtrip(self):
+        import tempfile
+
+        x = np.arange(12, dtype=np.float32).reshape(4, 3)
+        with tempfile.TemporaryDirectory() as td:
+            p = os.path.join(td, "hdr.csv")
+            ht.save_csv(ht.array(x, split=0), p, header_lines=["a,b,c", "units,none,none"])
+            with open(p) as fh:
+                lines = fh.read().strip().split("\n")
+            assert lines[0] == "a,b,c" and lines[1] == "units,none,none"
+            got = ht.load_csv(p, header_lines=2, split=0)
+            np.testing.assert_allclose(got.numpy(), x, rtol=1e-5)
+
+    def test_decimals_formatting(self):
+        import tempfile
+
+        x = np.array([[1.23456789, 2.5]], dtype=np.float64)
+        with tempfile.TemporaryDirectory() as td:
+            p = os.path.join(td, "dec.csv")
+            ht.save_csv(ht.array(x), p, decimals=2)
+            with open(p) as fh:
+                row = fh.read().strip()
+            assert row == "1.23,2.50", row
+
+    def test_int_dtype_saved_as_int(self):
+        import tempfile
+
+        x = np.arange(6, dtype=np.int64).reshape(2, 3)
+        with tempfile.TemporaryDirectory() as td:
+            p = os.path.join(td, "int.csv")
+            ht.save_csv(ht.array(x, split=0), p)
+            with open(p) as fh:
+                assert "." not in fh.read()
+            got = ht.load_csv(p, dtype=ht.int64, split=0)
+            assert got.dtype == ht.int64
+            np.testing.assert_array_equal(got.numpy(), x)
+
+    def test_truncate_false_keeps_trailing(self):
+        """Reference semantics: truncate=False overwrites from offset 0
+        but never shortens — stale trailing bytes survive."""
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            p = os.path.join(td, "trunc.csv")
+            big = np.arange(40, dtype=np.float32).reshape(10, 4)
+            small = np.zeros((2, 4), dtype=np.float32)
+            ht.save_csv(ht.array(big, split=0), p)
+            size_before = os.path.getsize(p)
+            ht.save_csv(ht.array(small, split=0), p, truncate=False)
+            assert os.path.getsize(p) == size_before
+            ht.save_csv(ht.array(small, split=0), p, truncate=True)
+            assert os.path.getsize(p) < size_before
+
+    def test_1d_saved_as_column(self):
+        import tempfile
+
+        x = np.arange(5, dtype=np.float32)
+        with tempfile.TemporaryDirectory() as td:
+            p = os.path.join(td, "col.csv")
+            ht.save_csv(ht.array(x, split=0), p)
+            got = ht.load_csv(p, split=0)
+            assert got.shape == (5, 1)
+            np.testing.assert_allclose(got.numpy().ravel(), x, rtol=1e-5)
+
+    def test_load_csv_type_contracts(self):
+        with pytest.raises(TypeError):
+            ht.load_csv(123)
+        with pytest.raises(TypeError):
+            ht.load_csv("/tmp/x.csv", sep=3)
+        with pytest.raises(TypeError):
+            ht.load_csv("/tmp/x.csv", header_lines="2")
+
+
+class TestHDF5Matrix(TestCase):
+    def test_mode_append_multiple_datasets(self):
+        import tempfile
+
+        h5py = pytest.importorskip("h5py")
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        y = x * 2
+        with tempfile.TemporaryDirectory() as td:
+            p = os.path.join(td, "multi.h5")
+            ht.save_hdf5(ht.array(x, split=0), p, "first", mode="w")
+            ht.save_hdf5(ht.array(y, split=0), p, "second", mode="a")
+            with h5py.File(p, "r") as f:
+                assert set(f.keys()) == {"first", "second"}
+            np.testing.assert_allclose(ht.load_hdf5(p, "first", split=0).numpy(), x)
+            np.testing.assert_allclose(ht.load_hdf5(p, "second", split=1).numpy(), y)
+
+    def test_dtype_cast_on_load(self):
+        import tempfile
+
+        x = np.arange(10, dtype=np.float64).reshape(5, 2)
+        with tempfile.TemporaryDirectory() as td:
+            p = os.path.join(td, "cast.h5")
+            ht.save_hdf5(ht.array(x, split=0), p, "d")
+            got = ht.load_hdf5(p, "d", dtype=ht.int32, split=0)
+            assert got.dtype == ht.int32
+            np.testing.assert_array_equal(got.numpy(), x.astype(np.int32))
+
+    def test_3d_split_matrix(self):
+        import tempfile
+
+        x = np.arange(60, dtype=np.float32).reshape(3, 4, 5)
+        with tempfile.TemporaryDirectory() as td:
+            p = os.path.join(td, "cube.h5")
+            ht.save_hdf5(ht.array(x, split=1), p, "cube")
+            for split in (None, 0, 1, 2):
+                got = ht.load_hdf5(p, "cube", split=split)
+                assert got.split == split
+                np.testing.assert_allclose(got.numpy(), x, err_msg=str(split))
+
+    def test_negative_split_sanitized(self):
+        import tempfile
+
+        x = np.arange(20, dtype=np.float32).reshape(4, 5)
+        with tempfile.TemporaryDirectory() as td:
+            p = os.path.join(td, "neg.h5")
+            ht.save_hdf5(ht.array(x), p, "d")
+            got = ht.load_hdf5(p, "d", split=-1)
+            assert got.split == 1
+
+    def test_load_dispatch_by_extension(self):
+        import tempfile
+
+        x = np.arange(8, dtype=np.float32).reshape(2, 4)
+        with tempfile.TemporaryDirectory() as td:
+            p = os.path.join(td, "disp.h5")
+            ht.save(ht.array(x, split=0), p, "data")
+            got = ht.load(p, dataset="data", split=0)
+            np.testing.assert_allclose(got.numpy(), x)
+
+
+class TestNetCDFMatrix(TestCase):
+    def test_variable_roundtrip_splits(self):
+        import tempfile
+
+        x = np.arange(42, dtype=np.float32).reshape(6, 7)
+        with tempfile.TemporaryDirectory() as td:
+            p = os.path.join(td, "var.nc")
+            ht.save_netcdf(ht.array(x, split=0), p, "temp")
+            for split in (None, 0, 1):
+                got = ht.load_netcdf(p, "temp", split=split)
+                assert got.split == split
+                np.testing.assert_allclose(got.numpy(), x)
+
+    def test_missing_variable_raises(self):
+        import tempfile
+
+        x = np.ones((3, 3), dtype=np.float32)
+        with tempfile.TemporaryDirectory() as td:
+            p = os.path.join(td, "mv.nc")
+            ht.save_netcdf(ht.array(x), p, "present")
+            with pytest.raises((KeyError, ValueError)):
+                ht.load_netcdf(p, "absent")
+
+    def test_reference_iris_netcdf_loads(self):
+        """The reference repo's own iris.nc (netCDF-4) must load."""
+        ref = "/root/reference/heat/datasets/iris.nc"
+        if not os.path.exists(ref):
+            pytest.skip("reference dataset not present")
+        got = ht.load_netcdf(ref, "data", split=0)
+        assert got.shape == (150, 4)
+        csv = ht.load_csv("/root/reference/heat/datasets/iris.csv", sep=";", split=0)
+        np.testing.assert_allclose(got.numpy(), csv.numpy(), rtol=1e-5)

@@ -339,6 +339,26 @@ class TestTiledTSQR(TestCase):
         q, r = ht.linalg.qr(a, tiles_per_proc=np.int64(2))  # integer-like ok
         assert r.shape == (4, 4)
 
+    def test_ragged_tail_tile_keeps_fast_path(self):
+        """mi % tile_rows != 0 must NOT trip the batched CholQR2 fallback
+        (review regression: a zero-padded tail tile had a singular Gram,
+        so any(bad) was deterministically true). The tail factors at its
+        true row count; full tiles stay on the fast path — and the result
+        is still an exact factorization."""
+        from heat_tpu.core.linalg.qr import _tile_geometry
+
+        rng = np.random.default_rng(21)
+        # choose a shape whose per-device block does not divide the tile
+        for rows in (72, 88, 104):
+            x = rng.normal(size=(rows, 2)).astype(np.float32)
+            a = ht.array(x, split=0)
+            mi = a.comm.padded_dim(rows) // a.comm.size
+            n_tiles, tile_rows = _tile_geometry(a, 3, mi)
+            if mi % tile_rows == 0:
+                continue  # not the geometry under test
+            q, r = ht.linalg.qr(a, tiles_per_proc=3)
+            self._check(x, q, r)
+
     def test_forced_methods_with_tiles(self):
         rng = np.random.default_rng(13)
         x = rng.normal(size=(80, 4)).astype(np.float32)

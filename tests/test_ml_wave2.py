@@ -1,0 +1,194 @@
+"""ML-layer depth, wave 2 (reference cluster/regression/naive_bayes test
+dirs): graph Laplacian axioms, spectral-embedding clustering accuracy,
+KMeans edge geometries, Lasso regularization-path properties, and
+GaussianNB probability calibration — property-based, numpy-oracled.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from tests.base import TestCase
+
+
+def _blobs(n_per, centers, seed, scale=0.15):
+    rng = np.random.default_rng(seed)
+    pts = [c + scale * rng.normal(size=(n_per, len(c))) for c in centers]
+    X = np.concatenate(pts).astype(np.float32)
+    y = np.repeat(np.arange(len(centers)), n_per)
+    perm = rng.permutation(len(X))
+    return X[perm], y[perm]
+
+
+class TestLaplacianAxioms(TestCase):
+    def _rbf_sim(self, x):
+        return ht.spatial.rbf(x, sigma=1.0)
+
+    def test_simple_laplacian_rowsums_zero(self):
+        """L = D - A: every row of the unnormalized Laplacian sums to 0."""
+        X, _ = _blobs(6, [(0, 0), (3, 3)], seed=0)
+        lap = ht.graph.Laplacian(self._rbf_sim, definition="simple")
+        L = lap.construct(ht.array(X, split=0)).numpy()
+        np.testing.assert_allclose(L.sum(axis=1), 0.0, atol=1e-5)
+        np.testing.assert_allclose(L, L.T, atol=1e-6)
+        # PSD: eigenvalues >= 0
+        ev = np.linalg.eigvalsh(L)
+        assert ev.min() > -1e-5
+
+    def test_norm_sym_eigenvalue_range(self):
+        """Symmetric normalized Laplacian eigenvalues lie in [0, 2]."""
+        X, _ = _blobs(8, [(0, 0), (4, 0)], seed=1)
+        lap = ht.graph.Laplacian(self._rbf_sim, definition="norm_sym")
+        L = lap.construct(ht.array(X, split=0)).numpy()
+        ev = np.linalg.eigvalsh(L)
+        assert ev.min() > -1e-5 and ev.max() < 2 + 1e-5
+        # connected graph: smallest eigenvalue ~ 0
+        assert abs(ev[0]) < 1e-4
+
+    def test_eneighbour_thresholds(self):
+        """eNeighbour prunes edges; upper keeps small-distance/similarity
+        entries per the threshold key contract."""
+        X, _ = _blobs(5, [(0, 0), (10, 10)], seed=2)
+        lap_full = ht.graph.Laplacian(self._rbf_sim, definition="simple", mode="fully_connected")
+        lap_thr = ht.graph.Laplacian(
+            self._rbf_sim, definition="simple", mode="eNeighbour",
+            threshold_key="lower", threshold_value=0.5,
+        )
+        Lf = lap_full.construct(ht.array(X, split=0)).numpy()
+        Lt = lap_thr.construct(ht.array(X, split=0)).numpy()
+        # thresholding can only remove weight: off-diagonal magnitude shrinks
+        offf = np.abs(Lf - np.diag(np.diag(Lf))).sum()
+        offt = np.abs(Lt - np.diag(np.diag(Lt))).sum()
+        assert offt <= offf + 1e-6
+
+    def test_invalid_modes_raise(self):
+        with pytest.raises(NotImplementedError):
+            ht.graph.Laplacian(self._rbf_sim, definition="rw")
+        with pytest.raises(NotImplementedError):
+            ht.graph.Laplacian(self._rbf_sim, mode="kNN")
+
+
+class TestSpectralDepth(TestCase):
+    def test_separates_two_blobs(self):
+        X, y = _blobs(12, [(0, 0), (6, 6)], seed=3)
+        sp = ht.cluster.Spectral(n_clusters=2, gamma=1.0, n_lanczos=20)
+        labels = sp.fit_predict(ht.array(X, split=0)).numpy().ravel()
+        # cluster agreement up to label permutation
+        agree = max(
+            (labels == y).mean(),
+            (labels == 1 - y).mean(),
+        )
+        assert agree > 0.9, agree
+
+
+class TestKMeansEdges(TestCase):
+    def test_single_cluster(self):
+        X, _ = _blobs(10, [(1, 1)], seed=4)
+        km = ht.cluster.KMeans(n_clusters=1, init="random", max_iter=10)
+        km.fit(ht.array(X, split=0))
+        np.testing.assert_allclose(
+            km.cluster_centers_.numpy().ravel(), X.mean(axis=0), rtol=1e-3, atol=1e-3
+        )
+
+    def test_k_equals_n_points(self):
+        rng = np.random.default_rng(5)
+        X = rng.normal(size=(8, 2)).astype(np.float32)
+        km = ht.cluster.KMeans(n_clusters=8, init="random", max_iter=5)
+        km.fit(ht.array(X, split=0))
+        centers = km.cluster_centers_.numpy()
+        assert centers.shape == (8, 2)
+        # every point is (numerically) its own center: inertia ~ 0
+        d = ((X[:, None, :] - centers[None]) ** 2).sum(-1).min(1)
+        assert d.max() < 1e-2
+
+    def test_functional_interface_fit_predict(self):
+        X, y = _blobs(15, [(0, 0), (5, 5)], seed=6)
+        km = ht.cluster.KMeans(n_clusters=2, max_iter=50)
+        labels = km.fit_predict(ht.array(X, split=0)).numpy().ravel()
+        agree = max((labels == y).mean(), (labels == 1 - y).mean())
+        assert agree > 0.95
+
+    def test_predict_new_points(self):
+        X, _ = _blobs(10, [(0, 0), (8, 8)], seed=7)
+        km = ht.cluster.KMeans(n_clusters=2, max_iter=30)
+        km.fit(ht.array(X, split=0))
+        probe = np.array([[0.1, 0.1], [7.9, 7.9]], dtype=np.float32)
+        lp = km.predict(ht.array(probe, split=0)).numpy().ravel()
+        assert lp[0] != lp[1]
+
+
+class TestLassoPath(TestCase):
+    def _data(self, seed=8):
+        """Reference usage pattern: X carries a leading ones column — its
+        weight (theta[0]) is the unregularized intercept, ``coef_`` is
+        theta[1:] (reference lasso demo convention)."""
+        rng = np.random.default_rng(seed)
+        F = rng.normal(size=(60, 5)).astype(np.float32)
+        X = np.concatenate([np.ones((60, 1), np.float32), F], axis=1)
+        w_true = np.array([2.0, -1.5, 0.0, 0.0, 1.0], dtype=np.float32)
+        y = 0.5 + F @ w_true + 0.01 * rng.normal(size=60).astype(np.float32)
+        return X, y, w_true
+
+    def test_regularization_shrinks_coefficients(self):
+        X, y, _ = self._data()
+        norms = []
+        for lam in (0.01, 0.5, 5.0):
+            m = ht.regression.lasso.Lasso(lam=lam, max_iter=200)
+            m.fit(ht.array(X, split=0), ht.array(y, split=0))
+            norms.append(np.abs(m.coef_.numpy()).sum())
+        assert norms[0] > norms[1] > norms[2]
+
+    def test_small_lam_recovers_signal(self):
+        X, y, w_true = self._data()
+        m = ht.regression.lasso.Lasso(lam=0.01, max_iter=500)
+        m.fit(ht.array(X, split=0), ht.array(y, split=0))
+        np.testing.assert_allclose(m.coef_.numpy().ravel(), w_true, atol=0.1)
+        np.testing.assert_allclose(
+            np.asarray(m.intercept_.numpy()).ravel(), [0.5], atol=0.1
+        )
+
+    def test_strong_lam_zeroes_everything(self):
+        X, y, _ = self._data()
+        m = ht.regression.lasso.Lasso(lam=1e4, max_iter=100)
+        m.fit(ht.array(X, split=0), ht.array(y, split=0))
+        np.testing.assert_allclose(m.coef_.numpy(), 0.0, atol=1e-3)
+
+    def test_predict_matches_linear_model(self):
+        X, y, _ = self._data()
+        m = ht.regression.lasso.Lasso(lam=0.05, max_iter=300)
+        m.fit(ht.array(X, split=0), ht.array(y, split=0))
+        pred = m.predict(ht.array(X, split=0)).numpy().ravel()
+        w = m.coef_.numpy().ravel()
+        b = np.asarray(m.intercept_.numpy()).ravel()[0]
+        np.testing.assert_allclose(pred, X[:, 1:] @ w + b, rtol=1e-4, atol=1e-4)
+
+
+class TestGaussianNBCalibration(TestCase):
+    def test_probabilities_sum_to_one(self):
+        X, y = _blobs(12, [(0, 0), (4, 0), (2, 4)], seed=9)
+        nb = ht.naive_bayes.GaussianNB()
+        nb.fit(ht.array(X, split=0), ht.array(y.astype(np.int64), split=0))
+        proba = nb.predict_proba(ht.array(X, split=0)).numpy()
+        np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-4)
+        assert (proba >= 0).all()
+
+    def test_log_proba_consistency(self):
+        X, y = _blobs(10, [(0, 0), (5, 5)], seed=10)
+        nb = ht.naive_bayes.GaussianNB()
+        nb.fit(ht.array(X, split=0), ht.array(y.astype(np.int64), split=0))
+        lp = nb.predict_log_proba(ht.array(X, split=0)).numpy()
+        p = nb.predict_proba(ht.array(X, split=0)).numpy()
+        np.testing.assert_allclose(np.exp(lp), p, rtol=1e-4, atol=1e-5)
+
+    def test_class_priors_reflect_imbalance(self):
+        rng = np.random.default_rng(11)
+        X0 = rng.normal(size=(30, 2)).astype(np.float32)
+        X1 = rng.normal(size=(10, 2)).astype(np.float32) + 6
+        X = np.concatenate([X0, X1])
+        y = np.array([0] * 30 + [1] * 10, dtype=np.int64)
+        nb = ht.naive_bayes.GaussianNB()
+        nb.fit(ht.array(X, split=0), ht.array(y, split=0))
+        priors = np.asarray(nb.class_prior_.numpy()).ravel()
+        np.testing.assert_allclose(priors, [0.75, 0.25], atol=1e-5)
