@@ -223,6 +223,14 @@ def _qr_impl(
         Gram singular and deterministically trip the batch-level CholQR2
         fallback (review finding), killing the fast path for every
         non-divisible mi.
+
+        Mesh-level padding (m % p != 0) is different: the LAST device's
+        block ends in zero rows that can leave a tile with < n valid rows.
+        That is per-device-dynamic (axis_index-dependent), so no static
+        tile partition can exclude it; the batch cond runs per device
+        inside shard_map, so only that one device reroutes to Householder
+        while the rest keep CholQR2 — the correct degradation, not a
+        global loss of the fast path.
         """
         if n_tiles <= 1:
             return _factor_block(block, mi)

@@ -142,6 +142,11 @@ int parse_row(const Line &ln, char sep, T *out, int64_t cols) {
     while (p < fend && (*p == ' ' || *p == '\t')) ++p;
     const char *vend = fend;
     while (vend > p && (vend[-1] == ' ' || vend[-1] == '\t')) --vend;
+    // std::from_chars rejects an explicit leading '+', which Python's
+    // float() (the reference parser, heat/core/io.py:800) accepts; skip it.
+    // Rarer float()-isms (underscore separators, "infinity") still return
+    // -2 here and reach the Python fallback — that fallback stays load-bearing
+    if (p + 1 < vend && *p == '+' && *(p + 1) != '-') ++p;
     double v;
     auto res = std::from_chars(p, vend, v);
     if (res.ec != std::errc() || res.ptr != vend) return -2;

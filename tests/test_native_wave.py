@@ -1,0 +1,244 @@
+"""Native runtime depth, wave 2 (C++ csv/idx/stream extension,
+``heat_tpu/native/``): numeric-format edge cases in the CSV parser,
+range-partition invariants under adversarial boundaries, IDX header
+validation, and FileStream windowing/prefetch behavior.
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from heat_tpu import native
+
+from tests.base import TestCase
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native extension unavailable"
+)
+
+
+def _write(td, name, text):
+    p = os.path.join(td, name)
+    with open(p, "w") as fh:
+        fh.write(text)
+    return p
+
+
+class TestCSVNumericFormats(TestCase):
+    def test_scientific_notation_and_signs(self):
+        """Everything Python float() (the reference parser) accepts must
+        parse natively — including an explicit leading '+', which
+        std::from_chars alone rejects."""
+        with tempfile.TemporaryDirectory() as td:
+            p = _write(td, "sci.csv", "1e3,-2.5E-2,+4.25\n-1e-3,3E2,-0.0\n")
+            got = native.csv_parse(p, dtype=np.float64)
+            assert got is not None
+            want = np.array([[1e3, -2.5e-2, 4.25], [-1e-3, 3e2, -0.0]])
+            np.testing.assert_allclose(got, want, rtol=1e-12)
+            # lone '+' or '+-3' must still be a parse failure, not a zero
+            bad = _write(td, "badplus.csv", "+,1\n2,3\n")
+            assert native.csv_parse(bad, dtype=np.float64) is None
+
+    def test_precision_float64_roundtrip(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(20, 3))
+        with tempfile.TemporaryDirectory() as td:
+            p = os.path.join(td, "prec.csv")
+            with open(p, "w") as fh:
+                for row in x:
+                    fh.write(",".join(f"{v:.17g}" for v in row) + "\n")
+            got = native.csv_parse(p, dtype=np.float64)
+            np.testing.assert_array_equal(got, x)  # bit-exact via 17g
+
+    def test_whitespace_tolerance(self):
+        with tempfile.TemporaryDirectory() as td:
+            p = _write(td, "ws.csv", " 1.5 , 2.5\n3.5,4.5\n")
+            got = native.csv_parse(p, dtype=np.float32)
+            if got is not None:  # whitespace handling is parser-defined...
+                np.testing.assert_allclose(
+                    got, [[1.5, 2.5], [3.5, 4.5]]
+                )  # ...but if parsed, values must be right
+
+    def test_blank_trailing_lines(self):
+        with tempfile.TemporaryDirectory() as td:
+            p = _write(td, "blank.csv", "1,2\n3,4\n\n")
+            got = native.csv_parse(p, dtype=np.float32)
+            assert got is None or got.shape[0] in (2, 3)
+            if got is not None and got.shape[0] == 2:
+                np.testing.assert_allclose(got, [[1, 2], [3, 4]])
+
+    def test_int64_parses_via_float_like_reference(self):
+        """Documented parity: ints parse as f64 then cast — EXACTLY the
+        reference's Python float() pipeline (heat/core/io.py:800-806),
+        including its >2**53 rounding. Values are float(str(v)) rounded."""
+        vals = np.array(
+            [[2**53 + 1, -(2**53) - 1], [123456789012345678, -1]], dtype=np.int64
+        )
+        with tempfile.TemporaryDirectory() as td:
+            p = os.path.join(td, "big.csv")
+            with open(p, "w") as fh:
+                for row in vals:
+                    fh.write(",".join(str(v) for v in row) + "\n")
+            got = native.csv_parse(p, dtype=np.int64)
+            assert got is not None and got.dtype == np.int64
+            want = np.array(
+                [[float(v) for v in row] for row in vals], dtype=np.float64
+            ).astype(np.int64)
+            np.testing.assert_array_equal(got, want)
+            # in-range values stay exact
+            small = _write(td, "small.csv", "123,-456\n0,2147483647\n")
+            np.testing.assert_array_equal(
+                native.csv_parse(small, dtype=np.int64),
+                [[123, -456], [0, 2147483647]],
+            )
+
+    def test_header_lines_skipped(self):
+        with tempfile.TemporaryDirectory() as td:
+            p = _write(td, "hdr.csv", "# a header\nanother,header\n1,2\n3,4\n")
+            got = native.csv_parse(p, header_lines=2, dtype=np.float32)
+            np.testing.assert_allclose(got, [[1, 2], [3, 4]])
+
+
+class TestRangePartitionInvariants(TestCase):
+    def _file(self, td, n_rows=97, cols=3, seed=1):
+        rng = np.random.default_rng(seed)
+        x = rng.integers(0, 1000, size=(n_rows, cols)).astype(np.float64)
+        p = os.path.join(td, "part.csv")
+        with open(p, "w") as fh:
+            for row in x:
+                fh.write(",".join(f"{v:.17g}" for v in row) + "\n")
+        return p, x
+
+    def test_every_partition_covers_exactly(self):
+        """For MANY different partition counts, the per-range row sets are
+        disjoint and their ordered concat equals the file (first-byte
+        ownership invariant the multi-host loader rides on)."""
+        with tempfile.TemporaryDirectory() as td:
+            p, x = self._file(td)
+            fsize = os.path.getsize(p)
+            for nproc in (1, 2, 3, 5, 8):
+                per = -(-fsize // nproc)
+                parts = [
+                    native.csv_parse_range(p, i * per, per, dtype=np.float64)
+                    for i in range(nproc)
+                ]
+                assert all(pt is not None for pt in parts)
+                got = np.concatenate([pt for pt in parts if pt.size], axis=0)
+                np.testing.assert_array_equal(got, x, err_msg=f"nproc={nproc}")
+
+    def test_boundary_exactly_at_newline(self):
+        """A range starting exactly at a row's first byte owns that row."""
+        with tempfile.TemporaryDirectory() as td:
+            p = _write(td, "nb.csv", "1,1\n2,2\n3,3\n")
+            # rows are 4 bytes each: "1,1\n"
+            first = native.csv_parse_range(p, 0, 4, dtype=np.float64)
+            second = native.csv_parse_range(p, 4, 4, dtype=np.float64)
+            third = native.csv_parse_range(p, 8, 4, dtype=np.float64)
+            np.testing.assert_array_equal(first, [[1, 1]])
+            np.testing.assert_array_equal(second, [[2, 2]])
+            np.testing.assert_array_equal(third, [[3, 3]])
+
+    def test_range_to_eof(self):
+        with tempfile.TemporaryDirectory() as td:
+            p, x = self._file(td, n_rows=10)
+            got = native.csv_parse_range(p, 0, -1, dtype=np.float64)
+            np.testing.assert_array_equal(got, x)
+
+    def test_empty_mid_range(self):
+        """A byte range falling strictly inside one row owns nothing."""
+        with tempfile.TemporaryDirectory() as td:
+            p = _write(td, "mid.csv", "11111,22222\n33333,44444\n")
+            got = native.csv_parse_range(p, 2, 3, dtype=np.float64)
+            assert got is None or got.size == 0
+
+
+class TestIdxDepth(TestCase):
+    def _idx(self, td, data, code):
+        import struct
+
+        p = os.path.join(td, "t.idx")
+        with open(p, "wb") as fh:
+            fh.write(struct.pack(">HBB", 0, code, data.ndim))
+            for d in data.shape:
+                fh.write(struct.pack(">i", d))
+            fh.write(data.tobytes())
+        return p
+
+    def test_dtype_code_matrix(self):
+        cases = [
+            (np.uint8, 0x08), (np.int8, 0x09), (np.int16, 0x0B),
+            (np.int32, 0x0C), (np.float32, 0x0D), (np.float64, 0x0E),
+        ]
+        rng = np.random.default_rng(2)
+        with tempfile.TemporaryDirectory() as td:
+            for npdt, code in cases:
+                if np.issubdtype(npdt, np.floating):
+                    data = rng.normal(size=(3, 4)).astype(npdt)
+                else:
+                    info = np.iinfo(npdt)
+                    data = rng.integers(info.min, info.max, size=(3, 4)).astype(npdt)
+                # idx is big-endian on disk
+                p = self._idx(td, data.astype(data.dtype.newbyteorder(">")), code)
+                got = native.idx_read(p)
+                assert got is not None, npdt
+                np.testing.assert_array_equal(got.astype(npdt), data, err_msg=str(npdt))
+
+    def test_3d_shape(self):
+        rng = np.random.default_rng(3)
+        data = rng.integers(0, 255, size=(5, 4, 3)).astype(np.uint8)
+        with tempfile.TemporaryDirectory() as td:
+            p = self._idx(td, data, 0x08)
+            got = native.idx_read(p)
+            np.testing.assert_array_equal(got, data)
+
+    def test_unknown_code_rejected(self):
+        with tempfile.TemporaryDirectory() as td:
+            data = np.zeros((2, 2), np.uint8)
+            p = self._idx(td, data, 0x42)
+            assert native.idx_read(p) is None
+
+    def test_truncated_payload_rejected(self):
+        with tempfile.TemporaryDirectory() as td:
+            data = np.zeros((100, 100), np.uint8)
+            p = self._idx(td, data, 0x08)
+            with open(p, "r+b") as fh:
+                fh.truncate(os.path.getsize(p) // 2)
+            assert native.idx_read(p) is None
+
+
+class TestFileStreamDepth(TestCase):
+    def test_chunk_sizes_and_order(self):
+        payload = bytes(range(256)) * 40  # 10240 bytes
+        with tempfile.TemporaryDirectory() as td:
+            p = os.path.join(td, "s.bin")
+            with open(p, "wb") as fh:
+                fh.write(payload)
+            chunks = []
+            with native.FileStream(p, chunk_bytes=1000, depth=2) as fs:
+                for c in fs:
+                    assert len(c) <= 1000
+                    chunks.append(bytes(c))
+            assert b"".join(chunks) == payload
+
+    def test_window_offset_length(self):
+        payload = b"0123456789" * 100
+        with tempfile.TemporaryDirectory() as td:
+            p = os.path.join(td, "w.bin")
+            with open(p, "wb") as fh:
+                fh.write(payload)
+            with native.FileStream(p, offset=10, length=25, chunk_bytes=7) as fs:
+                got = b"".join(bytes(c) for c in fs)
+            assert got == payload[10:35]
+
+    def test_tiny_chunks_many_buffers(self):
+        payload = os.urandom(511)
+        with tempfile.TemporaryDirectory() as td:
+            p = os.path.join(td, "t.bin")
+            with open(p, "wb") as fh:
+                fh.write(payload)
+            with native.FileStream(p, chunk_bytes=16, depth=8) as fs:
+                got = b"".join(bytes(c) for c in fs)
+            assert got == payload

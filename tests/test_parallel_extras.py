@@ -290,3 +290,132 @@ class TestProfiling(TestCase):
     def test_annotate(self):
         with ht.utils.profiling.annotate("region"):
             pass
+
+
+class TestLongContextGradients(TestCase):
+    """Long-context training is first-class: both sequence-parallel
+    schedules must be exactly differentiable — grads through the ppermute
+    ring / all-to-all reshards equal grads of the dense oracle."""
+
+    def _qkv(self, shape, seed=17):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(seed)
+        mk = lambda: jnp.asarray(rng.normal(size=shape).astype(np.float32))
+        return mk(), mk(), mk()
+
+    def test_ring_attention_grads_match_dense(self):
+        import jax
+        import jax.numpy as jnp
+
+        from heat_tpu.parallel.ring_attention import attention, ring_attention
+
+        comm = ht.get_comm()
+        if comm.size == 1:
+            pytest.skip("needs multi-device mesh")
+        q, k, v = self._qkv((comm.size * 4, 8))
+        for causal in (False, True):
+            g_ring = jax.grad(
+                lambda *a: (ring_attention(*a, comm, causal=causal) ** 2).sum(),
+                argnums=(0, 1, 2),
+            )(q, k, v)
+            g_dense = jax.grad(
+                lambda *a: (attention(*a, causal=causal) ** 2).sum(),
+                argnums=(0, 1, 2),
+            )(q, k, v)
+            for got, want, name in zip(g_ring, g_dense, "qkv"):
+                np.testing.assert_allclose(
+                    np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-4,
+                    err_msg=f"causal={causal} d{name}",
+                )
+
+    def test_ring_attention_grads_non_divisible(self):
+        """Pad-and-trim must be transparent to AD: grads on a sequence
+        length that does not divide the mesh still match dense."""
+        import jax
+
+        from heat_tpu.parallel.ring_attention import attention, ring_attention
+
+        comm = ht.get_comm()
+        if comm.size == 1:
+            pytest.skip("needs multi-device mesh")
+        q, k, v = self._qkv((comm.size * 3 + 1, 4), seed=18)
+        g_ring = jax.grad(
+            lambda *a: (ring_attention(*a, comm, causal=True) ** 2).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        g_dense = jax.grad(
+            lambda *a: (attention(*a, causal=True) ** 2).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for got, want in zip(g_ring, g_dense):
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+            )
+
+    def test_ulysses_grads_match_dense(self):
+        import jax
+        import jax.numpy as jnp
+
+        from heat_tpu.parallel import ulysses_attention
+        from heat_tpu.parallel.ring_attention import attention
+
+        comm = ht.get_comm()
+        if comm.size == 1:
+            pytest.skip("needs multi-device mesh")
+        p = comm.size
+        q, k, v = self._qkv((p * 4, p, 8), seed=19)
+
+        def dense(qq, kk, vv):
+            import jax.numpy as jnp
+
+            out = attention(
+                jnp.moveaxis(qq, 1, 0), jnp.moveaxis(kk, 1, 0), jnp.moveaxis(vv, 1, 0),
+                causal=True,
+            )
+            return (jnp.moveaxis(out, 0, 1) ** 2).sum()
+
+        g_u = jax.grad(
+            lambda *a: (ulysses_attention(*a, comm, causal=True) ** 2).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        g_d = jax.grad(dense, argnums=(0, 1, 2))(q, k, v)
+        for got, want in zip(g_u, g_d):
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+            )
+
+    def test_training_step_through_ring_attention(self):
+        """A real optimization loop through the sequence-parallel kernel:
+        loss must decrease when fitting a toy target."""
+        import jax
+        import jax.numpy as jnp
+
+        from heat_tpu.parallel.ring_attention import ring_attention
+
+        comm = ht.get_comm()
+        if comm.size == 1:
+            pytest.skip("needs multi-device mesh")
+        rng = np.random.default_rng(20)
+        n, d = comm.size * 4, 8
+        x = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+        target = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+        params = {
+            "wq": jnp.eye(d), "wk": jnp.eye(d), "wv": jnp.eye(d),
+        }
+
+        def loss_fn(p):
+            out = ring_attention(x @ p["wq"], x @ p["wk"], x @ p["wv"], comm)
+            return ((out - target) ** 2).mean()
+
+        step = jax.jit(
+            lambda p: jax.tree.map(
+                lambda w, g: w - 0.1 * g, p, jax.grad(loss_fn)(p)
+            )
+        )
+        l0 = float(loss_fn(params))
+        for _ in range(30):
+            params = step(params)
+        l1 = float(loss_fn(params))
+        # random-target attention fit: expect steady descent, not zero
+        assert l1 < 0.8 * l0, (l0, l1)
