@@ -144,12 +144,19 @@ int parse_row(const Line &ln, char sep, T *out, int64_t cols) {
     while (vend > p && (vend[-1] == ' ' || vend[-1] == '\t')) --vend;
     // std::from_chars rejects an explicit leading '+', which Python's
     // float() (the reference parser, heat/core/io.py:800) accepts; skip it.
-    // Rarer float()-isms (underscore separators, "infinity") still return
-    // -2 here and reach the Python fallback — that fallback stays load-bearing
+    // Underscore separators ("1_5") still return -2 here and reach the
+    // Python fallback — that fallback stays load-bearing
     if (p + 1 < vend && *p == '+' && *(p + 1) != '-') ++p;
     double v;
     auto res = std::from_chars(p, vend, v);
     if (res.ec != std::errc() || res.ptr != vend) return -2;
+    if (v != v) {
+      // from_chars accepts "nan(123)" but Python float() raises on the
+      // parenthesized form; divert it so native never parses what the
+      // reference rejects (bare "nan" stays accepted — float() takes it)
+      for (const char *q = p; q < vend; ++q)
+        if (*q == '(') return -2;
+    }
     out[c] = static_cast<T>(v);
     p = fend + 1;
   }

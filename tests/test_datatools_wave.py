@@ -1,0 +1,185 @@
+"""Data tooling + checkpoint depth wave (reference ``test_datatools.py``,
+``test_partial_dataset.py``; checkpointing is beyond-reference): Dataset/
+DataLoader iteration contracts, shuffle determinism and conservation,
+multi-array datasets with transforms, MNIST idx loading, matrix gallery
+properties, and checkpoint round-trip edge cases.
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.utils.data import DataLoader, Dataset
+
+from tests.base import TestCase
+
+
+class TestDatasetContracts(TestCase):
+    def test_len_getitem_single(self):
+        x = np.arange(24, dtype=np.float32).reshape(12, 2)
+        ds = Dataset(ht.array(x, split=0), shuffle=False)
+        assert len(ds) == 12
+        np.testing.assert_array_equal(np.asarray(ds[3]), x[3])
+        np.testing.assert_array_equal(np.asarray(ds[slice(2, 5)]), x[2:5])
+
+    def test_multi_array_alignment(self):
+        x = np.arange(20, dtype=np.float32).reshape(10, 2)
+        y = np.arange(10, dtype=np.int64)
+        ds = Dataset([ht.array(x, split=0), ht.array(y, split=0)], shuffle=False)
+        xi, yi = ds[4]
+        np.testing.assert_array_equal(np.asarray(xi), x[4])
+        assert int(np.asarray(yi)) == 4
+
+    def test_mismatched_sample_axis_raises(self):
+        with pytest.raises(ValueError):
+            Dataset([ht.zeros((5, 2)), ht.zeros((6, 2))])
+
+    def test_transform_applied(self):
+        x = np.ones((6, 3), dtype=np.float32)
+        ds = Dataset(ht.array(x, split=0), transforms=lambda b: b * 10, shuffle=False)
+        np.testing.assert_array_equal(np.asarray(ds[0]), x[0] * 10)
+
+    def test_shuffle_conserves_samples(self):
+        x = np.arange(16, dtype=np.float32).reshape(16, 1)
+        ds = Dataset(ht.array(x, split=0), shuffle=True)
+        ds.shuffle()
+        got = np.sort(np.asarray(ds[slice(0, 16)]).ravel())
+        np.testing.assert_array_equal(got, x.ravel())
+
+    def test_ishuffle_conserves_samples(self):
+        x = np.arange(12, dtype=np.float32).reshape(12, 1)
+        ds = Dataset(ht.array(x, split=0), shuffle=True)
+        ds.ishuffle()
+        got = np.sort(np.asarray(ds[slice(0, 12)]).ravel())
+        np.testing.assert_array_equal(got, x.ravel())
+
+
+class TestDataLoaderContracts(TestCase):
+    def test_batch_count_drop_last_matrix(self):
+        x = ht.array(np.arange(23, dtype=np.float32).reshape(23, 1), split=0)
+        for bs, drop, want in [(4, True, 5), (4, False, 6), (23, True, 1), (1, True, 23)]:
+            dl = DataLoader(x, batch_size=bs, drop_last=drop, shuffle=False)
+            assert len(dl) == want, (bs, drop)
+            batches = list(dl)
+            assert len(batches) == want
+
+    def test_batches_cover_in_order_unshuffled(self):
+        x = np.arange(12, dtype=np.float32).reshape(12, 1)
+        dl = DataLoader(ht.array(x, split=0), batch_size=5, drop_last=False, shuffle=False)
+        got = np.concatenate([np.asarray(b) for b in dl])
+        np.testing.assert_array_equal(got, x)
+
+    def test_first_epoch_unshuffled_then_reshuffles(self):
+        """Reference semantics: shuffle happens at epoch END — the first
+        epoch sees insertion order."""
+        x = np.arange(10, dtype=np.float32).reshape(10, 1)
+        dl = DataLoader(ht.array(x, split=0), batch_size=10, drop_last=False, shuffle=True)
+        first = np.asarray(next(iter(dl)))
+        np.testing.assert_array_equal(first, x)
+        second = np.asarray(next(iter(dl)))
+        np.testing.assert_array_equal(np.sort(second.ravel()), x.ravel())
+
+    def test_type_contract(self):
+        with pytest.raises(TypeError):
+            DataLoader(np.zeros((4, 2)))
+
+
+class TestMNISTAndGallery(TestCase):
+    def test_mnist_dataset_from_idx(self):
+        """MNISTDataset must read idx files (via the native reader or its
+        fallback) into sample-axis datasets."""
+        import struct
+
+        from heat_tpu.utils.data.mnist import MNISTDataset
+
+        rng = np.random.default_rng(0)
+        images = rng.integers(0, 255, size=(32, 4, 4)).astype(np.uint8)
+        labels = rng.integers(0, 10, size=(32,)).astype(np.uint8)
+        with tempfile.TemporaryDirectory() as td:
+            def write_idx(name, data, code):
+                p = os.path.join(td, name)
+                with open(p, "wb") as fh:
+                    fh.write(struct.pack(">HBB", 0, code, data.ndim))
+                    for d in data.shape:
+                        fh.write(struct.pack(">i", d))
+                    fh.write(data.tobytes())
+                return p
+
+            write_idx("train-images-idx3-ubyte", images, 0x08)
+            write_idx("train-labels-idx1-ubyte", labels, 0x08)
+            ds = MNISTDataset(td, train=True, split=0)
+            assert len(ds) == 32
+            img0, lbl0 = ds[0]
+            assert np.asarray(img0).shape[-2:] == (4, 4)
+
+    def test_parter_matrix_properties(self):
+        """parter: a_ij = 1/(j - i + 0.5) — a Cauchy-like test matrix
+        (reference ``matrixgallery.py:15``)."""
+        n = 16
+        a = ht.utils.data.matrixgallery.parter(n, split=0)
+        an = a.numpy()
+        i, j = np.meshgrid(np.arange(n), np.arange(n), indexing="ij")
+        # reference builds 1/(JJ - II + 0.5): II varies along columns
+        np.testing.assert_allclose(an, 1.0 / (j - i + 0.5), rtol=1e-6)
+
+    def test_hermitian_is_hermitian(self):
+        a = ht.utils.data.matrixgallery.hermitian(12, split=0)
+        an = a.numpy()
+        np.testing.assert_allclose(an, an.conj().T, atol=1e-6)
+
+
+class TestCheckpointDepth(TestCase):
+    def test_roundtrip_nested_pytree(self):
+        from heat_tpu.utils.checkpointing import load_checkpoint, save_checkpoint
+
+        state = {
+            "params": {"w": ht.arange(6, split=0), "b": ht.zeros(3)},
+            "step": 7,
+            "nested": [ht.ones((2, 2), split=1), {"x": ht.full((2,), 2.5)}],
+        }
+        with tempfile.TemporaryDirectory() as td:
+            p = os.path.join(td, "ck")
+            save_checkpoint(p, state, step=7)
+            back, step, meta = load_checkpoint(p, like=state)
+            assert step == 7
+            np.testing.assert_array_equal(
+                back["params"]["w"].numpy(), np.arange(6)
+            )
+            np.testing.assert_array_equal(
+                back["nested"][0].numpy(), np.ones((2, 2))
+            )
+
+    def test_split_metadata_restored(self):
+        from heat_tpu.utils.checkpointing import load_checkpoint, save_checkpoint
+
+        state = {"x": ht.arange(13, split=0)}
+        with tempfile.TemporaryDirectory() as td:
+            p = os.path.join(td, "ck2")
+            save_checkpoint(p, state)
+            back, _, _ = load_checkpoint(p, like=state)
+            assert back["x"].split == 0
+            assert back["x"].shape == (13,)
+
+    def test_missing_checkpoint_raises(self):
+        from heat_tpu.utils.checkpointing import load_checkpoint
+
+        with pytest.raises((FileNotFoundError, OSError, ValueError)):
+            load_checkpoint("/nonexistent/path/ck")
+
+    def test_rng_state_travels(self):
+        from heat_tpu.utils.checkpointing import load_checkpoint, save_checkpoint
+
+        ht.random.seed(77)
+        ht.random.rand(5)
+        with tempfile.TemporaryDirectory() as td:
+            p = os.path.join(td, "ck3")
+            save_checkpoint(p, {"x": ht.zeros(2)})
+            a = ht.random.rand(8, split=0).numpy()
+            ht.random.seed(0)  # clobber
+            load_checkpoint(p, like={"x": ht.zeros(2)}, restore_rng=True)
+            b = ht.random.rand(8, split=0).numpy()
+        np.testing.assert_array_equal(a, b)

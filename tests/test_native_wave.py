@@ -42,6 +42,19 @@ class TestCSVNumericFormats(TestCase):
             bad = _write(td, "badplus.csv", "+,1\n2,3\n")
             assert native.csv_parse(bad, dtype=np.float64) is None
 
+    def test_nan_inf_float_parity(self):
+        """Python float() (the reference parser) accepts nan/inf/infinity
+        but RAISES on the parenthesized "nan(123)" form — the native
+        parser must match: never parse what the reference rejects."""
+        with tempfile.TemporaryDirectory() as td:
+            p = _write(td, "ni.csv", "nan,inf\n-inf,infinity\n")
+            got = native.csv_parse(p, dtype=np.float64)
+            assert got is not None
+            assert np.isnan(got[0, 0]) and np.isposinf(got[0, 1])
+            assert np.isneginf(got[1, 0]) and np.isposinf(got[1, 1])
+            bad = _write(td, "nanpar.csv", "nan(123),1\n2,3\n")
+            assert native.csv_parse(bad, dtype=np.float64) is None
+
     def test_precision_float64_roundtrip(self):
         rng = np.random.default_rng(0)
         x = rng.normal(size=(20, 3))

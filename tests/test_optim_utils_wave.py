@@ -1,0 +1,153 @@
+"""Optimizer utils + nn compat depth wave (reference ``optim/utils.py``
+DetectMetricPlateau, ``nn/tests``): the plateau state machine that drives
+DASO's skip decay, and the torch-signature flax module layer.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.optim.utils import DetectMetricPlateau
+
+from tests.base import TestCase
+
+
+class TestDetectMetricPlateau(TestCase):
+    def test_improving_sequence_never_plateaus(self):
+        d = DetectMetricPlateau(patience=2)
+        for v in (10.0, 9.0, 8.0, 7.0, 6.0):
+            assert not d.test_if_improving(v) or v == 10.0  # first call seeds
+
+    def test_plateau_fires_after_patience(self):
+        """Reference contract: the first `patience` bad epochs are
+        IGNORED; the plateau fires on bad epoch patience+1."""
+        d = DetectMetricPlateau(patience=2)
+        d.test_if_improving(5.0)             # seeds best
+        assert not d.test_if_improving(5.0)  # bad epoch 1 (ignored)
+        assert not d.test_if_improving(5.0)  # bad epoch 2 (ignored)
+        assert d.test_if_improving(5.0)      # bad epoch 3 -> plateau
+        # counter resets after firing
+        assert not d.test_if_improving(5.0)
+
+    def test_improvement_resets_counter(self):
+        d = DetectMetricPlateau(patience=1)
+        d.test_if_improving(5.0)
+        assert not d.test_if_improving(5.0)  # bad 1 (ignored)
+        assert not d.test_if_improving(4.0)  # improvement resets counter
+        assert not d.test_if_improving(4.0)  # bad 1 (ignored)
+        assert d.test_if_improving(4.0)      # bad 2 -> plateau
+
+    def test_max_mode(self):
+        d = DetectMetricPlateau(mode="max", patience=0)
+        d.test_if_improving(0.5)
+        assert not d.test_if_improving(0.9)  # higher is better
+        assert d.test_if_improving(0.8)      # worse; patience 0 -> fires
+
+    def test_threshold_modes(self):
+        # rel: must beat best*(1-eps); abs: best-eps
+        d = DetectMetricPlateau(patience=0, threshold=0.1, threshold_mode="rel")
+        d.test_if_improving(100.0)
+        assert d.test_if_improving(95.0)  # not < 90 -> bad; patience 0 fires
+        d2 = DetectMetricPlateau(patience=0, threshold=5.0, threshold_mode="abs")
+        d2.test_if_improving(100.0)
+        assert not d2.test_if_improving(90.0)  # < 95 -> improving
+
+    def test_state_roundtrip(self):
+        d = DetectMetricPlateau(patience=3)
+        d.test_if_improving(5.0)
+        d.test_if_improving(5.0)
+        s = d.get_state()
+        d2 = DetectMetricPlateau(patience=3)
+        d2.set_state(s)
+        assert d2.get_state() == d.get_state()
+        # same future behavior
+        assert d.test_if_improving(5.0) == d2.test_if_improving(5.0)
+
+    def test_reset(self):
+        d = DetectMetricPlateau(patience=1)
+        d.test_if_improving(1.0)
+        d.reset()
+        assert not d.test_if_improving(50.0)  # fresh best
+
+    def test_invalid_mode_rejected(self):
+        with pytest.raises(ValueError):
+            DetectMetricPlateau(mode="sideways")
+
+
+class TestNNCompatLayers(TestCase):
+    def _init_apply(self, mod, x):
+        import jax
+
+        params = mod.init(jax.random.PRNGKey(0), x)
+        return mod.apply(params, x)
+
+    def test_linear_shapes(self):
+        import jax.numpy as jnp
+
+        from heat_tpu import nn
+
+        x = jnp.ones((4, 7))
+        out = self._init_apply(nn.Linear(7, 3), x)
+        assert out.shape == (4, 3)
+
+    def test_conv2d_padding_semantics(self):
+        import jax.numpy as jnp
+
+        from heat_tpu import nn
+
+        x = jnp.ones((2, 8, 8, 3))  # NHWC
+        out = self._init_apply(nn.Conv2d(3, 5, kernel_size=3, padding=1), x)
+        assert out.shape == (2, 8, 8, 5)  # torch padding=1 keeps H,W
+        out = self._init_apply(nn.Conv2d(3, 5, kernel_size=3, padding=0), x)
+        assert out.shape == (2, 6, 6, 5)
+
+    def test_activations_match_jax(self):
+        import jax
+        import jax.numpy as jnp
+
+        from heat_tpu import nn
+
+        x = jnp.linspace(-3, 3, 13)
+        np.testing.assert_allclose(
+            np.asarray(self._init_apply(nn.ReLU(), x)), np.maximum(np.asarray(x), 0)
+        )
+        np.testing.assert_allclose(
+            np.asarray(self._init_apply(nn.Sigmoid(), x)),
+            np.asarray(jax.nn.sigmoid(x)), rtol=1e-6,
+        )
+        sm = np.asarray(self._init_apply(nn.Softmax(dim=-1), x.reshape(1, -1)))
+        np.testing.assert_allclose(sm.sum(), 1.0, rtol=1e-6)
+
+    def test_pooling_shapes(self):
+        import jax.numpy as jnp
+
+        from heat_tpu import nn
+
+        x = jnp.arange(64, dtype=jnp.float32).reshape(1, 8, 8, 1)
+        out = self._init_apply(nn.MaxPool2d(2), x)
+        assert out.shape == (1, 4, 4, 1)
+        # max pool of an increasing ramp picks the bottom-right of each window
+        np.testing.assert_array_equal(
+            np.asarray(out).ravel()[:2], [9.0, 11.0]
+        )
+        out = self._init_apply(nn.AvgPool2d(2), x)
+        assert out.shape == (1, 4, 4, 1)
+
+    def test_flatten(self):
+        import jax.numpy as jnp
+
+        from heat_tpu import nn
+
+        x = jnp.ones((3, 4, 5))
+        out = self._init_apply(nn.Flatten(), x)
+        assert out.shape == (3, 20)
+
+    def test_embedding(self):
+        import jax.numpy as jnp
+
+        from heat_tpu import nn
+
+        ids = jnp.array([[0, 2], [1, 0]])
+        out = self._init_apply(nn.Embedding(5, 8), ids)
+        assert out.shape == (2, 2, 8)

@@ -1,0 +1,214 @@
+"""Higher-rank sweep: 3-D/4-D arrays x every split axis through the core
+op surface. Most depth files exercise 1-D/2-D; the reference's tests
+routinely run 3-D+ (``test_manipulations.py``, ``test_statistics.py``) —
+this wave closes that rank gap with numpy oracles.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from tests.base import TestCase
+
+
+def _data3():
+    return np.arange(3 * 4 * 5, dtype=np.float32).reshape(3, 4, 5) - 25.0
+
+
+def _data4():
+    rng = np.random.default_rng(0)
+    return rng.normal(size=(2, 3, 4, 5)).astype(np.float32)
+
+
+class TestRank3Reductions(TestCase):
+    def test_every_axis_every_split(self):
+        x = _data3()
+        for split in (None, 0, 1, 2):
+            a = ht.array(x, split=split)
+            for axis in (0, 1, 2, -1):
+                np.testing.assert_allclose(
+                    ht.sum(a, axis=axis).numpy(), x.sum(axis=axis), rtol=1e-5,
+                    err_msg=f"sum s={split} ax={axis}",
+                )
+                np.testing.assert_allclose(
+                    ht.mean(a, axis=axis).numpy(), x.mean(axis=axis), rtol=1e-5
+                )
+                np.testing.assert_allclose(
+                    ht.max(a, axis=axis).numpy(), x.max(axis=axis)
+                )
+                np.testing.assert_array_equal(
+                    ht.argmin(a, axis=axis).numpy(), np.argmin(x, axis=axis)
+                )
+
+    def test_cumops_rank3(self):
+        x = _data3()
+        for split in (None, 0, 2):
+            a = ht.array(x, split=split)
+            for axis in (0, 1, 2):
+                np.testing.assert_allclose(
+                    ht.cumsum(a, axis).numpy(), np.cumsum(x, axis), rtol=1e-5,
+                    err_msg=f"s={split} ax={axis}",
+                )
+
+    def test_var_std_rank4(self):
+        x = _data4()
+        for split in (None, 0, 3):
+            a = ht.array(x, split=split)
+            for axis in (0, 2, (1, 3)):
+                np.testing.assert_allclose(
+                    ht.var(a, axis=axis).numpy(), x.var(axis=axis), rtol=1e-3, atol=1e-4,
+                    err_msg=f"s={split} ax={axis}",
+                )
+
+
+class TestRank3Manipulations(TestCase):
+    def test_swap_move_flip(self):
+        x = _data3()
+        for split in (None, 0, 1, 2):
+            a = ht.array(x, split=split)
+            np.testing.assert_array_equal(
+                ht.swapaxes(a, 0, 2).numpy(), np.swapaxes(x, 0, 2)
+            )
+            np.testing.assert_array_equal(
+                ht.moveaxis(a, [0, 1], [1, 0]).numpy(), np.moveaxis(x, [0, 1], [1, 0])
+            )
+            np.testing.assert_array_equal(
+                ht.flip(a, (0, 2)).numpy(), np.flip(x, (0, 2))
+            )
+
+    def test_concatenate_axis2(self):
+        x = _data3()
+        y = x + 100
+        for split in (None, 0, 1, 2):
+            got = ht.concatenate(
+                [ht.array(x, split=split), ht.array(y, split=split)], axis=2
+            )
+            np.testing.assert_array_equal(got.numpy(), np.concatenate([x, y], axis=2))
+
+    def test_reshape_rank_change_matrix(self):
+        x = _data3()
+        for split in (None, 0, 1, 2):
+            a = ht.array(x, split=split)
+            for shp in [(60,), (12, 5), (3, 20), (6, 10), (2, 2, 15)]:
+                np.testing.assert_array_equal(
+                    ht.reshape(a, shp).numpy(), x.reshape(shp),
+                    err_msg=f"s={split} {shp}",
+                )
+
+    def test_stack_unstack_rank3(self):
+        x = _data3()
+        parts = [ht.array(x[i], split=0) for i in range(3)]
+        got = ht.stack(parts, axis=0)
+        np.testing.assert_array_equal(got.numpy(), x)
+
+    def test_pad_rank3(self):
+        x = _data3()
+        w = ((1, 0), (0, 2), (1, 1))
+        for split in (None, 0, 1, 2):
+            got = ht.pad(ht.array(x, split=split), w)
+            np.testing.assert_array_equal(got.numpy(), np.pad(x, w))
+
+    def test_roll_rank3(self):
+        x = _data3()
+        for split in (None, 0, 1, 2):
+            a = ht.array(x, split=split)
+            got = ht.roll(a, (1, -2), axis=(0, 2))
+            np.testing.assert_array_equal(got.numpy(), np.roll(x, (1, -2), axis=(0, 2)))
+
+
+class TestRank3Indexing(TestCase):
+    def test_slice_matrix(self):
+        x = _data3()
+        keys = [
+            (slice(1, 3),),
+            (slice(None), slice(0, 2)),
+            (slice(None), slice(None), slice(1, 4)),
+            (1, slice(None), slice(None)),
+            (slice(None), 2),
+            (Ellipsis, 0),
+            (0, Ellipsis),
+            (slice(None, None, 2), slice(None), slice(None, None, 2)),
+        ]
+        for split in (None, 0, 1, 2):
+            a = ht.array(x, split=split)
+            for key in keys:
+                np.testing.assert_array_equal(
+                    a[key].numpy(), x[key], err_msg=f"s={split} {key}"
+                )
+
+    def test_setitem_matrix(self):
+        x = _data3()
+        for split in (None, 0, 1, 2):
+            for key, val in [
+                ((slice(1, 2),), -1.0),
+                ((slice(None), 1), 7.5),
+                ((2, 3), 0.0),
+                ((slice(None), slice(None), slice(0, 2)), 3.0),
+            ]:
+                a = ht.array(x, split=split)
+                a[key] = val
+                want = x.copy()
+                want[key] = val
+                np.testing.assert_array_equal(
+                    a.numpy(), want, err_msg=f"s={split} {key}"
+                )
+
+    def test_bool_mask_rank3(self):
+        x = _data3()
+        for split in (None, 0):
+            a = ht.array(x, split=split)
+            got = a[ht.array(x > 0)]
+            np.testing.assert_array_equal(np.sort(got.numpy()), np.sort(x[x > 0]))
+
+
+class TestRank4Elementwise(TestCase):
+    def test_binary_broadcast_rank4(self):
+        x = _data4()
+        bias = np.arange(5, dtype=np.float32)
+        for split in (None, 0, 1, 3):
+            a = ht.array(x, split=split)
+            got = a + ht.array(bias)
+            np.testing.assert_allclose(got.numpy(), x + bias, rtol=1e-6)
+            got = a * 2.0 - ht.array(bias)
+            np.testing.assert_allclose(got.numpy(), x * 2 - bias, rtol=1e-6)
+
+    def test_where_rank4(self):
+        x = _data4()
+        for split in (None, 0, 2):
+            a = ht.array(x, split=split)
+            got = ht.where(a > 0, a, ht.zeros_like(a))
+            np.testing.assert_allclose(got.numpy(), np.where(x > 0, x, 0), rtol=1e-6)
+
+    def test_clip_transpose_rank4(self):
+        x = _data4()
+        a = ht.array(x, split=1)
+        np.testing.assert_allclose(
+            a.clip(-0.5, 0.5).numpy(), x.clip(-0.5, 0.5), rtol=1e-6
+        )
+        got = ht.linalg.transpose(a, [3, 1, 2, 0])
+        np.testing.assert_array_equal(got.numpy(), np.transpose(x, (3, 1, 2, 0)))
+        assert got.split == 1  # split tracked through the permutation
+
+
+class TestRank3Sort(TestCase):
+    def test_sort_every_axis(self):
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(4, 5, 6)).astype(np.float32)
+        for split in (None, 0, 1, 2):
+            a = ht.array(x, split=split)
+            for axis in (0, 1, 2):
+                v, i = ht.sort(a, axis=axis)
+                np.testing.assert_array_equal(
+                    v.numpy(), np.sort(x, axis=axis), err_msg=f"s={split} ax={axis}"
+                )
+
+    def test_topk_rank3(self):
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(3, 4, 9)).astype(np.float32)
+        for split in (None, 0):
+            a = ht.array(x, split=split)
+            v, i = ht.topk(a, 3, dim=-1)
+            want = -np.sort(-x, axis=-1)[..., :3]
+            np.testing.assert_allclose(v.numpy(), want, rtol=1e-6)
