@@ -574,11 +574,24 @@ def load_csv(
     if data is None and len(sep) == 1:
         # reference semantics (io.py:800-806): every field parsed with
         # float(), rows of fields -> always 2-D, then cast to the requested
-        # dtype. loadtxt(ndmin=2) matches that exactly (genfromtxt would
-        # collapse single-column files to 1-D and parse ints directly).
-        data = np.loadtxt(
-            path, delimiter=sep, skiprows=header_lines, dtype=np.float64, encoding=encoding, ndmin=2
-        ).astype(np.dtype(dtype.jax_type()))
+        # dtype. loadtxt(ndmin=2) matches that almost exactly; the rare
+        # float()-isms loadtxt rejects (underscore numerals like "1_5")
+        # get a last-resort per-field float() pass for full parity.
+        try:
+            data = np.loadtxt(
+                path, delimiter=sep, skiprows=header_lines, dtype=np.float64, encoding=encoding, ndmin=2
+            ).astype(np.dtype(dtype.jax_type()))
+        except ValueError:
+            with open(path, "r", encoding=encoding) as f:
+                lines = f.read().splitlines()[header_lines:]
+            rows = [
+                [float(field) for field in line.split(sep)]
+                for line in lines
+                if line.strip()
+            ]
+            data = np.array(rows, dtype=np.float64, ndmin=2).astype(
+                np.dtype(dtype.jax_type())
+            )
     elif data is None:
         # multi-character separators: loadtxt rejects them (numpy >= 1.23);
         # parse with line.split(sep) like the reference does

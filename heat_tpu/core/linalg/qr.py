@@ -193,8 +193,12 @@ def _qr_impl(
             q, r = _cholqr2_with_fallback(x)
         else:
             q, r = jnp.linalg.qr(x)
+        # world-size-invariant metadata: split=0 input yields a replicated
+        # R exactly like the distributed TSQR path (the ws=1 degenerate
+        # case must not carry different splits than ws>1)
+        r_split = None if a.split == 0 else a.split
         Q = DNDarray(q, split=a.split, device=a.device, comm=comm) if calc_q else None
-        return QR_out(Q, DNDarray(r, split=a.split, device=a.device, comm=comm))
+        return QR_out(Q, DNDarray(r, split=r_split, device=a.device, comm=comm))
 
     # split == 0: TSQR. The buffer is already tail-padded to a multiple of
     # the mesh size; zero the padding (QR of [A; 0] has the same R and a

@@ -144,8 +144,9 @@ int parse_row(const Line &ln, char sep, T *out, int64_t cols) {
     while (vend > p && (vend[-1] == ' ' || vend[-1] == '\t')) --vend;
     // std::from_chars rejects an explicit leading '+', which Python's
     // float() (the reference parser, heat/core/io.py:800) accepts; skip it.
-    // Underscore separators ("1_5") still return -2 here and reach the
-    // Python fallback — that fallback stays load-bearing
+    // Underscore numerals ("1_5") still return -2 here and reach the
+    // Python fallback, whose last-resort per-field float() pass
+    // (core/io.py load_csv) parses them like the reference
     if (p + 1 < vend && *p == '+' && *(p + 1) != '-') ++p;
     double v;
     auto res = std::from_chars(p, vend, v);

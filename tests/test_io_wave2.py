@@ -92,6 +92,19 @@ class TestCSVOptionMatrix(TestCase):
             assert got.shape == (5, 1)
             np.testing.assert_allclose(got.numpy().ravel(), x, rtol=1e-5)
 
+    def test_underscore_numerals_float_parity(self):
+        """Python float() (the reference parser) accepts "1_5" == 15.0;
+        the native parser punts and the last-resort float() pass in
+        load_csv must parse it identically (review regression)."""
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            p = os.path.join(td, "und.csv")
+            with open(p, "w") as fh:
+                fh.write("1_5,2.5\n3,4_0\n")
+            got = ht.load_csv(p, split=0, dtype=ht.float64)
+            np.testing.assert_array_equal(got.numpy(), [[15.0, 2.5], [3.0, 40.0]])
+
     def test_load_csv_type_contracts(self):
         with pytest.raises(TypeError):
             ht.load_csv(123)

@@ -1,0 +1,200 @@
+"""Statistics depth, wave 3 (toward the reference's ~2,000-LoC
+``test_statistics.py``): closed-form moment identities, percentile
+q-array/axis/keepdim matrices, maximum/minimum broadcast + out=, median
+dtype behavior, and cov parameter interplay.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from tests.base import TestCase
+
+
+class TestMomentIdentities(TestCase):
+    def test_var_equals_moment_identity(self):
+        """var == E[x^2] - E[x]^2 computed through independent ht calls
+        (catches partial-moment merge bugs across shards)."""
+        rng = np.random.default_rng(0)
+        x = rng.normal(loc=5.0, size=257).astype(np.float64)
+        for split in (None, 0):
+            a = ht.array(x, split=split)
+            v = float(np.asarray(ht.var(a).numpy()))
+            ex2 = float(np.asarray(ht.mean(a * a).numpy()))
+            ex = float(np.asarray(ht.mean(a).numpy()))
+            np.testing.assert_allclose(v, ex2 - ex * ex, rtol=1e-10)
+
+    def test_shift_invariance_of_var(self):
+        """var(x + c) == var(x): the pairwise moment merge must not lose
+        precision on shifted data (the classic catastrophic-cancellation
+        trap the reference's __merge_moments avoids)."""
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=200).astype(np.float64)
+        for split in (None, 0):
+            v0 = float(np.asarray(ht.var(ht.array(x, split=split)).numpy()))
+            v1 = float(np.asarray(ht.var(ht.array(x + 1e6, split=split)).numpy()))
+            np.testing.assert_allclose(v0, v1, rtol=1e-6)
+
+    def test_uniform_kurtosis_closed_form(self):
+        """Excess kurtosis of uniform = -1.2; skew = 0 (closed forms)."""
+        rng = np.random.default_rng(2)
+        x = rng.uniform(size=40_000).astype(np.float64)
+        a = ht.array(x, split=0)
+        k = float(np.asarray(ht.kurtosis(a, unbiased=False).numpy()))
+        s = float(np.asarray(ht.skew(a, unbiased=False).numpy()))
+        assert abs(k + 1.2) < 0.05, k
+        assert abs(s) < 0.05, s
+
+    def test_exponential_skew_closed_form(self):
+        """Skewness of Exp(1) = 2."""
+        rng = np.random.default_rng(3)
+        x = rng.exponential(size=60_000).astype(np.float64)
+        s = float(np.asarray(ht.skew(ht.array(x, split=0), unbiased=False).numpy()))
+        assert abs(s - 2.0) < 0.15, s
+
+    def test_mean_weighted_by_average_identity(self):
+        x = np.arange(24, dtype=np.float64).reshape(4, 6)
+        a = ht.array(x, split=0)
+        np.testing.assert_allclose(
+            np.asarray(ht.average(a).numpy()), np.asarray(ht.mean(a).numpy()), rtol=1e-12
+        )
+
+
+class TestPercentileMatrix(TestCase):
+    def test_q_array_forms(self):
+        rng = np.random.default_rng(4)
+        x = rng.normal(size=101).astype(np.float64)
+        a = ht.array(x, split=0)
+        q = [5.0, 25.0, 50.0, 75.0, 95.0]
+        got = ht.percentile(a, q)
+        np.testing.assert_allclose(
+            np.asarray(got.numpy()).ravel(), np.percentile(x, q), rtol=1e-12
+        )
+
+    def test_axis_and_keepdim(self):
+        rng = np.random.default_rng(5)
+        x = rng.normal(size=(7, 9)).astype(np.float64)
+        for split in (None, 0, 1):
+            a = ht.array(x, split=split)
+            for axis in (0, 1):
+                got = ht.percentile(a, 30.0, axis=axis)
+                np.testing.assert_allclose(
+                    got.numpy().ravel(), np.percentile(x, 30.0, axis=axis),
+                    rtol=1e-10, err_msg=f"s={split} ax={axis}",
+                )
+
+    def test_extremes_are_min_max(self):
+        rng = np.random.default_rng(6)
+        x = rng.normal(size=53).astype(np.float64)
+        a = ht.array(x, split=0)
+        np.testing.assert_allclose(np.asarray(ht.percentile(a, 0).numpy()), x.min())
+        np.testing.assert_allclose(np.asarray(ht.percentile(a, 100).numpy()), x.max())
+
+    def test_invalid_q_rejected(self):
+        a = ht.arange(10, split=0)
+        with pytest.raises(ValueError):
+            ht.percentile(a, 101.0)
+        with pytest.raises(ValueError):
+            ht.percentile(a, -0.5)
+
+
+class TestMaximumMinimumDepth(TestCase):
+    def test_broadcast_matrix(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        row = np.full(4, 5.0, dtype=np.float32)
+        for split in (None, 0, 1):
+            a = ht.array(x, split=split)
+            got = ht.maximum(a, ht.array(row))
+            np.testing.assert_array_equal(got.numpy(), np.maximum(x, row))
+            got = ht.minimum(a, 6.0)
+            np.testing.assert_array_equal(got.numpy(), np.minimum(x, 6.0))
+
+    def test_out_kwarg(self):
+        x = np.arange(6, dtype=np.float32)
+        y = x[::-1].copy()
+        a, b = ht.array(x, split=0), ht.array(y, split=0)
+        out = ht.zeros(6, split=0)
+        res = ht.maximum(a, b, out=out)
+        np.testing.assert_array_equal(out.numpy(), np.maximum(x, y))
+
+    def test_int_dtypes(self):
+        x = np.array([3, -7, 2], dtype=np.int64)
+        y = np.array([1, 5, 2], dtype=np.int64)
+        got = ht.maximum(ht.array(x, split=0), ht.array(y, split=0))
+        assert got.dtype == ht.int64
+        np.testing.assert_array_equal(got.numpy(), [3, 5, 2])
+
+
+class TestMedianDepth(TestCase):
+    def test_even_odd_counts(self):
+        for n in (9, 10, 16, 17):
+            x = np.random.default_rng(n).normal(size=n).astype(np.float64)
+            got = np.asarray(ht.median(ht.array(x, split=0)).numpy())
+            np.testing.assert_allclose(got, np.median(x), rtol=1e-12, err_msg=str(n))
+
+    def test_int_input_gives_float_median(self):
+        x = np.array([1, 2, 3, 4], dtype=np.int32)
+        got = np.asarray(ht.median(ht.array(x, split=0)).numpy())
+        np.testing.assert_allclose(got, 2.5)
+
+    def test_median_equals_p50(self):
+        x = np.random.default_rng(7).normal(size=41).astype(np.float64)
+        a = ht.array(x, split=0)
+        np.testing.assert_allclose(
+            np.asarray(ht.median(a).numpy()),
+            np.asarray(ht.percentile(a, 50.0).numpy()),
+            rtol=1e-12,
+        )
+
+
+class TestCovParamMatrix(TestCase):
+    def test_bias_ddof_interplay(self):
+        rng = np.random.default_rng(8)
+        m = rng.normal(size=(4, 30)).astype(np.float64)
+        for split in (None, 1):
+            a = ht.array(m, split=split)
+            np.testing.assert_allclose(
+                ht.cov(a).numpy(), np.cov(m), rtol=1e-8, err_msg="default"
+            )
+            np.testing.assert_allclose(
+                ht.cov(a, bias=True).numpy(), np.cov(m, bias=True), rtol=1e-8
+            )
+            np.testing.assert_allclose(
+                ht.cov(a, ddof=0).numpy(), np.cov(m, ddof=0), rtol=1e-8
+            )
+
+    def test_rowvar_false(self):
+        rng = np.random.default_rng(9)
+        m = rng.normal(size=(25, 3)).astype(np.float64)
+        got = ht.cov(ht.array(m, split=0), rowvar=False)
+        np.testing.assert_allclose(got.numpy(), np.cov(m, rowvar=False), rtol=1e-8)
+
+    def test_1d_input(self):
+        x = np.random.default_rng(10).normal(size=50).astype(np.float64)
+        got = np.asarray(ht.cov(ht.array(x, split=0)).numpy())
+        np.testing.assert_allclose(got, np.cov(x), rtol=1e-8)
+
+
+class TestBincountDigitizeWave3(TestCase):
+    def test_bincount_empty_and_single(self):
+        got = ht.bincount(ht.array(np.array([], dtype=np.int64)))
+        assert got.shape == (0,)
+        got = ht.bincount(ht.array(np.array([5], dtype=np.int64)))
+        np.testing.assert_array_equal(got.numpy(), np.bincount([5]))
+
+    def test_digitize_monotonic_decreasing_bins(self):
+        x = np.array([0.5, 1.5, 2.5], dtype=np.float64)
+        bins = np.array([3.0, 2.0, 1.0])
+        for right in (False, True):
+            got = ht.digitize(ht.array(x, split=0), ht.array(bins), right=right)
+            np.testing.assert_array_equal(
+                got.numpy(), np.digitize(x, bins, right=right), err_msg=str(right)
+            )
+
+    def test_histc_clamps_to_range(self):
+        x = np.array([-5.0, 0.1, 0.5, 0.9, 5.0], dtype=np.float32)
+        got = ht.histc(ht.array(x, split=0), bins=4, min=0.0, max=1.0)
+        # torch semantics: out-of-range values are IGNORED
+        assert int(np.asarray(got.numpy()).sum()) == 3
