@@ -476,6 +476,19 @@ def _rebalance_csv_rows(local: np.ndarray, comm) -> tuple:
     return out, t_lo, n
 
 
+def _float_fields_parse(path, header_lines, sep, encoding, dtype):
+    """Reference-exact CSV row parse: ``line.split(sep)`` + Python
+    ``float()`` per field (``/root/reference/heat/core/io.py:800-806``) —
+    the ONE implementation both the loadtxt-rejected fallback and the
+    multi-character-separator path share."""
+    with open(path, "r", encoding=encoding) as f:
+        lines = f.read().splitlines()[header_lines:]
+    rows = [
+        [float(field) for field in line.split(sep)] for line in lines if line.strip()
+    ]
+    return np.array(rows, dtype=np.float64, ndmin=2).astype(np.dtype(dtype.jax_type()))
+
+
 def load_csv(
     path: str,
     header_lines: int = 0,
@@ -576,31 +589,16 @@ def load_csv(
         # float(), rows of fields -> always 2-D, then cast to the requested
         # dtype. loadtxt(ndmin=2) matches that almost exactly; the rare
         # float()-isms loadtxt rejects (underscore numerals like "1_5")
-        # get a last-resort per-field float() pass for full parity.
+        # get a last-resort pass through the reference-exact parser.
         try:
             data = np.loadtxt(
                 path, delimiter=sep, skiprows=header_lines, dtype=np.float64, encoding=encoding, ndmin=2
             ).astype(np.dtype(dtype.jax_type()))
         except ValueError:
-            with open(path, "r", encoding=encoding) as f:
-                lines = f.read().splitlines()[header_lines:]
-            rows = [
-                [float(field) for field in line.split(sep)]
-                for line in lines
-                if line.strip()
-            ]
-            data = np.array(rows, dtype=np.float64, ndmin=2).astype(
-                np.dtype(dtype.jax_type())
-            )
+            data = _float_fields_parse(path, header_lines, sep, encoding, dtype)
     elif data is None:
-        # multi-character separators: loadtxt rejects them (numpy >= 1.23);
-        # parse with line.split(sep) like the reference does
-        with open(path, "r", encoding=encoding) as f:
-            lines = f.read().splitlines()[header_lines:]
-        rows = [
-            [float(field) for field in line.split(sep)] for line in lines if line.strip()
-        ]
-        data = np.array(rows, dtype=np.float64, ndmin=2).astype(np.dtype(dtype.jax_type()))
+        # multi-character separators: loadtxt rejects them (numpy >= 1.23)
+        data = _float_fields_parse(path, header_lines, sep, encoding, dtype)
     return DNDarray(jnp.asarray(data), dtype=dtype, split=split, device=device, comm=comm)
 
 

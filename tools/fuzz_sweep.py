@@ -508,6 +508,58 @@ def t_proto():
     assert x.tolist() == x.numpy().tolist()
 check("dndarray/protocol", t_proto)
 
+# ------------------------------------------------------------- wave 5 (r4)
+sweep("man/roll +3 ax0", lambda x: ht.roll(x, 3, axis=0), lambda a: np.roll(a, 3, axis=0))
+sweep("man/roll -2 ax1", lambda x: ht.roll(x, -2, axis=1), lambda a: np.roll(a, -2, axis=1))
+sweep("man/roll flat", lambda x: ht.roll(x, 5), lambda a: np.roll(a, 5))
+sweep("man/pad const", lambda x: ht.pad(x, ((1, 2), (0, 1))), lambda a: np.pad(a, ((1, 2), (0, 1))))
+sweep("man/pad edge", lambda x: ht.pad(x, ((1, 1), (1, 1)), mode="edge"), lambda a: np.pad(a, ((1, 1), (1, 1)), mode="edge"))
+sweep("arith/diff ax0", lambda x: ht.diff(x, axis=0), lambda a: np.diff(a, axis=0))
+sweep("arith/diff n2 ax1", lambda x: ht.diff(x, n=2, axis=1), lambda a: np.diff(a, n=2, axis=1))
+sweep("man/repeat flat", lambda x: ht.repeat(x, 2), lambda a: np.repeat(a, 2))
+sweep("man/tile 2x1", lambda x: ht.tile(x, (2, 1)), lambda a: np.tile(a, (2, 1)))
+sweep("man/fliplr", lambda x: ht.fliplr(x), lambda a: np.fliplr(a))
+sweep("man/flipud", lambda x: ht.flipud(x), lambda a: np.flipud(a))
+sweep("man/rot90 k2", lambda x: ht.rot90(x, 2), lambda a: np.rot90(a, 2))
+sweep("man/diag off1", lambda x: ht.diag(x, 1), lambda a: np.diag(a, 1))
+sweep("round/clip", lambda x: x.clip(-1, 1), lambda a: a.clip(-1, 1))
+sweep("round/round d2", lambda x: ht.round(x, decimals=2), lambda a: np.round(a, 2), rtol=1e-6)
+sweep("round/sign", lambda x: ht.sign(x), lambda a: np.sign(a))
+sweep("trig/sinc", lambda x: ht.sinc(x), lambda a: np.sinc(a), rtol=1e-4)
+sweep("exp/logaddexp self", lambda x: ht.logaddexp(x, x), lambda a: np.logaddexp(a, a), rtol=1e-5)
+sweep("arith/copysign self-neg", lambda x: ht.copysign(x, -x), lambda a: np.copysign(a, -a))
+sweep("arith/hypot", lambda x: ht.hypot(x, x), lambda a: np.hypot(a, a), rtol=1e-5)
+sweep("stat/median ax0", lambda x: ht.median(x, axis=0), lambda a: np.median(a, axis=0), rtol=1e-5)
+sweep("stat/ptp-ish max-min", lambda x: ht.max(x, axis=1) - ht.min(x, axis=1), lambda a: a.max(axis=1) - a.min(axis=1))
+sweep("linalg/vecdot ax0", lambda x: ht.linalg.vecdot(x, x, axis=0), lambda a: (a * a).sum(0), rtol=1e-4)
+sweep("man/broadcast_to", lambda x: ht.broadcast_to(x, (2,) + tuple(x.shape)), lambda a: np.broadcast_to(a, (2,) + a.shape))
+sweep("logic/signbit", lambda x: ht.signbit(x), lambda a: np.signbit(a))
+sweep(
+    "man/unique sorted",
+    lambda x: ht.sort(ht.unique(x))[0],
+    lambda a: np.unique(a),
+    dtypes=("int32",),
+)
+
+
+def t_modf_wave():
+    a = (rng.random((5, 4)) * 6 - 3).astype("float32")
+    for sp in (None, 0, 1):
+        f, w = ht.modf(ht.array(a, split=sp))
+        nf, nw = np.modf(a)
+        cmp(f"round/modf frac split={sp}", f, nf, rtol=1e-6)
+        cmp(f"round/modf whole split={sp}", w, nw, rtol=1e-6)
+check("round/modf", t_modf_wave)
+
+
+def t_outer_wave():
+    v = rng.random(9).astype("float32")
+    w = rng.random(6).astype("float32")
+    for sv in (None, 0):
+        got = ht.linalg.outer(ht.array(v, split=sv), ht.array(w))
+        cmp(f"linalg/outer split={sv}", got, np.outer(v, w), rtol=1e-5)
+check("linalg/outer", t_outer_wave)
+
 print()
 print("=" * 70)
 print(f"{len(FAILURES)} failures")
