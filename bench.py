@@ -499,15 +499,38 @@ def update_history(out, suspect=frozenset()):
         if v > rec.get("best_median", 0) and k not in suspect:
             rec["best_median"] = v
         best_median_deltas[k] = round(v / rec.get("best_median", v), 3)
-        # the GATE baseline is the trailing median of prior runs, not the
-        # best-ever median: honest medians swing up to ~2x between tunneled
-        # chip allocations (matmul history spans 17-50 TFLOP/s), so a
+        # the GATE baseline is the trailing median of prior CLEAN runs
+        # (runs that passed their own gate), not the best-ever median:
+        # honest medians swing up to ~2x between tunneled chip
+        # allocations (matmul history spans 17-50 TFLOP/s), so a
         # 0.7x-of-best floor would fail a healthy run on a slower chip.
-        # A trailing median tracks the sustained band; real regressions
-        # (everything sinking) still trip it.
-        prior = rec["runs"][:-1][-9:]
+        # Violating runs are kept out of the baseline window — otherwise
+        # a sustained regression would drag the median down to itself
+        # within a few runs and the gate would self-normalize. If three
+        # consecutive violations agree within 15% the new level is
+        # accepted as a re-baseline (a persistent environment change,
+        # e.g. a permanently slower chip) — after failing visibly three
+        # times, not silently.
+        clean = rec.get("clean")
+        if clean is None:
+            clean = rec["runs"][:-1][-9:]  # migrate: prior history assumed clean
+        prior = clean[-9:]
         baseline = sorted(prior)[len(prior) // 2] if prior else v
-        gate_deltas[k] = round(min(v / baseline, 9.999), 3)
+        gate = round(min(v / baseline, 9.999), 3)
+        gate_deltas[k] = gate
+        pending = rec.get("pending_violations", [])
+        if gate >= FLOOR:
+            if k not in suspect:  # corrupted timers never move the baseline
+                clean = (clean + [v])[-20:]
+            pending = []
+        elif k not in suspect:  # corrupted timers cannot vote to rebaseline either
+            pending = (pending + [v])[-3:]
+            if len(pending) == 3 and max(pending) <= 1.15 * min(pending):
+                clean = list(pending)  # the new sustained level IS the baseline now
+                rec["rebaselined_at"] = v
+                pending = []
+        rec["clean"] = clean
+        rec["pending_violations"] = pending
     hist["_floor_deltas"] = gate_deltas  # informational in the file
     try:
         with open(HISTORY_PATH, "w") as fh:

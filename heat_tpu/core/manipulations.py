@@ -335,7 +335,43 @@ def redistribute(arr: DNDarray, lshape_map=None, target_map=None) -> DNDarray:
 def repeat(a: DNDarray, repeats, axis: Optional[int] = None) -> DNDarray:
     """Repeat elements (reference ``manipulations.py``)."""
     if isinstance(repeats, DNDarray):
-        repeats = repeats._logical()
+        # the reference rejects non-integer DNDarray repeats with a clear
+        # error instead of surfacing the backend's shape-dtype complaint
+        if not (
+            types.issubdtype(repeats.dtype, types.integer)
+            or repeats.dtype is types.bool
+        ):
+            raise TypeError(
+                f"invalid dtype for repeats: {repeats.dtype.__name__}, must be integer"
+            )
+        if repeats.ndim != 1:
+            raise ValueError(
+                f"repeats must be a 1d-object or integer, but was {repeats.ndim}-dimensional"
+            )
+        if repeats.gshape[0] == 0:
+            raise ValueError("repeats must contain data")
+        repeats = repeats._logical().astype(jnp.int64)
+    elif isinstance(repeats, (list, tuple, np.ndarray)):
+        # the reference accepts sequence repeats (torch.repeat_interleave)
+        # — integers and booleans — but rejects floats/strings rather
+        # than truncating them
+        arr = np.asarray(repeats)
+        if arr.size == 0:
+            raise ValueError("repeats must contain data")
+        if arr.ndim != 1:
+            raise ValueError(
+                f"repeats must be a 1d-object or integer, but was {arr.ndim}-dimensional"
+            )
+        # bool counts as integer; uint64 does not (values >= 2**63 would
+        # wrap negative under the int64 cast)
+        if not (
+            arr.dtype == np.bool_
+            or (np.issubdtype(arr.dtype, np.integer) and np.can_cast(arr.dtype, np.int64))
+        ):
+            raise TypeError(
+                f"all components of repeats must be integers, got {arr.dtype}"
+            )
+        repeats = jnp.asarray(arr.astype(np.int64))
     result = jnp.repeat(a._logical(), repeats, axis=axis)
     if axis is None:
         split = 0 if a.split is not None else None

@@ -32,11 +32,11 @@ def get_printoptions() -> dict:
 def set_printoptions(precision=None, threshold=None, edgeitems=None, linewidth=None, profile=None, sci_mode=None):
     """Configure printing (reference ``printing.py:150``)."""
     if profile == "default":
-        __PRINT_OPTIONS.update(precision=4, threshold=1000, edgeitems=3, linewidth=120)
+        __PRINT_OPTIONS.update(precision=4, threshold=1000, edgeitems=3, linewidth=120, sci_mode=None)
     elif profile == "short":
-        __PRINT_OPTIONS.update(precision=2, threshold=1000, edgeitems=2, linewidth=120)
+        __PRINT_OPTIONS.update(precision=2, threshold=1000, edgeitems=2, linewidth=120, sci_mode=None)
     elif profile == "full":
-        __PRINT_OPTIONS.update(precision=4, threshold=float("inf"), edgeitems=3, linewidth=120)
+        __PRINT_OPTIONS.update(precision=4, threshold=float("inf"), edgeitems=3, linewidth=120, sci_mode=None)
     for key, value in dict(
         precision=precision, threshold=threshold, edgeitems=edgeitems, linewidth=linewidth, sci_mode=sci_mode
     ).items():
@@ -62,6 +62,78 @@ def print0(*args, **kwargs) -> None:
 
     if jax.process_index() == 0:
         print(*args, **kwargs)
+
+
+def _edge_data(dndarray, edgeitems: int) -> np.ndarray:
+    """Bounded gather for summarized printing (reference
+    ``printing.py:208-265``: when the output will be ellipsed, only the
+    ``edgeitems + 1`` head/tail slices of each large axis travel to rank
+    0, never the full array).
+
+    TPU-native shape of the same idea: slice the logical (sharded) array
+    device-side — the head/tail of the split axis touch only the first
+    and last shards, XLA moves at most ``2 * (edgeitems + 1)`` rows per
+    axis — and transfer just that reduced block to the host. Axes no
+    longer than ``2 * edgeitems + 2`` are kept whole (numpy's own
+    summarizer prints short axes in full, so the edges line up exactly
+    with what formatting the full array would have shown)."""
+    import jax
+    import jax.numpy as jnp
+
+    data = dndarray._logical()
+    for axis, extent in enumerate(dndarray.gshape):
+        if extent <= 2 * edgeitems + 2:
+            continue
+        head = [slice(None)] * data.ndim
+        tail = [slice(None)] * data.ndim
+        head[axis] = slice(0, edgeitems + 1)
+        tail[axis] = slice(extent - (edgeitems + 1), extent)
+        data = jnp.concatenate([data[tuple(head)], data[tuple(tail)]], axis=axis)
+    if not getattr(data, "is_fully_addressable", True):
+        # multi-process: replicate the (small) edge block so every
+        # process can format it — the only cross-host traffic of the
+        # whole print (reference gathers the same slices, printing.py:259).
+        # device_put reshards without tracing, so repeated prints don't
+        # recompile anything.
+        comm = dndarray.comm
+        data = jax.device_put(data, comm.sharding(data.ndim, None))
+        data = data.addressable_shards[0].data
+    return np.asarray(jax.device_get(data))
+
+
+def _array2string(data: np.ndarray, opts: dict, force_summary: bool = False) -> str:
+    """numpy formatting honoring ``sci_mode`` (reference
+    ``printing.py:150-182``: ``None`` lets the formatter decide, ``True``
+    forces scientific notation, ``False`` suppresses it)."""
+    threshold = opts["threshold"] if np.isfinite(opts["threshold"]) else data.size + 1
+    if force_summary:
+        # the caller already reduced each large axis to its edge slices;
+        # force the summarizer on so numpy emits the "..." separators
+        threshold = max(data.size - 1, 0)
+    kwargs = dict(
+        precision=opts["precision"],
+        threshold=threshold,
+        edgeitems=opts["edgeitems"],
+        linewidth=opts["linewidth"],
+    )
+    if opts.get("sci_mode") is True:
+        precision = opts["precision"]
+
+        def _sci(x):
+            return np.format_float_scientific(x, precision=precision)
+
+        kwargs["formatter"] = {
+            "float_kind": _sci,
+            # numpy consults complex_kind for complex floats — torch's
+            # sci_mode applies there too
+            "complex_kind": lambda z: (
+                f"{_sci(z.real)}{'+' if z.imag >= 0 else '-'}{_sci(abs(z.imag))}j"
+            ),
+        }
+    elif opts.get("sci_mode") is False:
+        kwargs["suppress"] = True
+    with np.printoptions(**kwargs):
+        return np.array2string(data, separator=", ", prefix="DNDarray(")
 
 
 def __str__(dndarray) -> str:
@@ -91,15 +163,16 @@ def __str__(dndarray) -> str:
                 data = data[tuple(sl)]
         else:
             data = np.asarray(ordered[0].data)
+        body = _array2string(data, opts)
     else:
-        data = np.asarray(dndarray.numpy())
-    with np.printoptions(
-        precision=opts["precision"],
-        threshold=opts["threshold"] if np.isfinite(opts["threshold"]) else data.size + 1,
-        edgeitems=opts["edgeitems"],
-        linewidth=opts["linewidth"],
-    ):
-        body = np.array2string(data, separator=", ", prefix="DNDarray(")
+        size = int(np.prod(dndarray.gshape)) if dndarray.gshape else 1
+        summarize = np.isfinite(opts["threshold"]) and size > opts["threshold"]
+        if summarize and dndarray.split is not None:
+            # ellipsed output: gather only the edge slices (reference
+            # ``printing.py:208`` gathers edgeitems+1 per axis, not all)
+            body = _array2string(_edge_data(dndarray, opts["edgeitems"]), opts, force_summary=True)
+        else:
+            body = _array2string(np.asarray(dndarray.numpy()), opts)
     return (
         f"DNDarray({body}, dtype=ht.{dndarray.dtype.__name__}, "
         f"device={dndarray.device}, split={dndarray.split})"

@@ -1,0 +1,95 @@
+"""The bench floor gate must not self-normalize a sustained regression.
+
+``bench.update_history`` keeps the gate baseline as the trailing median
+of runs that themselves passed the gate; violating runs stay out of the
+window (else a regression drags the median to itself within a few runs
+and the 0.7x floor goes silent). Three consecutive violations agreeing
+within 15% re-baseline — a persistent environment change is accepted
+only after failing visibly three times.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench
+
+
+def _gate(value):
+    out = {
+        "value": value,
+        "cdist_gbps": None,
+        "moments_gbps": None,
+        "qr_gflops": None,
+        "matmul_gflops": None,
+        "lasso_sweeps_per_sec": None,
+    }
+    return bench.update_history(out)[2]["kmeans_iters_per_sec"]
+
+
+def _with_history(tmp_path, name):
+    bench.HISTORY_PATH = str(tmp_path / name)
+
+
+def test_sustained_regression_keeps_failing(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "HISTORY_PATH", str(tmp_path / "h.json"))
+    for v in (100, 105, 98, 102, 101):
+        assert _gate(v) >= bench.FLOOR
+    # a drop to half speed must violate on EVERY run until re-baselined,
+    # not launder itself into the trailing median
+    gates = [_gate(v) for v in (50, 52, 50)]
+    assert all(g < bench.FLOOR for g in gates), gates
+
+
+def test_rebaseline_after_three_agreeing_violations(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "HISTORY_PATH", str(tmp_path / "h.json"))
+    for v in (100, 105, 98):
+        _gate(v)
+    for v in (50, 52, 50):
+        _gate(v)
+    # the new sustained level is now the baseline: an honest run at that
+    # level passes, and a further regression below it fails again
+    assert _gate(51) >= bench.FLOOR
+    assert _gate(30) < bench.FLOOR
+
+
+def test_single_dip_does_not_move_baseline(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "HISTORY_PATH", str(tmp_path / "h.json"))
+    for v in (100, 105, 98):
+        _gate(v)
+    assert _gate(60) < bench.FLOOR
+    # recovery compares against the healthy window, not the dip
+    assert _gate(99) >= bench.FLOOR
+
+
+def test_suspect_runs_cannot_rebaseline(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "HISTORY_PATH", str(tmp_path / "h.json"))
+    for v in (100, 102, 98):
+        _gate(v)
+    # three agreeing low runs, all flagged as timer-corrupted: they must
+    # not install themselves as the baseline
+    out = {
+        "value": 50,
+        "cdist_gbps": None,
+        "moments_gbps": None,
+        "qr_gflops": None,
+        "matmul_gflops": None,
+        "lasso_sweeps_per_sec": None,
+    }
+    for _ in range(3):
+        bench.update_history(dict(out), suspect={"kmeans_iters_per_sec"})
+    # an honest run at the old level still passes against the old baseline
+    assert _gate(99) >= bench.FLOOR
+    # and an honest run at the low level still violates (no rebaseline)
+    assert _gate(50) < bench.FLOOR
+
+
+def test_disagreeing_violations_do_not_rebaseline(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "HISTORY_PATH", str(tmp_path / "h.json"))
+    for v in (100, 102, 98):
+        _gate(v)
+    # three violations spanning >15% disagree — noise, not a new level
+    gates = [_gate(v) for v in (50, 65, 50, 50)]
+    assert all(g < bench.FLOOR for g in gates[:3])

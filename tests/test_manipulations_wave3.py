@@ -1,0 +1,370 @@
+"""Manipulations depth, wave 3 (toward the reference's 3,625-LoC
+``test_manipulations.py``): the section-splitters (``split``/``vsplit``/
+``hsplit``/``dsplit``) over both section counts and index lists, pad-width
+and constant-value forms, roll over multi-axis shift/axis tuples, repeat
+with array repeats, topk corner cases, tile/broadcast sweeps, and
+``balance``/``row_stack``/``column_stack`` metadata — all against numpy,
+at every applicable split.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from tests.base import TestCase
+
+SPLITS2 = (None, 0, 1)
+SPLITS3 = (None, 0, 1, 2)
+
+
+def _mk(shape, split, seed=0, dtype=np.float32):
+    x = (np.arange(int(np.prod(shape)), dtype=dtype) % 23).reshape(shape)
+    return ht.array(x, split=split), x
+
+
+class TestSectionSplitters(TestCase):
+    """Reference ``manipulations.py`` splitters accept an int (equal
+    sections, error when not divisible — numpy semantics for ``split``)
+    or a 1-D index list (arbitrary section boundaries)."""
+
+    def test_split_sections_int(self):
+        for split in SPLITS2:
+            a, x = _mk((12, 5), split)
+            outs = ht.split(a, 3, axis=0)
+            wants = np.split(x, 3, axis=0)
+            assert len(outs) == 3
+            for o, w in zip(outs, wants):
+                np.testing.assert_array_equal(o.numpy(), w, err_msg=f"split={split}")
+
+    def test_split_index_list(self):
+        for split in SPLITS2:
+            a, x = _mk((11, 4), split)
+            outs = ht.split(a, [2, 5, 9], axis=0)
+            wants = np.split(x, [2, 5, 9], axis=0)
+            assert len(outs) == len(wants) == 4
+            for o, w in zip(outs, wants):
+                np.testing.assert_array_equal(o.numpy(), w)
+
+    def test_split_axis1_and_negative_axis(self):
+        for split in SPLITS2:
+            a, x = _mk((4, 12), split)
+            for sections, axis in ((4, 1), (3, -1)):
+                outs = ht.split(a, sections, axis=axis)
+                wants = np.split(x, sections, axis=axis)
+                for o, w in zip(outs, wants):
+                    np.testing.assert_array_equal(o.numpy(), w)
+
+    def test_split_indivisible_raises(self):
+        a, _ = _mk((10, 3), 0)
+        with pytest.raises(ValueError):
+            ht.split(a, 3, axis=0)
+
+    def test_vsplit_hsplit_dsplit(self):
+        for split in SPLITS3:
+            a, x = _mk((4, 6, 2), split)
+            for houts, nouts in (
+                (ht.vsplit(a, 2), np.vsplit(x, 2)),
+                (ht.hsplit(a, 3), np.hsplit(x, 3)),
+                (ht.dsplit(a, 2), np.dsplit(x, 2)),
+            ):
+                for o, w in zip(houts, nouts):
+                    np.testing.assert_array_equal(o.numpy(), w, err_msg=f"split={split}")
+
+    def test_hsplit_1d_uses_axis0(self):
+        # numpy: hsplit on 1-D splits axis 0
+        a, x = _mk((12,), 0)
+        for o, w in zip(ht.hsplit(a, 4), np.hsplit(x, 4)):
+            np.testing.assert_array_equal(o.numpy(), w)
+
+    def test_sections_with_index_arrays(self):
+        for split in SPLITS2:
+            a, x = _mk((3, 10), split)
+            for o, w in zip(ht.hsplit(a, [3, 7]), np.hsplit(x, [3, 7])):
+                np.testing.assert_array_equal(o.numpy(), w)
+
+
+class TestPadForms(TestCase):
+    """Reference ``manipulations.py:1128``: pad accepts scalar, pair, and
+    per-axis pair lists; constant mode takes matching constant_values."""
+
+    def test_scalar_width(self):
+        for split in SPLITS2:
+            a, x = _mk((5, 4), split)
+            got = ht.pad(a, 2)
+            np.testing.assert_array_equal(got.numpy(), np.pad(x, 2), err_msg=f"split={split}")
+
+    def test_pair_width_pads_last_dim(self):
+        # heat semantics (torch F.pad): a flat (before, after) pair
+        # applies to the LAST dimension only
+        for split in SPLITS2:
+            a, x = _mk((5, 4), split)
+            got = ht.pad(a, (1, 3))
+            np.testing.assert_array_equal(got.numpy(), np.pad(x, ((0, 0), (1, 3))))
+
+    def test_per_axis_pairs(self):
+        for split in SPLITS2:
+            a, x = _mk((5, 4), split)
+            got = ht.pad(a, ((0, 2), (3, 1)))
+            np.testing.assert_array_equal(got.numpy(), np.pad(x, ((0, 2), (3, 1))))
+
+    def test_constant_values(self):
+        for split in SPLITS2:
+            a, x = _mk((4, 3), split)
+            got = ht.pad(a, ((1, 1), (0, 2)), constant_values=7)
+            want = np.pad(x, ((1, 1), (0, 2)), constant_values=7)
+            np.testing.assert_array_equal(got.numpy(), want)
+
+    def test_3d_split2(self):
+        a, x = _mk((2, 3, 8), 2)
+        got = ht.pad(a, ((0, 0), (1, 0), (2, 3)))
+        np.testing.assert_array_equal(got.numpy(), np.pad(x, ((0, 0), (1, 0), (2, 3))))
+        assert got.split == 2
+
+
+class TestRollDepth(TestCase):
+    def test_flat_roll_no_axis(self):
+        for split in SPLITS2:
+            a, x = _mk((5, 6), split)
+            for shift in (0, 1, -4, 13, -29, 30):
+                got = ht.roll(a, shift)
+                np.testing.assert_array_equal(
+                    got.numpy(), np.roll(x, shift), err_msg=f"split={split} shift={shift}"
+                )
+
+    def test_multi_axis_tuples(self):
+        for split in SPLITS2:
+            a, x = _mk((5, 6), split)
+            for shift, axis in (((1, 2), (0, 1)), ((-2, 5), (1, 0)), ((7, -7), (0, 0))):
+                got = ht.roll(a, shift, axis)
+                np.testing.assert_array_equal(
+                    got.numpy(), np.roll(x, shift, axis), err_msg=f"{shift},{axis}"
+                )
+
+    def test_split_axis_shift_preserves_metadata(self):
+        a, x = _mk((13, 3), 0)
+        got = ht.roll(a, 5, 0)
+        np.testing.assert_array_equal(got.numpy(), np.roll(x, 5, 0))
+        assert got.split == 0 and got.gshape == a.gshape
+
+    def test_scalar_shift_tuple_axis_broadcasts(self):
+        a, x = _mk((4, 6), 1)
+        got = ht.roll(a, 2, (0, 1))
+        np.testing.assert_array_equal(got.numpy(), np.roll(x, 2, (0, 1)))
+
+
+class TestRepeatDepth(TestCase):
+    def test_scalar_repeats_flat(self):
+        for split in SPLITS2:
+            a, x = _mk((3, 4), split)
+            got = ht.repeat(a, 3)
+            np.testing.assert_array_equal(got.numpy(), np.repeat(x, 3))
+
+    def test_scalar_repeats_axis(self):
+        for split in SPLITS2:
+            a, x = _mk((3, 4), split)
+            for axis in (0, 1):
+                got = ht.repeat(a, 2, axis)
+                np.testing.assert_array_equal(got.numpy(), np.repeat(x, 2, axis))
+
+    def test_array_repeats_axis(self):
+        for split in SPLITS2:
+            a, x = _mk((3, 4), split)
+            reps = [1, 0, 2]
+            got = ht.repeat(a, reps, axis=0)
+            np.testing.assert_array_equal(got.numpy(), np.repeat(x, reps, axis=0))
+
+    def test_bool_repeats_accepted(self):
+        # reference semantics: booleans are valid repeats (cast to int)
+        a, x = _mk((3,), 0)
+        got = ht.repeat(a, [True, False, True], axis=0)
+        np.testing.assert_array_equal(got.numpy(), np.repeat(x, [1, 0, 1], axis=0))
+
+    def test_float_repeats_rejected(self):
+        a, _ = _mk((2,), None)
+        with pytest.raises(TypeError):
+            ht.repeat(a, [1.9, 2.9])
+        with pytest.raises(TypeError):
+            ht.repeat(a, ht.array([1.5, 2.5]))
+
+    def test_dndarray_repeats(self):
+        a, x = _mk((3,), 0)
+        got = ht.repeat(a, ht.array([2, 1, 0]), axis=0)
+        np.testing.assert_array_equal(got.numpy(), np.repeat(x, [2, 1, 0], axis=0))
+
+    def test_repeats_sanitation_edges(self):
+        a, _ = _mk((3,), None)
+        with pytest.raises(ValueError, match="contain data"):
+            ht.repeat(a, [])
+        with pytest.raises(ValueError, match="1d-object"):
+            ht.repeat(a, np.array([[1, 2, 3]]))
+        with pytest.raises(TypeError):
+            ht.repeat(a, np.array([1, 2**63], dtype=np.uint64))
+        # uint8/16/32 cast safely and are fine
+        got = ht.repeat(a, np.array([2, 0, 1], dtype=np.uint8), axis=0)
+        assert got.shape == (3,)
+
+    def test_zero_repeats(self):
+        a, x = _mk((5,), 0)
+        got = ht.repeat(a, 0)
+        assert got.shape == (0,)
+        np.testing.assert_array_equal(got.numpy(), np.repeat(x, 0))
+
+
+class TestTopkDepth(TestCase):
+    def test_largest_smallest_rows(self):
+        rng = np.random.default_rng(3)
+        x = rng.permutation(60).reshape(6, 10).astype(np.float32)
+        for split in SPLITS2:
+            a = ht.array(x, split=split)
+            for largest in (True, False):
+                vals, idx = ht.topk(a, 4, dim=1, largest=largest)
+                order = np.argsort(-x if largest else x, axis=1)[:, :4]
+                want = np.take_along_axis(x, order, 1)
+                np.testing.assert_array_equal(vals.numpy(), want, err_msg=f"{split},{largest}")
+                np.testing.assert_array_equal(
+                    np.take_along_axis(x, idx.numpy(), 1), want
+                )
+
+    def test_k_equals_extent(self):
+        x = np.array([[3.0, 1.0, 2.0]], np.float32)
+        vals, idx = ht.topk(ht.array(x, split=1), 3, dim=1)
+        np.testing.assert_array_equal(vals.numpy(), [[3.0, 2.0, 1.0]])
+        np.testing.assert_array_equal(idx.numpy(), [[0, 2, 1]])
+
+    def test_split_axis_topk(self):
+        rng = np.random.default_rng(5)
+        x = rng.permutation(37).astype(np.float32)
+        vals, idx = ht.topk(ht.array(x, split=0), 5, dim=0)
+        np.testing.assert_array_equal(vals.numpy(), np.sort(x)[::-1][:5])
+        np.testing.assert_array_equal(x[idx.numpy()], vals.numpy())
+
+    def test_k_validation(self):
+        a, _ = _mk((4,), 0)
+        with pytest.raises(ValueError):
+            ht.topk(a, 5)
+
+
+class TestTileBroadcast(TestCase):
+    def test_tile_reps_forms(self):
+        for split in SPLITS2:
+            a, x = _mk((3, 4), split)
+            for reps in (2, (2,), (2, 3), (2, 1, 3)):
+                got = ht.tile(a, reps)
+                np.testing.assert_array_equal(
+                    got.numpy(), np.tile(x, reps), err_msg=f"split={split} reps={reps}"
+                )
+
+    def test_broadcast_to_sweep(self):
+        a, x = _mk((1, 4), None)
+        got = ht.broadcast_to(a, (3, 4))
+        np.testing.assert_array_equal(got.numpy(), np.broadcast_to(x, (3, 4)))
+        b = ht.array(np.arange(5, dtype=np.float32), split=0)
+        got = ht.broadcast_to(b, (2, 5))
+        np.testing.assert_array_equal(got.numpy(), np.broadcast_to(np.arange(5, dtype=np.float32), (2, 5)))
+
+    def test_broadcast_arrays_pair(self):
+        a = ht.array(np.arange(12, dtype=np.float32).reshape(3, 4), split=0)
+        b = ht.array(np.arange(4, dtype=np.float32), split=0)
+        oa, ob = ht.broadcast_arrays(a, b)
+        assert oa.shape == ob.shape == (3, 4)
+        na, nb = np.broadcast_arrays(
+            np.arange(12, dtype=np.float32).reshape(3, 4), np.arange(4, dtype=np.float32)
+        )
+        np.testing.assert_array_equal(oa.numpy(), na)
+        np.testing.assert_array_equal(ob.numpy(), nb)
+
+
+class TestStackFamilies(TestCase):
+    def test_row_stack_mixed_ranks(self):
+        x1 = np.arange(4, dtype=np.float32)
+        x2 = np.arange(8, dtype=np.float32).reshape(2, 4)
+        for split in (None, 0):
+            got = ht.row_stack([ht.array(x1, split=split), ht.array(x2, split=split)])
+            np.testing.assert_array_equal(got.numpy(), np.vstack([x1, x2]))
+
+    def test_column_stack_mixed_ranks(self):
+        x1 = np.arange(3, dtype=np.float32)
+        x2 = np.arange(6, dtype=np.float32).reshape(3, 2)
+        for split in (None, 0):
+            got = ht.column_stack([ht.array(x1, split=split), ht.array(x2, split=split)])
+            np.testing.assert_array_equal(got.numpy(), np.column_stack([x1, x2]))
+
+    def test_stack_new_axis_positions(self):
+        for split in SPLITS2:
+            a, x = _mk((3, 4), split, 1)
+            b, y = _mk((3, 4), split, 2)
+            for axis in (0, 1, 2, -1):
+                got = ht.stack([a, b], axis=axis)
+                np.testing.assert_array_equal(
+                    got.numpy(), np.stack([x, y], axis=axis), err_msg=f"{split},{axis}"
+                )
+
+    def test_hstack_vstack_1d(self):
+        x = np.arange(5, dtype=np.float32)
+        y = np.arange(5, 9, dtype=np.float32)
+        got = ht.hstack([ht.array(x, split=0), ht.array(y, split=0)])
+        np.testing.assert_array_equal(got.numpy(), np.hstack([x, y]))
+        got = ht.vstack([ht.array(x, split=0), ht.array(x, split=0)])
+        np.testing.assert_array_equal(got.numpy(), np.vstack([x, x]))
+
+
+class TestBalanceDepth(TestCase):
+    def test_balance_restores_canonical_map(self):
+        p = ht.get_comm().size
+        if p < 2:
+            pytest.skip("needs >1 device")
+        x = ht.arange(4 * p + 3, dtype=ht.float32, split=0)
+        canonical = x.lshape_map.copy()
+        skew = np.zeros((p, 1), dtype=np.int64)
+        skew[0, 0] = int(x.gshape[0])  # everything on shard 0
+        x.redistribute_(target_map=skew)
+        assert not x.is_balanced()
+        x.balance_()
+        assert x.is_balanced()
+        np.testing.assert_array_equal(x.lshape_map, canonical)
+        np.testing.assert_array_equal(x.numpy(), np.arange(4 * p + 3, dtype=np.float32))
+
+    def test_balance_copy_leaves_original(self):
+        p = ht.get_comm().size
+        if p < 2:
+            pytest.skip("needs >1 device")
+        x = ht.arange(2 * p + 1, dtype=ht.float32, split=0)
+        skew = np.zeros((p, 1), dtype=np.int64)
+        skew[-1, 0] = int(x.gshape[0])
+        x.redistribute_(target_map=skew)
+        y = ht.balance(x, copy=True)
+        assert y.is_balanced()
+        assert not x.is_balanced()
+        np.testing.assert_array_equal(y.numpy(), x.numpy())
+
+    def test_balanced_noop(self):
+        x = ht.arange(16, dtype=ht.float32, split=0)
+        assert x.is_balanced()
+        x.balance_()
+        assert x.is_balanced()
+
+
+class TestFlipRot(TestCase):
+    def test_flip_axis_combinations(self):
+        for split in SPLITS3:
+            a, x = _mk((3, 4, 2), split)
+            for axis in (None, 0, 1, 2, (0, 1), (0, 2), (0, 1, 2), -1):
+                got = ht.flip(a, axis)
+                np.testing.assert_array_equal(
+                    got.numpy(), np.flip(x, axis), err_msg=f"split={split} axis={axis}"
+                )
+
+    def test_fliplr_flipud(self):
+        for split in SPLITS2:
+            a, x = _mk((4, 5), split)
+            np.testing.assert_array_equal(ht.fliplr(a).numpy(), np.fliplr(x))
+            np.testing.assert_array_equal(ht.flipud(a).numpy(), np.flipud(x))
+
+    def test_rot90_k_sweep(self):
+        for split in SPLITS2:
+            a, x = _mk((3, 5), split)
+            for k in (-1, 0, 1, 2, 3, 4):
+                got = ht.rot90(a, k)
+                np.testing.assert_array_equal(got.numpy(), np.rot90(x, k), err_msg=f"k={k}")
