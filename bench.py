@@ -522,7 +522,10 @@ def update_history(out, suspect=frozenset()):
         if gate >= FLOOR:
             if k not in suspect:  # corrupted timers never move the baseline
                 clean = (clean + [v])[-20:]
-            pending = []
+                # a suspect run that happens to pass must not reset the
+                # three-consecutive-violation rebaseline vote either:
+                # corrupted timers neither vote for nor against
+                pending = []
         elif k not in suspect:  # corrupted timers cannot vote to rebaseline either
             pending = (pending + [v])[-3:]
             if len(pending) == 3 and max(pending) <= 1.15 * min(pending):

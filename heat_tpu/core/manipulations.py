@@ -355,23 +355,38 @@ def repeat(a: DNDarray, repeats, axis: Optional[int] = None) -> DNDarray:
         # the reference accepts sequence repeats (torch.repeat_interleave)
         # — integers and booleans — but rejects floats/strings rather
         # than truncating them
-        arr = np.asarray(repeats)
+        # the reference's sanitation order differs per container: for an
+        # np.ndarray the DTYPE is checked first (can_cast to int64), so an
+        # empty or 2-D float ndarray raises TypeError; for a list/tuple a
+        # per-element isinstance(int) check runs first, which an empty
+        # list vacuously passes (ValueError "contain data" follows)
+        if isinstance(repeats, np.ndarray):
+            # bool casts safely to int64; uint64 does not (values >= 2**63
+            # would wrap negative under the int64 cast)
+            if not np.can_cast(repeats.dtype, np.int64):
+                raise TypeError(
+                    f"all components of repeats must be integers, got {repeats.dtype}"
+                )
+            arr = repeats
+        else:
+            # strict Python-int check like the reference's list branch
+            # (numpy scalars fail isinstance(r, int) there too); bools are
+            # int subclasses and accepted
+            if not all(isinstance(r, int) for r in repeats):
+                raise TypeError("all components of repeats must be integers")
+            try:
+                arr = np.asarray(repeats, dtype=np.int64)
+            except OverflowError:
+                raise TypeError(
+                    "all components of repeats must be integers representable as int64"
+                ) from None
         if arr.size == 0:
             raise ValueError("repeats must contain data")
         if arr.ndim != 1:
             raise ValueError(
                 f"repeats must be a 1d-object or integer, but was {arr.ndim}-dimensional"
             )
-        # bool counts as integer; uint64 does not (values >= 2**63 would
-        # wrap negative under the int64 cast)
-        if not (
-            arr.dtype == np.bool_
-            or (np.issubdtype(arr.dtype, np.integer) and np.can_cast(arr.dtype, np.int64))
-        ):
-            raise TypeError(
-                f"all components of repeats must be integers, got {arr.dtype}"
-            )
-        repeats = jnp.asarray(arr.astype(np.int64))
+        repeats = jnp.asarray(arr.astype(np.int64, copy=False))
     result = jnp.repeat(a._logical(), repeats, axis=axis)
     if axis is None:
         split = 0 if a.split is not None else None

@@ -32,16 +32,20 @@ def get_printoptions() -> dict:
 def set_printoptions(precision=None, threshold=None, edgeitems=None, linewidth=None, profile=None, sci_mode=None):
     """Configure printing (reference ``printing.py:150``)."""
     if profile == "default":
-        __PRINT_OPTIONS.update(precision=4, threshold=1000, edgeitems=3, linewidth=120, sci_mode=None)
+        __PRINT_OPTIONS.update(precision=4, threshold=1000, edgeitems=3, linewidth=120)
     elif profile == "short":
-        __PRINT_OPTIONS.update(precision=2, threshold=1000, edgeitems=2, linewidth=120, sci_mode=None)
+        __PRINT_OPTIONS.update(precision=2, threshold=1000, edgeitems=2, linewidth=120)
     elif profile == "full":
-        __PRINT_OPTIONS.update(precision=4, threshold=float("inf"), edgeitems=3, linewidth=120, sci_mode=None)
+        __PRINT_OPTIONS.update(precision=4, threshold=float("inf"), edgeitems=3, linewidth=120)
     for key, value in dict(
-        precision=precision, threshold=threshold, edgeitems=edgeitems, linewidth=linewidth, sci_mode=sci_mode
+        precision=precision, threshold=threshold, edgeitems=edgeitems, linewidth=linewidth
     ).items():
         if value is not None:
             __PRINT_OPTIONS[key] = value
+    # torch semantics (the reference delegates to torch.set_printoptions,
+    # which resets sci_mode to auto on EVERY non-profile call unless the
+    # caller passes it explicitly) — assign unconditionally
+    __PRINT_OPTIONS["sci_mode"] = sci_mode
 
 
 def local_printing() -> None:

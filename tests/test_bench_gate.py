@@ -17,7 +17,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import bench
 
 
-def _gate(value):
+def _gate(value, suspect=frozenset()):
     out = {
         "value": value,
         "cdist_gbps": None,
@@ -26,7 +26,7 @@ def _gate(value):
         "matmul_gflops": None,
         "lasso_sweeps_per_sec": None,
     }
-    return bench.update_history(out)[2]["kmeans_iters_per_sec"]
+    return bench.update_history(out, suspect=suspect)[2]["kmeans_iters_per_sec"]
 
 
 def _with_history(tmp_path, name):
@@ -70,20 +70,28 @@ def test_suspect_runs_cannot_rebaseline(tmp_path, monkeypatch):
         _gate(v)
     # three agreeing low runs, all flagged as timer-corrupted: they must
     # not install themselves as the baseline
-    out = {
-        "value": 50,
-        "cdist_gbps": None,
-        "moments_gbps": None,
-        "qr_gflops": None,
-        "matmul_gflops": None,
-        "lasso_sweeps_per_sec": None,
-    }
     for _ in range(3):
-        bench.update_history(dict(out), suspect={"kmeans_iters_per_sec"})
+        _gate(50, suspect={"kmeans_iters_per_sec"})
     # an honest run at the old level still passes against the old baseline
     assert _gate(99) >= bench.FLOOR
     # and an honest run at the low level still violates (no rebaseline)
     assert _gate(50) < bench.FLOOR
+
+
+def test_suspect_pass_does_not_reset_rebaseline_vote(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "HISTORY_PATH", str(tmp_path / "h.json"))
+    for v in (100, 102, 98):
+        _gate(v)
+    # two honest agreeing violations start the rebaseline vote...
+    assert _gate(50) < bench.FLOOR
+    assert _gate(52) < bench.FLOOR
+    # ...then a timer-corrupted rep that happens to pass the gate must
+    # NOT clear the pending vote (corrupted timers neither vote for nor
+    # against a rebaseline)
+    _gate(101, suspect={"kmeans_iters_per_sec"})
+    # the third agreeing honest violation completes the vote: rebaselined
+    assert _gate(50) < bench.FLOOR
+    assert _gate(51) >= bench.FLOOR
 
 
 def test_disagreeing_violations_do_not_rebaseline(tmp_path, monkeypatch):

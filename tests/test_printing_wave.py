@@ -75,6 +75,16 @@ class TestPrintOptions(TestCase):
             ht.set_printoptions(profile="default")
             assert ht.get_printoptions()["sci_mode"] is None
 
+    def test_nonprofile_call_resets_sci_mode(self):
+        """torch resets ``sci_mode`` to auto on EVERY set_printoptions
+        call unless explicitly passed — the reference delegates to
+        torch.set_printoptions, so ht.set_printoptions(precision=2)
+        after sci_mode=True returns to auto."""
+        with printoptions(sci_mode=True):
+            ht.set_printoptions(precision=2)
+            assert ht.get_printoptions()["sci_mode"] is None
+            assert ht.get_printoptions()["precision"] == 2
+
 
 class TestReprEquality(TestCase):
     """A split array and its unsplit copy must print identically: the
