@@ -12,19 +12,44 @@
    lasso 1-iter protocol (``/root/reference/benchmarks/lasso/heat-cpu.py``)
    as coordinate-descent sweeps/s.
 
+Measurement protocol (r5, "api-r5"): every HEADLINE metric is measured
+through the PUBLIC DNDarray API — ``KMeans(...).fit(x)``,
+``ht.spatial.cdist(x)``, ``ht.mean``/``ht.std``, ``ht.linalg.qr``,
+``ht.matmul``, ``Lasso().fit`` — on split=0 DNDarrays, exactly the program
+a user runs (the reference protocol times ``fit()``/``cdist`` on
+distributed arrays, ``/root/reference/benchmarks/kmeans/heat-cpu.py:20-26``).
+The raw-jnp kernel measurements ride along as ``kernel_*`` diagnostics and
+feed the per-workload ``api_over_kernel`` ratio: headline / the
+same-program-structure jnp kernel, i.e. the pure cost of DNDarray
+dispatch. Two workloads changed program structure when moving to the API
+(their old kernel series continue under new keys, see ``update_history``):
+
+- moments: the API sequence is SIX separate reduction programs (mean+std
+  per axis, like the reference protocol) — the pre-r5 number timed one
+  artificially fused 6-in-1 jit no user can express; that series
+  continues as ``kernel_moments_fused_gbps``.
+- matmul: the API gram is ``ht.matmul(xT, x)`` over two distinct buffers
+  (the reference API has no lazy transpose), reading 2x the bytes of the
+  pre-r5 same-buffer ``x.T @ x`` kernel; that series continues as
+  ``kernel_matmul_gram_gflops``.
+
 Every metric's ``*_vs_baseline`` is the speedup over a single-CPU-process
 NumPy implementation of the identical computation (BASELINE.json target:
 >=8x). All device timing uses chained programs + marginal (long-minus-
 short) differencing — the tunneled chip's block_until_ready does not
 synchronize and one host fetch costs ~100 ms, so per-trial sync timing
 would measure pure RPC (see the three failed designs in git history).
+API-path batches need no eps-chaining: a single device executes programs
+in dispatch order, so fetching one scalar from the LAST output fences the
+whole batch (and an eps-chain would add a full extra pass over the
+operand as a separate program on the API path, corrupting the number).
 
 Regression visibility: BENCH_HISTORY.json records the best value ever
 seen per metric; each run appends a ``vs_best`` map (current/best) to
 the output and updates the file. Run-to-run spread on the shared chip is
-~±20% — the r01->r02 kmeans "drop" (12424 -> 11169, -10%) is inside that
-band; genuine regressions show up as vs_best staying well below 1.0
-across rounds, not as one noisy sample.
+~±20%. Every metric carries a physical cap (``CAPS``): a marginal
+estimate above the workload's achievable ceiling is a corrupted timer,
+not a capability, and can neither become a best nor pass as a rep.
 
 Prints exactly ONE JSON line; all metrics ride as keys of that object.
 """
@@ -44,6 +69,10 @@ ITERS = 30
 CDIST_N = 30000  # (n, n) f32 output = 3.6 GB, fits single-chip HBM
 CDIST_F = 18  # SUSY feature count (reference config)
 
+MOM_N, MOM_F = 1 << 22, 32
+QR_N, QR_F = 1 << 20, 64
+LASSO_N, LASSO_F = 1 << 19, 64
+
 
 def numpy_lloyd(x, c, iters):
     for _ in range(iters):
@@ -57,7 +86,8 @@ def numpy_lloyd(x, c, iters):
 
 _BASELINE_CACHE = {}  # numpy baselines measured once, reused across reps
 
-# headline metrics the history/floor/median machinery tracks
+# headline metrics (public-API measured) the history/floor/median
+# machinery gates on
 HEADLINE = (
     "kmeans_iters_per_sec",
     "cdist_gbps",
@@ -67,14 +97,104 @@ HEADLINE = (
     "lasso_sweeps_per_sec",
 )
 
-# Roofline model (v5e-1, the bench chip): peak dense bf16 matmul rate and
-# HBM bandwidth from the public TPU v5e spec. Default matmul precision on
-# this chip IS bf16 (MXU passes), so the matmul/qr fractions are against
-# the bf16 peak. kmeans' working set (64 MB) fits VMEM (128 MB), so rates
-# above the HBM roofline are physical there; its fraction is reported
-# against the MXU peak of its dominant 2NFK distance matmul.
+# kernel diagnostics recorded in history (never gated): the raw-jnp
+# programs matching each headline's structure, plus the two legacy fused
+# kernels whose pre-r5 series migrated to these keys
+KERNEL_TRACKED = (
+    "kernel_kmeans_iters_per_sec",
+    "kernel_cdist_gbps",
+    "kernel_moments_gbps",
+    "kernel_moments_fused_gbps",
+    "kernel_qr_gflops",
+    "kernel_matmul_gflops",
+    "kernel_matmul_gram_gflops",
+    "kernel_lasso_sweeps_per_sec",
+)
+
+# Chip model (v5e-1, the bench chip): peak dense bf16 matmul rate and HBM
+# bandwidth from the public TPU v5e spec. Default matmul precision on
+# this chip IS bf16 (MXU passes).
 PEAK_BF16_GFLOPS = 197_000.0
 PEAK_HBM_GBPS = 819.0
+
+# Intensity-aware achievable ceilings, in each metric's COUNTED units
+# (the counted work per trial is a normalization constant; the ceiling is
+# counted_work / min_time where min_time = max(bytes/HBM, flops/MXU) from
+# the byte/flop accounting documented per workload in _roofline). The
+# binding bound and the accounting ride in the roofline JSON.
+ACHIEVABLE = {
+    # API gram ht.matmul(xT, x): 2 distinct (n, f) f32 operands ->
+    # AI = 2nf^2 / (2*4nf) = f/4 = 16 FLOP/byte; min(197e3, 16*819)
+    "matmul_gflops": 16 * PEAK_HBM_GBPS,  # 13_104
+    # legacy same-buffer gram x.T @ x: one operand read -> AI = f/2 = 32
+    "kernel_matmul_gram_gflops": 32 * PEAK_HBM_GBPS,  # 26_208
+    "kernel_matmul_gflops": 16 * PEAK_HBM_GBPS,
+    # CholQR2 traffic: read X twice (gram1 + solve1), write+2x read Q1,
+    # write+read Q2 = 7 passes over the (n, f) operand; counted flops are
+    # the nominal 2nf^2 -> ceiling = 2nf^2 / (7*4nf / HBM) = f*HBM/14
+    "qr_gflops": QR_F * PEAK_HBM_GBPS / 14.0,  # 3_744
+    "kernel_qr_gflops": QR_F * PEAK_HBM_GBPS / 14.0,
+    # cdist: the (n, n) f32 output MUST commit to HBM (3.6 GB >> VMEM);
+    # counted bytes = that output, so the ceiling IS the HBM write rate
+    "cdist_gbps": PEAK_HBM_GBPS,
+    "kernel_cdist_gbps": PEAK_HBM_GBPS,
+    # API moments: mean (1 pass) + std (2 passes: mean, then centered
+    # moment) per axis = 9 passes minimum for the 6-call sequence;
+    # counted bytes = 3 passes -> ceiling = 819 * 3/9
+    "moments_gbps": PEAK_HBM_GBPS / 3.0,  # 273
+    "kernel_moments_gbps": PEAK_HBM_GBPS / 3.0,
+    # fused 6-in-1 sweep: information minimum is 2 passes (all three
+    # means in one read, all three centered moments in a second);
+    # counted bytes = 3 passes -> ceiling = 819 * 3/2
+    "kernel_moments_fused_gbps": PEAK_HBM_GBPS * 1.5,  # 1_228
+    # kmeans ceiling: the k=8 distance matmul alone (2NFK flops) on an
+    # MXU running 8-of-128 output lanes cannot beat ~22 us/iter -> 45k
+    # iters/s; the empirical floor probe in the roofline is the honest
+    # per-round number, this static cap only guards the history
+    "kmeans_iters_per_sec": 45_000.0,
+    "kernel_kmeans_iters_per_sec": 45_000.0,
+    # lasso: 65-column sequential CD chain; per sweep >= 2 passes over X
+    # (each column read for rho and for the residual update)
+    "lasso_sweeps_per_sec": 2 * PEAK_HBM_GBPS / (2 * LASSO_N * (LASSO_F + 1) * 4 / 1e9),
+    "kernel_lasso_sweeps_per_sec": 2 * PEAK_HBM_GBPS / (2 * LASSO_N * (LASSO_F + 1) * 4 / 1e9),
+}
+
+# Physical caps = achievable x grace. Committed-output and latency-chain
+# workloads get 1.1x (nothing can hide the bound); matmul-family
+# workloads get 1.35x (DMA prefetch of the next chained trial overlaps
+# with MXU compute, hiding up to ~1/3 of the read time — measured: the
+# honest same-buffer gram band is 25-33 TFLOP/s vs the 26.2 no-overlap
+# ceiling; the retired 50.5/102.8 TFLOP/s spikes sit at 1.9x/3.9x).
+def _cap(key: str) -> float:
+    grace = 1.35 if "matmul" in key or "kmeans" in key else 1.1
+    if "cdist" in key:
+        grace = 1.02  # committed HBM write; spec tolerance only
+    return ACHIEVABLE[key] * grace
+
+
+CAPS = {k: _cap(k) for k in ACHIEVABLE}
+
+
+def _api_timed(call, fence, attempts=4):
+    """best-of-``attempts`` timer for back-to-back public-API calls.
+
+    A single device executes dispatched programs in order, so one scalar
+    fetch from the LAST output fences the whole batch; refs to earlier
+    outputs are dropped as the loop advances, keeping device memory
+    bounded (at most two live outputs)."""
+
+    def timed(reps):
+        best = float("inf")
+        for _ in range(attempts):
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(reps):
+                out = call()
+            fence(out)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    return timed
 
 
 def kmeans_bench():
@@ -91,17 +211,18 @@ def kmeans_bench():
     rng.shuffle(data)
     init = data[rng.choice(N, K, replace=False)].copy()
 
-    # --- heat_tpu on all devices: the whole fit is ONE device program
-    # (lax.while_loop), so host<->TPU latency is paid once. The tunneled
-    # TPU platform's block_until_ready does not synchronize, so completion
-    # is forced with a device->host fetch, and the per-call RPC overhead is
-    # excluded by differencing a long and a short run (marginal throughput,
-    # the sustained rate the reference protocol's 30x10-trial loop measures).
+    # the whole fit is ONE device program (lax.while_loop), so host<->TPU
+    # latency is paid once. The tunneled TPU platform's block_until_ready
+    # does not synchronize, so completion is forced with a device->host
+    # fetch, and the per-call RPC overhead is excluded by differencing a
+    # long and a short run (marginal throughput, the sustained rate the
+    # reference protocol's 30x10-trial loop measures).
     x = ht.array(data, split=0)
     xa = x.larray
     c = jnp.asarray(init)
+    init_dnd = ht.array(init)  # replicated initial centroids for the API fit
 
-    def timed_fit(iters: int, repeats: int = 5) -> float:
+    def timed_fit_kernel(iters: int, repeats: int = 5) -> float:
         np.asarray(_lloyd_fit(xa, c, K, iters, -1.0)[0])  # warm compile
         best = float("inf")
         for _ in range(repeats):
@@ -112,10 +233,30 @@ def kmeans_bench():
             assert int(n_done) == iters
         return best
 
+    def timed_fit_api(iters: int, repeats: int = 5) -> float:
+        # the public path: fit() itself syncs (inertia + n_iter fetches);
+        # those constants cancel in the long-minus-short difference
+        model = ht.cluster.KMeans(n_clusters=K, init=init_dnd, max_iter=iters, tol=None)
+        model.fit(x)  # warm compile for this max_iter
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            fitted = ht.cluster.KMeans(
+                n_clusters=K, init=init_dnd, max_iter=iters, tol=None
+            ).fit(x)
+            best = min(best, time.perf_counter() - t0)
+            assert fitted.n_iter_ == iters if hasattr(fitted, "n_iter_") else True
+        return best
+
     short, long_ = 10, 4010  # marginal window >> per-call RPC jitter
-    t_short = timed_fit(short)
-    t_long = timed_fit(long_)
-    iters_per_sec = (long_ - short) / max(t_long - t_short, 1e-9)
+    k_ips = (long_ - short) / max(
+        timed_fit_kernel(long_) - timed_fit_kernel(short), 1e-9
+    )
+    a_ips = (long_ - short) / max(
+        timed_fit_api(long_) - timed_fit_api(short), 1e-9
+    )
+    k_ips = min(k_ips, CAPS["kernel_kmeans_iters_per_sec"])
+    a_ips = min(a_ips, CAPS["kmeans_iters_per_sec"])
 
     # --- single-process numpy baseline (best of 3 timed runs, cached) ---
     if "kmeans" not in _BASELINE_CACHE:
@@ -128,10 +269,61 @@ def kmeans_bench():
         _BASELINE_CACHE["kmeans"] = nb_iters / nb_best
     baseline_ips = _BASELINE_CACHE["kmeans"]
 
+    out = {
+        "kmeans_iters_per_sec": round(a_ips, 3),
+        "unit": f"iters/s via KMeans.fit on a split=0 DNDarray (n={N}, f={F}, k={K})",
+        "vs_baseline": round(a_ips / baseline_ips, 3),
+        "kernel_kmeans_iters_per_sec": round(k_ips, 3),
+    }
+    if "kmeans_probe" not in _BASELINE_CACHE:
+        _BASELINE_CACHE["kmeans_probe"] = kmeans_floor_probe(xa, c)
+    return out
+
+
+def kmeans_floor_probe(xa, c):
+    """Empirical k=8 floor: time the Lloyd iteration's two matmul halves
+    in isolation (chained-eps marginal protocol). The fused while-loop
+    iteration should land at or below their sum — if it does, the
+    measured iters/s IS the small-k floor of this decomposition and the
+    remaining headroom is only what a single-pass fused kernel could
+    reclaim (VERDICT r4 weak item 4)."""
+    import jax
+    import jax.numpy as jnp
+
+    from heat_tpu.spatial.distance import _quadratic_expand
+
+    k = c.shape[0]
+
+    @jax.jit
+    def dist_argmin(x, eps):
+        d2 = _quadratic_expand(x + eps * jnp.float32(1e-30), c)
+        return jnp.sum(jnp.argmin(d2, axis=1))
+
+    labels = jnp.argmin(_quadratic_expand(xa, c), axis=1)
+    onehot = jax.nn.one_hot(labels, k, dtype=xa.dtype)
+
+    @jax.jit
+    def update(x, eps):
+        xx = x + eps * jnp.float32(1e-30)
+        s = onehot.T @ xx
+        return s[0, 0]
+
+    float(dist_argmin(xa, jnp.float32(0)))
+    float(update(xa, jnp.float32(0)))
+    r_dist = _marginal(_chained_timed(dist_argmin, xa), 20, 220, 1.0)
+    r_upd = _marginal(_chained_timed(update, xa), 20, 220, 1.0)
+    t_sum_us = 1e6 / r_dist + 1e6 / r_upd
     return {
-        "kmeans_iters_per_sec": round(iters_per_sec, 3),
-        "unit": f"iters/s (n={N}, f={F}, k={K})",
-        "vs_baseline": round(iters_per_sec / baseline_ips, 3),
+        "dist_argmin_us": round(1e6 / r_dist, 1),
+        "update_matmul_us": round(1e6 / r_upd, 1),
+        "component_sum_us": round(t_sum_us, 1),
+        "floor_iters_per_sec": round(1e6 / t_sum_us, 1),
+        "note": (
+            "k=8 leaves 8-of-128 MXU output lanes active; the update "
+            "matmul (k x n @ n x f) dominates. A fused-iteration rate at "
+            "or above floor_iters_per_sec means the while-loop body "
+            "already overlaps/fuses as well as the decomposition allows."
+        ),
     }
 
 
@@ -152,25 +344,89 @@ def _merge_median(runs):
 
 
 def _roofline(merged):
-    """Achieved fraction of the chip roofline per workload, so a 20%
-    swing reads as 'still 0.8 of peak' instead of an uninterpretable
-    raw-number change."""
-    kmeans_gflops = merged["kmeans_iters_per_sec"] * (2.0 * N * F * K) / 1e9
-    model = {
-        "matmul": {"achieved_gflops": merged.get("matmul_gflops"), "peak_gflops": PEAK_BF16_GFLOPS, "bound": "mxu"},
-        "qr": {"achieved_gflops": merged.get("qr_gflops"), "peak_gflops": PEAK_BF16_GFLOPS, "bound": "mxu"},
-        "moments": {"achieved_gbps": merged.get("moments_gbps"), "peak_gbps": PEAK_HBM_GBPS, "bound": "hbm"},
-        "cdist": {"achieved_gbps": merged.get("cdist_gbps"), "peak_gbps": PEAK_HBM_GBPS, "bound": "hbm-output"},
-        "kmeans": {"achieved_gflops": round(kmeans_gflops, 1), "peak_gflops": PEAK_BF16_GFLOPS, "bound": "vmem-resident"},
+    """Per-workload achieved fraction of the ACHIEVABLE ceiling — the
+    intensity-aware bound min(MXU peak, AI x HBM peak) computed from the
+    byte/flop accounting in ``ACHIEVABLE`` — with the binding bound and
+    the accounting stated per row. fraction_of_achievable ~ 1.0 means
+    the kernel is done; > 1.0 happens only where cross-trial DMA overlap
+    can hide part of the (already counted) traffic."""
+    rows = {
+        "matmul": {
+            "achieved": merged.get("matmul_gflops"),
+            "achievable": ACHIEVABLE["matmul_gflops"],
+            "unit": "counted GFLOP/s",
+            "bound": "hbm",
+            "model": "2nf^2 FLOP vs two distinct (n,f) f32 operand reads: AI=f/4=16 FLOP/B",
+        },
+        "matmul_gram_kernel": {
+            "achieved": merged.get("kernel_matmul_gram_gflops"),
+            "achievable": ACHIEVABLE["kernel_matmul_gram_gflops"],
+            "unit": "counted GFLOP/s",
+            "bound": "hbm",
+            "model": "same-buffer x.T@x: one (n,f) read, AI=f/2=32 FLOP/B; >1.0 = chained-trial DMA overlap",
+        },
+        "qr": {
+            "achieved": merged.get("qr_gflops"),
+            "achievable": ACHIEVABLE["qr_gflops"],
+            "unit": "counted GFLOP/s (nominal 2nf^2)",
+            "bound": "hbm",
+            "model": "CholQR2 = 7 passes over the 268 MB operand (2x read X, W+2xR Q1, W+R Q2)",
+        },
+        "cdist": {
+            "achieved": merged.get("cdist_gbps"),
+            "achievable": ACHIEVABLE["cdist_gbps"],
+            "unit": "GB/s of committed (n,n) output",
+            "bound": "hbm-output",
+            "model": "3.6 GB output write >> VMEM: the write rate IS the bound",
+        },
+        "moments": {
+            "achieved": merged.get("moments_gbps"),
+            "achievable": ACHIEVABLE["moments_gbps"],
+            "unit": "counted GB/s (3-pass normalization)",
+            "bound": "hbm",
+            "model": "6-call mean/std sequence: 9 physical passes minimum (std = 2)",
+        },
+        "moments_fused_kernel": {
+            "achieved": merged.get("kernel_moments_fused_gbps"),
+            "achievable": ACHIEVABLE["kernel_moments_fused_gbps"],
+            "unit": "counted GB/s (3-pass normalization)",
+            "bound": "hbm",
+            "model": "6-in-1 fused sweep: information minimum 2 passes; XLA compiles ~4",
+        },
+        "lasso": {
+            "achieved": merged.get("lasso_sweeps_per_sec"),
+            "achievable": None,
+            "unit": "CD sweeps/s",
+            "bound": "latency-chain",
+            "model": (
+                "65-column strictly sequential coordinate descent: 130 dependent "
+                "(n,)-vector ops per sweep; bandwidth model (2 passes over X = "
+                f"{ACHIEVABLE['lasso_sweeps_per_sec']:.0f}/s) is NOT the binding bound"
+            ),
+        },
     }
-    for row in model.values():
-        ach = row.get("achieved_gflops") or row.get("achieved_gbps")
-        peak = row.get("peak_gflops") or row.get("peak_gbps")
-        row["fraction"] = round(ach / peak, 4) if ach else None
-    return model
+    probe = _BASELINE_CACHE.get("kmeans_probe")
+    km = {
+        "achieved": merged.get("kmeans_iters_per_sec"),
+        "unit": "iters/s",
+        "bound": "mxu-narrow-output (k=8: 8-of-128 lanes)",
+        "model": "empirical floor probe: unfused dist+argmin and onehot-update matmul timed in isolation",
+    }
+    if probe:
+        km["achievable"] = probe["floor_iters_per_sec"]
+        km["probe"] = probe
+    else:
+        km["achievable"] = None
+    rows["kmeans"] = km
+    for row in rows.values():
+        ach, ceil = row.get("achieved"), row.get("achievable")
+        row["fraction_of_achievable"] = (
+            round(ach / ceil, 4) if (ach and ceil) else None
+        )
+    return rows
 
 
-FLOOR = 0.7  # fail the run when a median falls below 0.7x best-in-history
+FLOOR = 0.7  # fail the run when a median falls below 0.7x the gate baseline
 
 
 def main():
@@ -189,8 +445,9 @@ def main():
             }
         )
     merged = _merge_median(runs)
+    tracked = HEADLINE + KERNEL_TRACKED
     best = {
-        k: round(max(r[k] for r in runs), 3) for k in HEADLINE if k in merged
+        k: round(max(r[k] for r in runs), 3) for k in tracked if k in merged
     }
     # a single rep wildly above its own run's median is a timing artifact
     # (e.g. a marginal-differencing glitch under the roofline cap), not a
@@ -206,16 +463,10 @@ def main():
         **merged,
         **smoke_check(),
         "bench_reps": reps,
+        "bench_protocol": "api-r5 (headline metrics timed through the public DNDarray API)",
         "best_of_reps": best,
-        # VERDICT r3 item 5 asked to recover kmeans to >= 13k iters/s or
-        # explain: the recorded 13,291 was a single sample from the +20%
-        # tail of the shared-chip noise band — best_of_reps still reaches
-        # ~13-14k on good runs, while the median across full invocations
-        # sits at ~11-12k; the median is the honest sustained number and
-        # the floor gate now tracks medians so this stops reading as a
-        # regression
-        "kmeans_note": "median across reps; single-shot history bests rode the noise tail (see best_of_reps)",
     }
+    out["api_over_kernel"] = _api_over_kernel(out)
     out["roofline"] = _roofline({**merged, "kmeans_iters_per_sec": out["value"]})
     # the gate uses the deltas computed THIS run, not a file round-trip
     # (a swallowed history-write failure must not evaluate stale numbers)
@@ -223,7 +474,9 @@ def main():
         update_history(out, suspect=set(suspect))
     )
     violations = {
-        k: v for k, v in out["vs_trailing_median"].items() if v < FLOOR
+        k: v
+        for k, v in out["vs_trailing_median"].items()
+        if v < FLOOR and k in HEADLINE
     }
     if violations:
         out["floor_violations"] = violations
@@ -231,9 +484,30 @@ def main():
     if violations and not os.environ.get("HEAT_TPU_BENCH_NO_FLOOR"):
         # median-of-reps below 0.7x the trailing median of prior runs is
         # a regression, not chip-allocation noise — fail loudly
-        # (VERDICT r3 item 5; trailing baseline so a slower tunneled chip
-        # doesn't false-fail against a faster chip's best)
+        # (trailing baseline so a slower tunneled chip doesn't false-fail
+        # against a faster chip's best)
         sys.exit(1)
+
+
+def _api_over_kernel(out):
+    """headline / matching-structure kernel, per workload. The kernel in
+    each denominator runs the SAME program shape as the API path (for
+    moments, the 6-program unfused jnp sequence; for matmul, the
+    two-buffer jnp gram), so the ratio isolates DNDarray dispatch cost."""
+    pairs = {
+        "kmeans": ("kmeans_iters_per_sec", "kernel_kmeans_iters_per_sec"),
+        "cdist": ("cdist_gbps", "kernel_cdist_gbps"),
+        "moments": ("moments_gbps", "kernel_moments_gbps"),
+        "qr": ("qr_gflops", "kernel_qr_gflops"),
+        "matmul": ("matmul_gflops", "kernel_matmul_gflops"),
+        "lasso": ("lasso_sweeps_per_sec", "kernel_lasso_sweeps_per_sec"),
+    }
+    value = lambda k: out["value"] if k == "kmeans_iters_per_sec" else out.get(k)
+    return {
+        name: round(value(a) / value(b), 3)
+        for name, (a, b) in pairs.items()
+        if value(a) and value(b)
+    }
 
 
 def smoke_check():
@@ -274,10 +548,11 @@ def _chained_timed(trial, xa):
 def _marginal(timed, short, long_, work_per_unit, cap=None):
     """Best-of-two positive marginal estimates (shared-chip spread).
 
-    ``cap`` is the physical roofline for the metric: an estimate above it
-    is a corrupted measurement (a noise spike shrinking t_long - t_short),
-    not a capability, and is discarded — a reported "best" beyond the
-    hardware peak would only advertise that the timer broke."""
+    ``cap`` is the physical ceiling for the metric (CAPS): an estimate
+    above it is a corrupted measurement (a noise spike shrinking
+    t_long - t_short), not a capability, and is discarded — a reported
+    "best" beyond the hardware bound would only advertise that the timer
+    broke."""
     estimates = []
     t_long_min = float("inf")
     for _ in range(3):
@@ -294,34 +569,79 @@ def _marginal(timed, short, long_, work_per_unit, cap=None):
         return max(estimates)
     # conservative whole-run fallback from the BEST long run (the last
     # one may carry a noise spike; r3 ADVICE)
-    return work_per_unit * long_ / t_long_min
+    fallback = work_per_unit * long_ / t_long_min
+    return min(fallback, cap) if cap is not None else fallback
 
 
 def moments_bench():
     """Progression config 2: mean+std over axes {None, 0, 1} on a random
-    split=0 array — one jitted sweep per trial, trials chained through a
-    device scalar (eps) so XLA cannot collapse repeats."""
+    split=0 array.
+
+    Headline: the 6-call public sequence ``ht.mean(x, axis)`` +
+    ``ht.std(x, axis)`` (the reference protocol's own call structure,
+    ``statistical_moments/heat-cpu.py:20-27``). Kernel comparator: the
+    same six programs on the raw jnp buffer. Legacy fused 6-in-1 sweep
+    rides as ``kernel_moments_fused_gbps`` (pre-r5 series continuity).
+    All three share the 3-pass byte normalization so they graph on one
+    axis; the fraction-of-achievable accounting lives in _roofline."""
     import jax
     import jax.numpy as jnp
 
-    n, f = 1 << 22, 32
+    import heat_tpu as ht
+
+    n, f = MOM_N, MOM_F
     rng = np.random.default_rng(2)
     data = rng.normal(size=(n, f)).astype(np.float32)
-    xa = jnp.asarray(data)
+    X = ht.array(data, split=0)
+    xa = X.larray
+    gb_per_sweep = n * f * 4 * 3 / 1e9  # 3-pass normalization (all series)
 
+    # --- legacy fused sweep (one jit, trials chained through eps) ---
     @jax.jit
-    def sweep(x, eps):
+    def fused_sweep(x, eps):
         xx = x + eps * jnp.float32(1e-30)
         outs = []
         for axis in (None, 0, 1):
             outs.append(jnp.mean(xx, axis=axis))
             outs.append(jnp.std(xx, axis=axis))
-        # fold everything into one scalar to chain the next trial
         return sum(jnp.sum(o) for o in outs)
 
-    float(sweep(xa, jnp.float32(0)))  # warm compile
-    gb_per_sweep = n * f * 4 * 3 / 1e9  # one pass per axis, mean+std fused
-    gbps = _marginal(_chained_timed(sweep, xa), 3, 23, gb_per_sweep, cap=1.2 * PEAK_HBM_GBPS)
+    float(fused_sweep(xa, jnp.float32(0)))  # warm compile
+    fused_gbps = _marginal(
+        _chained_timed(fused_sweep, xa), 3, 23, gb_per_sweep,
+        cap=CAPS["kernel_moments_fused_gbps"],
+    )
+
+    # --- unfused kernel comparator: the API's program structure on jnp ---
+    mean_j = {ax: jax.jit(lambda v, a=ax: jnp.mean(v, axis=a)) for ax in (None, 0, 1)}
+    std_j = {ax: jax.jit(lambda v, a=ax: jnp.std(v, axis=a)) for ax in (None, 0, 1)}
+
+    def kernel_sweep():
+        last = None
+        for ax in (None, 0, 1):
+            mean_j[ax](xa)
+            last = std_j[ax](xa)
+        return last
+
+    def api_sweep():
+        last = None
+        for ax in (None, 0, 1):
+            ht.mean(X, axis=ax)
+            last = ht.std(X, axis=ax)
+        return last
+
+    kernel_sweep()  # warm all six compiles
+    api_sweep()
+    fence = lambda out: float(np.asarray(out[0] if out.ndim else out))
+    fence_api = lambda out: float(np.asarray((out.larray[0] if out.larray.ndim else out.larray)))
+    kernel_gbps = _marginal(
+        _api_timed(kernel_sweep, fence), 3, 23, gb_per_sweep,
+        cap=CAPS["kernel_moments_gbps"],
+    )
+    api_gbps = _marginal(
+        _api_timed(api_sweep, fence_api), 3, 23, gb_per_sweep,
+        cap=CAPS["moments_gbps"],
+    )
 
     if "moments" not in _BASELINE_CACHE:
         sub = data[: n // 8]
@@ -332,23 +652,36 @@ def moments_bench():
         _BASELINE_CACHE["moments"] = (sub.nbytes * 3 / 1e9) / (time.perf_counter() - t0)
     base_gbps = _BASELINE_CACHE["moments"]
     return {
-        "moments_gbps": round(gbps, 2),
-        "moments_unit": f"GB/s read, mean+std x axes(None,0,1) (n={n}, f={f})",
-        "moments_vs_baseline": round(gbps / base_gbps, 2),
+        "moments_gbps": round(api_gbps, 2),
+        "moments_unit": f"GB/s (3-pass norm), ht.mean+ht.std x axes(None,0,1) (n={n}, f={f})",
+        "moments_vs_baseline": round(api_gbps / base_gbps, 2),
+        "kernel_moments_gbps": round(kernel_gbps, 2),
+        "kernel_moments_fused_gbps": round(fused_gbps, 2),
     }
 
 
 def qr_matmul_bench():
-    """Progression config 5: tall-skinny QR + gram matmul GFLOP/s."""
+    """Progression config 5: tall-skinny QR + gram matmul GFLOP/s.
+
+    Headline qr: ``ht.linalg.qr(A, calc_q=False)`` on a split=0 DNDarray
+    (the kernel trial consumes only R, so calc_q=False is the matching
+    user call — XLA dead-code-eliminates Q identically in both).
+    Headline matmul: ``ht.matmul(xT, x)`` with the transpose hoisted
+    outside the timed window, as a user would; its jnp twin is the
+    two-buffer kernel comparator, and the legacy same-buffer gram rides
+    as ``kernel_matmul_gram_gflops``."""
     import jax
     import jax.numpy as jnp
 
-    n, f = 1 << 20, 64
+    import heat_tpu as ht
+    from heat_tpu.core.linalg.qr import _cholqr2_with_fallback
+
+    n, f = QR_N, QR_F
     rng = np.random.default_rng(3)
     data = rng.normal(size=(n, f)).astype(np.float32)
-    xa = jnp.asarray(data)
-
-    from heat_tpu.core.linalg.qr import _cholqr2_with_fallback
+    A = ht.array(data, split=0)
+    xa = A.larray
+    AT = ht.array(jnp.asarray(xa.T))  # hoisted, like a user would
 
     @jax.jit
     def qr_trial(x, eps):
@@ -359,15 +692,36 @@ def qr_matmul_bench():
         return r[0, 0]
 
     @jax.jit
-    def mm_trial(x, eps):
+    def mm_gram_trial(x, eps):
         xx = x + eps * jnp.float32(1e-30)
         return (xx.T @ xx)[0, 0]
 
+    xaT = jnp.asarray(xa.T)
+
+    @jax.jit
+    def mm2_kernel(at, b, eps):
+        return (at @ (b + eps * jnp.float32(1e-30)))[0, 0]
+
+    mm2_trial = lambda b, s: mm2_kernel(xaT, b, s)
+
     float(qr_trial(xa, jnp.float32(0)))
-    float(mm_trial(xa, jnp.float32(0)))
-    flops = 2.0 * n * f * f / 1e9  # GFLOP per trial (both kernels)
-    qr_gflops = _marginal(_chained_timed(qr_trial, xa), 2, 10, flops, cap=1.2 * PEAK_BF16_GFLOPS)
-    mm_gflops = _marginal(_chained_timed(mm_trial, xa), 3, 23, flops, cap=1.2 * PEAK_BF16_GFLOPS)
+    float(mm_gram_trial(xa, jnp.float32(0)))
+    float(mm2_trial(xa, jnp.float32(0)))
+
+    flops = 2.0 * n * f * f / 1e9  # GFLOP per trial (all kernels)
+    k_qr = _marginal(_chained_timed(qr_trial, xa), 2, 10, flops, cap=CAPS["kernel_qr_gflops"])
+    k_gram = _marginal(_chained_timed(mm_gram_trial, xa), 3, 23, flops, cap=CAPS["kernel_matmul_gram_gflops"])
+    k_mm2 = _marginal(_chained_timed(mm2_trial, xa), 3, 23, flops, cap=CAPS["kernel_matmul_gflops"])
+
+    # --- public API paths ---
+    api_qr_call = lambda: ht.linalg.qr(A, calc_q=False)
+    api_mm_call = lambda: ht.matmul(AT, A)
+    fence_r = lambda out: float(np.asarray(out.R.larray[0, 0]))
+    fence_mm = lambda out: float(np.asarray(out.larray[0, 0]))
+    api_qr_call()  # warm
+    api_mm_call()
+    a_qr = _marginal(_api_timed(api_qr_call, fence_r), 2, 10, flops, cap=CAPS["qr_gflops"])
+    a_mm = _marginal(_api_timed(api_mm_call, fence_mm), 3, 23, flops, cap=CAPS["matmul_gflops"])
 
     if "qr" not in _BASELINE_CACHE:
         sub = data[: n // 16]
@@ -379,11 +733,15 @@ def qr_matmul_bench():
         _BASELINE_CACHE["mm"] = (2.0 * sub.shape[0] * f * f / 1e9) / (time.perf_counter() - t0)
     base_qr, base_mm = _BASELINE_CACHE["qr"], _BASELINE_CACHE["mm"]
     return {
-        "qr_gflops": round(qr_gflops, 2),
-        "qr_unit": f"GFLOP/s tall-skinny QR (n={n}, f={f})",
-        "qr_vs_baseline": round(qr_gflops / base_qr, 2),
-        "matmul_gflops": round(mm_gflops, 2),
-        "matmul_vs_baseline": round(mm_gflops / base_mm, 2),
+        "qr_gflops": round(a_qr, 2),
+        "qr_unit": f"GFLOP/s ht.linalg.qr(calc_q=False), split=0 (n={n}, f={f})",
+        "qr_vs_baseline": round(a_qr / base_qr, 2),
+        "matmul_gflops": round(a_mm, 2),
+        "matmul_unit": f"GFLOP/s ht.matmul(xT, x), two (n,f) buffers (n={n}, f={f})",
+        "matmul_vs_baseline": round(a_mm / base_mm, 2),
+        "kernel_qr_gflops": round(k_qr, 2),
+        "kernel_matmul_gflops": round(k_mm2, 2),
+        "kernel_matmul_gram_gflops": round(k_gram, 2),
     }
 
 
@@ -391,22 +749,26 @@ def lasso_bench():
     """Lasso protocol: coordinate-descent sweeps/s (the reference times
     1-iteration fits; a sweep = one fit iteration). The whole fit is one
     device program (lax.while_loop), so sweeps/s comes from differencing
-    a long and a short max_iter."""
+    a long and a short max_iter — through ``Lasso.fit`` on DNDarrays for
+    the headline, through the raw ``_cd_fit`` kernel for the comparator."""
     import jax.numpy as jnp
 
+    import heat_tpu as ht
     from heat_tpu.regression.lasso import _cd_fit
 
-    n, f = 1 << 19, 64
+    n, f = LASSO_N, LASSO_F
     rng = np.random.default_rng(4)
-    X = rng.normal(size=(n, f)).astype(np.float32)
-    yv = (X @ rng.normal(size=f).astype(np.float32)).astype(np.float32)
-    Xb = np.concatenate([np.ones((n, 1), np.float32), X], axis=1)
-    Xa, ya = jnp.asarray(Xb), jnp.asarray(yv)
+    Xnp = rng.normal(size=(n, f)).astype(np.float32)
+    yv = (Xnp @ rng.normal(size=f).astype(np.float32)).astype(np.float32)
+    Xb = np.concatenate([np.ones((n, 1), np.float32), Xnp], axis=1)
+    Xd = ht.array(Xb, split=0)
+    yd = ht.array(yv, split=0)
+    Xa, ya = Xd.larray, jnp.asarray(yv)
     theta0 = jnp.zeros(f + 1, jnp.float32)
     lam = jnp.float32(0.01)
     tol = jnp.float32(0.0)  # run exactly max_iter sweeps
 
-    def timed(iters):
+    def timed_kernel(iters):
         best = float("inf")
         for _ in range(3):
             t0 = time.perf_counter()
@@ -418,15 +780,22 @@ def lasso_bench():
             assert int(it) == iters
         return best
 
+    def timed_api(iters):
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            est = ht.regression.Lasso(lam=0.01, max_iter=iters, tol=0.0).fit(Xd, yd)
+            best = min(best, time.perf_counter() - t0)
+            assert est.n_iter == iters
+        return best
+
     np.asarray(_cd_fit(Xa, ya, theta0, lam, tol, jnp.int32(1))[0])  # warm
+    ht.regression.Lasso(lam=0.01, max_iter=1, tol=0.0).fit(Xd, yd)
     # window sized so t_long - t_short >> the ~100 ms tunnel jitter (a
     # 2->22 window measured 20 sweeps ~ 4 ms and produced 100x-spread
-    # garbage both directions); cap = 4x the one-X-pass HBM bound (the
-    # operand may be partially VMEM-resident, never 4x)
-    gb_per_sweep = n * (f + 1) * 4 / 1e9
-    sweeps_per_sec = _marginal(
-        timed, 50, 1050, 1.0, cap=4.0 * PEAK_HBM_GBPS / gb_per_sweep
-    )
+    # garbage both directions)
+    k_sps = _marginal(timed_kernel, 50, 1050, 1.0, cap=CAPS["kernel_lasso_sweeps_per_sec"])
+    a_sps = _marginal(timed_api, 50, 1050, 1.0, cap=CAPS["lasso_sweeps_per_sec"])
 
     if "lasso" not in _BASELINE_CACHE:
         sub = Xb[: n // 8]
@@ -437,9 +806,10 @@ def lasso_bench():
         _BASELINE_CACHE["lasso"] = (1.0 / (time.perf_counter() - t0)) / 8.0
     base_sps_full = _BASELINE_CACHE["lasso"]
     return {
-        "lasso_sweeps_per_sec": round(sweeps_per_sec, 2),
-        "lasso_unit": f"CD sweeps/s (n={n}, f={f + 1})",
-        "lasso_vs_baseline": round(sweeps_per_sec / base_sps_full, 2),
+        "lasso_sweeps_per_sec": round(a_sps, 2),
+        "lasso_unit": f"CD sweeps/s via Lasso.fit on split=0 DNDarrays (n={n}, f={f + 1})",
+        "lasso_vs_baseline": round(a_sps / base_sps_full, 2),
+        "kernel_lasso_sweeps_per_sec": round(k_sps, 2),
     }
 
 
@@ -457,6 +827,70 @@ def _numpy_cd_sweep(X, y, theta, lam):
     return theta
 
 
+PROTOCOL = "api-r5"
+
+
+def _purge_record(rec, cap):
+    """Recompute best/best_median from physically possible values only;
+    retire the impossible ones visibly (VERDICT r4 weak item 3: corrupt
+    bests make healthy at-roofline medians read as regressions)."""
+    pools = [v for key in ("runs", "clean") for v in rec.get(key, [])]
+    retired = sorted({v for v in pools + [rec.get("best"), rec.get("best_median")]
+                      if isinstance(v, (int, float)) and v > cap})
+    if not retired:
+        return rec
+    rec["retired_artifacts"] = sorted(
+        set(retired) | set(rec.get("retired_artifacts", []))
+    )
+    rec["artifact_note"] = (
+        f"values above the physical cap {round(cap, 2)} are corrupted "
+        "marginal-timer spikes, not capabilities; best/best_median/clean "
+        "recomputed from possible values only"
+    )
+    rec["runs"] = [v for v in rec.get("runs", []) if v <= cap]
+    if "clean" in rec:
+        rec["clean"] = [v for v in rec["clean"] if v <= cap]
+    possible = [v for v in pools if v <= cap]
+    if possible:
+        rec["best"] = max(possible)
+        rec["best_median"] = max(rec["runs"]) if rec["runs"] else max(possible)
+    else:
+        rec.pop("best", None)
+        rec.pop("best_median", None)
+    return rec
+
+
+def _migrate_history(hist):
+    """One-time protocol migration to api-r5:
+
+    - the pre-r5 moments/matmul series measured different PROGRAMS than
+      the new API headline (an unexpressible fused sweep; a same-buffer
+      gram) — they continue under their kernel_* keys so the series stay
+      comparable, and the API headline starts a fresh record;
+    - every record is purged of physically impossible values (CAPS).
+    """
+    if hist.get("_protocol") == PROTOCOL:
+        return hist
+    renames = {
+        "moments_gbps": "kernel_moments_fused_gbps",
+        "matmul_gflops": "kernel_matmul_gram_gflops",
+    }
+    for old, new in renames.items():
+        if old in hist and new not in hist:
+            rec = hist.pop(old)
+            rec["migrated_from"] = old
+            rec["migration_note"] = (
+                "pre-r5 series measured the kernel program now tracked "
+                f"under {new}; the {old} headline is API-measured from r5 on"
+            )
+            hist[new] = rec
+    for key, cap in CAPS.items():
+        if key in hist and isinstance(hist[key], dict):
+            _purge_record(hist[key], cap)
+    hist["_protocol"] = PROTOCOL
+    return hist
+
+
 def update_history(out, suspect=frozenset()):
     """Record per-metric best-so-far; return {metric: current/best}.
 
@@ -464,53 +898,51 @@ def update_history(out, suspect=frozenset()):
     corruption under the roofline cap) never RATCHET the history: their
     median still appends to ``runs`` and still faces the existing floor,
     but cannot set a new ``best``/``best_median`` that would falsely arm
-    the 0.7x gate against future honest runs.
+    the 0.7x gate against future honest runs. Values above a metric's
+    physical cap (CAPS) can never ratchet either.
     """
-    metrics = {
-        "kmeans_iters_per_sec": out["value"],
-        "cdist_gbps": out.get("cdist_gbps"),
-        "moments_gbps": out.get("moments_gbps"),
-        "qr_gflops": out.get("qr_gflops"),
-        "matmul_gflops": out.get("matmul_gflops"),
-        "lasso_sweeps_per_sec": out.get("lasso_sweeps_per_sec"),
-    }
+    metrics = {"kmeans_iters_per_sec": out["value"]}
+    for k in HEADLINE[1:] + KERNEL_TRACKED:
+        metrics[k] = out.get(k)
     try:
         with open(HISTORY_PATH) as fh:
             hist = json.load(fh)
     except (OSError, ValueError):
         hist = {}
+    hist = _migrate_history(hist)
     deltas = {}
     best_median_deltas = {}
     gate_deltas = {}
     for k, v in metrics.items():
         if v is None:
             continue
+        cap = CAPS.get(k, float("inf"))
         rec = hist.setdefault(k, {"runs": []})
         rec["runs"] = (rec.get("runs", []) + [v])[-20:]
-        # a suspect first-ever entry must not seed `best` either —
-        # setdefault seeding would persist the corrupted value as the bar
-        if v > rec.get("best", 0) and k not in suspect:
+        # a suspect or physically impossible first-ever entry must not
+        # seed `best` either — setdefault seeding would persist the
+        # corrupted value as the bar
+        if v > rec.get("best", 0) and k not in suspect and v <= cap:
             rec["best"] = v
         deltas[k] = round(v / rec.get("best", v), 3)
         # medians compare against the best MEDIAN, not the pre-round-4
         # single-shot maxima the "best" field accumulated (those rode the
         # +20% tail of the noise band; a median can sit at 0.8x of them
         # forever without any regression)
-        if v > rec.get("best_median", 0) and k not in suspect:
+        if v > rec.get("best_median", 0) and k not in suspect and v <= cap:
             rec["best_median"] = v
         best_median_deltas[k] = round(v / rec.get("best_median", v), 3)
         # the GATE baseline is the trailing median of prior CLEAN runs
         # (runs that passed their own gate), not the best-ever median:
         # honest medians swing up to ~2x between tunneled chip
-        # allocations (matmul history spans 17-50 TFLOP/s), so a
-        # 0.7x-of-best floor would fail a healthy run on a slower chip.
-        # Violating runs are kept out of the baseline window — otherwise
-        # a sustained regression would drag the median down to itself
-        # within a few runs and the gate would self-normalize. If three
-        # consecutive violations agree within 15% the new level is
-        # accepted as a re-baseline (a persistent environment change,
-        # e.g. a permanently slower chip) — after failing visibly three
-        # times, not silently.
+        # allocations, so a 0.7x-of-best floor would fail a healthy run
+        # on a slower chip. Violating runs are kept out of the baseline
+        # window — otherwise a sustained regression would drag the median
+        # down to itself within a few runs and the gate would
+        # self-normalize. If three consecutive violations agree within
+        # 15% the new level is accepted as a re-baseline (a persistent
+        # environment change, e.g. a permanently slower chip) — after
+        # failing visibly three times, not silently.
         clean = rec.get("clean")
         if clean is None:
             clean = rec["runs"][:-1][-9:]  # migrate: prior history assumed clean
@@ -554,21 +986,27 @@ def numpy_cdist(x):
 def cdist_bench():
     """cdist GB/s on device vs single-process numpy.
 
-    Each trial is a separate jit call whose (n, n) output is a committed
-    HBM buffer — XLA cannot elide the write (inside one fused loop it can:
-    only the final scalar would be observable). Trials chain through a
-    device scalar so they execute sequentially; the host drops each output
-    reference immediately, keeping device memory bounded. Constant per-run
-    overhead cancels in the long-minus-short marginal difference, like the
-    kmeans timer above.
+    Each trial is a separate program whose (n, n) output is a committed
+    HBM buffer — XLA cannot elide the write (inside one fused loop it
+    can: only the final scalar would be observable). Headline: the
+    public ``ht.spatial.cdist(X, quadratic_expansion=True)`` on a
+    split=0 DNDarray (since r5 the GSPMD path dispatches ONE fused jitted
+    program, so the API writes the same single output buffer the kernel
+    trial does). Kernel comparator: the eps-chained jnp trial. The host
+    drops each output reference immediately, keeping device memory
+    bounded. Constant per-run overhead cancels in the long-minus-short
+    marginal difference, like the kmeans timer above.
     """
     import jax
     import jax.numpy as jnp
 
+    import heat_tpu as ht
+
     n, f = CDIST_N, CDIST_F
     rng = np.random.default_rng(1)
     data = rng.normal(size=(n, f)).astype(np.float32)
-    xa = jnp.asarray(data)
+    X = ht.array(data, split=0)
+    xa = X.larray
 
     @jax.jit
     def one_trial(x, eps):
@@ -584,7 +1022,7 @@ def cdist_bench():
     # execution is serialized by that data dependency, so at most two
     # (n, n) buffers are ever live on device (validated: no
     # RESOURCE_EXHAUSTED across repeated reps=24 runs on a single chip).
-    def timed(reps):
+    def timed_kernel(reps):
         best = float("inf")
         for _ in range(5):
             s = jnp.float32(0)
@@ -598,9 +1036,15 @@ def cdist_bench():
 
     float(one_trial(xa, jnp.float32(0))[0, 1])  # warm compile
     out_gb = n * n * 4 / 1e9
-    # same measurement semantics as every other metric: _marginal with
-    # the HBM roofline cap (per-trial work = one (n,n) output)
-    gbps = _marginal(timed, 4, 24, out_gb, cap=1.2 * PEAK_HBM_GBPS)
+
+    api_call = lambda: ht.spatial.cdist(X, quadratic_expansion=True)
+    fence = lambda d: float(np.asarray(d.larray[0, 1]))
+    fence(api_call())  # warm
+
+    k_gbps = _marginal(timed_kernel, 4, 24, out_gb, cap=CAPS["kernel_cdist_gbps"])
+    a_gbps = _marginal(
+        _api_timed(api_call, fence, attempts=5), 4, 24, out_gb, cap=CAPS["cdist_gbps"]
+    )
 
     # numpy baseline on a smaller n (same bytes/s semantics), best of 3
     nb = 8000
@@ -615,9 +1059,10 @@ def cdist_bench():
     base_gbps = _BASELINE_CACHE["cdist"]
 
     return {
-        "cdist_gbps": round(gbps, 2),
-        "cdist_unit": f"GB/s of (n,n) f32 output (n={n}, f={f})",
-        "cdist_vs_baseline": round(gbps / base_gbps, 2),
+        "cdist_gbps": round(a_gbps, 2),
+        "cdist_unit": f"GB/s of (n,n) f32 output via ht.spatial.cdist (n={n}, f={f})",
+        "cdist_vs_baseline": round(a_gbps / base_gbps, 2),
+        "kernel_cdist_gbps": round(k_gbps, 2),
     }
 
 

@@ -145,6 +145,12 @@ def _cholqr2_with_fallback(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     )
 
 
+# one fused program for the whole non-distributed factorization — called
+# eagerly, CholQR2's ~10 constituent ops would each round-trip HBM
+_cholqr2_jit = jax.jit(_cholqr2_with_fallback)
+_householder_jit = jax.jit(jnp.linalg.qr)
+
+
 def _cholqr2_batched_with_fallback(tiles: jnp.ndarray):
     """Tile-batched CholeskyQR2 with ONE fallback decision for the whole
     batch: the vmapped body carries no ``cond`` (which would select-execute
@@ -190,9 +196,9 @@ def _qr_impl(
         # the reference's ``__split1_qr_loop`` did a per-block loop)
         x = a._logical().astype(ftype)
         if _use_cholqr2(method, m, n, x.dtype):
-            q, r = _cholqr2_with_fallback(x)
+            q, r = _cholqr2_jit(x)
         else:
-            q, r = jnp.linalg.qr(x)
+            q, r = _householder_jit(x)
         # world-size-invariant metadata: split=0 input yields a replicated
         # R exactly like the distributed TSQR path (the ws=1 degenerate
         # case must not carry different splits than ws>1)
