@@ -17,6 +17,7 @@ from . import nn
 from . import optim
 from . import parallel
 from . import regression
+from . import resilience
 from . import spatial
 from . import utils
 from .core import random

@@ -26,16 +26,29 @@ class ExecutableCache(OrderedDict):
             value = super().__getitem__(key)
         except KeyError:
             return default
-        self.move_to_end(key)
+        self._touch(key)
         return value
 
     def __getitem__(self, key):
         value = super().__getitem__(key)
-        self.move_to_end(key)
+        self._touch(key)
         return value
 
     def __setitem__(self, key, value):
         super().__setitem__(key, value)
         self.move_to_end(key)
+        # evict oldest-first WITHOUT OrderedDict.popitem: on CPython 3.10
+        # popitem() re-enters the overridden __getitem__ after unlinking
+        # the node, so the LRU touch raised KeyError and corrupted the
+        # cache the first time it ever filled up
         while len(self) > self.maxsize:
-            self.popitem(last=False)
+            del self[next(iter(self))]
+
+    def _touch(self, key) -> None:
+        # inherited methods (pop, popitem, ...) may call __getitem__ for a
+        # key they have already unlinked — a failed recency touch must not
+        # turn a successful lookup into a KeyError
+        try:
+            self.move_to_end(key)
+        except KeyError:
+            pass

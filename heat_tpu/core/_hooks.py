@@ -1,0 +1,54 @@
+"""Fault-injection hook points (consumed by :mod:`heat_tpu.resilience.chaos`).
+
+Production code calls :func:`fault_point` at the places where real
+deployments fail — file opens/writes/commits in :mod:`heat_tpu.core.io`,
+shard assembly and host allgathers in :mod:`heat_tpu.core.communication`,
+checkpoint shard serialization — and the call is a no-op unless an
+injector has been installed. ``resilience.chaos(...)`` installs a seeded
+injector for the duration of a ``with`` block, which lets every recovery
+path (retry, atomic rename, checksum verification) be exercised
+deterministically on CPU.
+
+This module is dependency-free on purpose: ``core`` must not import
+``resilience`` at module scope (resilience sits above core), so the
+registry lives down here and chaos reaches down to install itself.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+# the active injector: fn(name, ctx) -> None, may raise to simulate a
+# fault and may mutate ``ctx`` values in place (e.g. corrupt a byte
+# buffer). None means fault injection is off (the production state).
+_INJECTOR: Optional[Callable[[str, Dict], None]] = None
+
+
+def set_injector(injector: Optional[Callable[[str, Dict], None]]):
+    """Install (or with ``None`` remove) the process-wide fault injector.
+
+    Returns the previous injector so callers can restore it (the chaos
+    context manager nests correctly).
+    """
+    global _INJECTOR
+    prev = _INJECTOR
+    _INJECTOR = injector
+    return prev
+
+
+def get_injector() -> Optional[Callable[[str, Dict], None]]:
+    return _INJECTOR
+
+
+def fault_point(name: str, **ctx) -> Dict:
+    """Declare a fault-injection site.
+
+    ``name`` is a dotted site id (``"io.open"``, ``"io.commit"``,
+    ``"collective.assemble"``, ``"checkpoint.shard_bytes"`` ...). The
+    installed injector may raise (OSError, TimeoutError, ...) to simulate
+    a failure at this site, or mutate mutable ``ctx`` entries (e.g. a
+    ``bytearray`` payload) to simulate corruption. Returns ``ctx`` so call
+    sites can read mutated values back.
+    """
+    if _INJECTOR is not None:
+        _INJECTOR(name, ctx)
+    return ctx

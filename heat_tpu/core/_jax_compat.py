@@ -1,0 +1,41 @@
+"""Version-compatibility shims for the underlying jax runtime.
+
+The library targets the modern ``jax.shard_map`` entry point (keyword
+``check_vma``). Older runtimes (<= 0.4.x) only ship
+``jax.experimental.shard_map.shard_map`` with the keyword spelled
+``check_rep``. Importing this module installs a translating wrapper at
+``jax.shard_map`` when the top-level name is missing, so every
+``from jax import shard_map`` site in the package works on both runtimes.
+
+This must be imported before any module that does
+``from jax import shard_map`` at module scope (``heat_tpu.core.__init__``
+imports it first).
+"""
+from __future__ import annotations
+
+import jax
+
+if not hasattr(jax, "shard_map"):
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, check_rep=None, **kwargs):
+        """``jax.shard_map`` signature adapter over the experimental API."""
+        if check_rep is None and check_vma is not None:
+            check_rep = check_vma
+        if check_rep is not None:
+            kwargs["check_rep"] = check_rep
+        return _experimental_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+        )
+
+    jax.shard_map = shard_map
+
+if not hasattr(jax.lax, "axis_size"):
+
+    def _axis_size(axis_name):
+        """``jax.lax.axis_size`` backport: on runtimes without it,
+        ``psum(1, axis)`` of a Python scalar evaluates statically inside
+        ``shard_map``/``pmap`` and yields the mapped axis size as an int."""
+        return jax.lax.psum(1, axis_name)
+
+    jax.lax.axis_size = _axis_size

@@ -371,6 +371,9 @@ def _assemble_from_chunks(read_chunk, gshape, split, comm, np_dtype):
     of the reference's per-rank parallel reads (``io.py:57-147``). No
     device and no host ever holds the full array.
     """
+    from . import _hooks
+
+    _hooks.fault_point("collective.assemble", gshape=tuple(gshape), split=split)
     pshape = comm.padded_shape(gshape, split)
     sharding = comm.array_sharding(pshape, split)
     block_shape = list(pshape)
@@ -386,6 +389,9 @@ def _assemble_from_chunks(read_chunk, gshape, split, comm, np_dtype):
             buf = np.zeros(tuple(block_shape), dtype=np_dtype)
             if all(s > 0 for s in lshape):
                 buf[tuple(slice(0, s) for s in lshape)] = read_chunk(slices)
+                # chaos can plant NaNs here — the simulated silently-
+                # corrupted shard that validate()/health_check() must catch
+                _hooks.fault_point("collective.shard", array=buf, rank=rank)
             blocks[rank] = buf
         arrays.append(jax.device_put(blocks[rank], dev))
     return jax.make_array_from_single_device_arrays(pshape, sharding, arrays)
@@ -400,6 +406,9 @@ def ragged_process_allgather(arr: np.ndarray, axis: int = 0):
     merge, and ``nonzero``'s coordinate concat all route through it."""
     from jax.experimental import multihost_utils
 
+    from . import _hooks
+
+    _hooks.fault_point("collective.allgather", shape=tuple(np.asarray(arr).shape))
     nproc = jax.process_count()
     moved = np.moveaxis(np.asarray(arr), axis, 0)
     counts = np.asarray(

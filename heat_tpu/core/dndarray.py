@@ -414,6 +414,16 @@ class DNDarray:
         non-canonical target map."""
         return self.lcounts is None
 
+    def health_check(self, check_values: bool = False) -> "DNDarray":
+        """Validate this array's distributed invariants — ``gshape`` vs
+        ``lshape_map`` vs the physical buffer, dtype annotation, split
+        range; ``check_values=True`` additionally scans the logical values
+        for NaN/Inf. Raises :class:`heat_tpu.resilience.ValidationError`
+        on any violation; returns ``self`` when healthy (chainable)."""
+        from ..resilience.validate import validate
+
+        return validate(self, check_values=check_values)
+
     @property
     def ndim(self) -> int:
         return len(self.__gshape)

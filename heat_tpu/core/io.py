@@ -18,7 +18,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import devices, types
+from . import _hooks, devices, types
+from ._atomic import atomic_write, tmp_path_for
+from ._retry import NO_RETRY, RetryPolicy
 from .communication import _assemble_from_chunks, sanitize_comm
 from .dndarray import DNDarray
 
@@ -67,18 +69,35 @@ def supports_netcdf() -> bool:
     return __HAS_NETCDF or __HAS_HDF5
 
 
-def load(path: str, *args, **kwargs) -> DNDarray:
-    """Load by file extension (reference ``io.py:662``)."""
+def load(path: str, *args, retry: Optional[RetryPolicy] = None, **kwargs) -> DNDarray:
+    """Load by file extension (reference ``io.py:662``).
+
+    A missing file raises ``FileNotFoundError`` naming the path *before*
+    extension dispatch — the backends otherwise surface inconsistent
+    ``OSError``/``KeyError`` texts for the same mistake. ``retry`` (a
+    :class:`~heat_tpu.resilience.retry.RetryPolicy`) reruns the whole
+    backend read on transient OSError/TimeoutError with backoff; the
+    default is a single attempt.
+    """
     if not isinstance(path, str):
         raise TypeError(f"Expected path to be str, but was {type(path)}")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no such file: {path!r}")
     extension = os.path.splitext(path)[-1].strip().lower()
     if extension in (".h5", ".hdf5"):
-        return load_hdf5(path, *args, **kwargs)
-    if extension in __NETCDF_EXTENSIONS:
-        return load_netcdf(path, *args, **kwargs)
-    if extension == __CSV_EXTENSION:
-        return load_csv(path, *args, **kwargs)
-    raise ValueError(f"Unsupported file extension {extension}")
+        backend = load_hdf5
+    elif extension in __NETCDF_EXTENSIONS:
+        backend = load_netcdf
+    elif extension == __CSV_EXTENSION:
+        backend = load_csv
+    else:
+        raise ValueError(f"Unsupported file extension {extension}")
+
+    def attempt():
+        _hooks.fault_point("io.open", path=path)
+        return backend(path, *args, **kwargs)
+
+    return (retry or NO_RETRY).call(attempt, label=f"load({path!r})")
 
 
 def load_hdf5(
@@ -130,7 +149,15 @@ def load_hdf5(
 def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs) -> None:
     """Save to HDF5 (reference ``io.py:149``: parallel ``mpio`` driver or
     rank-serialized writes; rank-serialized here — each process writes only
-    its local shards' regions, coordinated by a global barrier)."""
+    its local shards' regions, coordinated by a global barrier).
+
+    Writes are ATOMIC: all bytes land in a temp file next to ``path``
+    (append modes first copy the existing file there) and ``os.replace``
+    commits only on success — a crash or injected mid-write fault can
+    never corrupt a previously-saved file. Multi-host, every process
+    stages into the SAME deterministic temp name and process 0 renames
+    after the success barrier.
+    """
     if not __HAS_HDF5:
         raise ImportError("h5py is required for HDF5 support")
     if not isinstance(data, DNDarray):
@@ -159,14 +186,31 @@ def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs
                 trim.append(slice(0, stop - start))
             if all(s.stop > s.start for s in sl):
                 local.append((tuple(sl), np.asarray(shard.data)[tuple(trim)]))
+        # all processes stage into the SAME temp file (deterministic
+        # suffix, NOT the pid); the destination is touched only by the
+        # final rename, so a failure at any round leaves it intact
+        tmp = tmp_path_for(path, suffix="mh")
+        err = None
+        try:
+            _hooks.fault_point("io.open", path=path)
+            if pid == 0 and mode != "w" and os.path.exists(path):
+                import shutil
+
+                shutil.copy2(path, tmp)  # append modes extend a copy
+        except BaseException as e:  # noqa: BLE001 - re-raised below
+            err = e
+        multihost_utils.sync_global_devices("heat_tpu_save_hdf5_prep")
         # a failed write must not desert the remaining barriers (the other
         # processes would hang forever) — carry the error through every
         # round, then let ALL processes fail together via a status gather
-        err = None
         for p in range(nproc):
             try:
-                if pid == p:
-                    with h5py.File(path, mode if p == 0 else "a") as handle:
+                if pid == p and err is None:
+                    # process 0 truncates (unless appending to the staged
+                    # copy — a stale temp from a crashed run must not leak
+                    # in); later ranks extend what round 0 created
+                    p0_mode = "a" if (mode != "w" and os.path.exists(tmp)) else "w"
+                    with h5py.File(tmp, p0_mode if p == 0 else "a") as handle:
                         if p == 0:
                             handle.create_dataset(
                                 dataset, shape=gshape, dtype=np.dtype(data.dtype.jax_type()), **kwargs
@@ -182,17 +226,38 @@ def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs
         statuses = np.asarray(
             multihost_utils.process_allgather(np.asarray([0 if err is None else 1]))
         ).ravel()
+        if err is None and not statuses.any() and pid == 0:
+            try:
+                _hooks.fault_point("io.commit", path=path, tmp_path=tmp)
+                os.replace(tmp, path)
+            except BaseException as e:  # noqa: BLE001
+                err = e
+        if (err is not None or statuses.any()) and pid == 0:
+            import contextlib
+
+            with contextlib.suppress(OSError):
+                os.remove(tmp)
+        # second gather: the commit itself may have failed on process 0
+        commit = np.asarray(
+            multihost_utils.process_allgather(np.asarray([0 if err is None else 1]))
+        ).ravel()
         if err is not None:
             raise err
-        if statuses.any():
+        if statuses.any() or commit.any():
             raise RuntimeError(
-                f"save_hdf5 failed on process(es) {np.nonzero(statuses)[0].tolist()}"
+                f"save_hdf5 failed on process(es) "
+                f"{np.nonzero(statuses | commit)[0].tolist()}"
             )
         return
     arr = data.numpy()
     if jax.process_index() == 0:
-        with h5py.File(path, mode) as handle:
-            handle.create_dataset(dataset, data=arr, **kwargs)
+        with atomic_write(path) as tmp:
+            if mode != "w" and os.path.exists(path):
+                import shutil
+
+                shutil.copy2(path, tmp)  # append modes extend a copy
+            with h5py.File(tmp, mode) as handle:
+                handle.create_dataset(dataset, data=arr, **kwargs)
 
 
 def load_netcdf(path: str, variable: str, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
@@ -325,7 +390,9 @@ def save_netcdf(
         try:
             if jax.process_index() == 0:
                 version = 2 if "64BIT" in format.upper() else 1
-                write_netcdf3(path, variable, data.numpy(), version=version)
+                arr = data.numpy()
+                with atomic_write(path) as tmp:
+                    write_netcdf3(tmp, variable, arr, version=version)
             else:
                 data.numpy()  # participate in the gather collectives
         except BaseException as e:  # noqa: BLE001 - re-raised after the barrier
@@ -348,28 +415,30 @@ def save_netcdf(
         raise ImportError("netCDF support needs netCDF4 or h5py installed")
     if mode not in ("w", "a", "r+"):
         raise ValueError(f"unsupported mode {mode!r}")
-    # the variable write reuses save_hdf5 — including its rank-serialized,
-    # barrier-coordinated multi-host path — then process 0 attaches the
-    # netCDF-4 dimension-scale structure
+    if jax.process_count() == 1:
+        # single-controller: variable AND dimension scales are staged in
+        # one temp file and committed with a single rename — fully atomic
+        arr = data.numpy()
+        with atomic_write(path) as tmp:
+            if mode != "w" and os.path.exists(path):
+                import shutil
+
+                shutil.copy2(path, tmp)
+            with h5py.File(tmp, "a" if (mode != "w" and os.path.exists(tmp)) else "w") as handle:
+                handle.create_dataset(variable, data=arr, **kwargs)
+                _attach_netcdf_scales(handle, variable, data.gshape)
+        return
+    # multi-host: the variable write reuses save_hdf5 — including its
+    # rank-serialized, barrier-coordinated, temp-staged atomic path — then
+    # process 0 attaches the netCDF-4 dimension-scale structure (a second
+    # phase on the committed file; a failure there leaves the data intact
+    # but scale-less)
     save_hdf5(data, path, variable, mode=mode, **kwargs)
     err = None
     try:
         if jax.process_index() == 0:
             with h5py.File(path, "r+") as handle:
-                var = handle[variable]
-                for i, n_i in enumerate(data.gshape):
-                    dname = f"dim_{i}_{variable}" if f"dim_{i}" in handle else f"dim_{i}"
-                    # shape-only dataset: netCDF4's own phony dimensions
-                    # never materialize their fill storage either
-                    scale = handle.create_dataset(dname, shape=(n_i,), dtype=np.float32)
-                    scale.make_scale(dname)
-                    # netCDF4's phony-dimension marker: these are
-                    # dimensions, not data variables (load_netcdf refuses
-                    # to load them)
-                    scale.attrs["NAME"] = np.bytes_(
-                        b"This is a netCDF dimension but not a netCDF variable. %10d" % n_i
-                    )
-                    var.dims[i].attach_scale(scale)
+                _attach_netcdf_scales(handle, variable, data.gshape)
     except BaseException as e:  # noqa: BLE001 - re-raised after the barrier
         err = e
     if jax.process_count() > 1:
@@ -387,6 +456,24 @@ def save_netcdf(
             )
     if err is not None:
         raise err
+
+
+def _attach_netcdf_scales(handle, variable: str, gshape) -> None:
+    """Register per-dimension datasets as HDF5 dimension scales and attach
+    them to ``variable`` — the on-disk structure of the netCDF-4 data model."""
+    var = handle[variable]
+    for i, n_i in enumerate(gshape):
+        dname = f"dim_{i}_{variable}" if f"dim_{i}" in handle else f"dim_{i}"
+        # shape-only dataset: netCDF4's own phony dimensions never
+        # materialize their fill storage either
+        scale = handle.create_dataset(dname, shape=(n_i,), dtype=np.float32)
+        scale.make_scale(dname)
+        # netCDF4's phony-dimension marker: these are dimensions, not data
+        # variables (load_netcdf refuses to load them)
+        scale.attrs["NAME"] = np.bytes_(
+            b"This is a netCDF dimension but not a netCDF variable. %10d" % n_i
+        )
+        var.dims[i].attach_scale(scale)
 
 
 def _py_csv_range(path, offset, length, header_lines, sep, encoding):
@@ -634,25 +721,49 @@ def save_csv(
         if header_lines is not None:
             header = "\n".join(header_lines) if not isinstance(header_lines, str) else header_lines
         if truncate or not os.path.exists(path):
-            mode = "w"
+            # full overwrite: render to bytes, then one atomic staged
+            # write — a mid-write crash (or injected torn write) can never
+            # corrupt an existing file
+            import io as _io_module
+
+            buf = _io_module.StringIO()
+            np.savetxt(buf, arr, fmt=fmt, delimiter=sep, header=header or "", comments="")
+            from ._atomic import atomic_write_bytes
+
+            atomic_write_bytes(path, buf.getvalue().encode(encoding))
         else:
             # reference semantics (io.py:926): without truncation the file
-            # is overwritten from offset 0 but never shortened
-            mode = "r+"
-        with open(path, mode, encoding=encoding) as fh:
-            fh.seek(0)
-            np.savetxt(fh, arr, fmt=fmt, delimiter=sep, header=header or "", comments="")
+            # is overwritten from offset 0 but never shortened — stale
+            # trailing rows must survive, so this path is inherently
+            # in-place (copy to temp first to keep the crash guarantee)
+            with atomic_write(path) as tmp:
+                import shutil
+
+                shutil.copy2(path, tmp)
+                with open(tmp, "r+", encoding=encoding) as fh:
+                    fh.seek(0)
+                    np.savetxt(fh, arr, fmt=fmt, delimiter=sep, header=header or "", comments="")
 
 
-def save(data: DNDarray, path: str, *args, **kwargs) -> None:
-    """Save by file extension (reference ``io.py:1060``)."""
+def save(data: DNDarray, path: str, *args, retry: Optional[RetryPolicy] = None, **kwargs) -> None:
+    """Save by file extension (reference ``io.py:1060``).
+
+    All backends write atomically (temp file + ``os.replace``), so a
+    failed attempt never corrupts an existing file and ``retry`` (a
+    :class:`~heat_tpu.resilience.retry.RetryPolicy`) can safely rerun the
+    whole save on transient OSError/TimeoutError.
+    """
     if not isinstance(path, str):
         raise TypeError(f"Expected path to be str, but was {type(path)}")
     extension = os.path.splitext(path)[-1].strip().lower()
     if extension in (".h5", ".hdf5"):
-        return save_hdf5(data, path, *args, **kwargs)
-    if extension in __NETCDF_EXTENSIONS:
-        return save_netcdf(data, path, *args, **kwargs)
-    if extension == __CSV_EXTENSION:
-        return save_csv(data, path, *args, **kwargs)
-    raise ValueError(f"Unsupported file extension {extension}")
+        backend = save_hdf5
+    elif extension in __NETCDF_EXTENSIONS:
+        backend = save_netcdf
+    elif extension == __CSV_EXTENSION:
+        backend = save_csv
+    else:
+        raise ValueError(f"Unsupported file extension {extension}")
+    return (retry or NO_RETRY).call(
+        backend, data, path, *args, label=f"save({path!r})", **kwargs
+    )

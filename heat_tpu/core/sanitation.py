@@ -10,7 +10,7 @@ from . import types
 from .dndarray import DNDarray
 from .stride_tricks import sanitize_axis
 
-__all__ = ["sanitize_in", "sanitize_in_tensor", "sanitize_infinity", "sanitize_out", "sanitize_distribution", "sanitize_sequence", "sanitize_lshape", "scalar_to_1d"]
+__all__ = ["sanitize_in", "sanitize_in_tensor", "sanitize_infinity", "sanitize_out", "sanitize_distribution", "sanitize_sequence", "sanitize_lshape", "sanitize_split", "scalar_to_1d", "validate_layout"]
 
 
 _WARNED_KNOBS = set()
@@ -89,6 +89,52 @@ def sanitize_lshape(array: DNDarray, tensor) -> None:
     ``sanitation.py:213``)."""
     if tuple(tensor.shape) != tuple(array.lshape):
         raise ValueError(f"local tensor shape {tensor.shape} does not match lshape {array.lshape}")
+
+
+def sanitize_split(shape, split) -> Optional[int]:
+    """Validate (and normalize negatives of) a ``split`` annotation against
+    a global shape; raises ValueError outside ``[-ndim, ndim)``. The
+    resilience layer and the checkpoint manifest reader both route through
+    this so an on-disk/in-memory split is checked in one place."""
+    return sanitize_axis(tuple(int(s) for s in shape), split)
+
+
+def validate_layout(gshape, split, lshape_map, comm) -> None:
+    """Cross-check the structural invariants tying ``gshape``, ``split``
+    and ``lshape_map`` together (used by :func:`heat_tpu.resilience.validate`
+    and ``DNDarray.health_check``).
+
+    Raises ValueError naming the first violated invariant:
+
+    - ``lshape_map`` has one row per shard (``comm.size``) and one column
+      per dimension;
+    - non-split columns all equal the global extent;
+    - the split column sums to the global split extent;
+    - ``split`` (when not None) indexes a real dimension.
+    """
+    gshape = tuple(int(s) for s in gshape)
+    split = sanitize_split(gshape, split)
+    lmap = np.asarray(lshape_map)
+    if lmap.shape != (comm.size, len(gshape)):
+        raise ValueError(
+            f"lshape_map shape {lmap.shape} does not match "
+            f"(size, ndim) = ({comm.size}, {len(gshape)})"
+        )
+    for d in range(len(gshape)):
+        if split is not None and d == split:
+            total = int(lmap[:, d].sum())
+            if total != gshape[d]:
+                raise ValueError(
+                    f"split-dim {d} shard extents {lmap[:, d].tolist()} sum to "
+                    f"{total}, but gshape[{d}] = {gshape[d]}"
+                )
+        else:
+            bad = [int(v) for v in lmap[:, d] if int(v) != gshape[d]]
+            if bad:
+                raise ValueError(
+                    f"non-split dim {d}: shard extents {lmap[:, d].tolist()} "
+                    f"disagree with gshape[{d}] = {gshape[d]}"
+                )
 
 
 def sanitize_in_tensor(x) -> None:

@@ -31,19 +31,54 @@ def make_hierarchical_mesh(
     devices: Optional[Sequence] = None,
     slow_axis: str = "nodes",
     fast_axis: str = SPLIT_AXIS,
+    validate: bool = True,
 ) -> Mesh:
     """2-D (slow × fast) mesh for DASO-style hierarchical data parallelism.
 
     ``n_slow`` defaults to the number of processes (hosts), so the fast axis
     maps onto intra-host ICI and the slow axis onto inter-host DCN — the
     TPU-native version of the reference's node-local/global split.
+
+    ``validate=True`` additionally checks the resulting mesh is sane: no
+    device appears twice, and when ``devices`` is omitted the mesh covers
+    every addressable device exactly once. Pass ``validate=False`` to
+    build a mesh over a deliberate subset.
     """
     if devices is None:
         devices = jax.devices()
+        check_coverage = validate
+    else:
+        check_coverage = False
     devices = list(devices)
     if n_slow is None:
         n_slow = max(jax.process_count(), 1)
+    if n_slow < 1:
+        raise ValueError(f"n_slow must be >= 1, got n_slow={n_slow}")
     if len(devices) % n_slow:
-        raise ValueError(f"{len(devices)} devices not divisible into {n_slow} groups")
+        raise ValueError(
+            f"cannot build a hierarchical mesh: {len(devices)} device(s) do not "
+            f"divide evenly into n_slow={n_slow} group(s) "
+            f"({len(devices)} % {n_slow} = {len(devices) % n_slow}); pick an "
+            f"n_slow that divides the device count"
+        )
     arr = np.array(devices).reshape(n_slow, len(devices) // n_slow)
+    if validate:
+        _validate_mesh_devices(arr, check_coverage=check_coverage)
     return Mesh(arr, axis_names=(slow_axis, fast_axis))
+
+
+def _validate_mesh_devices(device_array: np.ndarray, check_coverage: bool) -> None:
+    """Every device at most once; with ``check_coverage``, every
+    addressable device exactly once."""
+    flat = list(device_array.ravel())
+    ids = [getattr(d, "id", d) for d in flat]
+    dupes = sorted({i for i in ids if ids.count(i) > 1})
+    if dupes:
+        raise ValueError(f"mesh contains duplicate device id(s) {dupes}")
+    if check_coverage:
+        missing = [d.id for d in jax.local_devices() if d not in set(flat)]
+        if missing:
+            raise ValueError(
+                f"mesh does not cover addressable device id(s) {sorted(missing)}: "
+                f"every addressable device must appear exactly once"
+            )

@@ -1,0 +1,50 @@
+"""Resilience subsystem: durable sharded state + chaos testing.
+
+The paper's SPMD execution model (every rank runs the same script,
+collectives fire eagerly inside ops) has no recovery story: one failed
+host or torn file write poisons the whole computation. This package adds
+the production-side counterweights:
+
+- :mod:`~heat_tpu.resilience.checkpoint` — sharded, checksummed, atomic
+  ``save_checkpoint`` / ``load_checkpoint`` with restore-onto-any-mesh;
+- :mod:`~heat_tpu.resilience.chaos` — seeded deterministic fault
+  injection into I/O and collective entry points (testable on CPU);
+- :mod:`~heat_tpu.resilience.retry` — :class:`RetryPolicy` exponential
+  backoff + jitter, wired into ``core.io`` and checkpoint I/O;
+- :mod:`~heat_tpu.resilience.validate` — runtime invariant validation
+  (``resilience.validate(x)`` / ``DNDarray.health_check()``).
+
+See ``docs/RESILIENCE.md`` for the manifest format, chaos knobs, and the
+failure-modes table.
+"""
+from . import chaos as _chaos_mod  # noqa: F401
+from .chaos import Injection, chaos
+from .checkpoint import (
+    CHECKPOINT_FORMAT,
+    CheckpointCorruptionError,
+    CheckpointError,
+    MANIFEST_NAME,
+    load_checkpoint,
+    read_manifest,
+    save_checkpoint,
+)
+from .retry import DEFAULT_CHECKPOINT_POLICY, NO_RETRY, RetryError, RetryPolicy
+from .validate import ValidationError, validate
+
+__all__ = [
+    "chaos",
+    "Injection",
+    "save_checkpoint",
+    "load_checkpoint",
+    "read_manifest",
+    "CheckpointError",
+    "CheckpointCorruptionError",
+    "CHECKPOINT_FORMAT",
+    "MANIFEST_NAME",
+    "RetryPolicy",
+    "RetryError",
+    "NO_RETRY",
+    "DEFAULT_CHECKPOINT_POLICY",
+    "validate",
+    "ValidationError",
+]

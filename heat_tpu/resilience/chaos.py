@@ -1,0 +1,147 @@
+"""Deterministic fault injection ("chaos") for I/O and collective paths.
+
+``with resilience.chaos(seed=0, io_error=0.3):`` installs a seeded
+injector into the fault points declared across :mod:`heat_tpu.core`
+(:mod:`~heat_tpu.core._hooks`): file opens/writes/commits in ``core.io``
+and the checkpointer, and shard-assembly / host-allgather entry points in
+``core.communication``. Faults fire from a ``random.Random(seed)`` stream
+— one draw per fault point hit, in program order — so a given seed
+produces the identical failure schedule on every run, which makes
+recovery paths (RetryPolicy, atomic rename, checksum verification)
+testable on CPU with no real hardware faults.
+
+Fault kinds (independent probabilities, checked in this order against a
+single uniform draw):
+
+- ``torn_write``  — payload-carrying sites only: the staged bytes are
+  truncated mid-buffer and an OSError is raised (a crash mid-write);
+- ``corrupt``     — payload sites: bytes are flipped *silently* (no
+  exception) — the file commits and only checksum verification can catch
+  it; array sites: NaNs are planted in the shard values;
+- ``io_error``    — an OSError is raised at the site;
+- ``timeout``     — a TimeoutError is raised at the site.
+
+``max_faults`` caps the total number of injected faults, after which all
+sites pass — the standard recipe for "transient" faults that a
+RetryPolicy must survive: ``chaos(io_error=1.0, max_faults=2)`` fails the
+first two attempts and lets the third through, deterministically.
+"""
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..core import _hooks
+
+__all__ = ["chaos", "Injection"]
+
+# site categories a chaos context can target (site id prefix before ".")
+_KNOWN_TARGETS = ("io", "collective", "checkpoint")
+
+
+@dataclass
+class Injection:
+    """Record of one injected fault (exposed as ``chaos(...).injected``)."""
+
+    site: str
+    kind: str
+    detail: str = ""
+
+
+@dataclass
+class chaos:
+    """Context manager injecting deterministic faults; see module docs.
+
+    Parameters
+    ----------
+    seed : int
+        Seeds the fault stream; same seed + same program = same faults.
+    io_error, timeout, torn_write, corrupt : float
+        Per-site probabilities in [0, 1] for each fault kind.
+    targets : sequence of {"io", "collective", "checkpoint"}
+        Which site categories participate; others always pass.
+    max_faults : int, optional
+        Stop injecting after this many faults (transient-fault recipe).
+    """
+
+    seed: int = 0
+    io_error: float = 0.0
+    timeout: float = 0.0
+    torn_write: float = 0.0
+    corrupt: float = 0.0
+    targets: Sequence[str] = _KNOWN_TARGETS
+    max_faults: Optional[int] = None
+    injected: List[Injection] = field(default_factory=list, init=False)
+    draws: int = field(default=0, init=False)
+
+    def __post_init__(self):
+        unknown = set(self.targets) - set(_KNOWN_TARGETS)
+        if unknown:
+            raise ValueError(f"unknown chaos targets {sorted(unknown)}; known: {_KNOWN_TARGETS}")
+        for knob in ("io_error", "timeout", "torn_write", "corrupt"):
+            p = getattr(self, knob)
+            if not 0.0 <= p <= 1.0:
+                raise ValueError(f"{knob} must be a probability in [0, 1], got {p}")
+
+    # -- context management ------------------------------------------------
+    def __enter__(self) -> "chaos":
+        self._rng = random.Random(self.seed)
+        self.injected = []
+        self.draws = 0
+        self._prev = _hooks.set_injector(self._inject)
+        return self
+
+    def __exit__(self, *exc):
+        _hooks.set_injector(self._prev)
+        return False
+
+    # -- the injector ------------------------------------------------------
+    def _exhausted(self) -> bool:
+        return self.max_faults is not None and len(self.injected) >= self.max_faults
+
+    def _inject(self, site: str, ctx: dict) -> None:
+        category = site.split(".", 1)[0]
+        if category not in self.targets or self._exhausted():
+            return
+        u = self._rng.random()
+        self.draws += 1
+        payload = ctx.get("payload")  # bytearray at byte-write sites
+        array = ctx.get("array")  # np.ndarray at shard-assembly sites
+        threshold = 0.0
+        if payload is not None or array is not None:
+            threshold += self.torn_write
+            if u < threshold and payload is not None:
+                cut = max(1, len(payload) // 2)
+                del payload[cut:]
+                self.injected.append(Injection(site, "torn_write", f"truncated to {cut}B"))
+                raise OSError(f"chaos[{site}]: torn write (crashed mid-buffer)")
+            threshold += self.corrupt
+            if u < threshold:
+                if payload is not None and len(payload):
+                    # flip a deterministic byte PAST the .npy header so the
+                    # file still parses but its checksum no longer matches
+                    pos = min(len(payload) - 1, 128 + int(u * 1000) % max(1, len(payload) - 128))
+                    payload[pos] ^= 0xFF
+                    self.injected.append(Injection(site, "corrupt", f"flipped byte {pos}"))
+                elif array is not None and np.issubdtype(array.dtype, np.floating) and array.size:
+                    flat = array.reshape(-1)
+                    flat[int(u * 1000) % flat.size] = np.nan
+                    self.injected.append(Injection(site, "corrupt", "planted NaN"))
+                return  # silent corruption: no exception, commit proceeds
+        threshold += self.io_error
+        if u < threshold:
+            self.injected.append(Injection(site, "io_error", ""))
+            raise OSError(f"chaos[{site}]: injected I/O failure")
+        threshold += self.timeout
+        if u < threshold:
+            self.injected.append(Injection(site, "timeout", ""))
+            raise TimeoutError(f"chaos[{site}]: injected timeout")
+
+    # -- reporting ---------------------------------------------------------
+    def report(self) -> str:
+        lines = [f"chaos(seed={self.seed}): {len(self.injected)} fault(s) in {self.draws} draw(s)"]
+        lines += [f"  {i.kind:>10} @ {i.site} {i.detail}".rstrip() for i in self.injected]
+        return "\n".join(lines)

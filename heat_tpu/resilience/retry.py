@@ -1,0 +1,22 @@
+"""Retry / backoff policies (public face of :mod:`heat_tpu.core._retry`).
+
+The implementation lives in ``core`` so ``core.io`` can use it without an
+import cycle; this module re-exports it and holds the resilience-level
+defaults:
+
+- :data:`NO_RETRY` — single attempt, the default for plain ``ht.load`` /
+  ``ht.save`` (unchanged behavior unless the caller opts in);
+- :data:`DEFAULT_CHECKPOINT_POLICY` — 3 attempts with exponential backoff,
+  the default for checkpoint I/O, where transient filesystem hiccups
+  (NFS/GCS flakiness) are the common failure and a retry is always safe
+  because every write is atomic (write-temp-then-rename).
+"""
+from __future__ import annotations
+
+from ..core._retry import NO_RETRY, RetryError, RetryPolicy
+
+__all__ = ["RetryPolicy", "RetryError", "NO_RETRY", "DEFAULT_CHECKPOINT_POLICY"]
+
+DEFAULT_CHECKPOINT_POLICY = RetryPolicy(
+    max_attempts=3, base_delay=0.05, max_delay=2.0, multiplier=2.0, jitter=0.1, seed=0
+)

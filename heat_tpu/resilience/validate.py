@@ -1,0 +1,81 @@
+"""Runtime invariant validation for distributed arrays.
+
+``resilience.validate(x)`` (and the method form ``x.health_check()``)
+cross-checks the metadata triangle a DNDarray must keep consistent —
+``gshape`` vs ``lshape_map`` vs the physical buffer — plus the dtype
+annotation and the split-axis range, and optionally scans the logical
+values for NaN/Inf. A silently-corrupted shard (bitflip, torn read,
+injected NaN) is caught here before it poisons a whole SPMD computation.
+
+Structural checks reuse :func:`heat_tpu.core.sanitation.validate_layout`
+so the invariants live in one place.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import types
+from ..core.dndarray import DNDarray
+from ..core.sanitation import sanitize_in, validate_layout
+
+__all__ = ["validate", "ValidationError"]
+
+
+class ValidationError(ValueError):
+    """A DNDarray invariant does not hold; ``problems`` lists every
+    violation found (validation continues past the first failure so one
+    report names them all)."""
+
+    def __init__(self, problems: List[str]):
+        self.problems = list(problems)
+        super().__init__(
+            "DNDarray failed health check:\n" + "\n".join(f"  - {p}" for p in self.problems)
+        )
+
+
+def validate(x: DNDarray, check_values: bool = False) -> DNDarray:
+    """Check ``x``'s distributed invariants; returns ``x`` on success.
+
+    Structural checks (always): ``split`` indexes a real dimension;
+    ``lshape_map`` is (size, ndim), its non-split columns equal ``gshape``,
+    its split column sums to the split extent; the physical buffer has the
+    padded shape ``comm.padded_shape(gshape, split)`` and the dtype the
+    annotation promises.
+
+    Value checks (``check_values=True``): every *logical* element of an
+    inexact-dtype array is finite — tail padding is excluded, so garbage
+    pad content (by design unspecified) never trips the scan.
+
+    Raises :class:`ValidationError` listing every violated invariant.
+    """
+    sanitize_in(x)
+    problems: List[str] = []
+    try:
+        validate_layout(x.gshape, x.split, x.lshape_map, x.comm)
+    except ValueError as e:
+        problems.append(str(e))
+    expected_pshape = x.comm.padded_shape(x.gshape, x.split)
+    buf = x.larray
+    if tuple(buf.shape) != tuple(expected_pshape):
+        problems.append(
+            f"physical buffer shape {tuple(buf.shape)} != padded shape "
+            f"{tuple(expected_pshape)} for gshape {x.gshape}, split {x.split}"
+        )
+    promised = np.dtype(x.dtype.jax_type())
+    if np.dtype(buf.dtype) != promised:
+        problems.append(
+            f"buffer dtype {buf.dtype} does not match annotation "
+            f"{x.dtype.__name__} ({promised})"
+        )
+    if check_values and not types.heat_type_is_exact(x.dtype):
+        n_bad = int((~jnp.isfinite(x._logical())).sum())
+        if n_bad:
+            problems.append(
+                f"{n_bad} non-finite value(s) (NaN/Inf) in the logical array"
+            )
+    if problems:
+        raise ValidationError(problems)
+    return x

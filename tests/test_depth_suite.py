@@ -247,8 +247,14 @@ class TestIOFailures(TestCase):
                 ht.load_csv(os.path.join(d, "missing.csv"))
 
     def test_load_bad_extension_and_types(self):
-        with pytest.raises(ValueError):
+        # missing path wins over bad extension (checked before dispatch)
+        with pytest.raises(FileNotFoundError):
             ht.load("file.xyz")
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "file.xyz")
+            open(p, "w").close()
+            with pytest.raises(ValueError):
+                ht.load(p)
         with pytest.raises(TypeError):
             ht.load(42)
         with pytest.raises(TypeError):

@@ -62,6 +62,16 @@ def _assert_bounded(hlo: str, per_dev_bytes: int, c: float, what: str, allow_all
     )
 
 
+def _skip_on_old_gspmd():
+    """The buffer-bound HLO proofs are calibrated against the partitioner
+    of jax >= 0.5; older GSPMD emits wider intermediate buffers for the
+    same programs (a compiler property, not a kernel regression)."""
+    import jax
+
+    if jax.__version_info__ < (0, 5):
+        pytest.skip("HLO buffer-bound proofs need the jax >= 0.5 partitioner")
+
+
 def _comm():
     return ht.get_comm()
 
@@ -108,6 +118,7 @@ class TestReshapeBounded(TestCase):
         """A non-0-split reshape whose GSPMD program gathers must detour
         through split-0 + the kernel; force the decision and check the
         composite path end-to-end."""
+        _skip_on_old_gspmd()
         from heat_tpu.core import _movement
 
         comm = _comm()
@@ -200,6 +211,7 @@ class TestReshapeBounded(TestCase):
 class TestConcatenateBounded(TestCase):
     def test_hlo_no_allgather_bounded_buffers(self):
         _skip_unless_8()
+        _skip_on_old_gspmd()
         from heat_tpu.core._movement import concatenate_executable
 
         comm = _comm()
@@ -335,6 +347,7 @@ class TestTopkBounded(TestCase):
 class TestOuterBounded(TestCase):
     def test_hlo_gathers_only_second_operand(self):
         _skip_unless_8()
+        _skip_on_old_gspmd()
         from heat_tpu.core._movement import outer_executable
 
         comm = _comm()

@@ -17,6 +17,11 @@ import sys
 import numpy as np
 import pytest
 
+# each test here boots 2-4 real OS processes joined by jax.distributed and
+# drives whole sub-suites inside them — minutes of wall clock on a small
+# CPU box, so the file sits outside the tier-1 gate (-m 'not slow')
+pytestmark = pytest.mark.slow
+
 _WORKER = r"""
 import os, sys
 import jax

@@ -233,8 +233,15 @@ class TestIO(TestCase):
             np.testing.assert_array_equal(bi.numpy(), np.arange(23))
 
     def test_unsupported_extension(self):
-        with pytest.raises(ValueError):
+        # a missing path now raises FileNotFoundError BEFORE extension
+        # dispatch; the unsupported-extension ValueError needs a real file
+        with pytest.raises(FileNotFoundError):
             ht.load("/tmp/file.xyz")
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "file.xyz")
+            open(p, "w").close()
+            with pytest.raises(ValueError):
+                ht.load(p)
         with pytest.raises(ValueError):
             ht.save(ht.zeros(3), "/tmp/file.xyz")
 

@@ -1,0 +1,563 @@
+"""Resilience subsystem: sharded checkpoint/restore, chaos, retry, validate.
+
+Everything here runs on the virtual 8-device CPU mesh (conftest) — the
+acceptance bar is that every recovery path is exercisable with no TPU and
+no real faults, via the seeded chaos injector.
+"""
+import os
+import tempfile
+import unittest
+
+import jax
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu import resilience as rz
+from heat_tpu.core import _hooks
+
+from .base import TestCase
+
+
+def fast_policy(attempts=4, seed=0):
+    """Retry policy that never really sleeps (tests stay fast)."""
+    return rz.RetryPolicy(
+        max_attempts=attempts, base_delay=0.001, seed=seed, sleep=lambda s: None
+    )
+
+
+class TestCheckpointRoundTrip(TestCase):
+    def roundtrip(self, x, **load_kwargs):
+        with tempfile.TemporaryDirectory() as d:
+            manifest = rz.save_checkpoint(x, d)
+            self.assertTrue(os.path.exists(manifest))
+            y = rz.load_checkpoint(d, **load_kwargs)
+        np.testing.assert_array_equal(y.numpy(), x.numpy())
+        self.assertEqual(y.dtype, x.dtype)
+        return y
+
+    def test_split0_float(self):
+        x = ht.arange(23, dtype=ht.float32, split=0)
+        y = self.roundtrip(x)
+        self.assertEqual(y.split, 0)
+
+    def test_split1_2d(self):
+        x = ht.reshape(ht.arange(60, dtype=ht.float64), (5, 12)).resplit(1)
+        y = self.roundtrip(x)
+        self.assertEqual(y.split, 1)
+
+    def test_split_none(self):
+        x = ht.full((3, 4), 7.5, dtype=ht.float32)
+        y = self.roundtrip(x)
+        self.assertIsNone(y.split)
+
+    def test_int_dtype(self):
+        x = ht.arange(17, dtype=ht.int64, split=0)
+        y = self.roundtrip(x)
+        self.assertEqual(y.dtype, ht.int64)
+
+    def test_scalar(self):
+        y = self.roundtrip(ht.array(3.25))
+        self.assertEqual(y.ndim, 0)
+
+    def test_uneven_tail(self):
+        # 9 rows over 8 devices: last shards are short/empty
+        x = ht.reshape(ht.arange(27, dtype=ht.float32), (9, 3)).resplit(0)
+        self.roundtrip(x)
+
+    def test_restore_onto_fewer_devices(self):
+        x = ht.arange(23, dtype=ht.float32, split=0)
+        comm4 = ht.MeshCommunication(devices=jax.devices()[:4])
+        with tempfile.TemporaryDirectory() as d:
+            rz.save_checkpoint(x, d)
+            y = rz.load_checkpoint(d, comm=comm4)
+        self.assertEqual(y.comm.size, 4)
+        np.testing.assert_array_equal(y.numpy(), x.numpy())
+
+    def test_restore_onto_more_devices(self):
+        comm2 = ht.MeshCommunication(devices=jax.devices()[:2])
+        x = ht.arange(11, dtype=ht.float32, split=0, comm=comm2)
+        with tempfile.TemporaryDirectory() as d:
+            rz.save_checkpoint(x, d)
+            manifest = rz.read_manifest(d)
+            self.assertEqual(manifest["mesh"]["split_size"], 2)
+            y = rz.load_checkpoint(d)  # world comm: 8 devices
+        self.assertEqual(y.comm.size, 8)
+        self.assertEqual(y.split, 0)
+        np.testing.assert_array_equal(y.numpy(), x.numpy())
+
+    def test_manifest_contents(self):
+        x = ht.arange(23, dtype=ht.float32, split=0)
+        with tempfile.TemporaryDirectory() as d:
+            rz.save_checkpoint(x, d)
+            m = rz.read_manifest(d)
+            self.assertEqual(m["format"], rz.CHECKPOINT_FORMAT)
+            self.assertEqual(m["gshape"], [23])
+            self.assertEqual(m["dtype"], "float32")
+            self.assertEqual(m["split"], 0)
+            self.assertEqual(m["checksum"], "crc32")
+            # shards tile [0, 23) exactly, in order
+            offsets = [s["offset"] for s in m["shards"]]
+            lengths = [s["length"] for s in m["shards"]]
+            self.assertEqual(offsets, sorted(offsets))
+            self.assertEqual(sum(lengths), 23)
+            # every named shard file exists
+            for s in m["shards"]:
+                self.assertTrue(os.path.exists(os.path.join(d, s["file"])))
+
+    def test_sha256_checksum(self):
+        x = ht.arange(10, dtype=ht.float32, split=0)
+        with tempfile.TemporaryDirectory() as d:
+            rz.save_checkpoint(x, d, checksum="sha256")
+            self.assertEqual(rz.read_manifest(d)["checksum"], "sha256")
+            y = rz.load_checkpoint(d)
+        np.testing.assert_array_equal(y.numpy(), x.numpy())
+
+
+class TestCheckpointFailureModes(TestCase):
+    def test_corrupt_shard_detected(self):
+        x = ht.arange(23, dtype=ht.float32, split=0)
+        with tempfile.TemporaryDirectory() as d:
+            rz.save_checkpoint(x, d)
+            shard = sorted(f for f in os.listdir(d) if f.startswith("shard_"))[1]
+            p = os.path.join(d, shard)
+            raw = bytearray(open(p, "rb").read())
+            raw[-3] ^= 0xFF  # single bit-level corruption in the payload
+            open(p, "wb").write(bytes(raw))
+            with self.assertRaises(rz.CheckpointCorruptionError) as cm:
+                rz.load_checkpoint(d, retry=fast_policy(1))
+            # the diagnostic names the file and both digests
+            self.assertIn(shard, str(cm.exception))
+            self.assertIn("crc32", str(cm.exception))
+
+    def test_verify_false_skips_checksum(self):
+        x = ht.arange(23, dtype=ht.float32, split=0)
+        with tempfile.TemporaryDirectory() as d:
+            rz.save_checkpoint(x, d)
+            shard = sorted(f for f in os.listdir(d) if f.startswith("shard_"))[0]
+            p = os.path.join(d, shard)
+            raw = bytearray(open(p, "rb").read())
+            raw[-1] ^= 0x01
+            open(p, "wb").write(bytes(raw))
+            y = rz.load_checkpoint(d, verify=False, retry=fast_policy(1))
+            self.assertEqual(tuple(y.shape), (23,))
+
+    def test_missing_manifest(self):
+        with tempfile.TemporaryDirectory() as d:
+            with self.assertRaises(FileNotFoundError) as cm:
+                rz.load_checkpoint(d, retry=fast_policy(1))
+            self.assertIn(d, str(cm.exception))
+
+    def test_missing_shard_file(self):
+        x = ht.arange(23, dtype=ht.float32, split=0)
+        with tempfile.TemporaryDirectory() as d:
+            rz.save_checkpoint(x, d)
+            shard = sorted(f for f in os.listdir(d) if f.startswith("shard_"))[2]
+            os.remove(os.path.join(d, shard))
+            with self.assertRaises(rz.CheckpointError) as cm:
+                rz.load_checkpoint(d, retry=fast_policy(1))
+            self.assertIn(shard, str(cm.exception))
+
+    def test_garbled_manifest(self):
+        x = ht.arange(5, dtype=ht.float32, split=0)
+        with tempfile.TemporaryDirectory() as d:
+            rz.save_checkpoint(x, d)
+            with open(os.path.join(d, rz.MANIFEST_NAME), "w") as f:
+                f.write("{not json")
+            with self.assertRaises(rz.CheckpointCorruptionError):
+                rz.load_checkpoint(d, retry=fast_policy(1))
+
+    def test_save_under_transient_faults_then_bit_identical_restore(self):
+        # THE acceptance scenario: transient injected I/O faults during
+        # save are absorbed by the RetryPolicy; the restored array is
+        # bit-identical with the same dtype and split.
+        x = ht.reshape(ht.arange(46, dtype=ht.float32), (23, 2)).resplit(0)
+        with tempfile.TemporaryDirectory() as d:
+            with rz.chaos(seed=3, io_error=1.0, max_faults=2) as c:
+                rz.save_checkpoint(x, d, retry=fast_policy(4))
+            self.assertEqual(len(c.injected), 2)  # both faults absorbed
+            y = rz.load_checkpoint(d)
+        np.testing.assert_array_equal(y.numpy(), x.numpy())
+        self.assertEqual(y.dtype, x.dtype)
+        self.assertEqual(y.split, x.split)
+
+    def test_chaos_silent_corruption_caught_by_checksum(self):
+        # corrupt fires AFTER the checksum is computed and BEFORE bytes
+        # land on disk: the manifest is honest, the file is not
+        x = ht.arange(23, dtype=ht.float32, split=0)
+        with tempfile.TemporaryDirectory() as d:
+            with rz.chaos(seed=0, corrupt=1.0, targets=("io",)) as c:
+                rz.save_checkpoint(x, d, retry=fast_policy(1))
+            self.assertTrue(any(i.kind == "corrupt" for i in c.injected))
+            with self.assertRaises(rz.CheckpointCorruptionError):
+                rz.load_checkpoint(d, retry=fast_policy(1))
+
+    def test_torn_write_never_corrupts_committed_checkpoint(self):
+        x = ht.arange(23, dtype=ht.float32, split=0)
+        with tempfile.TemporaryDirectory() as d:
+            rz.save_checkpoint(x, d)
+            # a later save of DIFFERENT data dies with torn writes on
+            # every attempt; the original checkpoint must stay loadable
+            with rz.chaos(seed=1, torn_write=1.0):
+                with self.assertRaises((rz.RetryError, OSError)):
+                    rz.save_checkpoint(
+                        ht.zeros(23, dtype=ht.float32, split=0), d, retry=fast_policy(2)
+                    )
+            y = rz.load_checkpoint(d)
+        np.testing.assert_array_equal(y.numpy(), x.numpy())
+
+
+class TestChaos(TestCase):
+    def fire(self, seed, n=30, **kw):
+        outcomes = []
+        with rz.chaos(seed=seed, **kw) as c:
+            for _ in range(n):
+                try:
+                    _hooks.fault_point("io.open", path="x")
+                    outcomes.append("pass")
+                except TimeoutError:
+                    outcomes.append("timeout")
+                except OSError:
+                    outcomes.append("io_error")
+        return outcomes, c
+
+    def test_deterministic_given_seed(self):
+        a, _ = self.fire(7, io_error=0.4, timeout=0.2)
+        b, _ = self.fire(7, io_error=0.4, timeout=0.2)
+        c, _ = self.fire(8, io_error=0.4, timeout=0.2)
+        self.assertEqual(a, b)
+        self.assertNotEqual(a, c)
+        self.assertIn("io_error", a)
+        self.assertIn("timeout", a)
+
+    def test_injector_removed_on_exit(self):
+        self.fire(0, io_error=1.0)
+        self.assertIsNone(_hooks.get_injector())
+        _hooks.fault_point("io.open", path="x")  # must not raise
+
+    def test_nesting_restores_outer_injector(self):
+        with rz.chaos(seed=0, io_error=0.0) as outer:
+            with rz.chaos(seed=0, io_error=1.0):
+                with self.assertRaises(OSError):
+                    _hooks.fault_point("io.open", path="x")
+            self.assertIs(getattr(_hooks.get_injector(), "__self__", None), outer)
+
+    def test_max_faults_caps_injection(self):
+        outcomes, c = self.fire(3, io_error=1.0, max_faults=2)
+        self.assertEqual(outcomes[:2], ["io_error", "io_error"])
+        self.assertEqual(outcomes[2:], ["pass"] * (len(outcomes) - 2))
+        self.assertEqual(len(c.injected), 2)
+
+    def test_targets_filter(self):
+        with rz.chaos(seed=0, io_error=1.0, targets=("collective",)):
+            _hooks.fault_point("io.open", path="x")  # io not targeted
+            with self.assertRaises(OSError):
+                _hooks.fault_point("collective.assemble", gshape=(1,), split=0)
+
+    def test_unknown_target_rejected(self):
+        with self.assertRaises(ValueError):
+            rz.chaos(targets=("gpu",))
+
+    def test_bad_probability_rejected(self):
+        with self.assertRaises(ValueError):
+            rz.chaos(io_error=1.5)
+
+    def test_collective_injection(self):
+        # the assemble entry point is reachable from factories on a split
+        # load — simulate directly
+        with rz.chaos(seed=0, timeout=1.0, targets=("collective",)):
+            with self.assertRaises(TimeoutError):
+                _hooks.fault_point("collective.allgather", shape=(4,))
+
+    def test_nan_corruption_of_array_site(self):
+        arr = np.ones(8, dtype=np.float64)
+        with rz.chaos(seed=0, corrupt=1.0) as c:
+            _hooks.fault_point("collective.shard", array=arr, rank=0)
+        self.assertTrue(np.isnan(arr).any())
+        self.assertTrue(any(i.kind == "corrupt" for i in c.injected))
+
+    def test_report(self):
+        _, c = self.fire(0, io_error=1.0, max_faults=1)
+        rep = c.report()
+        self.assertIn("1 fault(s)", rep)
+        self.assertIn("io.open", rep)
+
+
+class TestRetryPolicy(TestCase):
+    def test_delays_deterministic_and_bounded(self):
+        p = rz.RetryPolicy(max_attempts=6, base_delay=0.1, max_delay=0.5, seed=11)
+        d1, d2 = p.delays(), p.delays()
+        self.assertEqual(d1, d2)
+        self.assertEqual(len(d1), 5)
+        self.assertTrue(all(0 < d <= 0.5 for d in d1))
+        # monotone non-decreasing until the cap bites
+        uncapped = [d for d in d1 if d < 0.5]
+        self.assertEqual(uncapped, sorted(uncapped))
+
+    def test_succeeds_after_transient_failures(self):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise OSError("transient")
+            return "done"
+
+        self.assertEqual(fast_policy(4).call(flaky), "done")
+        self.assertEqual(calls["n"], 3)
+
+    def test_exhaustion_raises_retry_error_with_history(self):
+        def always():
+            raise TimeoutError("nope")
+
+        with self.assertRaises(rz.RetryError) as cm:
+            fast_policy(3).call(always, label="doomed")
+        e = cm.exception
+        self.assertEqual(len(e.attempts), 3)
+        self.assertIn("doomed", str(e))
+        self.assertIn("failed after 3 attempt(s)", str(e))
+        self.assertIn("TimeoutError", str(e))
+        self.assertIsInstance(e.__cause__, TimeoutError)
+
+    def test_non_retryable_propagates_immediately(self):
+        calls = {"n": 0}
+
+        def bad():
+            calls["n"] += 1
+            raise ValueError("logic bug")
+
+        with self.assertRaises(ValueError):
+            fast_policy(5).call(bad)
+        self.assertEqual(calls["n"], 1)
+
+    def test_single_attempt_policy(self):
+        with self.assertRaises(rz.RetryError):
+            rz.NO_RETRY.call(lambda: (_ for _ in ()).throw(OSError("x")))
+
+    def test_invalid_policy_rejected(self):
+        with self.assertRaises(ValueError):
+            rz.RetryPolicy(max_attempts=0)
+
+    def test_wrap_decorator(self):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise OSError("once")
+            return 42
+
+        self.assertEqual(fast_policy(2).wrap(flaky)(), 42)
+
+
+class TestValidate(TestCase):
+    def test_healthy_arrays_pass(self):
+        for x in (
+            ht.arange(23, dtype=ht.float32, split=0),
+            ht.zeros((3, 5), dtype=ht.int32, split=1),
+            ht.array(2.0),
+            ht.full((2, 2), 1.0),
+        ):
+            self.assertIs(rz.validate(x, check_values=True), x)
+            self.assertIs(x.health_check(check_values=True), x)
+
+    def test_nan_caught_only_with_check_values(self):
+        bad = ht.array([1.0, float("nan"), 3.0], split=0)
+        bad.health_check()  # structural pass: NaN scan is opt-in
+        with self.assertRaises(rz.ValidationError) as cm:
+            bad.health_check(check_values=True)
+        self.assertTrue(any("non-finite" in p for p in cm.exception.problems))
+
+    def test_padding_not_scanned(self):
+        # 9 over 8 devices pads to 16; pad garbage must not trip the scan
+        x = ht.arange(9, dtype=ht.float32, split=0)
+        self.assertIs(rz.validate(x, check_values=True), x)
+
+    def test_structural_corruption_detected(self):
+        x = ht.arange(16, dtype=ht.float32, split=0)
+        # simulate metadata corruption: gshape no longer matches the buffer
+        object.__setattr__(x, "_DNDarray__gshape", (17,))
+        with self.assertRaises(rz.ValidationError) as cm:
+            x.health_check()
+        self.assertTrue(len(cm.exception.problems) >= 1)
+
+    def test_non_dndarray_rejected(self):
+        with self.assertRaises(TypeError):
+            rz.validate(np.ones(3))
+
+    def test_inf_counted(self):
+        bad = ht.array([float("inf"), 1.0], split=0)
+        with self.assertRaises(rz.ValidationError) as cm:
+            rz.validate(bad, check_values=True)
+        self.assertIn("1 non-finite", "".join(cm.exception.problems))
+
+
+class TestIOResilience(TestCase):
+    def test_load_missing_file_raises_filenotfound(self):
+        for name in ("nope.h5", "nope.nc", "nope.csv", "nope.unknown"):
+            with self.assertRaises(FileNotFoundError) as cm:
+                ht.load(os.path.join("/tmp", "definitely-missing", name))
+            self.assertIn(name, str(cm.exception))
+
+    def test_load_retry_recovers_from_transient_faults(self):
+        x = ht.arange(12, dtype=ht.float32)
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "x.csv")
+            ht.save(x, p)
+            with rz.chaos(seed=0, io_error=1.0, max_faults=2):
+                y = ht.load(p, retry=fast_policy(4))
+        np.testing.assert_allclose(y.numpy().ravel(), x.numpy())
+
+    def test_load_without_retry_fails_fast(self):
+        x = ht.arange(4, dtype=ht.float32)
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "x.csv")
+            ht.save(x, p)
+            with rz.chaos(seed=0, io_error=1.0):
+                with self.assertRaises(rz.RetryError):
+                    ht.load(p)
+
+    def test_atomic_csv_save_preserves_file_on_fault(self):
+        x = ht.arange(6, dtype=ht.float32)
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "x.csv")
+            ht.save(x, p)
+            before = open(p).read()
+            with rz.chaos(seed=1, torn_write=1.0):
+                with self.assertRaises(OSError):
+                    ht.save(ht.zeros(100, dtype=ht.float32), p)
+            self.assertEqual(open(p).read(), before)
+            self.assertEqual(
+                [f for f in os.listdir(d) if ".tmp-" in f], [], "no temp litter"
+            )
+
+    @unittest.skipUnless(ht.io.supports_hdf5(), "h5py not available")
+    def test_atomic_hdf5_save_preserves_file_on_fault(self):
+        x = ht.arange(8, dtype=ht.float32)
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "x.h5")
+            ht.save(x, p, "data")
+            before = ht.load(p, "data").numpy()
+            with rz.chaos(seed=0, io_error=1.0):
+                with self.assertRaises(OSError):
+                    ht.save(ht.zeros(8, dtype=ht.float32), p, "data")
+            np.testing.assert_array_equal(ht.load(p, "data").numpy(), before)
+
+    @unittest.skipUnless(ht.io.supports_hdf5(), "h5py not available")
+    def test_save_retry_kwarg(self):
+        x = ht.arange(8, dtype=ht.float32)
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "x.h5")
+            with rz.chaos(seed=0, io_error=1.0, max_faults=1):
+                ht.save(x, p, "data", retry=fast_policy(3))
+            np.testing.assert_array_equal(ht.load(p, "data").numpy(), x.numpy())
+
+
+class TestChunkEdgeCases(TestCase):
+    """MeshCommunication.chunk() must stay consistent at the layout edges
+    the checkpointer leans on (empty tails, size-0 axes, split=None)."""
+
+    def world_comm(self):
+        return ht.MeshCommunication(devices=jax.devices())
+
+    def test_empty_last_shard(self):
+        # 9 rows over 8 devices with ceil-div blocks of 2: ranks 0-3 get 2,
+        # rank 4 gets 1, ranks 5-7 get 0
+        comm = self.world_comm()
+        lengths = [comm.chunk((9, 3), 0, rank=r)[1][0] for r in range(comm.size)]
+        self.assertEqual(lengths, [2, 2, 2, 2, 1, 0, 0, 0])
+        self.assertEqual(sum(lengths), 9)
+        # empty shards have well-formed, in-range, zero-width slices
+        off, lshape, slices = comm.chunk((9, 3), 0, rank=7)
+        self.assertEqual(lshape, (0, 3))
+        self.assertEqual(slices[0].stop - slices[0].start, 0)
+        self.assertLessEqual(slices[0].stop, 9)
+        self.assertEqual(off, slices[0].start)
+
+    def test_size_zero_axis(self):
+        comm = self.world_comm()
+        for r in range(comm.size):
+            off, lshape, slices = comm.chunk((0, 5), 0, rank=r)
+            self.assertEqual(off, 0)
+            self.assertEqual(lshape, (0, 5))
+            self.assertEqual(slices[0], slice(0, 0))
+
+    def test_split_none(self):
+        comm = self.world_comm()
+        off, lshape, slices = comm.chunk((4, 5), None)
+        self.assertEqual(off, 0)
+        self.assertEqual(lshape, (4, 5))
+        self.assertEqual(slices, (slice(0, 4), slice(0, 5)))
+
+    def test_chunks_tile_axis_exactly(self):
+        comm = self.world_comm()
+        for n in (1, 7, 8, 15, 16, 23):
+            cursor = 0
+            for r in range(comm.size):
+                off, lshape, _ = comm.chunk((n,), 0, rank=r)
+                if lshape[0]:
+                    self.assertEqual(off, cursor)
+                cursor += lshape[0]
+            self.assertEqual(cursor, n)
+
+    def test_counts_displs_consistent_with_chunk(self):
+        comm = self.world_comm()
+        counts, displs, _ = comm.counts_displs_shape((9, 3), 0)
+        for r in range(comm.size):
+            off, lshape, _ = comm.chunk((9, 3), 0, rank=r)
+            self.assertEqual(counts[r], lshape[0])
+            if counts[r]:
+                self.assertEqual(displs[r], off)
+
+    def test_checkpoint_of_empty_tail_layout(self):
+        # round-trip an array whose layout has empty tail shards
+        x = ht.reshape(ht.arange(27, dtype=ht.float32), (9, 3)).resplit(0)
+        with tempfile.TemporaryDirectory() as d:
+            rz.save_checkpoint(x, d)
+            m = rz.read_manifest(d)
+            # no zero-length shard files are written
+            self.assertTrue(all(s["length"] > 0 for s in m["shards"]))
+            y = rz.load_checkpoint(d)
+        np.testing.assert_array_equal(y.numpy(), x.numpy())
+
+
+class TestMeshValidation(TestCase):
+    def test_divisibility_error_names_both_quantities(self):
+        from heat_tpu.parallel import make_hierarchical_mesh
+
+        with self.assertRaises(ValueError) as cm:
+            make_hierarchical_mesh(n_slow=3)  # 8 devices % 3 != 0
+        msg = str(cm.exception)
+        self.assertIn("8 device(s)", msg)
+        self.assertIn("n_slow=3", msg)
+
+    def test_valid_hierarchical_mesh(self):
+        from heat_tpu.parallel import make_hierarchical_mesh
+
+        mesh = make_hierarchical_mesh(n_slow=2)
+        self.assertEqual(dict(mesh.shape)["nodes"], 2)
+        self.assertEqual(dict(mesh.shape)["split"], 4)
+
+    def test_duplicate_devices_rejected(self):
+        from heat_tpu.parallel import make_hierarchical_mesh
+
+        devs = list(jax.devices())
+        devs[1] = devs[0]
+        with self.assertRaises(ValueError) as cm:
+            make_hierarchical_mesh(n_slow=2, devices=devs)
+        self.assertIn("duplicate", str(cm.exception))
+
+    def test_subset_allowed_without_coverage_check(self):
+        from heat_tpu.parallel import make_hierarchical_mesh
+
+        mesh = make_hierarchical_mesh(n_slow=2, devices=jax.devices()[:4])
+        self.assertEqual(mesh.devices.size, 4)
+
+    def test_n_slow_below_one_rejected(self):
+        from heat_tpu.parallel import make_hierarchical_mesh
+
+        with self.assertRaises(ValueError):
+            make_hierarchical_mesh(n_slow=0)
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -1,0 +1,17 @@
+"""Tier-1 wrapper for tools/chaos_smoke.py: the full fault-mix sweep."""
+import sys
+import os
+import unittest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+
+class TestChaosSmoke(unittest.TestCase):
+    def test_all_scenarios_pass(self):
+        import chaos_smoke
+
+        self.assertEqual(chaos_smoke.main(), 0)
+
+
+if __name__ == "__main__":
+    unittest.main()

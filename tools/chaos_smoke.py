@@ -1,0 +1,100 @@
+"""Chaos smoke: checkpoint round-trips survive a sweep of injected faults.
+
+Exercises the full resilience stack end-to-end on the virtual 8-device CPU
+mesh: for a matrix of (seed, fault-mix) chaos settings, save a checkpoint
+under injected I/O failures / torn writes / silent corruption, then prove
+that one of the two acceptable outcomes happened —
+
+- the save succeeded (transient faults absorbed by the RetryPolicy) and the
+  restore is bit-identical with the original dtype and split, or
+- the save failed loudly (faults outlasted the retry budget) and the
+  previously committed checkpoint is still fully loadable and verifiable
+  (atomicity: a dying save never destroys durable state), or
+- the save committed silently-corrupted bytes and the restore *detects* it
+  via checksum verification (CheckpointCorruptionError) instead of
+  returning wrong values.
+
+Exits 0 iff every scenario lands in an acceptable outcome. Run directly:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python tools/chaos_smoke.py
+
+or via the tier-1 test ``tests/test_resilience_smoke.py`` which invokes
+``main()`` in-process.
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu import resilience as rz
+
+# (name, chaos kwargs) — a spread of fault mixes; seeds swept per scenario
+SCENARIOS = [
+    ("clean", dict()),
+    ("transient-io", dict(io_error=1.0, max_faults=2)),
+    ("flaky-io", dict(io_error=0.3)),
+    ("timeouts", dict(timeout=0.4)),
+    ("torn-writes", dict(torn_write=0.5)),
+    ("silent-corruption", dict(corrupt=1.0, targets=("io",))),
+    ("everything", dict(io_error=0.2, timeout=0.2, torn_write=0.2, corrupt=0.2)),
+]
+SEEDS = (0, 1, 2)
+
+POLICY = rz.RetryPolicy(max_attempts=4, base_delay=0.001, seed=0, sleep=lambda s: None)
+
+
+def run_scenario(name: str, seed: int, chaos_kwargs: dict) -> str:
+    """Returns the outcome label, raising AssertionError on any violation."""
+    x = ht.reshape(ht.arange(46, dtype=ht.float32), (23, 2)).resplit(0)
+    ref = x.numpy()
+    with tempfile.TemporaryDirectory() as d:
+        # a known-good committed checkpoint that chaos must never destroy
+        rz.save_checkpoint(x, d)
+        with rz.chaos(seed=seed, **chaos_kwargs) as c:
+            try:
+                rz.save_checkpoint(x, d, retry=POLICY)
+                saved = True
+            except OSError:
+                saved = False  # RetryError/torn write: loud failure is fine
+        try:
+            y = rz.load_checkpoint(d)
+        except rz.CheckpointCorruptionError:
+            # only acceptable when chaos silently corrupted committed bytes
+            assert any(i.kind == "corrupt" for i in c.injected), (
+                f"{name}/seed={seed}: corruption detected but chaos never "
+                f"injected any — real bug\n{c.report()}"
+            )
+            return "detected-corruption"
+        np.testing.assert_array_equal(y.numpy(), ref)
+        assert y.dtype == x.dtype and y.split == x.split, (
+            f"{name}/seed={seed}: dtype/split drifted: {y.dtype}/{y.split}"
+        )
+        return "saved+restored" if saved else "save-failed,old-intact"
+
+
+def main() -> int:
+    failures = []
+    for name, kwargs in SCENARIOS:
+        for seed in SEEDS:
+            try:
+                outcome = run_scenario(name, seed, kwargs)
+                print(f"  ok   {name:>18} seed={seed}: {outcome}")
+            except Exception as e:  # noqa: BLE001 - report-all tool
+                failures.append((name, seed, e))
+                print(f"  FAIL {name:>18} seed={seed}: {type(e).__name__}: {e}")
+    print(
+        f"chaos_smoke: {len(SCENARIOS) * len(SEEDS) - len(failures)}/"
+        f"{len(SCENARIOS) * len(SEEDS)} scenarios ok"
+    )
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
