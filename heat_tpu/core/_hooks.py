@@ -52,3 +52,40 @@ def fault_point(name: str, **ctx) -> Dict:
     if _INJECTOR is not None:
         _INJECTOR(name, ctx)
     return ctx
+
+
+# the active deadline runner: fn(label, callable, args, kwargs) -> result.
+# None (the production default) means blocking host-side paths run inline
+# with zero overhead; ``resilience.watchdog.deadlines(...)`` installs a
+# runner that bounds each labeled call and raises CollectiveTimeout
+# instead of hanging forever. Same layering trick as the injector: the
+# slot lives down here so core never imports resilience.
+_DEADLINE_RUNNER = None
+
+
+def set_deadline_runner(runner):
+    """Install (or with ``None`` remove) the process-wide deadline runner.
+
+    Returns the previous runner so contexts nest correctly.
+    """
+    global _DEADLINE_RUNNER
+    prev = _DEADLINE_RUNNER
+    _DEADLINE_RUNNER = runner
+    return prev
+
+
+def get_deadline_runner():
+    return _DEADLINE_RUNNER
+
+
+def guarded_call(label: str, fn, *args, **kwargs):
+    """Run a blocking host-side operation under the active deadline runner.
+
+    ``label`` names the operation in any timeout raised
+    (``"collective.assemble"``, ``"flatmove.ragged"``, ...). With no
+    runner installed this is a direct call — the hot path pays one global
+    read and nothing else.
+    """
+    if _DEADLINE_RUNNER is None:
+        return fn(*args, **kwargs)
+    return _DEADLINE_RUNNER(label, fn, args, kwargs)

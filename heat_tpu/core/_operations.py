@@ -252,7 +252,7 @@ def _kwargs_key(kwargs: dict):
         return None
 
 
-@lru_cache(maxsize=None)
+@lru_cache(maxsize=256)
 def _jitted_reduce_cached(operation, axis, keepdims, pad_mode, pad_n, pad_split, fill, kwargs_items):
     kwargs = dict(kwargs_items)
 
@@ -275,8 +275,19 @@ def _jitted_reduce(operation, axis, keepdims, pad_mode, pad_n, pad_split, fill, 
     """Cached jitted reduce program, or None when any static is unhashable.
 
     A nan fill is tokenized ("__nan__") before keying: nan != nan would
-    make every lookup miss and retrace."""
+    make every lookup miss and retrace.
+
+    A closure created inside a function (``<locals>`` in its qualname)
+    keys the cache by a fresh object identity on every call — each call
+    recompiles AND permanently parks the dead executable in the cache.
+    Those take the eager fallback instead, unless the caller hoisted the
+    closure to module level and marked it ``_cache_stable = True`` (one
+    identity forever — see ``statistics._NANPROP_MAX``)."""
     if kwargs_items is None:
+        return None
+    if "<locals>" in getattr(operation, "__qualname__", "") and not getattr(
+        operation, "_cache_stable", False
+    ):
         return None
     if isinstance(fill, float) and fill != fill:
         fill = "__nan__"

@@ -370,7 +370,21 @@ def _assemble_from_chunks(read_chunk, gshape, split, comm, np_dtype):
     stitched with ``make_array_from_single_device_arrays`` — the analogue
     of the reference's per-rank parallel reads (``io.py:57-147``). No
     device and no host ever holds the full array.
+
+    Runs under the collective watchdog when one is installed
+    (``resilience.deadlines``): a wedged chunk read or device transfer
+    raises ``CollectiveTimeout`` instead of hanging the job.
     """
+    from . import _hooks
+
+    return _hooks.guarded_call(
+        "collective.assemble",
+        _assemble_from_chunks_impl,
+        read_chunk, gshape, split, comm, np_dtype,
+    )
+
+
+def _assemble_from_chunks_impl(read_chunk, gshape, split, comm, np_dtype):
     from . import _hooks
 
     _hooks.fault_point("collective.assemble", gshape=tuple(gshape), split=split)
@@ -403,7 +417,20 @@ def ragged_process_allgather(arr: np.ndarray, axis: int = 0):
     each process's block trimmed on receipt. Returns the list of blocks
     in process order. THE one implementation of this subtle protocol —
     ``assemble_local_shards``'s uneven path, ``unique``'s candidate
-    merge, and ``nonzero``'s coordinate concat all route through it."""
+    merge, and ``nonzero``'s coordinate concat all route through it.
+
+    The blocking host allgather is THE place a straggling or dead peer
+    wedges every process; under an installed watchdog
+    (``resilience.deadlines``) the wait is bounded and surfaces as
+    ``CollectiveTimeout('collective.allgather')``."""
+    from . import _hooks
+
+    return _hooks.guarded_call(
+        "collective.allgather", _ragged_process_allgather_impl, arr, axis
+    )
+
+
+def _ragged_process_allgather_impl(arr: np.ndarray, axis: int = 0):
     from jax.experimental import multihost_utils
 
     from . import _hooks
@@ -451,7 +478,18 @@ def assemble_local_shards(local: np.ndarray, split: int, comm: MeshCommunication
     devices, blocks align with process boundaries and assembly is
     local-only; otherwise the shards are allgathered once (O(n) host
     memory — the uneven path, like the reference's staged Recv).
+
+    Bounded end-to-end by the collective watchdog when installed
+    (``resilience.deadlines``), label ``collective.assemble_local``.
     """
+    from . import _hooks
+
+    return _hooks.guarded_call(
+        "collective.assemble_local", _assemble_local_shards_impl, local, split, comm
+    )
+
+
+def _assemble_local_shards_impl(local: np.ndarray, split: int, comm: MeshCommunication):
     from jax.experimental import multihost_utils
 
     nproc = jax.process_count()

@@ -577,11 +577,27 @@ class DNDarray:
     def resplit_(self, axis: Optional[int] = None) -> "DNDarray":
         """In-place redistribution to a new split axis (reference
         ``dndarray.py:1235``). One ``device_put``; XLA chooses the collective
-        (all-gather for ``axis=None``, all-to-all for split->split)."""
+        (all-gather for ``axis=None``, all-to-all for split->split).
+
+        Watchdog-bounded (label ``collective.resplit``) when
+        ``resilience.deadlines`` is active — a resharding that wedges on
+        the interconnect surfaces as ``CollectiveTimeout``, not a hang."""
+        from . import _hooks
+
         axis = sanitize_axis(self.gshape, axis)
         if axis == self.__split:
             return self
-        self.__array = _place(self._logical(), self.__comm, axis, self.__gshape, force=True)
+
+        def reshard():
+            _hooks.fault_point(
+                "collective.resplit", gshape=self.__gshape, to_split=axis
+            )
+            out = _place(self._logical(), self.__comm, axis, self.__gshape, force=True)
+            if _hooks.get_deadline_runner() is not None:
+                out = out.block_until_ready()  # keep the wedge inside the deadline
+            return out
+
+        self.__array = _hooks.guarded_call("collective.resplit", reshard)
         self.__split = axis
         return self
 

@@ -280,10 +280,21 @@ def _nan_propagating(op):
     return run
 
 
+# ONE closure per op, hoisted to module level: a fresh closure per call
+# would make every ht.max/ht.min a cache miss in _jitted_reduce_cached
+# (recompile each call, executables accumulating in the cache forever).
+# Module-level identity keys the cache once; _cache_stable marks them as
+# safe to cache despite being closures (see _operations._jitted_reduce).
+_NANPROP_MAX = _nan_propagating(jnp.max)
+_NANPROP_MIN = _nan_propagating(jnp.min)
+_NANPROP_MAX._cache_stable = True
+_NANPROP_MIN._cache_stable = True
+
+
 def max(x: DNDarray, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
     """Maximum along axis (reference ``statistics.py:781``); NaN wins."""
     return _reduce_op(
-        _nan_propagating(jnp.max), x, axis=axis, out=out, keepdims=bool(keepdim or keepdims), neutral="min"
+        _NANPROP_MAX, x, axis=axis, out=out, keepdims=bool(keepdim or keepdims), neutral="min"
     )
 
 
@@ -330,7 +341,7 @@ def median(x: DNDarray, axis=None, keepdim: bool = False, keepdims=None) -> DNDa
 def min(x: DNDarray, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
     """Minimum along axis (reference ``statistics.py:1114``); NaN wins."""
     return _reduce_op(
-        _nan_propagating(jnp.min), x, axis=axis, out=out, keepdims=bool(keepdim or keepdims), neutral="max"
+        _NANPROP_MIN, x, axis=axis, out=out, keepdims=bool(keepdim or keepdims), neutral="max"
     )
 
 

@@ -38,9 +38,29 @@ import numpy as np
 from jax import lax, shard_map
 from jax.sharding import PartitionSpec as P
 
+from ..core import _hooks
 from ..core.communication import SPLIT_AXIS, MeshCommunication
 
 from ..core._cache import ExecutableCache
+
+
+def _bounded_exchange(label: str, fn, buf: jax.Array):
+    """Dispatch one interval-exchange program under the collective
+    watchdog (no-op passthrough when none is installed). The fault point
+    fires inside the bounded region so chaos ``timeout``/``straggler``
+    faults compose with ``resilience.deadlines`` — the testable stand-in
+    for a reshard that really wedges on the interconnect."""
+
+    def dispatch():
+        _hooks.fault_point(f"collective.{label}", shape=tuple(buf.shape))
+        out = fn(buf)
+        if _hooks.get_deadline_runner() is not None and hasattr(out, "block_until_ready"):
+            # block inside the deadline, not at the caller's first use —
+            # async dispatch would let a wedged program escape the watchdog
+            out = out.block_until_ready()
+        return out
+
+    return _hooks.guarded_call(f"flatmove.{label}", dispatch)
 
 __all__ = [
     "flat_schedule",
@@ -335,10 +355,12 @@ def ragged_move(
     comm: MeshCommunication,
 ) -> jax.Array:
     """Move a split-``split`` padded buffer between arbitrary interval
-    partitions (see :func:`ragged_move_executable`)."""
-    return ragged_move_executable(
+    partitions (see :func:`ragged_move_executable`). Watchdog-bounded
+    (label ``flatmove.ragged``) when ``resilience.deadlines`` is active."""
+    fn = ragged_move_executable(
         tuple(buf.shape), buf.dtype, split, in_counts, out_counts, b_out, comm
-    )(buf)
+    )
+    return _bounded_exchange("ragged", fn, buf)
 
 
 def _t_interval(lo: int, hi: int, start: int, step: int, m: int) -> Tuple[int, int]:
@@ -469,11 +491,12 @@ def strided_take(
     step: int,
     comm: MeshCommunication,
 ) -> Tuple[jax.Array, int]:
-    """Apply :func:`strided_take_executable`; returns ``(buffer, m)``."""
+    """Apply :func:`strided_take_executable`; returns ``(buffer, m)``.
+    Watchdog-bounded (label ``flatmove.strided``) when active."""
     fn, m = strided_take_executable(
         tuple(buf.shape), buf.dtype, split, n_logical, start, stop, step, comm
     )
-    return fn(buf), m
+    return _bounded_exchange("strided", fn, buf), m
 
 
 def reshape_via_flatmove(
@@ -484,10 +507,12 @@ def reshape_via_flatmove(
 ) -> jax.Array:
     """Reshape a split-0 padded buffer to the split-0 padded buffer of
     ``out_shape`` with the interval-exchange kernel. Pure collective
-    permutes; per-device memory O(n/P)."""
-    return reshape_flatmove_executable(
+    permutes; per-device memory O(n/P). Watchdog-bounded (label
+    ``flatmove.reshape``) when ``resilience.deadlines`` is active."""
+    fn = reshape_flatmove_executable(
         tuple(buf.shape), buf.dtype, tuple(gshape), tuple(out_shape), comm
-    )(buf)
+    )
+    return _bounded_exchange("reshape", fn, buf)
 
 
 _JIT_CACHE = ExecutableCache()  # bounded LRU (round-3 ADVICE)

@@ -1,21 +1,44 @@
-"""Resilience subsystem: durable sharded state + chaos testing.
+"""Resilience subsystem: durable sharded state + runtime guards + chaos.
 
 The paper's SPMD execution model (every rank runs the same script,
 collectives fire eagerly inside ops) has no recovery story: one failed
-host or torn file write poisons the whole computation. This package adds
-the production-side counterweights:
+host, torn file write, hung reshard, or silently diverged replica poisons
+the whole computation. This package adds the production-side
+counterweights, split into a *storage* path and a *runtime* path:
+
+Storage (PR 1):
 
 - :mod:`~heat_tpu.resilience.checkpoint` — sharded, checksummed, atomic
   ``save_checkpoint`` / ``load_checkpoint`` with restore-onto-any-mesh;
-- :mod:`~heat_tpu.resilience.chaos` — seeded deterministic fault
-  injection into I/O and collective entry points (testable on CPU);
 - :mod:`~heat_tpu.resilience.retry` — :class:`RetryPolicy` exponential
   backoff + jitter, wired into ``core.io`` and checkpoint I/O;
 - :mod:`~heat_tpu.resilience.validate` — runtime invariant validation
   (``resilience.validate(x)`` / ``DNDarray.health_check()``).
 
-See ``docs/RESILIENCE.md`` for the manifest format, chaos knobs, and the
-failure-modes table.
+Runtime guards (PR 2):
+
+- :mod:`~heat_tpu.resilience.guard` — replica-divergence detection:
+  ``fingerprint(x)`` per-shard checksums + cross-replica digests,
+  ``guarded(...)`` op-boundary verification raising
+  :class:`DivergenceError` naming the offending devices;
+- :mod:`~heat_tpu.resilience.watchdog` — collective watchdog:
+  ``with_deadline(fn, timeout, label)`` and the fleet-wide
+  ``deadlines(timeout)`` context bound the blocking host-side
+  resharding/assembly paths, raising :class:`CollectiveTimeout` instead
+  of hanging;
+- :mod:`~heat_tpu.resilience.degrade` — graceful degradation:
+  ``mark_unhealthy`` / ``probe`` / ``shrink_to_healthy`` rebuild the
+  mesh over the surviving devices and redistribute live arrays (elastic
+  restore logic), so a bad device means a smaller mesh, not a dead job.
+
+Chaos (:mod:`~heat_tpu.resilience.chaos`) injects every failure class
+deterministically — I/O errors, torn writes, silent corruption,
+timeouts, stragglers, replica divergence — so all of the above is
+testable on CPU.
+
+Every guard-layer failure derives from :class:`ResilienceError`
+(:mod:`~heat_tpu.resilience.errors`); see ``docs/RESILIENCE.md`` for the
+failure taxonomy, manifest format, and chaos recipes.
 """
 from . import chaos as _chaos_mod  # noqa: F401
 from .chaos import Injection, chaos
@@ -28,12 +51,32 @@ from .checkpoint import (
     read_manifest,
     save_checkpoint,
 )
+from .degrade import (
+    clear_unhealthy,
+    healthy_devices,
+    mark_unhealthy,
+    probe,
+    shrink_to_healthy,
+    unhealthy_devices,
+)
+from .errors import (
+    CollectiveTimeout,
+    DegradeError,
+    DivergenceError,
+    NoHealthyDevicesError,
+    ResilienceError,
+)
+from .guard import Fingerprint, Guard, fingerprint, guarded
+from .guard import check as check_divergence
 from .retry import DEFAULT_CHECKPOINT_POLICY, NO_RETRY, RetryError, RetryPolicy
 from .validate import ValidationError, validate
+from .watchdog import deadlines, with_deadline
 
 __all__ = [
+    # chaos
     "chaos",
     "Injection",
+    # checkpoint
     "save_checkpoint",
     "load_checkpoint",
     "read_manifest",
@@ -41,10 +84,34 @@ __all__ = [
     "CheckpointCorruptionError",
     "CHECKPOINT_FORMAT",
     "MANIFEST_NAME",
+    # retry
     "RetryPolicy",
     "RetryError",
     "NO_RETRY",
     "DEFAULT_CHECKPOINT_POLICY",
+    # validation
     "validate",
     "ValidationError",
+    # error hierarchy
+    "ResilienceError",
+    "DivergenceError",
+    "CollectiveTimeout",
+    "DegradeError",
+    "NoHealthyDevicesError",
+    # guard
+    "fingerprint",
+    "Fingerprint",
+    "Guard",
+    "guarded",
+    "check_divergence",
+    # watchdog
+    "with_deadline",
+    "deadlines",
+    # degrade
+    "mark_unhealthy",
+    "clear_unhealthy",
+    "unhealthy_devices",
+    "healthy_devices",
+    "probe",
+    "shrink_to_healthy",
 ]

@@ -19,7 +19,16 @@ single uniform draw):
   exception) — the file commits and only checksum verification can catch
   it; array sites: NaNs are planted in the shard values;
 - ``io_error``    — an OSError is raised at the site;
-- ``timeout``     — a TimeoutError is raised at the site.
+- ``timeout``     — a TimeoutError is raised at the site;
+- ``straggler``   — the site *sleeps* for ``straggler_delay`` seconds and
+  then proceeds normally (no exception) — the injected slow host/device
+  that only a wall-clock deadline (:mod:`~heat_tpu.resilience.watchdog`)
+  can catch;
+- ``divergence``  — replica sites only (``guard.shard``, which carries a
+  ``replica`` index): the host bytes of a NON-primary replica are
+  perturbed silently, so the same logical shard digests differently
+  across its replica group — the injected silently-diverged replica that
+  :func:`~heat_tpu.resilience.guard.guarded` must catch.
 
 ``max_faults`` caps the total number of injected faults, after which all
 sites pass — the standard recipe for "transient" faults that a
@@ -29,6 +38,7 @@ first two attempts and lets the third through, deterministically.
 from __future__ import annotations
 
 import random
+import time
 from dataclasses import dataclass, field
 from typing import List, Optional, Sequence
 
@@ -39,7 +49,7 @@ from ..core import _hooks
 __all__ = ["chaos", "Injection"]
 
 # site categories a chaos context can target (site id prefix before ".")
-_KNOWN_TARGETS = ("io", "collective", "checkpoint")
+_KNOWN_TARGETS = ("io", "collective", "checkpoint", "guard", "degrade")
 
 
 @dataclass
@@ -59,9 +69,11 @@ class chaos:
     ----------
     seed : int
         Seeds the fault stream; same seed + same program = same faults.
-    io_error, timeout, torn_write, corrupt : float
+    io_error, timeout, torn_write, corrupt, straggler, divergence : float
         Per-site probabilities in [0, 1] for each fault kind.
-    targets : sequence of {"io", "collective", "checkpoint"}
+    straggler_delay : float
+        Seconds a ``straggler`` fault sleeps before the site proceeds.
+    targets : sequence of {"io", "collective", "checkpoint", "guard", "degrade"}
         Which site categories participate; others always pass.
     max_faults : int, optional
         Stop injecting after this many faults (transient-fault recipe).
@@ -72,6 +84,9 @@ class chaos:
     timeout: float = 0.0
     torn_write: float = 0.0
     corrupt: float = 0.0
+    straggler: float = 0.0
+    divergence: float = 0.0
+    straggler_delay: float = 0.05
     targets: Sequence[str] = _KNOWN_TARGETS
     max_faults: Optional[int] = None
     injected: List[Injection] = field(default_factory=list, init=False)
@@ -81,10 +96,12 @@ class chaos:
         unknown = set(self.targets) - set(_KNOWN_TARGETS)
         if unknown:
             raise ValueError(f"unknown chaos targets {sorted(unknown)}; known: {_KNOWN_TARGETS}")
-        for knob in ("io_error", "timeout", "torn_write", "corrupt"):
+        for knob in ("io_error", "timeout", "torn_write", "corrupt", "straggler", "divergence"):
             p = getattr(self, knob)
             if not 0.0 <= p <= 1.0:
                 raise ValueError(f"{knob} must be a probability in [0, 1], got {p}")
+        if self.straggler_delay < 0:
+            raise ValueError(f"straggler_delay must be >= 0, got {self.straggler_delay}")
 
     # -- context management ------------------------------------------------
     def __enter__(self) -> "chaos":
@@ -131,6 +148,21 @@ class chaos:
                     flat[int(u * 1000) % flat.size] = np.nan
                     self.injected.append(Injection(site, "corrupt", "planted NaN"))
                 return  # silent corruption: no exception, commit proceeds
+        replica = ctx.get("replica")  # replica index at guard.shard sites
+        if array is not None and replica is not None and replica != 0 and array.size:
+            # divergence: perturb a NON-primary replica's bytes silently, so
+            # the replica group digests disagree (primary replicas are left
+            # alone — corrupting every copy identically would be undetectable
+            # by construction, which is the point of the asymmetry)
+            threshold += self.divergence
+            if u < threshold:
+                view = array.reshape(-1).view(np.uint8)
+                pos = int(u * 1000) % view.size
+                view[pos] ^= 0xFF
+                self.injected.append(
+                    Injection(site, "divergence", f"replica {replica} byte {pos}")
+                )
+                return  # silent: detection is the guard layer's job
         threshold += self.io_error
         if u < threshold:
             self.injected.append(Injection(site, "io_error", ""))
@@ -139,6 +171,12 @@ class chaos:
         if u < threshold:
             self.injected.append(Injection(site, "timeout", ""))
             raise TimeoutError(f"chaos[{site}]: injected timeout")
+        threshold += self.straggler
+        if u < threshold:
+            self.injected.append(
+                Injection(site, "straggler", f"slept {self.straggler_delay}s")
+            )
+            time.sleep(self.straggler_delay)  # then proceed: slow, not dead
 
     # -- reporting ---------------------------------------------------------
     def report(self) -> str:

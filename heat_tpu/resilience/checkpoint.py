@@ -47,6 +47,7 @@ from ..core._atomic import atomic_write_bytes
 from ..core.communication import _assemble_from_chunks, sanitize_comm
 from ..core.dndarray import DNDarray
 from ..core.sanitation import sanitize_in, sanitize_split
+from .errors import ResilienceError
 from .retry import DEFAULT_CHECKPOINT_POLICY, RetryPolicy
 
 __all__ = [
@@ -63,7 +64,7 @@ MANIFEST_NAME = "manifest.json"
 CHECKPOINT_FORMAT = "heat_tpu.checkpoint.v1"
 
 
-class CheckpointError(RuntimeError):
+class CheckpointError(ResilienceError):
     """Structurally invalid or unreadable checkpoint."""
 
 

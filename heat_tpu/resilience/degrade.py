@@ -1,0 +1,176 @@
+"""Graceful degradation: shrink the mesh to its healthy devices.
+
+The paper's SPMD model has exactly one answer to a bad device: the job
+dies. This module implements the production answer — a probed-bad device
+means a *smaller mesh*, not a dead job:
+
+- :func:`mark_unhealthy` / :func:`clear_unhealthy` maintain the
+  process-wide set of devices excluded from future meshes (fed by the
+  watchdog, by :func:`probe`, or by an external health system);
+- :func:`probe` runs a tiny round-trip computation on every mesh device
+  and marks the ones that fail (the ``degrade.probe`` fault point makes
+  bad devices injectable with ``chaos(io_error=...)``);
+- :func:`shrink_to_healthy` rebuilds the communicator over the surviving
+  devices and redistributes live DNDarrays onto it, reusing the elastic
+  restore path from :mod:`~heat_tpu.resilience.checkpoint`
+  (``_assemble_from_chunks``: each new device's chunk is assembled from
+  the gathered global intervals — the saved and restored device counts
+  are independent there, and the pre- and post-shrink device counts are
+  independent here for the same reason).
+
+Values are preserved exactly: for every array,
+``shrunk.numpy() == original.numpy()``; only the layout (device count,
+per-shard extents, padding) changes.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Set, Tuple
+
+import jax
+import numpy as np
+
+from ..core import _hooks
+from ..core.communication import (
+    MeshCommunication,
+    _assemble_from_chunks,
+    sanitize_comm,
+)
+from ..core.dndarray import DNDarray
+from .errors import DegradeError, NoHealthyDevicesError
+
+__all__ = [
+    "mark_unhealthy",
+    "clear_unhealthy",
+    "unhealthy_devices",
+    "healthy_devices",
+    "probe",
+    "shrink_to_healthy",
+]
+
+# process-wide registry of device ids excluded from future meshes
+_UNHEALTHY: Set[int] = set()
+
+
+def _device_id(device) -> int:
+    """Accepts a jax.Device or a bare device id."""
+    if isinstance(device, (int, np.integer)):
+        return int(device)
+    dev_id = getattr(device, "id", None)
+    if dev_id is None:
+        raise TypeError(f"expected a jax.Device or device id, got {type(device)}")
+    return int(dev_id)
+
+
+def mark_unhealthy(device) -> None:
+    """Exclude ``device`` (a ``jax.Device`` or id) from future meshes."""
+    _UNHEALTHY.add(_device_id(device))
+
+
+def clear_unhealthy(device=None) -> None:
+    """Forget one device's unhealthy mark, or (default) all of them."""
+    if device is None:
+        _UNHEALTHY.clear()
+    else:
+        _UNHEALTHY.discard(_device_id(device))
+
+
+def unhealthy_devices() -> frozenset:
+    """The current set of unhealthy device ids."""
+    return frozenset(_UNHEALTHY)
+
+
+def healthy_devices(comm: Optional[MeshCommunication] = None) -> List:
+    """The communicator's mesh devices minus the unhealthy set, in mesh
+    (split-major) order."""
+    comm = sanitize_comm(comm)
+    return [
+        d for d in comm.mesh.devices.ravel().tolist() if int(d.id) not in _UNHEALTHY
+    ]
+
+
+def probe(
+    comm: Optional[MeshCommunication] = None, *, mark: bool = True
+) -> List[int]:
+    """Round-trip a tiny computation on every mesh device; return the ids
+    that failed (and with ``mark=True``, the default, mark them unhealthy).
+
+    A device that cannot place-compute-fetch one scalar is not going to
+    carry a shard; the ``degrade.probe`` fault point makes the failure
+    injectable (``chaos(io_error=1.0, targets=("degrade",))`` fails every
+    probe deterministically).
+    """
+    comm = sanitize_comm(comm)
+    pid = jax.process_index()
+    bad: List[int] = []
+    for dev in comm.mesh.devices.ravel().tolist():
+        if dev.process_index != pid:
+            continue  # only addressable devices are probe-able
+        try:
+            _hooks.fault_point("degrade.probe", device=int(dev.id))
+            got = float(jax.device_get(jax.device_put(np.float32(1.0), dev)) + 1.0)
+            if got != 2.0:
+                raise RuntimeError(f"probe computed {got}, expected 2.0")
+        except Exception:  # noqa: BLE001 - any probe failure means unhealthy
+            bad.append(int(dev.id))
+            if mark:
+                mark_unhealthy(dev)
+    return bad
+
+
+def shrink_to_healthy(
+    comm: Optional[MeshCommunication] = None,
+    arrays: Sequence[DNDarray] = (),
+    *,
+    set_default: bool = False,
+) -> Tuple[MeshCommunication, List[DNDarray]]:
+    """Rebuild the mesh over the surviving devices and move live arrays.
+
+    Returns ``(new_comm, new_arrays)``: a 1-D split-axis communicator
+    over ``comm``'s healthy devices, plus one redistributed DNDarray per
+    input (same ``gshape``/``dtype``/``split``, values bit-preserved,
+    resharded onto the smaller mesh with the elastic-restore assembly).
+    With no unhealthy devices the input ``comm`` and arrays are returned
+    unchanged. ``set_default=True`` additionally installs the shrunken
+    communicator as the process default (``use_comm``), so subsequently
+    created arrays avoid the bad devices too.
+
+    Raises :class:`NoHealthyDevicesError` when nothing survives.
+    """
+    comm = sanitize_comm(comm)
+    all_devices = comm.mesh.devices.ravel().tolist()
+    survivors = healthy_devices(comm)
+    if not survivors:
+        raise NoHealthyDevicesError(len(all_devices))
+    if len(survivors) == len(all_devices) and len(comm.mesh.axis_names) == 1:
+        return comm, list(arrays)
+
+    new_comm = MeshCommunication(devices=survivors)
+    new_arrays: List[DNDarray] = []
+    for x in arrays:
+        if not isinstance(x, DNDarray):
+            raise DegradeError(
+                f"shrink_to_healthy can only move DNDarrays, got {type(x)}"
+            )
+        new_arrays.append(_move_to_comm(x, new_comm))
+    if set_default:
+        from ..core.communication import use_comm
+
+        use_comm(new_comm)
+    return new_comm, new_arrays
+
+
+def _move_to_comm(x: DNDarray, new_comm: MeshCommunication) -> DNDarray:
+    """Redistribute one array onto ``new_comm``, elastic-restore style:
+    gather the logical values, then assemble each new device's chunk from
+    the global intervals (exactly :func:`load_checkpoint`'s reassembly,
+    minus the files)."""
+    host = x.numpy()  # collective on multi-host; exact logical values
+    np_dtype = np.dtype(x.dtype.jax_type())
+    if x.split is None:
+        return DNDarray(host, dtype=x.dtype, split=None, device=x.device, comm=new_comm)
+
+    def read_chunk(slices: Tuple[slice, ...]) -> np.ndarray:
+        return host[tuple(slices)]
+
+    buf = _assemble_from_chunks(read_chunk, x.gshape, x.split, new_comm, np_dtype)
+    return DNDarray._from_buffer(buf, x.gshape, x.dtype, x.split, x.device, new_comm)

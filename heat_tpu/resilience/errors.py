@@ -1,0 +1,106 @@
+"""Structured exception hierarchy for the resilience subsystem.
+
+Every failure the runtime guard layer can surface derives from
+:class:`ResilienceError`, so callers can catch the whole family with one
+``except`` while still dispatching on the precise failure:
+
+- :class:`DivergenceError` — replicated shards disagree (silent data
+  corruption detected by :func:`~heat_tpu.resilience.guard.fingerprint` /
+  :func:`~heat_tpu.resilience.guard.guarded`);
+- :class:`CollectiveTimeout` — a deadline-wrapped blocking collective or
+  resharding path exceeded its budget (hang bounded by
+  :mod:`~heat_tpu.resilience.watchdog`);
+- :class:`DegradeError` / :class:`NoHealthyDevicesError` — elastic
+  shrink-to-healthy cannot proceed
+  (:mod:`~heat_tpu.resilience.degrade`).
+
+The storage-side exceptions (``CheckpointError``, ``ValidationError``)
+join the same hierarchy in their defining modules; ``RetryError`` lives
+in ``core`` (layering: core must not import resilience) and stays an
+``OSError`` subclass.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+__all__ = [
+    "ResilienceError",
+    "DivergenceError",
+    "CollectiveTimeout",
+    "DegradeError",
+    "NoHealthyDevicesError",
+]
+
+
+class ResilienceError(RuntimeError):
+    """Base class for every failure the resilience subsystem raises."""
+
+
+class DivergenceError(ResilienceError):
+    """Replicated shards of a DNDarray do not agree.
+
+    Attributes
+    ----------
+    devices : tuple of int
+        Ids of the devices whose shard digest differs from the majority
+        of their replica group (ties name the whole group).
+    groups : tuple
+        One ``(split_start, ((device_id, digest), ...))`` entry per
+        divergent replica group — the full evidence.
+    label : str
+        Where the check ran (op-boundary label or ``"guarded"``).
+    """
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        devices: Sequence[int] = (),
+        groups: Sequence[Tuple] = (),
+        label: str = "guarded",
+    ):
+        super().__init__(message)
+        self.devices = tuple(devices)
+        self.groups = tuple(groups)
+        self.label = label
+
+
+class CollectiveTimeout(ResilienceError, TimeoutError):
+    """A deadline-wrapped collective/resharding path exceeded its budget.
+
+    Attributes
+    ----------
+    label : str
+        Operation label (``"collective.assemble"``, ``"flatmove.ragged"``,
+        ...).
+    elapsed : float
+        Seconds spent before the deadline fired.
+    deadline : float
+        The configured budget in seconds.
+    """
+
+    def __init__(self, label: str, elapsed: float, deadline: float, detail: str = ""):
+        self.label = label
+        self.elapsed = float(elapsed)
+        self.deadline = float(deadline)
+        msg = (
+            f"collective watchdog: {label!r} exceeded its {deadline:.3g}s "
+            f"deadline (elapsed {elapsed:.3g}s)"
+        )
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+
+
+class DegradeError(ResilienceError):
+    """Graceful degradation (shrink-to-healthy) cannot proceed."""
+
+
+class NoHealthyDevicesError(DegradeError):
+    """Every device of the mesh has been marked unhealthy."""
+
+    def __init__(self, total: int):
+        self.total = int(total)
+        super().__init__(
+            f"all {total} mesh device(s) are marked unhealthy; nothing to shrink onto"
+        )

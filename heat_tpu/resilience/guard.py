@@ -1,0 +1,260 @@
+"""Replica-divergence detection for distributed arrays.
+
+Under the paper's SPMD model a replicated shard is an *assumption*, not a
+checked invariant: every device that carries a copy of the same logical
+data is trusted to hold identical bytes. A single diverged replica (bad
+HBM, a miscompiled kernel on one chip, an asymmetric silent data
+corruption) poisons every downstream collective with no error raised.
+This module makes the assumption checkable:
+
+- :func:`fingerprint` computes a per-shard checksum table: one digest per
+  (device, shard) pair, grouped by the shard's global offset along the
+  split axis. Devices in the same group are replicas and MUST agree —
+  for ``split=None`` every device is a replica of the whole array; on a
+  multi-axis mesh the devices sharing a split coordinate replicate one
+  shard.
+- :func:`check` verifies the cross-replica agreement (and optionally the
+  layout invariants from :func:`~heat_tpu.resilience.validate.validate`)
+  and raises a structured
+  :class:`~heat_tpu.resilience.errors.DivergenceError` naming the
+  offending devices (majority vote inside each group; ties name the
+  whole group).
+- :func:`guarded` is the op-boundary form: a context manager that checks
+  its arrays on entry and on exit, with :meth:`Guard.check` for interior
+  boundaries.
+
+Each shard digest passes through the ``guard.shard`` fault point, so
+``chaos(divergence=...)`` can corrupt a single replica's bytes
+deterministically — the injected version of the real failure — and the
+detection path is testable on CPU.
+"""
+from __future__ import annotations
+
+import zlib
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core import _hooks
+from ..core.dndarray import DNDarray
+from ..core.sanitation import sanitize_in
+from .errors import DivergenceError
+
+__all__ = ["Fingerprint", "fingerprint", "check", "guarded", "Guard"]
+
+
+@dataclass(frozen=True)
+class Fingerprint:
+    """Checksum table of one DNDarray's on-device state.
+
+    ``groups`` maps each shard's global split-axis offset to the
+    ``(device_id, digest)`` pairs of every device holding (a replica of)
+    that shard; ``split=None`` arrays have the single group ``0`` spanning
+    all mesh devices. Two fingerprints of the same values compare equal;
+    any value or layout change produces a different one.
+    """
+
+    gshape: Tuple[int, ...]
+    dtype: str
+    split: Optional[int]
+    groups: Tuple[Tuple[int, Tuple[Tuple[int, str], ...]], ...]
+
+    def divergent_groups(self) -> List[Tuple[int, Tuple[Tuple[int, str], ...]]]:
+        """Replica groups whose digests do not all agree."""
+        return [
+            (start, members)
+            for start, members in self.groups
+            if len({digest for _, digest in members}) > 1
+        ]
+
+    def offending_devices(self) -> List[int]:
+        """Device ids voted out by their replica group's majority digest
+        (a tie names the whole group — no digest is more trustworthy)."""
+        bad: List[int] = []
+        for _, members in self.divergent_groups():
+            counts: Dict[str, int] = {}
+            for _, digest in members:
+                counts[digest] = counts.get(digest, 0) + 1
+            top = max(counts.values())
+            majority = [d for d, c in counts.items() if c == top]
+            if len(majority) == 1:
+                bad.extend(dev for dev, digest in members if digest != majority[0])
+            else:
+                bad.extend(dev for dev, _ in members)
+        return sorted(set(bad))
+
+
+def _shard_digest(host: np.ndarray, device_id: int, start: int, replica: int) -> str:
+    """crc32 of one shard's host bytes; the fault point lets chaos mutate
+    the bytes of a non-primary replica first (``divergence`` faults)."""
+    _hooks.fault_point(
+        "guard.shard", array=host, device=device_id, start=start, replica=replica
+    )
+    return f"{zlib.crc32(np.ascontiguousarray(host).tobytes()) & 0xFFFFFFFF:08x}"
+
+
+def fingerprint(x: DNDarray) -> Fingerprint:
+    """Per-shard checksums plus the cross-replica digest table of ``x``.
+
+    For a split array each addressable device contributes the digest of
+    its *valid* (padding-trimmed) shard bytes, keyed by the shard's
+    global offset; replicated devices land in the same group. For
+    ``split=None`` every device digests the full array into group ``0``.
+    Pure host-side bookkeeping — no collective is issued; on multi-host
+    each process fingerprints its addressable devices.
+    """
+    sanitize_in(x)
+    buf = x._raw
+    split = x.split
+    groups: Dict[int, List[Tuple[int, str]]] = {}
+    if split is None:
+        seen_replica: Dict[int, int] = {}
+        for shard in buf.addressable_shards:
+            # writable host copy: device_get hands back a read-only
+            # zero-copy view on CPU, and the guard.shard fault point must
+            # be able to mutate the bytes (divergence injection)
+            host = np.array(shard.data)
+            replica = seen_replica.setdefault(0, 0)
+            seen_replica[0] += 1
+            dev_id = int(shard.device.id)
+            groups.setdefault(0, []).append(
+                (dev_id, _shard_digest(host, dev_id, 0, replica))
+            )
+    else:
+        # rebalance a ragged layout first so offsets key the canonical map
+        if x.lcounts is not None:
+            x.balance_()
+            buf = x._raw
+        n = x.gshape[split]
+        replica_count: Dict[int, int] = {}
+        for shard in sorted(
+            buf.addressable_shards,
+            key=lambda s: (s.index[split].start or 0, s.device.id),
+        ):
+            start = shard.index[split].start or 0
+            valid = max(0, min(n - start, shard.data.shape[split]))
+            sl = [slice(None)] * x.ndim
+            sl[split] = slice(0, valid)
+            host = np.array(shard.data[tuple(sl)])  # writable copy (see above)
+            replica = replica_count.get(start, 0)
+            replica_count[start] = replica + 1
+            dev_id = int(shard.device.id)
+            groups.setdefault(start, []).append(
+                (dev_id, _shard_digest(host, dev_id, start, replica))
+            )
+    return Fingerprint(
+        gshape=tuple(x.gshape),
+        dtype=np.dtype(x.dtype.jax_type()).name,
+        split=split,
+        groups=tuple(
+            (start, tuple(members)) for start, members in sorted(groups.items())
+        ),
+    )
+
+
+def check(
+    x: DNDarray,
+    *,
+    check_layout: bool = False,
+    check_values: bool = False,
+    label: str = "guarded",
+) -> Fingerprint:
+    """Verify ``x``'s replicated shards agree; return the fingerprint.
+
+    Raises :class:`DivergenceError` naming the offending device ids when
+    any replica group disagrees. ``check_layout=True`` first re-verifies
+    the structural invariants (``lshape_map`` / padded-buffer / dtype)
+    via :func:`~heat_tpu.resilience.validate.validate`;
+    ``check_values=True`` extends that to the NaN/Inf scan.
+    """
+    if check_layout or check_values:
+        from .validate import validate
+
+        validate(x, check_values=check_values)
+    fp = fingerprint(x)
+    divergent = fp.divergent_groups()
+    if divergent:
+        devices = fp.offending_devices()
+        evidence = "; ".join(
+            f"shard@{start}: " + ", ".join(f"dev{d}={g}" for d, g in members)
+            for start, members in divergent
+        )
+        raise DivergenceError(
+            f"replica divergence detected at {label!r}: device(s) {devices} "
+            f"disagree with their replica group ({evidence}) — a silently "
+            f"diverged replica would corrupt every downstream collective",
+            devices=devices,
+            groups=divergent,
+            label=label,
+        )
+    return fp
+
+
+class Guard:
+    """Active :func:`guarded` context: re-check arrays at op boundaries.
+
+    ``check(x)`` verifies one array now (and starts watching it);
+    ``watch(x)`` adds an array to the exit check without checking yet.
+    """
+
+    def __init__(self, arrays, check_layout: bool, check_values: bool, label: str):
+        self._arrays: List[DNDarray] = list(arrays)
+        self._check_layout = check_layout
+        self._check_values = check_values
+        self._label = label
+
+    def watch(self, x: DNDarray) -> DNDarray:
+        self._arrays.append(x)
+        return x
+
+    def check(self, x: Optional[DNDarray] = None) -> None:
+        """Verify one array (or every watched array) at an op boundary."""
+        targets = self._arrays if x is None else [x]
+        for arr in targets:
+            check(
+                arr,
+                check_layout=self._check_layout,
+                check_values=self._check_values,
+                label=self._label,
+            )
+        if x is not None and all(x is not a for a in self._arrays):
+            self._arrays.append(x)
+
+
+class guarded:
+    """Context manager verifying replica agreement at op boundaries.
+
+    ::
+
+        with rz.guarded(x, w, check_layout=True) as g:
+            y = some_op(x, w)
+            g.check(y)          # interior op boundary
+        # exit re-checks x, w, y
+
+    Every watched array is checked on entry and again on exit; any
+    disagreement raises :class:`DivergenceError` naming the devices.
+    ``check_layout=True`` folds in the structural ``validate()``
+    invariants at each boundary; ``check_values=True`` adds the NaN/Inf
+    scan. The checks read back shard bytes — this is a debugging /
+    hardening tool for op boundaries you choose, not a free always-on
+    monitor.
+    """
+
+    def __init__(
+        self,
+        *arrays: DNDarray,
+        check_layout: bool = False,
+        check_values: bool = False,
+        label: str = "guarded",
+    ):
+        self._guard = Guard(arrays, check_layout, check_values, label)
+
+    def __enter__(self) -> Guard:
+        self._guard.check()
+        return self._guard
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc_type is None:
+            self._guard.check()
+        return False

@@ -20,11 +20,12 @@ import numpy as np
 from ..core import types
 from ..core.dndarray import DNDarray
 from ..core.sanitation import sanitize_in, validate_layout
+from .errors import ResilienceError
 
 __all__ = ["validate", "ValidationError"]
 
 
-class ValidationError(ValueError):
+class ValidationError(ResilienceError, ValueError):
     """A DNDarray invariant does not hold; ``problems`` lists every
     violation found (validation continues past the first failure so one
     report names them all)."""

@@ -1,0 +1,149 @@
+"""Collective watchdog: bound blocking host-side paths with deadlines.
+
+The SPMD failure mode the paper's model cannot express is the *hang*: a
+straggling host in ``ragged_process_allgather``, a wedged reshard in
+``flatmove``, a device that never answers ``assemble_local_shards`` —
+every rank blocks forever and no error is ever raised. This module turns
+unbounded waits into structured failures:
+
+- :func:`with_deadline` wraps one callable: run it, and if it has not
+  finished after ``timeout`` seconds raise
+  :class:`~heat_tpu.resilience.errors.CollectiveTimeout` carrying the
+  operation label and elapsed time;
+- :func:`deadlines` is the fleet-wide switch: a context manager that
+  installs a deadline runner into :mod:`heat_tpu.core._hooks`, so every
+  labeled blocking path in ``core.communication`` /
+  ``parallel.flatmove`` / ``resplit`` runs bounded for the duration of
+  the block. Outside the context those paths are direct calls with zero
+  overhead.
+
+A chaos-injected ``TimeoutError`` (``chaos(timeout=...)``) raised inside
+a deadline-wrapped call is converted to the same :class:`CollectiveTimeout`
+(label + elapsed attached), and a chaos ``straggler`` fault (an injected
+delay) is caught by the real wall-clock deadline — both make the
+watchdog testable on CPU without real hangs.
+
+Implementation note: Python cannot kill a wedged thread, so after a
+timeout the worker thread is abandoned (daemonized); the *job* gets a
+structured error and can degrade (checkpoint, shrink, re-dispatch)
+instead of wedging with it. Any late result is discarded.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from functools import wraps
+from typing import Callable, Optional
+
+from ..core import _hooks
+from .errors import CollectiveTimeout
+
+__all__ = ["with_deadline", "deadlines", "current_deadline", "CollectiveTimeout"]
+
+# poll granularity while waiting on the worker: fine enough that a fired
+# deadline is reported promptly, coarse enough to cost nothing
+_TICK = 0.005
+
+# the active default deadline (seconds) while inside a deadlines() block;
+# None means the watchdog is off
+_ACTIVE: Optional[float] = None
+
+
+def current_deadline() -> Optional[float]:
+    """The deadline (seconds) installed by the innermost :func:`deadlines`
+    block, or None when the watchdog is off."""
+    return _ACTIVE
+
+
+def _run_bounded(label: str, fn: Callable, args, kwargs, timeout: float):
+    """Execute ``fn(*args, **kwargs)`` in a worker thread, bounded by
+    ``timeout`` seconds. Returns the result, re-raises the callable's own
+    exception (chaos/real TimeoutErrors upgraded to CollectiveTimeout),
+    or raises CollectiveTimeout when the wait expires."""
+    result: list = []
+    error: list = []
+    done = threading.Event()
+
+    def worker():
+        try:
+            result.append(fn(*args, **kwargs))
+        except BaseException as e:  # noqa: BLE001 - transported to caller
+            error.append(e)
+        finally:
+            done.set()
+
+    t0 = time.monotonic()
+    thread = threading.Thread(target=worker, name=f"heat-tpu-watchdog:{label}", daemon=True)
+    thread.start()
+    deadline = t0 + timeout
+    while not done.is_set():
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise CollectiveTimeout(label, time.monotonic() - t0, timeout)
+        done.wait(min(_TICK, remaining))
+    if error:
+        exc = error[0]
+        if isinstance(exc, TimeoutError) and not isinstance(exc, CollectiveTimeout):
+            # a timeout raised INSIDE the operation (chaos-injected, or a
+            # lower transport layer's): surface it with the same structure
+            raise CollectiveTimeout(
+                label, time.monotonic() - t0, timeout, detail=str(exc)
+            ) from exc
+        raise exc
+    return result[0]
+
+
+def with_deadline(fn: Callable, timeout: float, label: Optional[str] = None) -> Callable:
+    """Wrap ``fn`` so each call must finish within ``timeout`` seconds.
+
+    The wrapped callable raises :class:`CollectiveTimeout` (carrying
+    ``label`` and the elapsed time) instead of blocking forever; a
+    ``TimeoutError`` raised by ``fn`` itself is upgraded to the same
+    type. ``label`` defaults to the callable's qualified name.
+
+    >>> safe_gather = with_deadline(ragged_process_allgather, 30.0,
+    ...                             "collective.allgather")
+    >>> blocks = safe_gather(local, axis=0)
+    """
+    if timeout <= 0:
+        raise ValueError(f"timeout must be positive, got {timeout}")
+    name = label or getattr(fn, "__qualname__", repr(fn))
+
+    @wraps(fn)
+    def bounded(*args, **kwargs):
+        return _run_bounded(name, fn, args, kwargs, timeout)
+
+    return bounded
+
+
+@contextmanager
+def deadlines(timeout: float):
+    """Bound every labeled blocking path for the duration of the block.
+
+    Installs a deadline runner into ``core._hooks``: while active, the
+    host-side resharding/assembly entry points (``collective.assemble``,
+    ``collective.allgather``, ``collective.assemble_local``,
+    ``flatmove.reshape`` / ``flatmove.ragged`` / ``flatmove.strided`` and
+    ``collective.resplit``) each get ``timeout`` seconds before a
+    :class:`CollectiveTimeout` names the one that wedged::
+
+        with resilience.deadlines(30.0):
+            y = x.resplit(1)            # hangs -> CollectiveTimeout, not a wedge
+
+    Nests: the innermost deadline wins; exiting restores the previous one.
+    """
+    if timeout <= 0:
+        raise ValueError(f"timeout must be positive, got {timeout}")
+
+    def runner(label, fn, args, kwargs):
+        return _run_bounded(label, fn, args, kwargs, timeout)
+
+    global _ACTIVE
+    prev_runner = _hooks.set_deadline_runner(runner)
+    prev_active, _ACTIVE = _ACTIVE, float(timeout)
+    try:
+        yield
+    finally:
+        _ACTIVE = prev_active
+        _hooks.set_deadline_runner(prev_runner)

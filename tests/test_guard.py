@@ -1,0 +1,394 @@
+"""Runtime guard layer: divergence detection, collective watchdog, shrink.
+
+Everything runs on the virtual 8-device CPU mesh (conftest). The chaos
+injector supplies the faults a real fleet would: a silently diverged
+replica (``divergence``), a wedged collective (``timeout``), a slow host
+(``straggler``), and a dead device (``io_error`` at the probe site).
+"""
+import threading
+import time
+import unittest
+
+import jax
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu import resilience as rz
+from heat_tpu.core import _hooks
+
+from .base import TestCase
+
+
+class TestFingerprint(TestCase):
+    def test_stable_across_calls(self):
+        x = ht.arange(23, dtype=ht.float32, split=0)
+        self.assertEqual(rz.fingerprint(x), rz.fingerprint(x))
+
+    def test_value_change_changes_fingerprint(self):
+        a = ht.arange(23, dtype=ht.float32, split=0)
+        b = a + 1
+        self.assertNotEqual(rz.fingerprint(a), rz.fingerprint(b))
+
+    def test_split_array_groups_are_singletons(self):
+        # 1-D mesh, split=0: every shard lives on exactly one device
+        x = ht.arange(16, dtype=ht.float32, split=0)
+        fp = rz.fingerprint(x)
+        self.assertEqual(fp.split, 0)
+        self.assertEqual(len(fp.groups), 8)
+        for _, members in fp.groups:
+            self.assertEqual(len(members), 1)
+        self.assertEqual(fp.divergent_groups(), [])
+
+    def test_replicated_array_is_one_group_of_eight(self):
+        # split=None: all 8 devices are replicas of the whole array
+        x = ht.full((3, 4), 2.5, dtype=ht.float32)
+        fp = rz.fingerprint(x)
+        self.assertIsNone(fp.split)
+        self.assertEqual(len(fp.groups), 1)
+        start, members = fp.groups[0]
+        self.assertEqual(start, 0)
+        self.assertEqual(len(members), 8)
+        # healthy replicas: one digest across the whole group
+        self.assertEqual(len({d for _, d in members}), 1)
+
+    def test_uneven_tail_padding_excluded(self):
+        # 9 over 8 devices pads to 16; pad garbage must not enter digests,
+        # so two arrays with equal logical values fingerprint identically
+        a = ht.arange(9, dtype=ht.float32, split=0)
+        b = ht.array(np.arange(9, dtype=np.float32), split=0)
+        self.assertEqual(rz.fingerprint(a).groups, rz.fingerprint(b).groups)
+
+    def test_check_returns_fingerprint_when_healthy(self):
+        x = ht.arange(8, dtype=ht.float32, split=0)
+        fp = rz.check_divergence(x, check_layout=True, check_values=True)
+        self.assertIsInstance(fp, rz.Fingerprint)
+
+
+class TestDivergenceDetection(TestCase):
+    def test_injected_divergence_raises_and_names_the_device(self):
+        # THE acceptance scenario: one non-primary replica's bytes are
+        # perturbed; check() must raise and the majority vote must name
+        # exactly the corrupted device
+        x = ht.full((4, 4), 1.0, dtype=ht.float32)  # replicated on all 8
+        with rz.chaos(seed=0, divergence=1.0, max_faults=1, targets=("guard",)) as c:
+            with self.assertRaises(rz.DivergenceError) as cm:
+                rz.check_divergence(x, label="after-op")
+        self.assertEqual([i.kind for i in c.injected], ["divergence"])
+        err = cm.exception
+        self.assertEqual(len(err.devices), 1)
+        self.assertEqual(err.label, "after-op")
+        self.assertIn(f"dev{err.devices[0]}", str(err))
+        self.assertTrue(err.groups)  # structured evidence attached
+        # the device itself is untouched: only the host-side digest copy
+        # was corrupted, so a re-check without chaos passes
+        rz.check_divergence(x)
+
+    def test_divergence_is_deterministic_given_seed(self):
+        x = ht.full((4, 4), 1.0, dtype=ht.float32)
+
+        def offenders(seed):
+            with rz.chaos(seed=seed, divergence=0.5, targets=("guard",)):
+                try:
+                    rz.check_divergence(x)
+                    return ()
+                except rz.DivergenceError as e:
+                    return tuple(e.devices)
+
+        self.assertEqual(offenders(3), offenders(3))
+
+    def test_split_array_has_no_replicas_to_diverge(self):
+        # on the 1-D mesh a split array has singleton groups: there is no
+        # replica to corrupt, so full-probability divergence injects nothing
+        x = ht.arange(16, dtype=ht.float32, split=0)
+        with rz.chaos(seed=0, divergence=1.0, targets=("guard",)) as c:
+            rz.check_divergence(x)
+        self.assertEqual(c.injected, [])
+
+    def test_guarded_context_checks_on_entry(self):
+        x = ht.full((2, 2), 3.0, dtype=ht.float32)
+        with rz.chaos(seed=0, divergence=1.0, max_faults=1, targets=("guard",)):
+            with self.assertRaises(rz.DivergenceError):
+                with rz.guarded(x):
+                    self.fail("body must not run when entry check fails")
+
+    def test_guarded_context_checks_on_exit_and_interior(self):
+        x = ht.arange(8, dtype=ht.float32, split=0)
+        with rz.guarded(x, check_layout=True) as g:
+            y = x + 1
+            g.check(y)  # interior boundary: y is now watched too
+        # exit re-checked x and y cleanly; divergence on exit raises
+        with self.assertRaises(rz.DivergenceError):
+            with rz.chaos(seed=0, divergence=0.0, targets=("guard",)) as c:
+                with rz.guarded() as g:
+                    g.watch(ht.full((2, 2), 1.0, dtype=ht.float32))
+                    c.divergence = 1.0  # entry was clean; exit diverges
+                    c.max_faults = 1
+
+    def test_no_false_positives_under_clean_ops(self):
+        x = ht.arange(24, dtype=ht.float32, split=0)
+        with rz.guarded(x, check_values=True) as g:
+            y = ht.reshape(x, (6, 4))
+            g.check(y)
+            z = y.resplit(1)
+            g.check(z)
+        np.testing.assert_array_equal(z.numpy(), np.arange(24, dtype=np.float32).reshape(6, 4))
+
+    def test_divergence_error_is_resilience_error(self):
+        self.assertTrue(issubclass(rz.DivergenceError, rz.ResilienceError))
+        self.assertTrue(issubclass(rz.CollectiveTimeout, rz.ResilienceError))
+        self.assertTrue(issubclass(rz.CollectiveTimeout, TimeoutError))
+        self.assertTrue(issubclass(rz.NoHealthyDevicesError, rz.DegradeError))
+
+
+class TestWatchdog(TestCase):
+    def test_result_passes_through(self):
+        self.assertEqual(rz.with_deadline(lambda a, b: a + b, 5.0)(2, 3), 5)
+
+    def test_own_exception_passes_through(self):
+        def boom():
+            raise ValueError("logic bug, not a hang")
+
+        with self.assertRaises(ValueError):
+            rz.with_deadline(boom, 5.0)()
+
+    def test_slow_callable_times_out(self):
+        release = threading.Event()
+        slow = rz.with_deadline(lambda: release.wait(5.0), 0.05, "stuck.gather")
+        t0 = time.monotonic()
+        with self.assertRaises(rz.CollectiveTimeout) as cm:
+            slow()
+        release.set()  # unwedge the abandoned worker
+        self.assertLess(time.monotonic() - t0, 2.0)  # bounded, not 5s
+        err = cm.exception
+        self.assertEqual(err.label, "stuck.gather")
+        self.assertGreaterEqual(err.elapsed, 0.05)
+        self.assertEqual(err.deadline, 0.05)
+        self.assertIn("stuck.gather", str(err))
+
+    def test_inner_timeout_error_upgraded(self):
+        def wedged_transport():
+            raise TimeoutError("barrier timed out")
+
+        with self.assertRaises(rz.CollectiveTimeout) as cm:
+            rz.with_deadline(wedged_transport, 5.0, "x.barrier")()
+        self.assertIn("barrier timed out", str(cm.exception))
+        self.assertIsInstance(cm.exception.__cause__, TimeoutError)
+
+    def test_invalid_timeout_rejected(self):
+        with self.assertRaises(ValueError):
+            rz.with_deadline(lambda: None, 0.0)
+        with self.assertRaises(ValueError):
+            rz.deadlines(-1.0).__enter__()
+
+    def test_deadlines_installs_and_restores_runner(self):
+        from heat_tpu.resilience import watchdog
+
+        self.assertIsNone(_hooks.get_deadline_runner())
+        self.assertIsNone(watchdog.current_deadline())
+        with rz.deadlines(1.0):
+            self.assertIsNotNone(_hooks.get_deadline_runner())
+            self.assertEqual(watchdog.current_deadline(), 1.0)
+            with rz.deadlines(0.25):
+                self.assertEqual(watchdog.current_deadline(), 0.25)
+            self.assertEqual(watchdog.current_deadline(), 1.0)
+        self.assertIsNone(_hooks.get_deadline_runner())
+        self.assertIsNone(watchdog.current_deadline())
+
+    def test_chaos_timeout_under_deadline_is_collective_timeout(self):
+        # a chaos-injected stall inside resplit surfaces as a structured
+        # CollectiveTimeout naming the collective, within the deadline
+        x = ht.reshape(ht.arange(24, dtype=ht.float32), (6, 4)).resplit(0)
+        with rz.deadlines(5.0):
+            with rz.chaos(seed=0, timeout=1.0, targets=("collective",)):
+                with self.assertRaises(rz.CollectiveTimeout) as cm:
+                    x.resplit_(1)
+        self.assertEqual(cm.exception.label, "collective.resplit")
+        # outside the deadline block the same fault is a plain TimeoutError
+        y = ht.reshape(ht.arange(24, dtype=ht.float32), (6, 4)).resplit(0)
+        with rz.chaos(seed=0, timeout=1.0, targets=("collective",)):
+            with self.assertRaises(TimeoutError):
+                y.resplit_(1)
+
+    def test_chaos_straggler_caught_by_wall_clock(self):
+        # the straggler raises nothing — only the real deadline catches it
+        x = ht.reshape(ht.arange(24, dtype=ht.float32), (6, 4)).resplit(0)
+        with rz.deadlines(0.05):
+            with rz.chaos(
+                seed=0, straggler=1.0, straggler_delay=0.5, targets=("collective",)
+            ) as c:
+                with self.assertRaises(rz.CollectiveTimeout):
+                    x.resplit_(1)
+        self.assertIn("straggler", [i.kind for i in c.injected])
+
+    def test_straggler_within_deadline_proceeds(self):
+        x = ht.reshape(ht.arange(24, dtype=ht.float32), (6, 4)).resplit(0)
+        with rz.deadlines(10.0):
+            with rz.chaos(
+                seed=0, straggler=1.0, straggler_delay=0.01, targets=("collective",)
+            ) as c:
+                y = x.resplit_(1)
+        self.assertTrue(any(i.kind == "straggler" for i in c.injected))
+        np.testing.assert_array_equal(
+            y.numpy(), np.arange(24, dtype=np.float32).reshape(6, 4)
+        )
+
+    def test_assembly_paths_run_under_deadline(self):
+        # numpy() funnels through assemble_local_shards; a generous
+        # deadline must be transparent (result identical, no error)
+        x = ht.arange(23, dtype=ht.float32, split=0)
+        with rz.deadlines(30.0):
+            np.testing.assert_array_equal(x.numpy(), np.arange(23, dtype=np.float32))
+
+
+class TestDegrade(TestCase):
+    def setUp(self):
+        rz.clear_unhealthy()
+
+    def tearDown(self):
+        rz.clear_unhealthy()
+
+    def test_mark_and_clear(self):
+        devs = jax.devices()
+        rz.mark_unhealthy(devs[3])
+        rz.mark_unhealthy(5)  # bare id form
+        self.assertEqual(rz.unhealthy_devices(), frozenset({3, 5}))
+        self.assertEqual(len(rz.healthy_devices()), 6)
+        rz.clear_unhealthy(3)
+        self.assertEqual(rz.unhealthy_devices(), frozenset({5}))
+        rz.clear_unhealthy()
+        self.assertEqual(rz.unhealthy_devices(), frozenset())
+
+    def test_probe_all_healthy(self):
+        self.assertEqual(rz.probe(), [])
+        self.assertEqual(rz.unhealthy_devices(), frozenset())
+
+    def test_probe_marks_injected_bad_devices(self):
+        with rz.chaos(seed=0, io_error=1.0, targets=("degrade",)) as c:
+            bad = rz.probe()
+        self.assertEqual(len(bad), 8)  # every probe failed deterministically
+        self.assertEqual(len(c.injected), 8)
+        self.assertEqual(rz.unhealthy_devices(), frozenset(bad))
+
+    def test_probe_mark_false_leaves_registry(self):
+        with rz.chaos(seed=0, io_error=1.0, max_faults=2, targets=("degrade",)):
+            bad = rz.probe(mark=False)
+        self.assertEqual(len(bad), 2)
+        self.assertEqual(rz.unhealthy_devices(), frozenset())
+
+    def test_shrink_noop_when_all_healthy(self):
+        x = ht.arange(10, dtype=ht.float32, split=0)
+        comm, arrays = rz.shrink_to_healthy(arrays=[x])
+        self.assertIs(arrays[0], x)
+        self.assertEqual(comm.size, 8)
+
+    def test_shrink_roundtrip_preserves_values(self):
+        # THE acceptance scenario: arrays survive the shrink bit-identical
+        xs = [
+            ht.arange(23, dtype=ht.float32, split=0),
+            ht.reshape(ht.arange(60, dtype=ht.float64), (5, 12)).resplit(1),
+            ht.full((3, 4), 7.5, dtype=ht.float32),  # replicated
+            ht.arange(17, dtype=ht.int64, split=0),
+        ]
+        before = [x.numpy() for x in xs]
+        rz.mark_unhealthy(6)
+        rz.mark_unhealthy(7)
+        new_comm, ys = rz.shrink_to_healthy(arrays=xs)
+        self.assertEqual(new_comm.size, 6)
+        for x, y, host in zip(xs, ys, before):
+            self.assertEqual(y.comm.size, 6)
+            self.assertEqual(y.split, x.split)
+            self.assertEqual(y.dtype, x.dtype)
+            np.testing.assert_array_equal(y.numpy(), host)
+
+    def test_shrink_to_single_device(self):
+        x = ht.arange(23, dtype=ht.float32, split=0)
+        for dev_id in range(1, 8):
+            rz.mark_unhealthy(dev_id)
+        new_comm, (y,) = rz.shrink_to_healthy(arrays=[x])
+        self.assertEqual(new_comm.size, 1)
+        np.testing.assert_array_equal(y.numpy(), np.arange(23, dtype=np.float32))
+
+    def test_no_healthy_devices_raises(self):
+        for d in jax.devices():
+            rz.mark_unhealthy(d)
+        with self.assertRaises(rz.NoHealthyDevicesError) as cm:
+            rz.shrink_to_healthy()
+        self.assertEqual(cm.exception.total, 8)
+        self.assertIn("all 8", str(cm.exception))
+
+    def test_shrink_rejects_non_dndarray(self):
+        rz.mark_unhealthy(0)
+        with self.assertRaises(rz.DegradeError):
+            rz.shrink_to_healthy(arrays=[np.ones(3)])
+
+    def test_set_default_installs_shrunken_comm(self):
+        from heat_tpu.core.communication import use_comm
+
+        old = ht.get_comm()
+        try:
+            rz.mark_unhealthy(7)
+            new_comm, _ = rz.shrink_to_healthy(set_default=True)
+            self.assertIs(ht.get_comm(), new_comm)
+            z = ht.arange(6, dtype=ht.float32, split=0)
+            self.assertEqual(z.comm.size, 7)
+        finally:
+            use_comm(old)
+
+    def test_probe_then_shrink_end_to_end(self):
+        # the full degradation story: probe finds the bad device, shrink
+        # rebuilds around it, computation continues with the same values
+        x = ht.arange(32, dtype=ht.float32, split=0)
+        with rz.chaos(seed=0, io_error=1.0, max_faults=1, targets=("degrade",)):
+            bad = rz.probe()
+        self.assertEqual(len(bad), 1)
+        new_comm, (y,) = rz.shrink_to_healthy(arrays=[x])
+        self.assertEqual(new_comm.size, 7)
+        self.assertNotIn(bad[0], [int(d.id) for d in new_comm.mesh.devices.ravel()])
+        np.testing.assert_array_equal(
+            (y + 1).numpy(), np.arange(32, dtype=np.float32) + 1
+        )
+
+
+class TestStatisticsCacheStability(TestCase):
+    """Satellite: ht.max/ht.min must reuse ONE jitted reduce executable
+    across calls instead of compiling (and leaking) one per call."""
+
+    def test_nanprop_closures_are_module_level_and_marked(self):
+        from heat_tpu.core import statistics as st
+
+        self.assertIs(st._NANPROP_MAX, st._NANPROP_MAX)
+        self.assertTrue(st._NANPROP_MAX._cache_stable)
+        self.assertTrue(st._NANPROP_MIN._cache_stable)
+
+    def test_repeated_max_min_hit_the_cache(self):
+        from heat_tpu.core import _operations as ops
+
+        x = ht.arange(24, dtype=ht.float32, split=0)
+        float(ht.max(x).numpy())  # populate both entries
+        float(ht.min(x).numpy())
+        before = ops._jitted_reduce_cached.cache_info()
+        for _ in range(5):
+            self.assertEqual(float(ht.max(x).numpy()), 23.0)
+            self.assertEqual(float(ht.min(x).numpy()), 0.0)
+        after = ops._jitted_reduce_cached.cache_info()
+        self.assertEqual(after.misses, before.misses)  # no recompiles
+        self.assertGreater(after.hits, before.hits)
+
+    def test_fresh_local_closure_bypasses_cache(self):
+        from heat_tpu.core import _operations as ops
+
+        def local_op(a, axis=None, keepdims=False):
+            return a.sum(axis=axis, keepdims=keepdims)
+
+        self.assertIsNone(
+            ops._jitted_reduce(local_op, None, False, None, 0, None, None, ())
+        )
+
+    def test_cache_is_bounded(self):
+        from heat_tpu.core import _operations as ops
+
+        self.assertEqual(ops._jitted_reduce_cached.cache_info().maxsize, 256)
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -231,6 +231,95 @@ def test_two_process_end_to_end(tmp_path):
         np.testing.assert_allclose(f["doubled"][...], ref * 2.0)
 
 
+_GUARD_WORKER = r"""
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+pid = int(sys.argv[1]); nproc = int(sys.argv[2]); port = sys.argv[3]
+
+import heat_tpu as ht
+from heat_tpu import resilience as rz
+
+ht.init_distributed(
+    coordinator_address=f"localhost:{port}", num_processes=nproc, process_id=pid
+)
+assert jax.device_count() == 8 and jax.local_device_count() == 4
+
+# --- fingerprint: each process digests only its addressable shards ---
+full = np.arange(24 * 3, dtype=np.float32).reshape(24, 3)
+x = ht.array(full, split=0)
+fp = rz.check_divergence(x, check_layout=True)  # healthy: no divergence
+assert fp.split == 0
+assert len(fp.groups) == 4, fp.groups  # 4 local shards of the 8 global
+
+# --- guarded() across a process-spanning reduce ---
+with rz.guarded(x) as g:
+    total = float(x.sum().item())
+    assert total == float(full.sum()), (total, full.sum())
+
+# --- watchdog: injected stall inside resplit_ -> CollectiveTimeout on
+# every rank (the fault fires host-side, symmetrically: same seed) ---
+y = ht.array(full, split=0)
+with rz.deadlines(30.0):
+    with rz.chaos(seed=0, timeout=1.0, targets=("collective",)):
+        try:
+            y.resplit_(1)
+            raise AssertionError("expected CollectiveTimeout")
+        except rz.CollectiveTimeout as e:
+            assert e.label == "collective.resplit", e.label
+
+# --- shrink-to-healthy: drop one device of process 1's four; the
+# surviving 7-device mesh still spans both processes and the values
+# survive the redistribution bit-identically ---
+rz.mark_unhealthy(7)
+new_comm, (z,) = rz.shrink_to_healthy(arrays=[x])
+assert new_comm.size == 7, new_comm.size
+assert 7 not in [int(d.id) for d in new_comm.mesh.devices.ravel()]
+assert float(z.sum().item()) == float(full.sum())
+zcol = float((z * ht.array(full, split=0, comm=new_comm)).sum().item())
+assert abs(zcol - float((full * full).sum())) < 1e-2, zcol
+rz.clear_unhealthy()
+
+print(f"WORKER{pid} GUARD OK {total:.3f}")
+"""
+
+
+@pytest.mark.skipif(
+    os.environ.get("HEAT_TPU_TEST_DEVICES", "8") != "8",
+    reason="one fixed 2x4 topology is enough for the matrix",
+)
+def test_two_process_guard_layer(tmp_path):
+    """Runtime guards under real multi-process execution: divergence
+    check over addressable shards, watchdog-bounded resplit, and an
+    elastic shrink whose surviving mesh still spans both processes."""
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+
+    worker = tmp_path / "guard_worker.py"
+    worker.write_text(_GUARD_WORKER)
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env.pop("HEAT_TPU_TEST_DEVICES", None)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(i), "2", str(port)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = [p.communicate(timeout=600)[0] for p in procs]
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out}"
+        assert f"WORKER{i} GUARD OK" in out, out
+
+
 _PYTEST_DRIVER = r"""
 import os, sys
 import jax

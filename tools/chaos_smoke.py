@@ -1,9 +1,11 @@
-"""Chaos smoke: checkpoint round-trips survive a sweep of injected faults.
+"""Chaos smoke: the resilience stack survives a sweep of injected faults.
 
 Exercises the full resilience stack end-to-end on the virtual 8-device CPU
-mesh: for a matrix of (seed, fault-mix) chaos settings, save a checkpoint
-under injected I/O failures / torn writes / silent corruption, then prove
-that one of the two acceptable outcomes happened —
+mesh, in two matrices:
+
+**Checkpoint matrix** — for a spread of (seed, fault-mix) chaos settings,
+save a checkpoint under injected I/O failures / torn writes / silent
+corruption, then prove that one of the acceptable outcomes happened —
 
 - the save succeeded (transient faults absorbed by the RetryPolicy) and the
   restore is bit-identical with the original dtype and split, or
@@ -13,6 +15,18 @@ that one of the two acceptable outcomes happened —
 - the save committed silently-corrupted bytes and the restore *detects* it
   via checksum verification (CheckpointCorruptionError) instead of
   returning wrong values.
+
+**Guard matrix** — for each seed, the runtime guard layer must convert
+every injected runtime failure into its structured error:
+
+- an injected replica divergence ALWAYS surfaces as ``DivergenceError``
+  naming at least one device (and never fires when chaos injected
+  nothing);
+- an injected collective stall or straggler under ``deadlines(t)`` ALWAYS
+  surfaces as ``CollectiveTimeout`` within the deadline (never a hang,
+  never a bare TimeoutError);
+- ``shrink_to_healthy`` after probe-detected device failures yields a
+  smaller mesh whose arrays equal their pre-shrink gathered values.
 
 Exits 0 iff every scenario lands in an acceptable outcome. Run directly:
 
@@ -79,6 +93,106 @@ def run_scenario(name: str, seed: int, chaos_kwargs: dict) -> str:
         return "saved+restored" if saved else "save-failed,old-intact"
 
 
+def guard_divergence(seed: int) -> str:
+    """An injected replica divergence MUST surface as DivergenceError."""
+    x = ht.full((4, 4), 1.0, dtype=ht.float32)  # replicated on all 8 devices
+    with rz.chaos(seed=seed, divergence=1.0, max_faults=1, targets=("guard",)) as c:
+        try:
+            rz.check_divergence(x, label="smoke")
+        except rz.DivergenceError as e:
+            assert e.devices, f"divergence detected but no device named: {e}"
+            return f"detected-divergence dev={e.devices}"
+        raise AssertionError(
+            f"seed={seed}: chaos injected {len(c.injected)} divergence fault(s) "
+            f"but check_divergence passed\n{c.report()}"
+        )
+
+
+def guard_divergence_probabilistic(seed: int) -> str:
+    """At p<1 the guard must agree with the injector exactly: raise iff a
+    fault was injected — no false positives, no false negatives."""
+    x = ht.full((2, 8), 3.0, dtype=ht.float32)
+    with rz.chaos(seed=seed, divergence=0.3, targets=("guard",)) as c:
+        try:
+            rz.check_divergence(x)
+            raised = False
+        except rz.DivergenceError:
+            raised = True
+    injected = any(i.kind == "divergence" for i in c.injected)
+    assert raised == injected, (
+        f"seed={seed}: injected={injected} but raised={raised}\n{c.report()}"
+    )
+    return "detected-divergence" if raised else "clean-pass"
+
+
+def guard_timeout(seed: int) -> str:
+    """An injected stall under deadlines() MUST be a CollectiveTimeout."""
+    x = ht.reshape(ht.arange(24, dtype=ht.float32), (6, 4)).resplit(0)
+    with rz.deadlines(30.0):
+        with rz.chaos(seed=seed, timeout=1.0, targets=("collective",)):
+            try:
+                x.resplit_(1)
+            except rz.CollectiveTimeout as e:
+                assert e.label == "collective.resplit", e.label
+                return "structured-timeout"
+            raise AssertionError(f"seed={seed}: injected stall was not caught")
+
+
+def guard_straggler(seed: int) -> str:
+    """An injected straggler (sleep, no exception) MUST trip the wall-clock
+    deadline promptly — well before the straggler itself finishes."""
+    deadline, delay = 0.05, 1.0
+    x = ht.reshape(ht.arange(24, dtype=ht.float32), (6, 4)).resplit(0)
+    with rz.deadlines(deadline):
+        with rz.chaos(
+            seed=seed, straggler=1.0, straggler_delay=delay, targets=("collective",)
+        ) as c:
+            try:
+                x.resplit_(1)
+            except rz.CollectiveTimeout as e:
+                assert any(i.kind == "straggler" for i in c.injected), c.report()
+                assert e.elapsed < delay * 0.8, (
+                    f"deadline fired only after {e.elapsed:.3f}s — the watchdog "
+                    f"waited for the straggler instead of bounding it"
+                )
+                return f"straggler-bounded ({e.elapsed * 1000:.0f}ms)"
+            raise AssertionError(f"seed={seed}: straggler slipped past the deadline")
+
+
+def guard_shrink(seed: int) -> str:
+    """Probe-detected bad devices -> shrink -> values preserved exactly."""
+    rz.clear_unhealthy()
+    try:
+        xs = [
+            ht.arange(23, dtype=ht.float32, split=0),
+            ht.reshape(ht.arange(60, dtype=ht.float64), (5, 12)).resplit(1),
+            ht.full((3, 4), 7.5, dtype=ht.float32),  # replicated
+        ]
+        before = [x.numpy() for x in xs]
+        with rz.chaos(seed=seed, io_error=1.0, max_faults=2, targets=("degrade",)):
+            bad = rz.probe()
+        assert len(bad) == 2, f"probe found {bad}, expected exactly 2 injected"
+        new_comm, ys = rz.shrink_to_healthy(arrays=xs)
+        assert new_comm.size == 6, new_comm.size
+        surviving = [int(d.id) for d in new_comm.mesh.devices.ravel()]
+        assert not set(bad) & set(surviving), (bad, surviving)
+        for x, y, host in zip(xs, ys, before):
+            np.testing.assert_array_equal(y.numpy(), host)
+            assert y.split == x.split and y.dtype == x.dtype
+        return f"shrunk 8->{new_comm.size}, values intact"
+    finally:
+        rz.clear_unhealthy()
+
+
+GUARD_SCENARIOS = [
+    ("divergence", guard_divergence),
+    ("divergence-p0.3", guard_divergence_probabilistic),
+    ("stall-deadline", guard_timeout),
+    ("straggler", guard_straggler),
+    ("probe+shrink", guard_shrink),
+]
+
+
 def main() -> int:
     failures = []
     for name, kwargs in SCENARIOS:
@@ -89,10 +203,16 @@ def main() -> int:
             except Exception as e:  # noqa: BLE001 - report-all tool
                 failures.append((name, seed, e))
                 print(f"  FAIL {name:>18} seed={seed}: {type(e).__name__}: {e}")
-    print(
-        f"chaos_smoke: {len(SCENARIOS) * len(SEEDS) - len(failures)}/"
-        f"{len(SCENARIOS) * len(SEEDS)} scenarios ok"
-    )
+    for name, fn in GUARD_SCENARIOS:
+        for seed in SEEDS:
+            try:
+                outcome = fn(seed)
+                print(f"  ok   {name:>18} seed={seed}: {outcome}")
+            except Exception as e:  # noqa: BLE001 - report-all tool
+                failures.append((name, seed, e))
+                print(f"  FAIL {name:>18} seed={seed}: {type(e).__name__}: {e}")
+    total = (len(SCENARIOS) + len(GUARD_SCENARIOS)) * len(SEEDS)
+    print(f"chaos_smoke: {total - len(failures)}/{total} scenarios ok")
     return 1 if failures else 0
 
 
