@@ -15,6 +15,15 @@ remains is exactly the *semantic* layer:
   and reductions that cross the split axis mask the padding with the op's
   neutral element (the analogue of the reference's neutral-element fill for
   empty shards, ``_operations.py:424-436``),
+- **ragged discipline**: arrays left in a ragged layout by ``redistribute_``
+  (per-shard ``lcounts``, data at offset 0 of each block) compute in place,
+  like the reference's unbalanced arrays (``_operations.py:72-77``) — the
+  invalid region of each block is masked exactly like tail padding (valid
+  iff ``pos % block < lcounts[pos // block]``). Binary operands with
+  identical ``lcounts`` compute directly; mismatched layouts align with ONE
+  bounded ``flatmove`` exchange into the first ragged operand's layout
+  (cheaper than rebalancing both); results inherit the ragged layout.
+  ``balance_()`` is reserved for ops that need the canonical ceil-div map.
 - ``out=`` rewriting.
 """
 from __future__ import annotations
@@ -31,7 +40,15 @@ from .communication import sanitize_comm
 from .dndarray import DNDarray
 from .stride_tricks import broadcast_shape, sanitize_axis
 
-__all__ = ["_binary_op", "_local_op", "_reduce_op", "_cum_op", "_mask_padding"]
+__all__ = [
+    "_binary_op",
+    "_local_op",
+    "_reduce_op",
+    "_cum_op",
+    "_mask_padding",
+    "_mask_ragged",
+    "_ragged_valid_mask",
+]
 
 Scalar = (int, float, bool, complex, np.number, np.bool_)
 
@@ -89,6 +106,40 @@ def _mask_padding(buffer: jax.Array, gshape, split: int, fill) -> jax.Array:
     return jnp.where(iota < n, buffer, jnp.asarray(fill, dtype=buffer.dtype))
 
 
+# --------------------------------------------------------------- ragged layout
+def _ragged_layout(x) -> Optional[Tuple[int, Tuple[int, ...]]]:
+    """``(block, lcounts)`` of a ragged-layout DNDarray, else None.
+
+    Hashable on purpose: the pair keys the jitted-reduce cache, so every
+    distinct ragged map compiles once and is reused (no per-call closures
+    — the statistics.py recompile bug must stay dead)."""
+    lcounts = getattr(x, "lcounts", None)
+    if lcounts is None:
+        return None
+    return (x._raw.shape[x.split] // x.comm.size, tuple(lcounts))
+
+
+def _ragged_valid_mask(shape, split: int, block: int, lcounts) -> jax.Array:
+    """Boolean buffer-shaped mask of the VALID positions of a ragged
+    layout: position ``k`` of block ``r`` is valid iff ``k < lcounts[r]``.
+    Traceable (``lcounts`` is a static tuple, so the limits are an XLA
+    constant); the generalization of the tail-padding ``iota < n`` test to
+    per-block valid extents."""
+    iota = jax.lax.broadcasted_iota(jnp.int32, shape, split)
+    limits = jnp.take(jnp.asarray(lcounts, dtype=jnp.int32), iota // block)
+    return (iota % block) < limits
+
+
+def _mask_ragged(buffer: jax.Array, split: int, block: int, lcounts, fill) -> jax.Array:
+    """Overwrite the ragged-invalid region of every block with ``fill``
+    (the ragged analogue of :func:`_mask_padding`)."""
+    fill = _neutral_value(fill, buffer.dtype)
+    if fill is None:
+        raise ValueError("no neutral value for this dtype on a ragged layout")
+    mask = _ragged_valid_mask(buffer.shape, split, block, lcounts)
+    return jnp.where(mask, buffer, jnp.asarray(fill, dtype=buffer.dtype))
+
+
 def _aligned_operand_buffer(
     op: DNDarray, jt, out_shape, out_split: Optional[int], out_pshape
 ) -> jax.Array:
@@ -114,6 +165,87 @@ def _aligned_operand_buffer(
     pad[j] = (0, out_pshape[out_split] - d)
     base = op._logical() if op.padded else op.larray
     return jnp.pad(base.astype(jt), pad)
+
+
+def _ragged_aligned_buffer(
+    op: DNDarray, jt, out_shape, j: int, lcounts, block: int, comm
+) -> Optional[jax.Array]:
+    """Operand buffer cast to ``jt`` and broadcast-compatible with the
+    target ragged layout ``(block, lcounts)`` at output axis ``j``.
+
+    At most ONE bounded flatmove exchange (a split operand in a different
+    layout); a replicated full-extent operand is re-indexed locally (its
+    data is everywhere already — a constant gather, no collective).
+    Returns None when the operand cannot be aligned cheaply (caller falls
+    back to the canonical path)."""
+    jo = j - (len(out_shape) - op.ndim)
+    if jo < 0 or op.gshape[jo] == 1:
+        # no dim / size-1 dim at the split axis: broadcasts against the
+        # padded block extent untouched
+        return (op._logical() if op.padded else op._raw).astype(jt)
+    if op.gshape[jo] != out_shape[j]:  # pragma: no cover - defensive
+        return None
+    if op.lcounts is not None:
+        if op.split != jo:  # pragma: no cover - defensive
+            return None
+        own_block = op._raw.shape[jo] // comm.size
+        if tuple(op.lcounts) == tuple(lcounts) and own_block == block:
+            return op._raw.astype(jt)  # identical layout: compute in place
+        from ..parallel.flatmove import ragged_move
+
+        return ragged_move(op._raw, jo, op.lcounts, lcounts, block, comm).astype(jt)
+    if op.split == jo:
+        # canonical split operand — a canonical buffer IS a ragged layout
+        # (ceil-div counts, data at offset 0 per block): one exchange
+        from ..parallel.flatmove import ragged_move
+
+        counts = tuple(comm.counts_displs_shape(op.gshape, jo)[0])
+        return ragged_move(op._raw, jo, counts, lcounts, block, comm).astype(jt)
+    if op.split is not None:  # pragma: no cover - defensive (sa == sb checked)
+        return None
+    # replicated at full extent: scatter the logical rows into the target
+    # block layout with a constant index map (local gather, no collective)
+    n = op.gshape[jo]
+    displs = np.concatenate([[0], np.cumsum(lcounts)[:-1]])
+    rows = np.concatenate(
+        [
+            displs[r] + np.minimum(np.arange(block), max(int(lcounts[r]) - 1, 0))
+            for r in range(comm.size)
+        ]
+    )
+    rows = np.clip(rows, 0, n - 1)
+    return jnp.take(op._logical().astype(jt), jnp.asarray(rows), axis=jo)
+
+
+def _ragged_binary(
+    operation, a: DNDarray, b: DNDarray, out_shape, j: int, jt, device, comm, fn_kwargs
+) -> Optional[DNDarray]:
+    """Binary op computed directly in a ragged layout (no rebalance).
+
+    The target layout is the first ragged operand's (its ``lcounts``
+    survive into the result); the other operand aligns with at most one
+    bounded exchange. Returns None when the pair needs the canonical
+    path."""
+    target = a if a.lcounts is not None else b
+    jt_axis = j - (len(out_shape) - target.ndim)
+    if jt_axis < 0 or target.gshape[jt_axis] != out_shape[j]:
+        return None  # ragged operand broadcasts at the split axis: rare, rebalance
+    lcounts = tuple(target.lcounts)
+    block = target._raw.shape[target.split] // comm.size
+    buf_a = _ragged_aligned_buffer(a, jt, out_shape, j, lcounts, block, comm)
+    buf_b = _ragged_aligned_buffer(b, jt, out_shape, j, lcounts, block, comm)
+    if buf_a is None or buf_b is None:
+        return None
+    result = operation(buf_a, buf_b, **fn_kwargs)
+    return DNDarray._from_ragged(
+        result,
+        out_shape,
+        types.canonical_heat_type(result.dtype),
+        j,
+        lcounts,
+        device,
+        comm,
+    )
 
 
 def _write_out(out: DNDarray, result: DNDarray) -> DNDarray:
@@ -171,6 +303,18 @@ def _binary_op(
     out_pshape = comm.padded_shape(out_shape, out_split)
 
     jt = promoted.jax_type()
+    if (
+        out is None
+        and where is True
+        and out_split is not None
+        and (a.lcounts is not None or b.lcounts is not None)
+    ):
+        # ragged fast path: compute in the ragged layout, no rebalance
+        res = _ragged_binary(
+            operation, a, b, out_shape, out_split, jt, device, comm, fn_kwargs
+        )
+        if res is not None:
+            return res
     buf_a = _aligned_operand_buffer(a, jt, out_shape, out_split, out_pshape)
     buf_b = _aligned_operand_buffer(b, jt, out_shape, out_split, out_pshape)
     result = operation(buf_a, buf_b, **fn_kwargs)
@@ -209,11 +353,12 @@ def _local_op(
     **kwargs,
 ) -> DNDarray:
     """Embarrassingly-parallel elementwise op (reference
-    ``_operations.py:305-376``). Split, sharding and padding are inherited:
-    the op runs on the padded buffer (pad content stays unspecified)."""
+    ``_operations.py:305-376``). Split, sharding, padding AND raggedness
+    are inherited: the op runs on the stored buffer (pad / ragged-invalid
+    content stays unspecified), so a ragged array never rebalances here."""
     if not isinstance(x, DNDarray):
         raise TypeError(f"expected x to be a DNDarray, but was {type(x)}")
-    arr = x.larray
+    arr = x._raw if x.lcounts is not None else x.larray
     if not no_cast and not jnp.issubdtype(arr.dtype, jnp.inexact) and not jnp.issubdtype(
         arr.dtype, jnp.complexfloating
     ):
@@ -222,7 +367,19 @@ def _local_op(
             arr = arr.astype(types.promote_types(x.dtype, types.float32).jax_type())
     result = operation(arr, **kwargs)
     dtype = out_dtype if out_dtype is not None else types.canonical_heat_type(result.dtype)
-    if tuple(result.shape) == x.pshape:
+    if x.lcounts is not None:
+        if tuple(result.shape) != tuple(arr.shape):
+            # shape-changing op: ragged block coordinates would be
+            # misinterpreted — recompute through the canonical layout
+            x.balance_()
+            return _local_op(
+                operation, x, out=out, no_cast=no_cast, out_dtype=out_dtype, **kwargs
+            )
+        res = DNDarray._from_ragged(
+            result.astype(dtype.jax_type()),
+            x.gshape, dtype, x.split, x.lcounts, x.device, x.comm,
+        )
+    elif tuple(result.shape) == x.pshape:
         res = DNDarray._from_buffer(
             result.astype(dtype.jax_type()), x.gshape, dtype, x.split, x.device, x.comm
         )
@@ -253,7 +410,9 @@ def _kwargs_key(kwargs: dict):
 
 
 @lru_cache(maxsize=256)
-def _jitted_reduce_cached(operation, axis, keepdims, pad_mode, pad_n, pad_split, fill, kwargs_items):
+def _jitted_reduce_cached(
+    operation, axis, keepdims, pad_mode, pad_n, pad_split, fill, kwargs_items, ragged=None
+):
     kwargs = dict(kwargs_items)
 
     fill_val = float("nan") if fill == "__nan__" else fill
@@ -266,12 +425,24 @@ def _jitted_reduce_cached(operation, axis, keepdims, pad_mode, pad_n, pad_split,
             sl = [slice(None)] * arr.ndim
             sl[pad_split] = slice(0, pad_n)
             arr = arr[tuple(sl)]
+        elif pad_mode == "ragged_mask":
+            block, lcounts = ragged
+            mask = _ragged_valid_mask(arr.shape, pad_split, block, lcounts)
+            arr = jnp.where(mask, arr, jnp.asarray(fill_val, dtype=arr.dtype))
+        elif pad_mode == "ragged_where":
+            # no neutral element (mean/std/var family): the op normalizes
+            # by the selected count itself, so pass the validity mask
+            block, lcounts = ragged
+            mask = _ragged_valid_mask(arr.shape, pad_split, block, lcounts)
+            return operation(arr, axis=axis, keepdims=keepdims, where=mask, **kwargs)
         return operation(arr, axis=axis, keepdims=keepdims, **kwargs)
 
     return jax.jit(run)
 
 
-def _jitted_reduce(operation, axis, keepdims, pad_mode, pad_n, pad_split, fill, kwargs_items):
+def _jitted_reduce(
+    operation, axis, keepdims, pad_mode, pad_n, pad_split, fill, kwargs_items, ragged=None
+):
     """Cached jitted reduce program, or None when any static is unhashable.
 
     A nan fill is tokenized ("__nan__") before keying: nan != nan would
@@ -293,7 +464,7 @@ def _jitted_reduce(operation, axis, keepdims, pad_mode, pad_n, pad_split, fill, 
         fill = "__nan__"
     try:
         return _jitted_reduce_cached(
-            operation, axis, keepdims, pad_mode, pad_n, pad_split, fill, kwargs_items
+            operation, axis, keepdims, pad_mode, pad_n, pad_split, fill, kwargs_items, ragged
         )
     except TypeError:
         return None
@@ -320,17 +491,42 @@ def _reduce_op(
     analogue of the reference's neutral fill for empty chunks
     (``_operations.py:424-436``). A padded input with no neutral given falls
     back to reducing the exact logical array.
+
+    A ragged-layout input reduces IN PLACE, no rebalance: when the split
+    axis is not reduced the op runs per-row and the result inherits the
+    ragged layout; when it is reduced, ragged-invalid positions are masked
+    with the neutral (``ragged_mask``) or, for the self-normalizing
+    mean/std/var family with no neutral, excluded via the op's ``where=``
+    mask (``ragged_where``). Both modes key the jitted cache by the
+    hashable ``(block, lcounts)`` pair — one compile per ragged map.
     """
     if not isinstance(x, DNDarray):
         raise TypeError(f"expected x to be a DNDarray, but was {type(x)}")
     axis = sanitize_axis(x.shape, axis)
-    arr = x.larray
-    if x.padded:
-        fill = None if neutral is None else _neutral_value(neutral, arr.dtype)
-        pad_mode = "mask" if fill is not None else "trim"
-        pad_n, pad_split = x.gshape[x.split], x.split
+    ragged = _ragged_layout(x)
+    split_reduced = axis is None or (
+        x.split in ((axis,) if isinstance(axis, int) else tuple(axis))
+        if x.split is not None
+        else False
+    )
+    if ragged is not None:
+        arr = x._raw
+        if not split_reduced:
+            # per-row reduction: invalid rows stay garbage, result ragged
+            pad_mode, pad_n, pad_split, fill = "none", 0, 0, None
+            ragged = None
+        else:
+            fill = None if neutral is None else _neutral_value(neutral, arr.dtype)
+            pad_mode = "ragged_mask" if fill is not None else "ragged_where"
+            pad_n, pad_split = x.gshape[x.split], x.split
     else:
-        pad_mode, pad_n, pad_split, fill = "none", 0, 0, None
+        arr = x.larray
+        if x.padded:
+            fill = None if neutral is None else _neutral_value(neutral, arr.dtype)
+            pad_mode = "mask" if fill is not None else "trim"
+            pad_n, pad_split = x.gshape[x.split], x.split
+        else:
+            pad_mode, pad_n, pad_split, fill = "none", 0, 0, None
     # One fused jitted program per (op, axis, padding) combination: the
     # composite reductions (std/var/nanmean) otherwise run as eager
     # per-primitive programs that materialize every (n, f) intermediate in
@@ -338,21 +534,44 @@ def _reduce_op(
     # mask/trim fuses into the reduction read instead of writing a copy.
     fn = _jitted_reduce(
         operation, _axis_key(axis), keepdims, pad_mode, pad_n, pad_split,
-        fill if pad_mode == "mask" else None, _kwargs_key(kwargs),
+        fill if pad_mode in ("mask", "ragged_mask") else None, _kwargs_key(kwargs),
+        ragged,
     )
-    if fn is not None:
-        result = fn(arr)
-    else:  # unhashable op/kwargs: eager fallback, semantics identical
-        if pad_mode == "mask":
-            arr = _mask_padding(arr, x.gshape, x.split, fill)
-        elif pad_mode == "trim":
-            arr = x._logical()
-        result = operation(arr, axis=axis, keepdims=keepdims, **kwargs)
+    try:
+        if fn is not None:
+            result = fn(arr)
+        else:  # unhashable op/kwargs: eager fallback, semantics identical
+            if pad_mode == "mask":
+                arr = _mask_padding(arr, x.gshape, x.split, fill)
+            elif pad_mode == "trim":
+                arr = x._logical()
+            elif pad_mode == "ragged_mask":
+                arr = jnp.where(
+                    _ragged_valid_mask(arr.shape, pad_split, ragged[0], ragged[1]),
+                    arr,
+                    jnp.asarray(fill, dtype=arr.dtype),
+                )
+            if pad_mode == "ragged_where":
+                mask = _ragged_valid_mask(arr.shape, pad_split, ragged[0], ragged[1])
+                result = operation(arr, axis=axis, keepdims=keepdims, where=mask, **kwargs)
+            else:
+                result = operation(arr, axis=axis, keepdims=keepdims, **kwargs)
+    except TypeError:
+        if pad_mode != "ragged_where":
+            raise
+        # op takes no where= mask: last resort, reduce the canonical
+        # logical array (one rebalance — correctness over layout)
+        result = operation(x._logical(), axis=axis, keepdims=keepdims, **kwargs)
     out_split = _reduced_split(x.split, axis, x.ndim, keepdims)
     dtype = out_dtype if out_dtype is not None else types.canonical_heat_type(result.dtype)
     result = jnp.asarray(result).astype(dtype.jax_type())
     out_gshape = _reduced_shape(x.gshape, axis, keepdims)
-    if out_split is not None and tuple(result.shape) != out_gshape:
+    if x.lcounts is not None and out_split is not None and not split_reduced:
+        # split axis survives: the result keeps the ragged layout
+        res = DNDarray._from_ragged(
+            result, out_gshape, dtype, out_split, x.lcounts, x.device, x.comm
+        )
+    elif out_split is not None and tuple(result.shape) != out_gshape:
         res = DNDarray._from_buffer(result, out_gshape, dtype, out_split, x.device, x.comm)
     else:
         res = DNDarray(
@@ -398,6 +617,7 @@ def _cum_op(
     axis: int,
     out: Optional[DNDarray] = None,
     dtype=None,
+    neutral=None,
 ) -> DNDarray:
     """Cumulative op along an axis (reference ``_operations.py:208-302``).
 
@@ -406,25 +626,48 @@ def _cum_op(
     global ``jnp`` call suffices. Tail padding is harmless here: it sits
     strictly *after* every valid element along the split axis, so valid
     prefixes never include it.
+
+    A ragged layout computes in place too: along a non-split axis the scan
+    runs per-row; along the split axis the ragged-invalid slots are filled
+    with the op's identity (``neutral``) first — block order restricted to
+    valid positions IS logical order, so every valid prefix is exact.
     """
     if not isinstance(x, DNDarray):
         raise TypeError(f"expected x to be a DNDarray, but was {type(x)}")
     axis = sanitize_axis(x.shape, axis)
     if axis is None:
         raise NotImplementedError("cumulative ops require an explicit axis")
-    arr = x.larray
+    lcounts = x.lcounts
+    if lcounts is not None and axis == x.split and neutral is None:
+        x.balance_()  # no identity to fill invalid slots with
+        lcounts = None
+    arr = x._raw if lcounts is not None else x.larray
     if dtype is not None:
         dtype = types.canonical_heat_type(dtype)
         arr = arr.astype(dtype.jax_type())
+    if lcounts is not None and axis == x.split:
+        block = arr.shape[x.split] // x.comm.size
+        arr = _mask_ragged(arr, x.split, block, lcounts, neutral)
     result = operation(arr, axis=axis)
-    res = DNDarray._from_buffer(
-        result,
-        x.gshape,
-        types.canonical_heat_type(result.dtype),
-        x.split,
-        x.device,
-        x.comm,
-    )
+    if lcounts is not None:
+        res = DNDarray._from_ragged(
+            result,
+            x.gshape,
+            types.canonical_heat_type(result.dtype),
+            x.split,
+            lcounts,
+            x.device,
+            x.comm,
+        )
+    else:
+        res = DNDarray._from_buffer(
+            result,
+            x.gshape,
+            types.canonical_heat_type(result.dtype),
+            x.split,
+            x.device,
+            x.comm,
+        )
     if out is not None:
         return _write_out(out, res)
     return res

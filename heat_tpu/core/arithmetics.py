@@ -186,12 +186,12 @@ def right_shift(t1, t2) -> DNDarray:
 def cumsum(a, axis: int, dtype=None, out=None) -> DNDarray:
     """Cumulative sum (reference ``arithmetics.py:261`` — local cumsum +
     Exscan; on TPU one jnp.cumsum, XLA inserts the scan collective)."""
-    return _cum_op(jnp.cumsum, a, axis, out=out, dtype=dtype)
+    return _cum_op(jnp.cumsum, a, axis, out=out, dtype=dtype, neutral=0)
 
 
 def cumprod(a, axis: int, dtype=None, out=None) -> DNDarray:
     """Cumulative product (reference ``arithmetics.py:224``)."""
-    return _cum_op(jnp.cumprod, a, axis, out=out, dtype=dtype)
+    return _cum_op(jnp.cumprod, a, axis, out=out, dtype=dtype, neutral=1)
 
 
 cumproduct = cumprod
@@ -267,9 +267,9 @@ def prod(a: DNDarray, axis=None, out=None, keepdim=None, keepdims=None) -> DNDar
 
 def nansum(a: DNDarray, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
     """Sum ignoring NaNs."""
-    return _reduce_op(jnp.nansum, a, axis=axis, out=out, keepdims=_merge_keepdim(keepdim, keepdims), neutral=("nan", None))
+    return _reduce_op(jnp.nansum, a, axis=axis, out=out, keepdims=_merge_keepdim(keepdim, keepdims), neutral=("nan", 0))
 
 
 def nanprod(a: DNDarray, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
     """Product ignoring NaNs."""
-    return _reduce_op(jnp.nanprod, a, axis=axis, out=out, keepdims=_merge_keepdim(keepdim, keepdims), neutral=("nan", None))
+    return _reduce_op(jnp.nanprod, a, axis=axis, out=out, keepdims=_merge_keepdim(keepdim, keepdims), neutral=("nan", 1))

@@ -17,12 +17,16 @@ interaction is hand-written MPI. Here the underlying object is a **global**
   op's neutral element (see ``_operations``), data-movement ops work on the
   logical view (:meth:`_logical`). For divisible shapes (and ``split=None``)
   buffer == logical array and nothing changes.
-- ``balance_`` (reference ``dndarray.py:470``) is metadata-trivial: XLA
-  always lays shards out in canonical ceil-div blocks, so every DNDarray is
-  permanently balanced. ``redistribute_`` (reference ``dndarray.py:1029``)
-  performs canonical target maps exactly (including the canonical map of a
-  different split axis, via one resharding) and raises on arbitrary
-  unbalanced maps, which have no XLA representation.
+- **Ragged layouts**: ``redistribute_`` (reference ``dndarray.py:1029``)
+  accepts any partition of the split extent; a non-canonical target leaves
+  the array in a *ragged* layout (``lcounts`` per-shard valid counts,
+  data at offset 0 of each fixed-size block). Elementwise ops, reductions
+  and cumops compute directly on ragged buffers (``_operations`` masks
+  ragged-invalid rows exactly like tail padding), so ``balance_``
+  (reference ``dndarray.py:470``) is reserved for consumers that need the
+  canonical ceil-div map — matmul tiles, ``resplit_``, I/O assembly —
+  reached via :attr:`larray`. See ``docs/PERFORMANCE.md`` for the layout
+  model and per-op alignment costs.
 - ``resplit_`` (reference ``dndarray.py:1235-1357``, tile-by-tile
   Isend/Irecv) is a single ``jax.device_put`` to a new sharding — XLA emits
   the optimal all-to-all/all-gather over ICI.
@@ -47,7 +51,12 @@ from .communication import MeshCommunication, sanitize_comm
 from .devices import Device
 from .stride_tricks import sanitize_axis
 
-__all__ = ["DNDarray"]
+__all__ = ["DNDarray", "LAYOUT_STATS"]
+
+# Running count of ragged→canonical rebalances actually performed by
+# ``balance_`` (no-op calls are not counted). Tests hook this to assert
+# that hot compute paths never force the rebalance round-trip.
+LAYOUT_STATS = {"rebalances": 0}
 
 
 class LocalIndex:
@@ -152,9 +161,11 @@ class DNDarray:
         (block size ``buffer.shape[split] // P``). This is the TPU
         representation of the reference's unbalanced arrays
         (``dndarray.py:1029``): raggedness is real, observable through
-        ``lshape_map``/``local_shards``/``counts_displs``, and any
-        *computation* first rebalances to the canonical ceil-div layout
-        (one bounded interval-exchange collective — see :meth:`larray`).
+        ``lshape_map``/``local_shards``/``counts_displs``, and elementwise
+        ops / reductions / cumops compute directly on it (ragged-invalid
+        rows are masked like tail padding — see
+        :mod:`heat_tpu.core._operations`). Only consumers of the
+        canonical ceil-div map (:meth:`larray`) rebalance.
         """
         comm = sanitize_comm(comm)
         lcounts = tuple(int(c) for c in lcounts)
@@ -194,10 +205,14 @@ class DNDarray:
         are available via :attr:`local_shards`.
 
         A ragged-layout array (after ``redistribute_`` to a non-canonical
-        map) is rebalanced in place first — on TPU all *computation*
-        happens in the canonical ceil-div layout; raggedness is a
-        transport state. The rebalance is logically invisible (it is
-        ``balance_()``) and costs one bounded interval exchange.
+        map) is rebalanced in place first — this accessor hands out the
+        canonical ceil-div buffer, which is what matmul tiling, resplit
+        and I/O assembly consume. Hot compute paths (elementwise ops,
+        reductions, cumops) do NOT route through here on ragged arrays;
+        they read :attr:`_raw` and mask per-shard ``lcounts`` instead
+        (see ``_operations``), so the rebalance (one bounded interval
+        exchange, counted in ``LAYOUT_STATS``) only happens for ops that
+        genuinely need the canonical map.
 
         NOTE: basic-index ``__setitem__`` updates the buffer IN PLACE
         (donated scatter — the torch-like mutation the reference performs
@@ -707,8 +722,17 @@ class DNDarray:
     def balance_(self) -> "DNDarray":
         """Rebalance to the canonical ceil-div layout (reference
         ``dndarray.py:470``). No-op unless the array is in a ragged layout
-        from ``redistribute_``; then one bounded interval exchange."""
+        from ``redistribute_``; then one bounded interval exchange.
+
+        Elementwise ops, reductions and cumops compute directly on ragged
+        layouts (see :mod:`heat_tpu.core._operations`), so this is only
+        needed by consumers of the canonical ceil-div map — matmul tiling,
+        ``resplit_``, I/O assembly — all of which reach it via
+        :attr:`larray`. ``LAYOUT_STATS["rebalances"]`` counts the
+        exchanges actually performed (tests hook it to prove hot paths
+        stay ragged)."""
         if self.lcounts is not None:
+            LAYOUT_STATS["rebalances"] += 1
             canonical, _, _ = self.__comm.counts_displs_shape(self.__gshape, self.__split)
             self._ragged_redistribute(tuple(canonical))
         return self
@@ -737,9 +761,11 @@ class DNDarray:
 
     # ------------------------------------------------------------ conversion
     def astype(self, dtype, copy: bool = True) -> "DNDarray":
-        """Cast to a new heat type (reference ``dndarray.py:451``)."""
+        """Cast to a new heat type (reference ``dndarray.py:451``).
+        Layout-preserving: a ragged array casts in place without
+        rebalancing (elementwise, no data movement)."""
         dtype = types.canonical_heat_type(dtype)
-        buf = self.larray
+        buf = self.__array
         casted = buf.astype(dtype.jax_type())
         if copy:
             if casted is buf:
@@ -747,6 +773,11 @@ class DNDarray:
                 # required because basic-index setitem donates its buffer
                 # (an aliasing "copy" would be deleted with the original)
                 casted = jnp.copy(casted)
+            if self.__lcounts is not None:
+                return DNDarray._from_ragged(
+                    casted, self.__gshape, dtype, self.__split, self.__lcounts,
+                    self.__device, self.__comm,
+                )
             return DNDarray._from_buffer(
                 casted, self.__gshape, dtype, self.__split, self.__device, self.__comm
             )

@@ -33,7 +33,15 @@ def nonzero(x: DNDarray) -> DNDarray:
     if x.split is not None and x.comm.size > 1:
         from ..parallel.dscan import nonzero_scan
 
-        parts = nonzero_scan(x.larray, x.split, x.gshape[x.split], x.comm)
+        if x.lcounts is not None:
+            # ragged layout: scan in place (validity = per-block lcounts,
+            # offsets = running displacements) — no rebalance
+            counts, displs = x.counts_displs()
+            parts = nonzero_scan(
+                x._raw, x.split, x.gshape[x.split], x.comm, ragged=(counts, displs)
+            )
+        else:
+            parts = nonzero_scan(x.larray, x.split, x.gshape[x.split], x.comm)
         coords = (
             np.concatenate(parts, axis=0)
             if parts

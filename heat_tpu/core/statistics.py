@@ -311,12 +311,12 @@ def mean(x: DNDarray, axis=None) -> DNDarray:
 
 def nanmax(x: DNDarray, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
     """Maximum ignoring NaNs (numpy extra beyond the reference)."""
-    return _reduce_op(jnp.nanmax, x, axis=axis, out=out, keepdims=bool(keepdim or keepdims), neutral=("nan", None))
+    return _reduce_op(jnp.nanmax, x, axis=axis, out=out, keepdims=bool(keepdim or keepdims), neutral=("nan", "min"))
 
 
 def nanmin(x: DNDarray, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
     """Minimum ignoring NaNs (numpy extra beyond the reference)."""
-    return _reduce_op(jnp.nanmin, x, axis=axis, out=out, keepdims=bool(keepdim or keepdims), neutral=("nan", None))
+    return _reduce_op(jnp.nanmin, x, axis=axis, out=out, keepdims=bool(keepdim or keepdims), neutral=("nan", "max"))
 
 
 def nanmean(x: DNDarray, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:

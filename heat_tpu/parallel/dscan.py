@@ -41,13 +41,26 @@ __all__ = [
 _JIT_CACHE = ExecutableCache()
 
 
-def _nonzero_kernel(x, *, axis_name: str, split: int, n_valid: int, ndim: int):
+def _nonzero_kernel(
+    x, *, axis_name: str, split: int, n_valid: int, ndim: int, ragged=None
+):
     """Per-device: coordinates of nonzero VALID elements, compacted to the
-    front of an O(block) buffer, plus the count."""
+    front of an O(block) buffer, plus the count.
+
+    ``ragged=(lcounts, displs)`` switches the validity test and the
+    local→global offset from the canonical tail-padded layout to a ragged
+    one: device ``r`` holds ``lcounts[r]`` valid rows at block offset 0,
+    starting at logical row ``displs[r]`` — no rebalance needed."""
     r = lax.axis_index(axis_name)
     b = x.shape[split]
     local_split = jax.lax.broadcasted_iota(jnp.int32, x.shape, split)
-    valid = (r * b + local_split) < n_valid
+    if ragged is not None:
+        lcounts, displs = ragged
+        valid = local_split < jnp.asarray(lcounts, jnp.int32)[r]
+        offset = jnp.asarray(displs, jnp.int64)[r]
+    else:
+        valid = (r * b + local_split) < n_valid
+        offset = jnp.int64(r) * b
     mask = (x != 0) & valid
     flat = mask.ravel()
     count = flat.sum(dtype=jnp.int32)
@@ -55,18 +68,20 @@ def _nonzero_kernel(x, *, axis_name: str, split: int, n_valid: int, ndim: int):
     # off host-side by `count`
     (pos,) = jnp.nonzero(flat, size=flat.size, fill_value=0)
     coords = jnp.stack(jnp.unravel_index(pos, x.shape), axis=1).astype(jnp.int64)
-    coords = coords.at[:, split].add(jnp.int64(r) * b)
+    coords = coords.at[:, split].add(offset)
     return coords, count.reshape(1)
 
 
 def nonzero_scan_executable(
-    buf_shape: Tuple[int, ...], dtype, split: int, n_valid: int, comm: MeshCommunication
+    buf_shape: Tuple[int, ...], dtype, split: int, n_valid: int, comm: MeshCommunication,
+    ragged=None,
 ):
     """Cached jitted one-dispatch nonzero scan. Outputs: a split-0
     (P*block_elems, ndim) coordinate buffer (each device's hits compacted
-    to its block's front) and a (P,) count vector."""
+    to its block's front) and a (P,) count vector. ``ragged`` is the
+    static ``(lcounts, displs)`` pair of a ragged input layout."""
     mesh = comm.mesh
-    key = ("nzscan", tuple(buf_shape), str(dtype), split, n_valid, mesh)
+    key = ("nzscan", tuple(buf_shape), str(dtype), split, n_valid, mesh, ragged)
     fn = _JIT_CACHE.get(key)
     if fn is not None:
         return fn
@@ -78,6 +93,7 @@ def nonzero_scan_executable(
         split=split,
         n_valid=n_valid,
         ndim=ndim,
+        ragged=ragged,
     )
     prog = shard_map(
         kernel,
@@ -90,11 +106,16 @@ def nonzero_scan_executable(
     return fn
 
 
-def nonzero_scan(buf: jax.Array, split: int, n_valid: int, comm: MeshCommunication):
+def nonzero_scan(
+    buf: jax.Array, split: int, n_valid: int, comm: MeshCommunication, ragged=None
+):
     """Run the scan and assemble the found coordinates host-side: fetch
     the (P,) counts, then slice exactly ``count`` rows off each
-    addressable coordinate shard — only the hits travel."""
-    fn = nonzero_scan_executable(tuple(buf.shape), buf.dtype, split, n_valid, comm)
+    addressable coordinate shard — only the hits travel. Pass
+    ``ragged=(lcounts, displs)`` to scan a ragged buffer in place."""
+    fn = nonzero_scan_executable(
+        tuple(buf.shape), buf.dtype, split, n_valid, comm, ragged
+    )
     coords, counts = fn(buf)
     return _fetch_found(coords, counts, comm)
 

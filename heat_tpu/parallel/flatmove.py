@@ -70,7 +70,13 @@ __all__ = [
     "ragged_move",
     "strided_take_executable",
     "strided_take",
+    "MOVE_STATS",
 ]
+
+# Running count of dispatched interval exchanges. Tests and the ragged
+# bench read (and reset) this to assert a pipeline's exchange budget —
+# e.g. redistribute→elementwise→redistribute must cost exactly ONE move.
+MOVE_STATS = {"ragged_moves": 0}
 
 
 class Edge(NamedTuple):
@@ -360,6 +366,7 @@ def ragged_move(
     fn = ragged_move_executable(
         tuple(buf.shape), buf.dtype, split, in_counts, out_counts, b_out, comm
     )
+    MOVE_STATS["ragged_moves"] += 1
     return _bounded_exchange("ragged", fn, buf)
 
 

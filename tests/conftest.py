@@ -25,3 +25,34 @@ def pytest_configure(config):
         "multihost: also executed inside the real 2-process jax.distributed "
         "runs (tests/test_multihost.py::test_multi_process_pytest_subset)",
     )
+
+
+def pytest_sessionstart(session):
+    session.config._heat_tpu_t0 = __import__("time").perf_counter()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Record suite wall clock into SUITE_SECONDS.json at the repo root so
+    ``bench.py`` can report ``suite_seconds`` alongside the perf metrics.
+    Only the full-suite invocation writes (single selected-test runs would
+    otherwise clobber the number with noise)."""
+    import json
+    import time
+
+    t0 = getattr(session.config, "_heat_tpu_t0", None)
+    if t0 is None or session.testscollected < 50:
+        return
+    path = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                        "SUITE_SECONDS.json")
+    try:
+        with open(path, "w") as fh:
+            json.dump(
+                {
+                    "suite_seconds": round(time.perf_counter() - t0, 1),
+                    "tests_collected": session.testscollected,
+                    "exit_status": int(exitstatus),
+                },
+                fh,
+            )
+    except OSError:
+        pass

@@ -1,0 +1,119 @@
+"""The bench stdout contract (PR 3 satellite): ``python bench.py`` ends
+with ONE parseable, budget-sized JSON line.
+
+r5's output was a single ~8 KB JSON dump; the harness's log-tail capture
+truncated it and the round recorded ``"parsed": null``. The fix splits
+the output — compact summary on stdout, full dict in BENCH_DETAIL.json —
+and these tests round-trip the summary builder through
+``tools/bench_check.py`` in tier-1, so the contract regresses in the
+suite rather than on the next hardware run.
+"""
+from __future__ import annotations
+
+import json
+
+import pytest
+
+import bench
+from tools import bench_check
+
+
+def _synthetic_out():
+    """A full bench result dict shaped like a real run's."""
+    out = {
+        "metric": "kmeans_iters_per_sec",
+        "value": 1234.5,
+        "smoke_ok": True,
+        "bench_reps": 3,
+        "bench_protocol": bench.PROTOCOL,
+        "suite_seconds": 321.4,
+        "ragged_elementwise_speedup": 2.7,
+        "ragged_new_moves_per_trip": 0,
+        "ragged_seed_moves_per_trip": 2,
+        "api_over_kernel": {},
+        "vs_best": {},
+        "vs_best_median": {},
+        "vs_trailing_median": {},
+        "best_of_reps": {},
+        "roofline": {k: {"model": "x" * 200} for k in bench.HEADLINE},
+    }
+    for k in bench.HEADLINE[1:] + bench.KERNEL_TRACKED:
+        out[k] = 99.9
+        out["vs_trailing_median"][k] = 1.01
+        out["api_over_kernel"][k.replace("kernel_", "")] = 0.97
+        out[k.split("_")[0] + "_unit"] = "u" * 60
+    return out
+
+
+class TestCompactSummary:
+    def test_round_trip_and_budget(self):
+        out = _synthetic_out()
+        line = json.dumps(bench._compact_summary(out, "/x/BENCH_DETAIL.json"))
+        obj = bench_check.check("warmup noise\nmore noise\n" + line + "\n")
+        assert obj["metric"] == "kmeans_iters_per_sec"
+        assert obj["value"] == 1234.5
+        assert obj["detail"] == "BENCH_DETAIL.json"
+        assert obj["suite_seconds"] == 321.4
+        assert obj["ragged_elementwise_speedup"] == 2.7
+        # every headline metric made it into the line
+        for k in bench.HEADLINE[1:]:
+            assert obj[k] == 99.9
+        assert len(line) <= bench_check.LINE_BUDGET
+
+    def test_floor_violations_ride_along(self):
+        out = _synthetic_out()
+        out["floor_violations"] = {"cdist_gbps": 0.6}
+        obj = bench_check.check(json.dumps(bench._compact_summary(out, "d.json")))
+        assert obj["floor_violations"] == {"cdist_gbps": 0.6}
+
+    def test_ragged_error_degrades_gracefully(self):
+        out = _synthetic_out()
+        del out["ragged_elementwise_speedup"]
+        out["ragged_error"] = "x" * 400
+        line = json.dumps(bench._compact_summary(out, "d.json"))
+        obj = bench_check.check(line)
+        assert "ragged_error" in obj
+        assert len(line) <= bench_check.LINE_BUDGET
+
+    def test_summary_is_much_smaller_than_full_dict(self):
+        out = _synthetic_out()
+        full = len(json.dumps(out))
+        compact = len(json.dumps(bench._compact_summary(out, "d.json")))
+        assert compact < full / 3
+
+
+class TestBenchCheck:
+    def test_rejects_oversized_line(self):
+        obj = {"metric": "m", "value": 1.0, "smoke_ok": True, "bench_reps": 3,
+               "detail": "d.json", "pad": "x" * bench_check.LINE_BUDGET}
+        with pytest.raises(ValueError, match="budget"):
+            bench_check.check(json.dumps(obj))
+
+    def test_rejects_missing_keys(self):
+        with pytest.raises(ValueError, match="missing required keys"):
+            bench_check.check('{"metric": "m", "value": 1.0}')
+
+    def test_rejects_non_json_tail(self):
+        with pytest.raises(ValueError, match="not JSON"):
+            bench_check.check('{"metric": 1}\nTraceback (most recent call last):')
+
+    def test_rejects_empty_output(self):
+        with pytest.raises(ValueError, match="empty"):
+            bench_check.check("\n\n")
+
+    def test_cli_ok_and_fail(self, tmp_path, capsys):
+        good = tmp_path / "good.txt"
+        good.write_text(json.dumps(bench._compact_summary(_synthetic_out(), "d.json")))
+        assert bench_check.main(["bench_check.py", str(good)]) == 0
+        bad = tmp_path / "bad.txt"
+        bad.write_text("not json at all")
+        assert bench_check.main(["bench_check.py", str(bad)]) == 1
+
+    def test_suite_seconds_reader(self, tmp_path, monkeypatch):
+        # bench._suite_seconds reads the conftest-written sidecar
+        monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+        assert bench._suite_seconds() is None
+        (tmp_path / "SUITE_SECONDS.json").write_text(
+            json.dumps({"suite_seconds": 123.456, "tests_collected": 900})
+        )
+        assert bench._suite_seconds() == 123.5

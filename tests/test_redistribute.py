@@ -127,14 +127,18 @@ class TestRaggedRedistribute(TestCase):
         skew = [0] * p
         skew[-1] = n
         x.redistribute_(target_map=np.column_stack([skew, [4] * p]))
-        # elementwise, reduction, matmul and indexing all transparently
-        # rebalance and produce exact results
-        self.assert_array_equal(x + 1.0, full + 1.0)
+        # elementwise ops and reductions compute DIRECTLY on the ragged
+        # layout (results inherit it); indexing still rebalances
+        z = x + 1.0
+        self.assertEqual(z.lcounts, x.lcounts)
+        self.assert_array_equal(z, full + 1.0)
         y = ht.array(full, split=0)
         self.assert_array_equal(x * y, full * full)
         np.testing.assert_allclose(float(x.sum()), full.sum(), rtol=1e-5)
+        if p > 1:
+            self.assertFalse(x.balanced)  # computation left the layout alone
         self.assert_array_equal(x[1:-1], full[1:-1])
-        self.assertTrue(x.balanced)  # computation rebalanced it in place
+        self.assertTrue(x.balanced)  # basic indexing needs the canonical map
 
     def test_setitem_on_ragged(self):
         p = self.comm.size

@@ -1,0 +1,85 @@
+"""Round-trip validator for the bench driver's stdout contract.
+
+``python bench.py`` must end with exactly one parseable JSON line that is
+compact enough to survive log-tail capture (r5's ~8 KB line was truncated
+by the harness and recorded as ``"parsed": null``). This tool enforces
+that contract: feed it the captured stdout (file argument or stdin) and
+it parses the LAST non-empty line, validates the required keys, checks
+the line-length budget, and re-serializes — exit 0 on success, 1 with a
+reason on any violation.
+
+Usage::
+
+    python bench.py | python tools/bench_check.py
+    python tools/bench_check.py captured_stdout.txt
+
+The helpers are importable (``tests/test_bench_output.py`` round-trips
+the summary builder through them in tier-1, so a bench output regression
+fails the suite, not the next hardware run).
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+# the harness's stdout-tail capture is ~2.4 KB; leave real headroom
+LINE_BUDGET = 2048
+
+REQUIRED_KEYS = ("metric", "value", "smoke_ok", "bench_reps", "detail")
+
+
+def last_json_line(text: str) -> tuple[str, dict]:
+    """The last non-empty stdout line, parsed as a JSON object."""
+    lines = [ln for ln in text.strip().splitlines() if ln.strip()]
+    if not lines:
+        raise ValueError("empty bench output: no final JSON line")
+    line = lines[-1].strip()
+    try:
+        obj = json.loads(line)
+    except ValueError as e:
+        raise ValueError(f"last stdout line is not JSON: {e}\nline: {line[:200]}") from e
+    if not isinstance(obj, dict):
+        raise ValueError(f"last stdout line is {type(obj).__name__}, expected object")
+    return line, obj
+
+
+def validate(line: str, obj: dict) -> None:
+    """Raise ValueError on any contract violation."""
+    missing = [k for k in REQUIRED_KEYS if k not in obj]
+    if missing:
+        raise ValueError(f"final JSON line is missing required keys: {missing}")
+    if not isinstance(obj["value"], (int, float)) or isinstance(obj["value"], bool):
+        raise ValueError(f"'value' must be numeric, got {obj['value']!r}")
+    if len(line) > LINE_BUDGET:
+        raise ValueError(
+            f"final JSON line is {len(line)} bytes, over the {LINE_BUDGET}-byte "
+            "log-tail budget — move detail into the BENCH_DETAIL.json sidecar"
+        )
+    # the round trip itself: re-serialization must be lossless JSON
+    if json.loads(json.dumps(obj)) != obj:
+        raise ValueError("final JSON line does not survive a serialization round trip")
+
+
+def check(text: str) -> dict:
+    line, obj = last_json_line(text)
+    validate(line, obj)
+    return obj
+
+
+def main(argv: list[str]) -> int:
+    if len(argv) > 1:
+        with open(argv[1]) as fh:
+            text = fh.read()
+    else:
+        text = sys.stdin.read()
+    try:
+        obj = check(text)
+    except ValueError as e:
+        print(f"bench_check: FAIL: {e}", file=sys.stderr)
+        return 1
+    print(f"bench_check: OK ({obj['metric']}={obj['value']}, {len(obj)} keys)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
