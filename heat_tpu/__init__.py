@@ -16,6 +16,7 @@ from . import naive_bayes
 from . import nn
 from . import optim
 from . import parallel
+from . import analysis
 from . import regression
 from . import resilience
 from . import spatial
@@ -23,6 +24,11 @@ from . import utils
 from .core import random
 from .core import version
 from .core.version import __version__
+
+# runtime counters: layout rebalances / ragged exchanges / compiles+transfers
+from .core.dndarray import LAYOUT_STATS
+from .parallel.flatmove import MOVE_STATS
+from .analysis.sanitizer import COMPILE_STATS
 
 
 def __getattr__(name: str):

@@ -1,0 +1,29 @@
+"""Static + runtime enforcement of the SPMD/JAX invariants.
+
+Two halves (full rule reference and failure stories: ``docs/ANALYSIS.md``):
+
+- :mod:`heat_tpu.analysis.graftlint` — pure-stdlib AST checker (rules
+  G001–G006: retrace leaks, unbounded executable caches, divergent
+  collectives, hot-path host syncs, unordered iteration, swallowed
+  ResilienceError).  CLI: ``python tools/graftlint.py heat_tpu/``.
+- :mod:`heat_tpu.analysis.sanitizer` — runtime region accounting of
+  compiles, host transfers, and collective dispatches
+  (:data:`COMPILE_STATS`, :func:`sanitizer`).
+"""
+from . import graftlint
+from .sanitizer import (
+    COMPILE_STATS,
+    Region,
+    SanitizerError,
+    reset_compile_stats,
+    sanitizer,
+)
+
+__all__ = [
+    "graftlint",
+    "COMPILE_STATS",
+    "Region",
+    "SanitizerError",
+    "reset_compile_stats",
+    "sanitizer",
+]

@@ -1,0 +1,214 @@
+"""Runtime compile-and-transfer sanitizer.
+
+graftlint (the static half of :mod:`heat_tpu.analysis`) catches retrace
+leaks and host syncs it can see in the source; this module catches the
+ones it can't — a cache key that silently misses on every call, a jit
+boundary that retraces because a static argument is a fresh object, an
+``np.asarray`` three layers down in user code.  It counts four kinds of
+runtime events and attributes them to a code region:
+
+- **backend compiles / traces** — via ``jax.monitoring``'s event-duration
+  listeners (fired by jax itself on every XLA backend compile and jaxpr
+  trace; jax 0.4.x event names, see ``_EVENT_PREFIXES``);
+- **executable-cache inserts** — every new-key insertion into any
+  :class:`heat_tpu.core._cache.ExecutableCache`, plus the miss counter of
+  the ``_jitted_reduce`` lru cache;
+- **host syncs** — ``DNDarray.numpy()/item()/__bool__``-style device→host
+  fetches, reported through the ``core._hooks`` observer slot;
+- **collectives** — every ``collective.*`` fault-point site (the chaos
+  hook sites double as instrumentation points).
+
+Running totals live in :data:`COMPILE_STATS`, the compile/transfer
+sibling of ``LAYOUT_STATS`` (rebalances) and ``MOVE_STATS`` (ragged
+moves).  Per-region accounting::
+
+    with sanitizer() as region:
+        y = x.resplit(0) + 1
+    region.assert_compiles(0)      # everything was cached
+    region.assert_no_host_sync()   # nothing left the device
+
+``sanitizer(block_host_sync=True)`` additionally arms jax's
+device-to-host transfer guard, so an unwaived sync raises at the
+offending call instead of being discovered in the post-mortem counts.
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+import jax
+
+from ..core import _hooks, _operations
+
+__all__ = ["COMPILE_STATS", "SanitizerError", "sanitizer", "Region", "reset_compile_stats"]
+
+
+# process-lifetime running totals (deltas per region via sanitizer())
+COMPILE_STATS: Dict[str, int] = {
+    "backend_compiles": 0,  # XLA backend compiles (jax.monitoring)
+    "traces": 0,            # jaxpr traces (jax.monitoring)
+    "cache_inserts": 0,     # new keys entering any ExecutableCache
+    "host_syncs": 0,        # DNDarray host fetches (numpy/item/scalar/...)
+    "collectives": 0,       # collective.* dispatch sites
+}
+
+_STATS_KEYS = tuple(COMPILE_STATS)
+
+# jax 0.4.x monitoring event names for the two compile stages; matched by
+# prefix so a patch release appending a suffix doesn't silently zero the
+# counters
+_EVENT_PREFIXES = (
+    ("/jax/core/compile/backend_compile_duration", "backend_compiles"),
+    ("/jax/core/compile/jaxpr_trace_duration", "traces"),
+)
+
+
+class SanitizerError(AssertionError):
+    """A region violated a declared compile/transfer budget."""
+
+
+def _on_event_duration(event: str, duration: float, **kwargs) -> None:
+    for prefix, counter in _EVENT_PREFIXES:
+        if event.startswith(prefix):
+            COMPILE_STATS[counter] += 1
+            return
+
+
+def _on_observe(event: str, ctx: dict) -> None:
+    if event.startswith("host."):
+        COMPILE_STATS["host_syncs"] += 1
+    elif event == "cache.insert":
+        COMPILE_STATS["cache_inserts"] += 1
+    elif event.startswith("collective."):
+        COMPILE_STATS["collectives"] += 1
+
+
+_installed = False
+_install_lock = threading.Lock()
+
+
+def _install() -> None:
+    """Register the listeners once per process (idempotent)."""
+    global _installed
+    with _install_lock:
+        if _installed:
+            return
+        jax.monitoring.register_event_duration_secs_listener(_on_event_duration)
+        _hooks.add_observer(_on_observe)
+        _installed = True
+
+
+# counting is always-on: the listeners are integer increments, and having
+# COMPILE_STATS live from import (like LAYOUT_STATS/MOVE_STATS) lets tests
+# and benches snapshot deltas without entering a region
+_install()
+
+
+def reset_compile_stats() -> None:
+    """Zero the running totals (regions are deltas and don't need this)."""
+    for k in _STATS_KEYS:
+        COMPILE_STATS[k] = 0
+
+
+class Region:
+    """Delta view of COMPILE_STATS between region entry and now.
+
+    Properties read live, so they work both inside the ``with`` block and
+    after it closes.
+    """
+
+    def __init__(self, label: Optional[str] = None):
+        self.label = label or "region"
+        self._entry = dict(COMPILE_STATS)
+        ci = _operations._jitted_reduce_cached.cache_info()
+        self._entry_reduce = (ci.hits, ci.misses)
+
+    def _delta(self, key: str) -> int:
+        return COMPILE_STATS[key] - self._entry[key]
+
+    @property
+    def compiles(self) -> int:
+        return self._delta("backend_compiles")
+
+    @property
+    def traces(self) -> int:
+        return self._delta("traces")
+
+    @property
+    def cache_inserts(self) -> int:
+        return self._delta("cache_inserts")
+
+    @property
+    def host_syncs(self) -> int:
+        return self._delta("host_syncs")
+
+    @property
+    def collectives(self) -> int:
+        return self._delta("collectives")
+
+    @property
+    def reduce_cache_hits(self) -> int:
+        return _operations._jitted_reduce_cached.cache_info().hits - self._entry_reduce[0]
+
+    @property
+    def reduce_cache_misses(self) -> int:
+        return _operations._jitted_reduce_cached.cache_info().misses - self._entry_reduce[1]
+
+    def stats(self) -> Dict[str, int]:
+        out = {k: self._delta(k) for k in _STATS_KEYS}
+        out["reduce_cache_hits"] = self.reduce_cache_hits
+        out["reduce_cache_misses"] = self.reduce_cache_misses
+        return out
+
+    # ------------------------------------------------------------ assertions
+    def assert_compiles(self, n: int) -> None:
+        """The region performed exactly ``n`` XLA backend compiles."""
+        got = self.compiles
+        if got != n:
+            raise SanitizerError(
+                f"{self.label}: expected exactly {n} backend compile(s), got {got} "
+                f"(full deltas: {self.stats()}) — a per-call closure or unstable "
+                "cache key retraces on every call"
+            )
+
+    def assert_max_compiles(self, n: int) -> None:
+        got = self.compiles
+        if got > n:
+            raise SanitizerError(
+                f"{self.label}: expected at most {n} backend compile(s), got {got} "
+                f"(full deltas: {self.stats()})"
+            )
+
+    def assert_no_host_sync(self) -> None:
+        """No device→host fetch was observed in the region."""
+        got = self.host_syncs
+        if got:
+            raise SanitizerError(
+                f"{self.label}: expected no host sync, observed {got} "
+                f"(full deltas: {self.stats()}) — something gathered device "
+                "values to host inside the region"
+            )
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"Region({self.label!r}, {self.stats()})"
+
+
+@contextmanager
+def sanitizer(label: Optional[str] = None, block_host_sync: bool = False):
+    """Open an accounting region over COMPILE_STATS.
+
+    ``block_host_sync=True`` arms ``jax.transfer_guard_device_to_host``
+    ("disallow"), turning any implicit device→host transfer inside the
+    region into an immediate error at the offending call — jit-internal
+    transfers are unaffected, and explicit ``jax.device_get`` still works
+    (that is jax's explicit-transfer escape hatch, mirrored by the
+    ``# graftlint: host-sync`` waiver on the static side).
+    """
+    _install()
+    region = Region(label)
+    if block_host_sync:
+        with jax.transfer_guard_device_to_host("disallow"):
+            yield region
+    else:
+        yield region

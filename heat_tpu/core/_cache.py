@@ -11,6 +11,8 @@ from __future__ import annotations
 
 from collections import OrderedDict
 
+from . import _hooks
+
 __all__ = ["ExecutableCache"]
 
 
@@ -35,6 +37,7 @@ class ExecutableCache(OrderedDict):
         return value
 
     def __setitem__(self, key, value):
+        is_new = key not in self
         super().__setitem__(key, value)
         self.move_to_end(key)
         # evict oldest-first WITHOUT OrderedDict.popitem: on CPython 3.10
@@ -43,6 +46,11 @@ class ExecutableCache(OrderedDict):
         # cache the first time it ever filled up
         while len(self) > self.maxsize:
             del self[next(iter(self))]
+        if is_new:
+            # a new key means a program was (or is about to be) traced for
+            # it — the sanitizer counts these to catch key-design bugs where
+            # repeated logical work never hits
+            _hooks.observe("cache.insert", size=len(self))
 
     def _touch(self, key) -> None:
         # inherited methods (pop, popitem, ...) may call __getitem__ for a

@@ -43,4 +43,6 @@ def fence_cpu_collectives(prev) -> None:
     except (StopIteration, TypeError):  # pragma: no cover - defensive
         return
     if platform == "cpu":
+        # graftlint: host-sync - deliberate fence: CPU collectives deadlock
+        # without draining in-flight work (see module docstring)
         jax.block_until_ready(leaves)

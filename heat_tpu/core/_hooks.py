@@ -49,6 +49,12 @@ def fault_point(name: str, **ctx) -> Dict:
     ``bytearray`` payload) to simulate corruption. Returns ``ctx`` so call
     sites can read mutated values back.
     """
+    if _OBSERVERS:
+        # the existing fault sites double as instrumentation points: every
+        # collective/io/checkpoint site is reported to passive observers
+        # (see ``observe`` below) before any injected fault can fire
+        for fn in tuple(_OBSERVERS):
+            fn(name, ctx)
     if _INJECTOR is not None:
         _INJECTOR(name, ctx)
     return ctx
@@ -89,3 +95,35 @@ def guarded_call(label: str, fn, *args, **kwargs):
     if _DEADLINE_RUNNER is None:
         return fn(*args, **kwargs)
     return _DEADLINE_RUNNER(label, fn, args, kwargs)
+
+
+# passive event observers: fn(event, ctx) -> None, must not raise. Unlike
+# the injector (which simulates faults) and the deadline runner (which
+# bounds calls), observers only *count*: ``analysis.sanitizer`` registers
+# one to attribute cache insertions, host transfers, and collective
+# dispatches to a code region. Same layering trick again — the list lives
+# down here so core never imports analysis.
+_OBSERVERS = []
+
+
+def add_observer(fn):
+    """Register a process-wide event observer; returns ``fn``."""
+    _OBSERVERS.append(fn)
+    return fn
+
+
+def remove_observer(fn):
+    """Remove a previously registered observer (no error if absent)."""
+    try:
+        _OBSERVERS.remove(fn)
+    except ValueError:
+        pass
+
+
+def observe(event: str, **ctx) -> None:
+    """Report an instrumentation event (``"cache.insert"``,
+    ``"host.gather"``, ...). Free when no observer is installed: one
+    falsy check on the hot path."""
+    if _OBSERVERS:
+        for fn in tuple(_OBSERVERS):
+            fn(event, ctx)

@@ -79,7 +79,9 @@ def _detect_accel() -> Optional[Device]:
             platform = jax.default_backend()
             if platform not in ("cpu",):
                 _accel = Device(platform)
-        except Exception:  # noqa: BLE001
+        except (RuntimeError, ValueError):
+            # backend probe failures only; anything else (incl. the
+            # ResilienceError hierarchy) must propagate
             pass
     return _accel
 

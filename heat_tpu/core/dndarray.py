@@ -45,6 +45,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import _hooks
 from . import communication as comm_module
 from . import devices, types
 from .communication import MeshCommunication, sanitize_comm
@@ -792,6 +793,7 @@ class DNDarray:
         Multi-host, a split array is assembled with ONE ragged process
         allgather of the valid local blocks (every process must call —
         collective, like the reference's ``resplit(None)`` gather)."""
+        _hooks.observe("host.gather", shape=self.__gshape)
         buf = self.larray
         if getattr(buf, "is_fully_addressable", True):
             host = np.asarray(jax.device_get(buf))
@@ -873,6 +875,7 @@ class DNDarray:
 
     def item(self):
         """Scalar extraction (reference ``dndarray.py:955``)."""
+        _hooks.observe("host.item")
         if self.padded:
             return self._logical().item()
         return self.__array.item()
@@ -891,6 +894,7 @@ class DNDarray:
 
     def __cast(self, cast_function):
         if np.prod(self.shape) == 1:
+            _hooks.observe("host.scalar")
             return cast_function(self._logical().reshape(()).item())
         raise TypeError("only size-1 arrays can be converted to Python scalars")
 

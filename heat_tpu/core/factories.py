@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import devices, types
+from ._cache import ExecutableCache
 from .communication import MeshCommunication, sanitize_comm
 from .devices import Device
 from .dndarray import DNDarray
@@ -118,21 +119,37 @@ def asarray(obj, dtype=None, copy=None, order="C", is_split=None, device=None) -
     return array(obj, dtype=dtype, is_split=is_split, device=device)
 
 
-def _sharded_factory(shape, split, comm, fill) -> jax.Array:
+# compiled fill programs keyed by (fill statics, pshape, sharding): the fill
+# closures below are rebuilt per call, so keying by their identity (what a
+# bare jax.jit would do) made every factory call a retrace; the token key
+# makes a repeated zeros/arange/... a cache hit instead
+_FILL_CACHE = ExecutableCache()
+
+
+def _sharded_factory(shape, split, comm, fill, fill_key) -> jax.Array:
     """jit a fill function straight into the target sharding (no host pass).
 
     ``fill`` receives the *physical* (padded) shape to build; the result is
     born in its final even sharding, so large distributed arrays never
-    materialize on one device.
+    materialize on one device.  ``fill_key`` must be a hashable token that
+    fully determines ``fill``'s behavior (name + every baked-in static);
+    it — not the closure object — keys the executable cache.
     """
     pshape = comm.padded_shape(shape, split)
     sharding = comm.array_sharding(pshape, split)
-    return jax.jit(lambda: fill(pshape), out_shardings=sharding)()
+    key = (fill_key, tuple(pshape), sharding)
+    try:
+        fn = _FILL_CACHE.get(key)
+    except TypeError:  # unhashable static (e.g. array fill_value): rare, uncached
+        return jax.jit(lambda: fill(pshape), out_shardings=sharding)()  # graftlint: retrace
+    if fn is None:
+        fn = _FILL_CACHE[key] = jax.jit(lambda: fill(pshape), out_shardings=sharding)
+    return fn()
 
 
-def _build(shape, split, comm, dtype, device, fill) -> DNDarray:
+def _build(shape, split, comm, dtype, device, fill, fill_key) -> DNDarray:
     """Run a padded-shape fill and wrap it with logical-gshape metadata."""
-    data = _sharded_factory(shape, split, comm, fill)
+    data = _sharded_factory(shape, split, comm, fill, fill_key)
     return DNDarray._from_buffer(
         data, shape, dtype, split, devices.sanitize_device(device), comm
     )
@@ -150,7 +167,7 @@ def __factory(shape, dtype, split, device, comm, fill_name) -> DNDarray:
         fill = lambda ps: jnp.ones(ps, dtype=jt)
     else:
         raise ValueError(fill_name)
-    return _build(shape, split, comm, dtype, device, fill)
+    return _build(shape, split, comm, dtype, device, fill, (fill_name, jt))
 
 
 def zeros(shape, dtype=types.float32, split=None, device=None, comm=None, order="C") -> DNDarray:
@@ -185,8 +202,12 @@ def full(shape, fill_value, dtype=None, split=None, device=None, comm=None, orde
     comm = sanitize_comm(comm)
     split = sanitize_axis(shape, split)
     jt = dtype.jax_type()
+    if isinstance(fill_value, np.ndarray) and fill_value.ndim == 0:
+        fill_value = fill_value.item()
     return _build(
-        shape, split, comm, dtype, device, lambda ps: jnp.full(ps, fill_value, dtype=jt)
+        shape, split, comm, dtype, device,
+        lambda ps: jnp.full(ps, fill_value, dtype=jt),
+        ("full", jt, fill_value),
     )
 
 
@@ -248,6 +269,7 @@ def arange(*args, dtype=None, split=None, device=None, comm=None) -> DNDarray:
         # fill the physical extent by extending the progression; the tail
         # (indices >= n) is padding and never observed
         lambda ps: (start + step * jnp.arange(ps[0])).astype(jt),
+        ("arange", jt, start, step),
     )
 
 
@@ -273,7 +295,8 @@ def linspace(
         vals = jnp.linspace(start, stop, num, endpoint=endpoint).astype(jt)
         return jnp.pad(vals, (0, ps[0] - num))
 
-    res = _build((num,), split, comm, dtype, device, _fill)
+    res = _build((num,), split, comm, dtype, device, _fill,
+                 ("linspace", jt, start, stop, num, endpoint))
     if retstep:
         step = (stop - start) / max(1, (num - 1 if endpoint else num))
         return res, step
@@ -305,7 +328,9 @@ def eye(shape, dtype=types.float32, split=None, device=None, comm=None, order="C
     split = sanitize_axis((n, m), split)
     jt = dtype.jax_type()
     return _build(
-        (n, m), split, comm, dtype, device, lambda ps: jnp.eye(ps[0], ps[1], dtype=jt)
+        (n, m), split, comm, dtype, device,
+        lambda ps: jnp.eye(ps[0], ps[1], dtype=jt),
+        ("eye", jt),
     )
 
 

@@ -145,19 +145,7 @@ def lanczos(
     return V_dnd, T_dnd
 
 
-def _lanczos_device(arr, v, m):
-    n_pad = arr.shape[0]
-
-    V = jnp.zeros((m, n_pad), dtype=arr.dtype)
-    alphas = jnp.zeros(m, dtype=arr.dtype)
-    betas = jnp.zeros(m, dtype=arr.dtype)
-
-    V = V.at[0].set(v)
-    w = arr @ v
-    alpha = jnp.dot(w, v)
-    w = w - alpha * v
-    alphas = alphas.at[0].set(alpha)
-
+def _lanczos_loop(arr, V, alphas, betas, w, m):
     def body(i, state):
         V, alphas, betas, w = state
         beta = jnp.linalg.norm(w)
@@ -172,9 +160,29 @@ def _lanczos_device(arr, v, m):
         w2 = w2 - alpha * v_next - beta * V[i - 1]
         return (V, alphas.at[i].set(alpha), betas.at[i].set(beta), w2)
 
-    V, alphas, betas, _ = jax.jit(
-        lambda V, a, b, w: jax.lax.fori_loop(1, m, body, (V, a, b, w))
-    )(V, alphas, betas, w)
+    return jax.lax.fori_loop(1, m, body, (V, alphas, betas, w))
+
+
+# module-level jit: arr enters as a traced operand and the iteration count is
+# a static argument, so repeated same-shape solves reuse one executable (a
+# per-call jitted lambda here retraced the whole fori_loop on every solve)
+_lanczos_jit = jax.jit(_lanczos_loop, static_argnames="m")
+
+
+def _lanczos_device(arr, v, m):
+    n_pad = arr.shape[0]
+
+    V = jnp.zeros((m, n_pad), dtype=arr.dtype)
+    alphas = jnp.zeros(m, dtype=arr.dtype)
+    betas = jnp.zeros(m, dtype=arr.dtype)
+
+    V = V.at[0].set(v)
+    w = arr @ v
+    alpha = jnp.dot(w, v)
+    w = w - alpha * v
+    alphas = alphas.at[0].set(alpha)
+
+    V, alphas, betas, _ = _lanczos_jit(arr, V, alphas, betas, w, m=m)
 
     T = jnp.diag(alphas) + jnp.diag(betas[1:], 1) + jnp.diag(betas[1:], -1)
     return V, T

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import devices, types
+from ._cache import ExecutableCache
 from .communication import sanitize_comm
 from .dndarray import DNDarray
 from .stride_tricks import sanitize_shape
@@ -94,7 +95,15 @@ def _finalize(data: jax.Array, dtype, split, device, comm) -> DNDarray:
     )
 
 
-def _sharded_fill(gen, key, shape, dtype, split, device, comm) -> DNDarray:
+# compiled generator programs keyed by (gen statics, shapes, sharding): the
+# gen lambdas below are rebuilt per call, so jitting them directly would key
+# the pjit cache by fresh closure identity and retrace on every draw; with
+# the token key a repeated same-shape draw reuses one executable (the PRNG
+# key enters as a traced operand, so new keys are cache hits too)
+_GEN_CACHE = ExecutableCache()
+
+
+def _sharded_fill(gen, gen_key, key, shape, dtype, split, device, comm) -> DNDarray:
     """Generate at the LOGICAL shape and zero-pad to the physical buffer,
     all inside one jitted program born in its final even sharding.
 
@@ -108,14 +117,18 @@ def _sharded_fill(gen, key, shape, dtype, split, device, comm) -> DNDarray:
     every consumption point like any other buffer padding."""
     pshape = comm.padded_shape(shape, split)
     sharding = comm.array_sharding(pshape, split)
+    cache_key = (gen_key, tuple(shape), tuple(pshape), sharding)
+    fn = _GEN_CACHE.get(cache_key)
+    if fn is None:
 
-    def fill(k):
-        x = gen(k, tuple(shape))
-        if tuple(pshape) != tuple(shape):
-            x = jnp.pad(x, [(0, p - s) for p, s in zip(pshape, shape)])
-        return x
+        def fill(k):
+            x = gen(k, tuple(shape))
+            if tuple(pshape) != tuple(shape):
+                x = jnp.pad(x, [(0, p - s) for p, s in zip(pshape, shape)])
+            return x
 
-    data = jax.jit(fill, out_shardings=sharding)(key)
+        fn = _GEN_CACHE[cache_key] = jax.jit(fill, out_shardings=sharding)
+    data = fn(key)
     return DNDarray._from_buffer(
         data, shape, dtype, split, devices.sanitize_device(device), comm
     )
@@ -138,6 +151,7 @@ def rand(*d, dtype=types.float32, split=None, device=None, comm=None) -> DNDarra
     key = _next_key(int(np.prod(shape)) if shape else 1)
     return _sharded_fill(
         lambda k, ps: jax.random.uniform(k, ps, dtype=jt),
+        ("uniform", jt),
         key, shape, dtype, split if shape else None, device, comm_,
     )
 
@@ -154,6 +168,7 @@ def randn(*d, dtype=types.float32, split=None, device=None, comm=None) -> DNDarr
     key = _next_key(int(np.prod(shape)) if shape else 1)
     return _sharded_fill(
         lambda k, ps: jax.random.normal(k, ps, dtype=jt),
+        ("normal", jt),
         key, shape, dtype, split if shape else None, device, comm_,
     )
 
@@ -181,6 +196,7 @@ def randint(
     split_ = split if shape else None
     return _sharded_fill(
         lambda k, ps: jax.random.randint(k, ps, low, high, dtype=jnp.int64).astype(dtype.jax_type()),
+        ("randint", dtype.jax_type(), int(low), int(high)),
         key, shape, dtype, split_, device, comm_,
     )
 

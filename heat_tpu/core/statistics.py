@@ -125,6 +125,7 @@ def average(x: DNDarray, axis=None, weights: Optional[DNDarray] = None, returned
     # (DNDarray) weights pay one small fetch — average is an eager
     # analytics entry point, not a training-loop op.
     if not isinstance(weights, DNDarray) and isinstance(axis_s, (int, type(None))):
+        # graftlint: host-sync - host-provided weights, checked on their host copy
         wnp = np.asarray(weights, dtype=np.float64).reshape(tuple(w.shape))
         if axis_s is None:
             zero = bool(wnp.sum() == 0)
@@ -439,7 +440,7 @@ def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear
     kd = bool(keepdim or keepdims)
     axis_s = sanitize_axis(x.shape, axis)
     q_arr = q._logical() if isinstance(q, DNDarray) else jnp.asarray(q)
-    q_host = np.asarray(q_arr)
+    q_host = np.asarray(q_arr)  # graftlint: host-sync - O(q) scalars, validated eagerly
     # negated all-form so NaN q fails too, like numpy
     if q_host.size and not np.all((q_host >= 0) & (q_host <= 100)):
         raise ValueError("percentiles must be in the range [0, 100]")

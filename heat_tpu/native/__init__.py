@@ -382,5 +382,7 @@ class FileStream:
     def __del__(self):  # pragma: no cover - best effort
         try:
             self.close()
-        except Exception:
+        except (OSError, AttributeError):
+            # close() only touches the ctypes handle; never mask anything
+            # wider (e.g. ResilienceError) from interpreter teardown
             pass

@@ -28,6 +28,7 @@ import numpy as np
 from jax import lax, shard_map
 from jax.sharding import PartitionSpec as P
 
+from ..core import _hooks
 from ..core._cache import ExecutableCache
 from ..core.communication import SPLIT_AXIS, MeshCommunication
 
@@ -180,8 +181,10 @@ def _fetch_found(data: jax.Array, counts: jax.Array, comm: MeshCommunication):
     a device_get of the whole vector). The cross-process candidate merge
     happens in the callers' existing allgather step."""
     per_rank = {}
+    _hooks.observe("host.fetch_found")
     for s in counts.addressable_shards:
         start = s.index[0].start or 0
+        # graftlint: host-sync - O(world) count vector, fetched once per scan
         for i, v in enumerate(np.asarray(s.data).reshape(-1)):
             per_rank[start + i] = int(v)
     p = comm.size
@@ -195,5 +198,7 @@ def _fetch_found(data: jax.Array, counts: jax.Array, comm: MeshCommunication):
         seen.add(r)
         c = per_rank[r]
         if c:
+            # graftlint: host-sync - the found hits ARE the result; host
+            # assembly here is the op's contract, not an accident
             parts.append(np.asarray(s.data[:c]))
     return parts

@@ -57,7 +57,7 @@ def _bounded_exchange(label: str, fn, buf: jax.Array):
         if _hooks.get_deadline_runner() is not None and hasattr(out, "block_until_ready"):
             # block inside the deadline, not at the caller's first use —
             # async dispatch would let a wedged program escape the watchdog
-            out = out.block_until_ready()
+            out = out.block_until_ready()  # graftlint: host-sync
         return out
 
     return _hooks.guarded_call(f"flatmove.{label}", dispatch)

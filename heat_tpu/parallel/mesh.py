@@ -23,6 +23,7 @@ def make_mesh(devices: Optional[Sequence] = None, axis_name: str = SPLIT_AXIS) -
     """1-D mesh over the given (default: all) devices."""
     if devices is None:
         devices = jax.devices()
+    # graftlint: host-sync - np.array over Device handles (construction time)
     return Mesh(np.array(devices), axis_names=(axis_name,))
 
 
@@ -61,6 +62,7 @@ def make_hierarchical_mesh(
             f"({len(devices)} % {n_slow} = {len(devices) % n_slow}); pick an "
             f"n_slow that divides the device count"
         )
+    # graftlint: host-sync - np.array over Device handles (construction time)
     arr = np.array(devices).reshape(n_slow, len(devices) // n_slow)
     if validate:
         _validate_mesh_devices(arr, check_coverage=check_coverage)

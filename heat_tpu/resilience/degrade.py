@@ -36,7 +36,7 @@ from ..core.communication import (
     sanitize_comm,
 )
 from ..core.dndarray import DNDarray
-from .errors import DegradeError, NoHealthyDevicesError
+from .errors import DegradeError, NoHealthyDevicesError, ResilienceError
 
 __all__ = [
     "mark_unhealthy",
@@ -110,6 +110,10 @@ def probe(
             got = float(jax.device_get(jax.device_put(np.float32(1.0), dev)) + 1.0)
             if got != 2.0:
                 raise RuntimeError(f"probe computed {got}, expected 2.0")
+        except ResilienceError:
+            # divergence/timeout verdicts are about the collective fabric,
+            # not this device — never converted into "unhealthy"
+            raise
         except Exception:  # noqa: BLE001 - any probe failure means unhealthy
             bad.append(int(dev.id))
             if mark:

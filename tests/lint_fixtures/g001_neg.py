@@ -1,0 +1,57 @@
+# graftlint-fixture: G001=0
+"""Near-miss negatives for G001: the same shapes, memoized correctly."""
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+
+from heat_tpu.core._cache import ExecutableCache
+
+_JIT_CACHE = ExecutableCache()
+
+# module scope: traced once at import, identity is stable
+_double = jax.jit(lambda v: v * 2)
+
+
+def jit_module_fn(x):
+    # jitting a module-level function: stable identity, pjit cache hits
+    return jax.jit(_module_step)(x)
+
+
+def _module_step(v):
+    return v + 1
+
+
+def builder_returned():
+    # returned builders are memoized by the caller (data_parallel pattern)
+    def step(v):
+        return v - 1
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+class Model:
+    def compile(self):
+        def avg(v):
+            return jnp.mean(v)
+
+        # stored on self: built once per object, reused across calls
+        self._avg_fn = jax.jit(avg)
+
+
+def cache_store(x):
+    key = (x.shape, x.dtype)
+    fn = _JIT_CACHE.get(key)
+    if fn is None:
+        # the flatmove idiom: keyed by hashable statics, traced on miss only
+        fn = _JIT_CACHE[key] = jax.jit(lambda v: v * 2)
+    return fn(x)
+
+
+@lru_cache(maxsize=256)
+def cached_builder(shape, dtype):
+    def run(v):
+        return v.sum()
+
+    # the local def is inside a cache-decorated builder: one trace per key
+    return jax.jit(run)

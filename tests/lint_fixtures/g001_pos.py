@@ -1,0 +1,36 @@
+# graftlint-fixture: G001=4
+"""True positives for G001: per-call callables traced into jit/caches.
+
+Never executed — parsed by tests/test_graftlint.py. Each flagged site is
+an object with fresh identity per call keying a trace cache: every call
+is a miss that compiles and parks a dead executable.
+"""
+import jax
+import jax.numpy as jnp
+
+from heat_tpu.core._cache import ExecutableCache
+
+_PROG_CACHE = ExecutableCache()
+
+
+def jit_lambda_invoked(x):
+    # fresh lambda jitted AND invoked per call: retrace every call
+    return jax.jit(lambda v: v * 2)(x)
+
+
+def jit_local_def_unmemoized(x):
+    def step(v):
+        return v + 1
+
+    f = jax.jit(step)  # assigned to a local name only — rebuilt per call
+    return f(x)
+
+
+def closure_into_reduce_cache(x):
+    # keys the lru cache by fresh closure identity (the statistics.py bug)
+    return _jitted_reduce(lambda v, axis: jnp.max(v, axis=axis), x, axis=0)
+
+
+def lambda_in_cache_key(x):
+    # per-call identity inside the key: every lookup misses, cache grows
+    return _PROG_CACHE[(x.shape, lambda v: v)]

@@ -1,0 +1,27 @@
+# graftlint-fixture: G002=0
+"""Near-miss negatives for G002: bounded or non-cache containers."""
+from functools import lru_cache
+
+import jax
+
+from heat_tpu.core._cache import ExecutableCache
+
+# the sanctioned idiom: bounded LRU, evicted executables just re-jit
+_EXEC_CACHE = ExecutableCache(maxsize=256)
+
+# a dict that is not a cache (name says so) holds config, not programs
+_registry = {}
+
+
+class Kernels:
+    # bounded class-level cache
+    _CACHE = ExecutableCache()
+
+
+@lru_cache(maxsize=256)
+def build_program_bounded(shape, dtype):
+    return jax.jit(_step)
+
+
+def _step(v):
+    return v + 1

@@ -1,0 +1,26 @@
+# graftlint-fixture: G002=3
+"""True positives for G002: unbounded executable caches."""
+import functools
+from functools import lru_cache
+
+import jax
+
+
+@lru_cache(maxsize=None)
+def build_program_unbounded(shape, dtype):
+    # never evicts: shape-polymorphic workloads pin every executable
+    return jax.jit(_step)
+
+
+@functools.cache
+def build_program_functools_cache(shape):
+    # functools.cache IS lru_cache(maxsize=None)
+    return jax.jit(_step)
+
+
+# module dict as an executable cache: grows for the process lifetime
+_EXEC_CACHE = {}
+
+
+def _step(v):
+    return v + 1

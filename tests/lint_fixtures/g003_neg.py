@@ -1,0 +1,27 @@
+# graftlint-fixture: G003=0
+"""Near-miss negatives for G003."""
+import jax
+
+
+def world_size_gated_reduce(x):
+    # process_count() is replicated-uniform: every rank takes the same
+    # branch, so the collective fires on all ranks or none
+    if jax.process_count() > 1:
+        return psum(x)
+    return x
+
+
+def rank_gated_io(comm, path, x):
+    # rank-dependent branch WITHOUT a collective inside: the classic
+    # "rank 0 writes the file" pattern is fine
+    if comm.rank == 0:
+        with open(path, "w") as fh:
+            fh.write(str(x))
+    return x
+
+
+def collective_outside_branch(comm, x):
+    y = psum(x)  # every rank participates ...
+    if comm.rank == 0:
+        print(y)  # ... and only the log line is rank-gated
+    return y

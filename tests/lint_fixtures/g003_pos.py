@@ -1,0 +1,27 @@
+# graftlint-fixture: G003=3
+"""True positives for G003: collectives under divergent control flow.
+
+Ranks taking different branches dispatch different collective sequences:
+the ranks inside the branch block forever waiting for the ones outside.
+"""
+import jax
+
+
+def rank_gated_reduce(comm, x):
+    if comm.rank == 0:
+        return psum(x)  # only rank 0 enters the collective: hang
+    return x
+
+
+def process_index_gated_move(layout, x):
+    if jax.process_index() == 0:
+        x = ragged_move(x, layout)  # same: a collective for rank 0 only
+    return x
+
+
+def device_value_gated_gather(x, threshold):
+    # .item() branches on a device value each rank computed locally —
+    # float nondeterminism can split the ranks across the branches
+    while x.max().item() > threshold:
+        x = all_gather(x)
+    return x

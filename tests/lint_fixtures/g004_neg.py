@@ -1,0 +1,25 @@
+# graftlint-fixture: G004=0
+# graftlint: hot-path
+"""Near-miss negatives for G004 (same hot-path pragma as the positive)."""
+import numpy as np
+
+
+def asarray_literal():
+    # literal argument: host data to host array, no device involved
+    return np.asarray([1.0, 2.0, 3.0])
+
+
+def waived_sync(x):
+    # an intentional, documented sync is waived
+    return np.asarray(x)  # graftlint: host-sync - O(world) metadata fetch
+
+
+def dict_items(d):
+    # .items() on a dict is not .item() on an array
+    return sorted(d.items())
+
+
+def asarray_in_cold_helper(x):
+    # waiver in the comment block directly above also applies
+    # graftlint: host-sync - result assembly is this op's contract
+    return np.asarray(x)

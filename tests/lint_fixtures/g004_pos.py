@@ -1,0 +1,26 @@
+# graftlint-fixture: G004=4
+# graftlint: hot-path
+"""True positives for G004: implicit host syncs on a hot path.
+
+The pragma above opts this file into the hot-path set (in the real tree
+that set is parallel/** plus the core dispatch modules).
+"""
+import jax
+import numpy as np
+
+
+def asarray_sync(x):
+    return np.asarray(x)  # device value -> host copy, blocks dispatch
+
+
+def item_sync(x):
+    return x.item()  # scalar fetch: full pipeline flush
+
+
+def device_get_sync(x):
+    return jax.device_get(x)
+
+
+def block_sync(x):
+    x.block_until_ready()
+    return x

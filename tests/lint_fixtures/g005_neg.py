@@ -1,0 +1,20 @@
+# graftlint-fixture: G005=0
+"""Near-miss negatives for G005."""
+from heat_tpu.core._cache import ExecutableCache
+
+_PROG_CACHE = ExecutableCache()
+
+
+def sorted_set_schedule(ranks, x):
+    # sorted(...) pins one global order: every host walks the same schedule
+    for r in sorted(set(ranks)):
+        x = ppermute(x, r)
+    return x
+
+
+def set_iteration_without_hazard(ranks):
+    # pure local accumulation: order genuinely does not matter
+    total = 0
+    for r in set(ranks):
+        total += r
+    return total

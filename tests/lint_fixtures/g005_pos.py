@@ -1,0 +1,23 @@
+# graftlint-fixture: G005=2
+"""True positives for G005: unordered iteration feeding collectives/keys.
+
+Set iteration order depends on hash randomization, so each host walks a
+different order — ranks dispatch mismatched collective sequences, or
+build cache keys in different orders.
+"""
+from heat_tpu.core._cache import ExecutableCache
+
+_PROG_CACHE = ExecutableCache()
+
+
+def collective_schedule_from_set(ranks, x):
+    for r in set(ranks):
+        x = ppermute(x, r)  # dispatch order differs per host: deadlock
+    return x
+
+
+def cache_keys_from_set(shapes):
+    out = []
+    for s in set(shapes):
+        out.append(_PROG_CACHE[s])  # insertion order differs per host
+    return out

@@ -1,0 +1,33 @@
+# graftlint-fixture: G006=0
+"""Near-miss negatives for G006: broad handlers that actually handle."""
+from heat_tpu.resilience.errors import ResilienceError
+
+
+def resilience_reraised_first(fn):
+    try:
+        return fn()
+    except ResilienceError:
+        raise  # verdicts always propagate ...
+    except Exception:
+        return None  # ... only mundane failures are absorbed
+
+
+def error_transported(fn, box):
+    try:
+        return fn()
+    except BaseException as exc:
+        box.append(exc)  # handed to the caller, re-raised there
+
+
+def error_reraised_wrapped(fn):
+    try:
+        return fn()
+    except Exception as exc:
+        raise RuntimeError("while running fn") from exc
+
+
+def narrow_handler(fn):
+    try:
+        return fn()
+    except (ValueError, OSError):
+        return None  # narrow types cannot hide the ResilienceError tree

@@ -1,0 +1,27 @@
+# graftlint-fixture: G006=3
+"""True positives for G006: broad handlers that ignore the caught error.
+
+A DivergenceError or CollectiveTimeout raised inside the try would be
+silently converted into "keep going with corrupt state".
+"""
+
+
+def swallow_exception(fn):
+    try:
+        return fn()
+    except Exception:
+        pass  # divergence verdicts vanish here
+
+
+def swallow_bare(fn):
+    try:
+        return fn()
+    except:  # noqa: E722
+        return None
+
+
+def swallow_named_but_unused(fn):
+    try:
+        return fn()
+    except BaseException as exc:  # bound, but never looked at
+        pass

@@ -1,0 +1,103 @@
+"""Tier-1 gate: the tree must stay graftlint-clean, and the CLI's JSON
+output contract must hold (bench_check-style schema assertions, so a
+report regression fails the suite rather than the CI consumer).
+
+A true finding is fixed; an intentional violation is waived in place
+with a ``# graftlint: <tag>`` comment that documents WHY (see
+docs/ANALYSIS.md). Either way the gate stays green — what it forbids is
+silent drift.
+"""
+import json
+import os
+import subprocess
+import sys
+
+from heat_tpu.analysis import graftlint as gl
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the gated surface: the package itself plus the repo tooling
+GATED_PATHS = ["heat_tpu", "tools", "bench.py"]
+
+# a JSON report with zero findings must stay a compact single line; with
+# findings it grows, but the clean-tree gate keeps CI in the small case
+CLEAN_LINE_BUDGET = 2048
+
+REQUIRED_KEYS = (
+    "tool", "schema_version", "paths", "files_checked", "rules",
+    "findings", "counts", "total", "exit_code",
+)
+
+
+def test_tree_is_lint_clean():
+    findings, files_checked = gl.lint_paths(
+        [os.path.join(REPO, p) for p in GATED_PATHS]
+    )
+    assert files_checked > 90  # the walker actually saw the tree
+    assert not findings, "graftlint found unwaived violations:\n" + "\n".join(
+        f"  {f.path}:{f.line}:{f.col}: {f.rule} {f.message}" for f in findings
+    )
+
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join("tools", "graftlint.py"), *args],
+        capture_output=True, text=True, cwd=REPO,
+    )
+
+
+def test_cli_clean_exit_zero():
+    proc = _run_cli(*GATED_PATHS)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "clean" in proc.stdout
+
+
+def test_cli_json_contract():
+    proc = _run_cli(*GATED_PATHS, "--format", "json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    assert len(lines) == 1, "JSON mode must emit exactly one line"
+    line = lines[0]
+    assert len(line) <= CLEAN_LINE_BUDGET
+    obj = json.loads(line)
+    missing = [k for k in REQUIRED_KEYS if k not in obj]
+    assert not missing, f"report missing keys: {missing}"
+    assert obj["tool"] == "graftlint"
+    assert obj["schema_version"] == gl.SCHEMA_VERSION
+    assert obj["total"] == 0 and obj["exit_code"] == 0
+    assert sorted(obj["counts"]) == sorted(gl.RULES)
+    assert all(v == 0 for v in obj["counts"].values())
+    assert isinstance(obj["files_checked"], int) and obj["files_checked"] > 90
+    assert {r["id"] for r in obj["rules"]} == set(gl.RULES)
+    for r in obj["rules"]:
+        assert set(r) == {"id", "tag", "bit", "summary"}
+    # the round trip itself: re-serialization must be lossless
+    assert json.loads(json.dumps(obj)) == obj
+
+
+def test_cli_report_matches_api():
+    """The CLI is a thin shell over the library: same findings, same code."""
+    proc = _run_cli("heat_tpu", "--format", "json")
+    obj = json.loads(proc.stdout.strip().splitlines()[-1])
+    findings, files_checked = gl.lint_paths([os.path.join(REPO, "heat_tpu")])
+    assert obj["total"] == len(findings)
+    assert obj["files_checked"] == files_checked
+    assert proc.returncode == gl.exit_code_for(findings)
+
+
+def test_cli_runs_without_jax():
+    """Lint must work on machines with no accelerator runtime: the CLI
+    pulls the checker in by file path and never imports heat_tpu/jax."""
+    proc = subprocess.run(
+        [
+            sys.executable, "-c",
+            "import sys; sys.argv = ['graftlint', 'heat_tpu/analysis'];\n"
+            "import tools.graftlint as cli\n"
+            "rc = cli.main(['heat_tpu/analysis'])\n"
+            "assert 'jax' not in sys.modules, 'lint imported jax!'\n"
+            "assert 'heat_tpu' not in sys.modules, 'lint imported heat_tpu!'\n"
+            "sys.exit(rc)",
+        ],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr

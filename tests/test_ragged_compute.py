@@ -224,6 +224,49 @@ class TestExactlyOneExchange(TestCase):
                 self.assertEqual(z.lcounts, a.lcounts)  # first operand wins
                 np.testing.assert_array_equal(z.numpy(), full + full)
 
+    def test_repeated_key_compiles_exactly_once(self):
+        """Pins the PR 3 cache contract with the compile sanitizer: the
+        whole redistribute -> op -> redistribute pipeline at a repeated
+        (block, lcounts) key compiles on the FIRST pass only. The second
+        identical pass must be compile-free — zero backend compiles, zero
+        new executable-cache keys, zero reduce-cache misses — while still
+        performing its real layout exchanges."""
+        from heat_tpu.analysis import sanitizer
+
+        for n in (2, 8):
+            with comm_context(_sub_comm(n)):
+                p = ht.get_comm().size
+                if p == 1:
+                    continue
+                rows = 4 * p + 3
+                full = np.arange(rows * 5, dtype=np.float32).reshape(rows, 5)
+                counts = _skew(p, rows, "tail")
+                even = _skew(p, rows, "stagger")
+
+                def pipeline():
+                    x = ht.array(full, split=0)
+                    x.redistribute_(target_map=_to_map(counts, full.shape, 0))
+                    s = ht.sum(x, axis=0)
+                    x.redistribute_(target_map=_to_map(even, full.shape, 0))
+                    return s
+
+                with sanitizer(f"cold ws={p}") as cold:
+                    s1 = pipeline()
+                with sanitizer(f"warm ws={p}") as warm:
+                    s2 = pipeline()
+                # first pass is allowed to compile; it must CACHE
+                self.assertGreaterEqual(cold.compiles, 1, f"ws={p}")
+                self.assertGreaterEqual(cold.cache_inserts, 1, f"ws={p}")
+                # repeated key: the entire pipeline is compile-free ...
+                warm.assert_compiles(0)
+                self.assertEqual(warm.cache_inserts, 0, f"ws={p}")
+                self.assertEqual(warm.reduce_cache_misses, 0, f"ws={p}")
+                self.assertGreaterEqual(warm.reduce_cache_hits, 1, f"ws={p}")
+                # ... but not work-free: the exchanges still happened
+                self.assertGreaterEqual(warm.collectives, 1, f"ws={p}")
+                np.testing.assert_array_equal(s1.numpy(), s2.numpy())
+                np.testing.assert_array_equal(s1.numpy(), full.sum(axis=0))
+
 
 @pytest.mark.multihost
 class TestRaggedComputeMultihost(TestCase):

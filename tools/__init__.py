@@ -1,0 +1,2 @@
+"""Repo tooling: bench validation (:mod:`tools.bench_check`), linting
+(:mod:`tools.graftlint`), chaos smoke runs, parity generation."""

@@ -25,7 +25,7 @@ FAILURES = []
 def check(label, fn):
     try:
         fn()
-    except Exception:
+    except Exception:  # graftlint: swallow - fuzz harness records, never aborts
         FAILURES.append((label, traceback.format_exc(limit=3)))
 
 
@@ -53,7 +53,7 @@ def sweep(label, heat_fn, np_fn, shapes=((6, 7),), dtypes=("float32",), splits="
                 a = (rng.random(shape) * 4 - 2).astype(dt)
             try:
                 exp = np_fn(a.copy())
-            except Exception:
+            except Exception:  # graftlint: swallow - numpy oracle rejects input: skip case
                 continue
             sp_list = [None] + list(range(len(shape))) if splits == "all" else splits
             for sp in sp_list:

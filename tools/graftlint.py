@@ -1,0 +1,43 @@
+"""CLI for graftlint, the SPMD/JAX invariant checker.
+
+Usage::
+
+    python tools/graftlint.py [paths...] [--format json|text] [--select G001,G004]
+    python tools/graftlint.py --list-rules
+
+or, installed, as the ``graftlint`` entry point (``pyproject.toml``).
+Exit code is a per-rule bitmask (G001=1 ... G006=32, errors=64), so a CI
+step can tell *which* invariant class regressed from the status alone.
+
+The checker itself lives in ``heat_tpu/analysis/graftlint.py`` and is
+pure stdlib; this wrapper loads that file directly so linting never
+imports ``heat_tpu`` (and therefore never initializes jax or a backend —
+lint must be runnable on a machine with no accelerator runtime at all).
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+
+def _load_linter():
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "heat_tpu", "analysis", "graftlint.py",
+    )
+    spec = importlib.util.spec_from_file_location("_graftlint_impl", path)
+    mod = importlib.util.module_from_spec(spec)
+    # dataclass processing resolves cls.__module__ through sys.modules, so
+    # the module must be registered before its body executes
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    return _load_linter().main(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
