@@ -1,6 +1,7 @@
 """Distributed linear algebra (reference ``heat/core/linalg/``)."""
-from . import basics, solver, svd
+from . import basics, factorizations, solver, svd
 from .basics import *
+from .factorizations import cholesky, solve, solve_triangular
 from .qr import qr
 from .solver import *
 from .svd import lstsq, pinv, rsvd, svd

@@ -10,7 +10,6 @@ result, matching the reference's conventions.
 """
 from __future__ import annotations
 
-import warnings
 from typing import List, Optional, Tuple, Union
 
 import jax.numpy as jnp
@@ -254,44 +253,31 @@ def cross(a: DNDarray, b: DNDarray, axisa: int = -1, axisb: int = -1, axisc: int
 
 
 def det(a: DNDarray) -> DNDarray:
-    """Determinant (reference ``basics.py:160`` — distributed pivoted
-    elimination with per-row Bcasts; batched local LU under XLA here).
+    """Determinant (reference ``basics.py:160``, a distributed pivoted
+    elimination with per-row Bcasts there).
 
-    .. warning:: A split operand is implicitly gathered to every device
-       and the LU factorization runs replicated — O(n^2) memory per device
-       and no distributed speedup. A ``UserWarning`` says so at call time."""
+    Split 2-D operands run the distributed blocked LU with tournament
+    pivoting (:mod:`~heat_tpu.core.linalg.factorizations`) — no
+    full-operand gather; batch-split stacks LU-factor per shard with zero
+    communication; replicated operands run the local batched LU."""
     _square_check(a)
-    _warn_replicated_lu("det", a)
-    result = jnp.linalg.det(a._logical().astype(_float_type(a)))
-    return DNDarray(result, split=None if a.ndim == 2 else a.split, device=a.device, comm=a.comm)
+    from .factorizations import _det_impl
+
+    return _det_impl(a)
 
 
 def inv(a: DNDarray) -> DNDarray:
     """Matrix inverse (reference ``basics.py:312``).
 
-    .. warning:: A split operand is implicitly gathered to every device
-       and the LU-based inverse runs replicated — O(n^2) memory per device
-       and no distributed speedup. A ``UserWarning`` says so at call time."""
+    Split 2-D operands run the distributed blocked LU with the identity
+    riding the elimination as augmented columns
+    (:mod:`~heat_tpu.core.linalg.factorizations`) — no full-operand
+    gather; batch-split stacks invert per shard; replicated operands run
+    the local LU-based inverse."""
     _square_check(a)
-    _warn_replicated_lu("inv", a)
-    result = jnp.linalg.inv(a._logical().astype(_float_type(a)))
-    return DNDarray(result, split=a.split, device=a.device, comm=a.comm)
+    from .factorizations import _inv_impl
 
-
-def _warn_replicated_lu(func: str, a: DNDarray) -> None:
-    """Name the hidden cost of the dense LU paths on split operands: the
-    operand is gathered in full to every device and the factorization is
-    replicated, NOT distributed (reference heat runs a distributed pivoted
-    elimination here; the TPU port does not yet)."""
-    if a.split is not None and a.comm.is_distributed():
-        warnings.warn(
-            f"heat_tpu.linalg.{func}: split operand is implicitly gathered in "
-            f"full to every device and the LU factorization runs replicated "
-            f"(no distributed speedup; O(n^2) memory per device). "
-            f"resplit_(None) beforehand to silence this warning.",
-            UserWarning,
-            stacklevel=3,
-        )
+    return _inv_impl(a)
 
 
 def _square_check(a: DNDarray):

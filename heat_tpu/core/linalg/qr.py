@@ -21,6 +21,7 @@ from jax.sharding import PartitionSpec as P
 from jax import shard_map
 
 from .. import sanitation, types
+from .._cache import ExecutableCache
 from .._operations import _mask_padding
 from ..communication import SPLIT_AXIS
 from ..dndarray import DNDarray
@@ -28,6 +29,12 @@ from ..dndarray import DNDarray
 __all__ = ["qr"]
 
 QR_out = collections.namedtuple("QR", "Q, R")
+
+# jitted TSQR shard_map programs keyed on the static geometry — the
+# program used to be rebuilt (and retraced) on EVERY qr() call from a
+# fresh closure, which the compile sanitizer flagged as the dominant
+# dispatch cost of the distributed path
+_TSQR_CACHE = ExecutableCache()
 
 
 def qr(
@@ -278,18 +285,34 @@ def _qr_impl(
         q_local = q1 @ q2_block  # (mi, K)
         return q_local[None], r2
 
-    q_sh, r = shard_map(
-        _tsqr_local,
-        mesh=mesh,
-        in_specs=P(SPLIT_AXIS, None),
-        out_specs=(P(SPLIT_AXIS, None, None), P()),
-        # R is computed redundantly (and identically) on every device from
-        # the all-gathered factors; tell shard_map to trust the replication
-        check_vma=False,
-    )(arr)
-    r_dnd = DNDarray(r, split=None, device=a.device, comm=comm)
+    # one compiled program per static geometry. calc_q=False gets its own
+    # R-only variant so XLA dead-code-eliminates the whole back-multiply
+    # (the eager shard_map computed and discarded Q on every R-only call).
+    key = (
+        "tsqr", mesh, p, mi, n, n_tiles, tile_rows, method, calc_q,
+        jnp.dtype(ftype).name,
+    )
+    fn = _TSQR_CACHE.get(key)
+    if fn is None:
+        body = _tsqr_local if calc_q else (lambda block: _tsqr_local(block)[1])
+        out_specs = (P(SPLIT_AXIS, None, None), P()) if calc_q else P()
+        fn = _TSQR_CACHE[key] = jax.jit(
+            shard_map(
+                body,
+                mesh=mesh,
+                in_specs=P(SPLIT_AXIS, None),
+                out_specs=out_specs,
+                # R is computed redundantly (and identically) on every
+                # device from the all-gathered factors; tell shard_map to
+                # trust the replication
+                check_vma=False,
+            )
+        )
     if not calc_q:
-        return QR_out(None, r_dnd)
+        r = fn(arr)
+        return QR_out(None, DNDarray(r, split=None, device=a.device, comm=comm))
+    q_sh, r = fn(arr)
+    r_dnd = DNDarray(r, split=None, device=a.device, comm=comm)
     # the padded rows of Q are exact zeros; keep them as canonical buffer pad
     q_buf = q_sh.reshape(mp, q_sh.shape[-1])
     Q = DNDarray._from_buffer(

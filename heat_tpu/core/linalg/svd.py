@@ -158,11 +158,14 @@ def lstsq(a: DNDarray, b: DNDarray, rcond: Optional[float] = None) -> DNDarray:
             Q, R = qr(a)
             diag = jnp.abs(jnp.diagonal(R._logical()))
             if float(jnp.min(diag)) > eps_cut * float(jnp.max(diag)):
-                # well-conditioned: qᴴ b is replicated after the psum,
-                # R is a k x k replicated triangular solve
+                # well-conditioned: qᴴ b is replicated after the psum, and
+                # the k x k triangular system routes through the shared
+                # solver (local branch here — R is replicated; a split R
+                # would run the distributed block substitution)
+                from .factorizations import solve_triangular
+
                 qhb = complex_math.conj(Q).T @ b
-                x = jax.scipy.linalg.solve_triangular(R._logical(), qhb._logical(), lower=False)
-                return DNDarray(x, split=None, device=a.device, comm=a.comm)
+                return solve_triangular(R, qhb, lower=False)
             # rank-deficient: match numpy's min-norm solution via the SVD
         p = pinv(a, rcond=rcond)
         return p @ b
@@ -184,15 +187,20 @@ def pinv(a: DNDarray, rcond: Optional[float] = None) -> DNDarray:
     if rcond is None:
         ftype = jnp.promote_types(a.larray.dtype, jnp.float32)
         rcond = float(jnp.finfo(ftype).eps) * max(a.gshape)
-    # logical views throughout: Vh inherits split=1 from a split-1 operand
-    # and its BUFFER carries column padding that must not leak into the
-    # result's extent (caught at world size 5 with n=64 -> padded 65)
+    # logical views on the SMALL factors only: Vh inherits split=1 from a
+    # split-1 operand and its BUFFER carries column padding that must not
+    # leak into the result's extent (caught at world size 5 with n=64 ->
+    # padded 65). U is the tall factor — it stays sharded and contracts
+    # through the DNDarray matmul (GSPMD psum), never a full gather.
+    from .. import complex_math
+
     sl = s._logical()
     cutoff = rcond * jnp.max(sl)
     s_inv = jnp.where(sl > cutoff, 1.0 / sl, 0.0)
     with jax.default_matmul_precision("highest"):
-        result = (Vh._logical().conj().T * s_inv[None, :]) @ U._logical().conj().T
-    return DNDarray(result, split=None, device=a.device, comm=a.comm)
+        vs = Vh._logical().conj().T * s_inv[None, :]
+        Uh = complex_math.conj(U).T  # row-split U -> column-split U^H
+        return DNDarray(vs, split=None, device=a.device, comm=a.comm) @ Uh
 
 
 def _svd_impl(a: DNDarray, full_matrices: bool, compute_uv: bool):

@@ -26,7 +26,27 @@ import numpy as np
 
 from .dndarray import DNDarray
 
-__all__ = ["SplitTiles", "SquareDiagTiles"]
+__all__ = ["SplitTiles", "SquareDiagTiles", "factor_block_edge"]
+
+
+def factor_block_edge(arr: DNDarray, tiles_per_proc: int, mi: int) -> int:
+    """Panel width for the blocked factorizations (``linalg/factorizations``).
+
+    The ``SquareDiagTiles`` row-tile edge for ``tiles_per_proc``, snapped
+    down to the largest divisor of the per-device row count ``mi`` — a
+    factorization panel must never straddle a device boundary, so the edge
+    has to divide the local block exactly (the same geometry source
+    ``qr(tiles_per_proc=)`` consumes, with the divisor constraint the
+    right-looking panel schedule adds on top)."""
+    mi = max(1, int(mi))
+    if tiles_per_proc <= 1 or mi <= 1:
+        return mi
+    ri = SquareDiagTiles(arr, tiles_per_proc).row_indices
+    edge = ri[1] - ri[0] if len(ri) > 1 else mi
+    edge = max(1, min(int(edge), mi))
+    while mi % edge:
+        edge -= 1
+    return edge
 
 
 def _tile_range(ends, k) -> slice:

@@ -58,7 +58,7 @@ class TestCompactSummary:
         # every headline metric made it into the line
         for k in bench.HEADLINE[1:]:
             assert obj[k] == 99.9
-        assert len(line) <= bench_check.LINE_BUDGET
+        assert len(line) < bench_check.LINE_BUDGET
 
     def test_floor_violations_ride_along(self):
         out = _synthetic_out()
@@ -73,7 +73,7 @@ class TestCompactSummary:
         line = json.dumps(bench._compact_summary(out, "d.json"))
         obj = bench_check.check(line)
         assert "ragged_error" in obj
-        assert len(line) <= bench_check.LINE_BUDGET
+        assert len(line) < bench_check.LINE_BUDGET
 
     def test_summary_is_much_smaller_than_full_dict(self):
         out = _synthetic_out()
@@ -88,6 +88,21 @@ class TestBenchCheck:
                "detail": "d.json", "pad": "x" * bench_check.LINE_BUDGET}
         with pytest.raises(ValueError, match="budget"):
             bench_check.check(json.dumps(obj))
+
+    def test_rejects_exactly_budget_sized_line(self):
+        # the budget is exclusive: a line of exactly LINE_BUDGET bytes is
+        # already truncation-prone under the harness's log-tail capture
+        base = {"metric": "m", "value": 1.0, "smoke_ok": True, "bench_reps": 3,
+                "detail": "d.json", "pad": ""}
+        pad = bench_check.LINE_BUDGET - len(json.dumps(base))
+        base["pad"] = "x" * pad
+        line = json.dumps(base)
+        assert len(line) == bench_check.LINE_BUDGET
+        with pytest.raises(ValueError, match="budget"):
+            bench_check.check(line)
+        # one byte under the budget passes
+        base["pad"] = "x" * (pad - 1)
+        assert bench_check.check(json.dumps(base))["value"] == 1.0
 
     def test_rejects_missing_keys(self):
         with pytest.raises(ValueError, match="missing required keys"):

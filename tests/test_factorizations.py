@@ -1,0 +1,229 @@
+"""Distributed dense factorizations (PR 5 tentpole): mesh-tiled LU and
+Cholesky with sharded triangular solves replace the gather-and-replicate
+linalg paths.
+
+What is asserted, per the issue's done bar:
+
+- ``cholesky``/``solve``/``det``/``inv``/``lstsq`` match the numpy oracle
+  at world sizes 1/2/4/8 (sub-mesh sweep, the suite's analogue of the
+  reference's mpirun matrix), for divisible AND non-divisible ``n``, in
+  float32 and float64, on split 0 and split 1;
+- the split-0 compute paths run with ZERO full-operand gathers: no ragged
+  layout exchanges (``MOVE_STATS``), no rebalances (``LAYOUT_STATS``), and
+  no device→host fetch (``COMPILE_STATS["host_syncs"]``) inside the calls;
+- every factorization program compiles ONCE per (op, mesh, geometry,
+  dtype) key — a second same-key call traces and inserts nothing;
+- the retired replicated-LU ``UserWarning`` is gone: split operands run
+  det/inv silently (the stale warning pin was deleted from
+  ``test_linalg.py``; the no-warning assertion lives here).
+
+A subset rides the real 2/4-process jax.distributed runs via the
+``multihost`` marker; the explicit 2-process worker case lives in
+``tests/test_multihost.py::test_two_process_factorizations``.
+"""
+from __future__ import annotations
+
+import warnings
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.analysis.sanitizer import COMPILE_STATS, sanitizer
+from heat_tpu.core.communication import MeshCommunication, comm_context
+from heat_tpu.core.dndarray import LAYOUT_STATS
+from heat_tpu.parallel.flatmove import MOVE_STATS
+from tests.base import TestCase
+
+WORLD_SIZES = (1, 2, 4, 8)
+
+# mesh objects must stay IDENTICAL across tests: every jitted factor
+# program is keyed on (op, mesh, geometry, dtype), so a fresh mesh per
+# test would recompile per test and break the compile-once asserts
+_COMMS = {}
+
+
+def _comm(n: int) -> MeshCommunication:
+    import jax
+
+    if n not in _COMMS:
+        _COMMS[n] = MeshCommunication(
+            devices=jax.devices()[: min(n, len(jax.devices()))]
+        )
+    return _COMMS[n]
+
+
+def _mats(n: int, dtype):
+    """(well-conditioned A with det ~ O(1), SPD, rhs) triple."""
+    rng = np.random.default_rng(100 + n)
+    A = (np.eye(n) + rng.standard_normal((n, n)) / (2.0 * np.sqrt(n))).astype(dtype)
+    spd = (A @ A.T + np.eye(n)).astype(dtype)
+    b = rng.standard_normal((n, 3)).astype(dtype)
+    return A, spd, b
+
+
+def _tol(dtype):
+    return 5e-3 if dtype == np.float32 else 1e-9
+
+
+class TestFactorizationOracle(TestCase):
+    """World-size x n x dtype sweep against the numpy oracle."""
+
+    def _battery(self, n: int, dtype):
+        tol = _tol(dtype)
+        A, spd, b = _mats(n, dtype)
+        a0 = ht.array(A, split=0)
+        b0 = ht.array(b, split=0)
+        s0 = ht.array(spd, split=0)
+
+        d = ht.linalg.det(a0)
+        np.testing.assert_allclose(
+            float(d.larray), np.linalg.det(A.astype(np.float64)), rtol=tol
+        )
+        self.assert_array_equal(ht.linalg.inv(a0), np.linalg.inv(A), rtol=tol, atol=tol)
+        self.assert_array_equal(
+            ht.linalg.solve(a0, b0), np.linalg.solve(A, b), rtol=tol, atol=tol
+        )
+        # 1-D right-hand side keeps its rank
+        x1 = ht.linalg.solve(a0, ht.array(b[:, 0], split=0))
+        self.assertEqual(x1.ndim, 1)
+        np.testing.assert_allclose(x1.numpy(), np.linalg.solve(A, b[:, 0]), atol=tol)
+        self.assert_array_equal(
+            ht.linalg.cholesky(s0), np.linalg.cholesky(spd), rtol=tol, atol=tol
+        )
+        # split=1 routes through the zero-data-movement transpose
+        self.assert_array_equal(
+            ht.linalg.cholesky(ht.array(spd, split=1)),
+            np.linalg.cholesky(spd),
+            rtol=tol,
+            atol=tol,
+        )
+        a1 = ht.array(A, split=1)
+        np.testing.assert_allclose(
+            float(ht.linalg.det(a1).larray), np.linalg.det(A.astype(np.float64)), rtol=tol
+        )
+        self.assert_array_equal(ht.linalg.inv(a1), np.linalg.inv(A), rtol=tol, atol=tol)
+        # triangular solves, both halves
+        T = np.triu(A + np.eye(n, dtype=dtype)).astype(dtype)
+        got = ht.linalg.solve_triangular(ht.array(T, split=0), b0)
+        exp = np.linalg.solve(T, b)
+        self.assert_array_equal(got, exp, rtol=tol, atol=tol)
+        Tl = np.tril(A + np.eye(n, dtype=dtype)).astype(dtype)
+        got = ht.linalg.solve_triangular(ht.array(Tl, split=0), b0, lower=True)
+        self.assert_array_equal(got, np.linalg.solve(Tl, b), rtol=tol, atol=tol)
+
+    def test_sweep_float32(self):
+        # n=16 divides every world size; n=37 is non-divisible (padded
+        # buffers, identity-extended trailing block) for every ws > 1
+        for ws in WORLD_SIZES:
+            with comm_context(_comm(ws)):
+                for n in (16, 37):
+                    with self.subTest(ws=ws, n=n):
+                        self._battery(n, np.float32)
+
+    def test_sweep_float64(self):
+        # one ws > 1 combo is enough to pin the x64 path (the f32 sweep
+        # carries the geometry matrix); tight tolerance proves the blocked
+        # schedule is numerically the direct factorization, not an
+        # approximation
+        with comm_context(_comm(4)):
+            self._battery(29, np.float64)
+
+    @pytest.mark.multihost
+    def test_battery_multihost(self):
+        # runs inside the real 2/4-process jax.distributed subset too
+        with comm_context(_comm(8)):
+            self._battery(19, np.float32)
+
+    def test_lstsq_matches_numpy(self):
+        for ws in (1, 4):
+            with comm_context(_comm(ws)):
+                rng = np.random.default_rng(7)
+                A = rng.standard_normal((50, 6)).astype(np.float32)
+                b = rng.standard_normal((50, 2)).astype(np.float32)
+                x = ht.linalg.lstsq(ht.array(A, split=0), ht.array(b, split=0))
+                exp = np.linalg.lstsq(A, b, rcond=None)[0]
+                np.testing.assert_allclose(
+                    np.asarray(x._logical()), exp, atol=2e-3
+                )
+
+    def test_singular_det_is_exact_zero(self):
+        with comm_context(_comm(4)):
+            S = np.ones((12, 12), dtype=np.float32)
+            self.assertEqual(float(ht.linalg.det(ht.array(S, split=0)).larray), 0.0)
+
+    def test_split_operands_no_longer_warn(self):
+        # the seed gathered split operands and emitted a replicated-LU
+        # UserWarning; the distributed kernels retire both the gather and
+        # the warning
+        with comm_context(_comm(4)):
+            A, _, _ = _mats(16, np.float32)
+            a0 = ht.array(A, split=0)
+            with warnings.catch_warnings():
+                warnings.simplefilter("error")
+                d = ht.linalg.det(a0)
+                inv = ht.linalg.inv(a0)
+            np.testing.assert_allclose(
+                float(d.larray), np.linalg.det(A.astype(np.float64)), rtol=5e-3
+            )
+            np.testing.assert_allclose(
+                np.asarray(inv._logical()), np.linalg.inv(A), atol=5e-3
+            )
+
+
+class TestNoGatherAndCompileOnce(TestCase):
+    """The perf claims, counter-asserted."""
+
+    def _warm_ops(self, a0, b0, s0):
+        return (
+            ht.linalg.det(a0),
+            ht.linalg.inv(a0),
+            ht.linalg.solve(a0, b0),
+            ht.linalg.cholesky(s0),
+        )
+
+    def test_split0_compute_runs_gather_free(self):
+        with comm_context(_comm(8)):
+            n = 37  # non-divisible: the padded path must be gather-free too
+            A, spd, b = _mats(n, np.float32)
+            a0 = ht.array(A, split=0)
+            b0 = ht.array(b, split=0)
+            s0 = ht.array(spd, split=0)
+            self._warm_ops(a0, b0, s0)  # compile outside the counted region
+            m0, r0 = MOVE_STATS["ragged_moves"], LAYOUT_STATS["rebalances"]
+            with sanitizer("factor-compute") as reg:
+                outs = self._warm_ops(a0, b0, s0)
+            self.assertEqual(MOVE_STATS["ragged_moves"] - m0, 0)
+            self.assertEqual(LAYOUT_STATS["rebalances"] - r0, 0)
+            reg.assert_no_host_sync()
+            # results only fetched AFTER the counted region
+            self.assertAlmostEqual(
+                float(outs[0].larray), float(np.linalg.det(A.astype(np.float64))), places=2
+            )
+            np.testing.assert_allclose(
+                np.asarray(outs[3]._logical()), np.linalg.cholesky(spd), atol=5e-3
+            )
+
+    def test_compile_once_per_geometry(self):
+        with comm_context(_comm(4)):
+            n = 24
+            A, spd, b = _mats(n, np.float32)
+            a0 = ht.array(A, split=0)
+            b0 = ht.array(b, split=0)
+            s0 = ht.array(spd, split=0)
+            self._warm_ops(a0, b0, s0)  # first call per key compiles
+            with sanitizer("factor-recall") as reg:
+                self._warm_ops(a0, b0, s0)
+            # warm same-key calls: no tracing, no cache growth, no compile
+            reg.assert_compiles(0)
+            self.assertEqual(reg.traces, 0, reg.stats())
+            self.assertEqual(reg.cache_inserts, 0, reg.stats())
+
+    def test_distinct_geometries_get_distinct_programs(self):
+        with comm_context(_comm(2)):
+            A16, _, _ = _mats(16, np.float32)
+            A20, _, _ = _mats(20, np.float32)
+            ht.linalg.det(ht.array(A16, split=0))  # warm the n=16 key
+            c0 = COMPILE_STATS["cache_inserts"]
+            ht.linalg.det(ht.array(A20, split=0))  # new n -> new program
+            self.assertGreaterEqual(COMPILE_STATS["cache_inserts"] - c0, 1)

@@ -97,11 +97,12 @@ class TestMatmul(TestCase):
         inv = ht.linalg.inv(ht.array(x))
         np.testing.assert_allclose(inv.numpy() @ x, np.eye(5), atol=1e-3)
 
-    def test_det_inv_warn_on_split_operand(self):
-        """det/inv on a SPLIT operand implicitly gather it in full to every
-        device and run the LU replicated — pinned as a UserWarning naming
-        the gather (PR 3 satellite); replicated operands stay silent and
-        the values stay correct either way."""
+    def test_det_inv_silent_on_split_operand(self):
+        """det/inv on a SPLIT operand run the distributed blocked LU
+        (``linalg/factorizations``) — the seed's gather-and-replicate path
+        and its ``UserWarning`` are retired, so NO warning may fire on any
+        split, and the values stay correct (the full oracle sweep lives in
+        ``tests/test_factorizations.py``)."""
         import warnings
 
         rng = np.random.default_rng(5)
@@ -111,14 +112,11 @@ class TestMatmul(TestCase):
              / abs(np.linalg.det(x)) < 1e-3),
             (ht.linalg.inv, lambda r: np.allclose(r.numpy() @ x, np.eye(6), atol=1e-3)),
         ):
-            if self.comm.is_distributed():
-                with pytest.warns(UserWarning, match="gathered in full"):
-                    res = func(ht.array(x, split=0))
-                assert check(res)
-            with warnings.catch_warnings():
-                warnings.simplefilter("error")  # no warning on replicated input
-                res = func(ht.array(x))
-            assert check(res)
+            for split in (None, 0, 1):
+                with warnings.catch_warnings():
+                    warnings.simplefilter("error")  # any warning is a failure
+                    res = func(ht.array(x, split=split))
+                assert check(res), (func.__name__, split)
 
     def test_cross(self):
         a = np.array([[1.0, 0, 0], [0, 1, 0]], dtype=np.float32)

@@ -50,9 +50,9 @@ def validate(line: str, obj: dict) -> None:
         raise ValueError(f"final JSON line is missing required keys: {missing}")
     if not isinstance(obj["value"], (int, float)) or isinstance(obj["value"], bool):
         raise ValueError(f"'value' must be numeric, got {obj['value']!r}")
-    if len(line) > LINE_BUDGET:
+    if len(line) >= LINE_BUDGET:
         raise ValueError(
-            f"final JSON line is {len(line)} bytes, over the {LINE_BUDGET}-byte "
+            f"final JSON line is {len(line)} bytes, at or over the {LINE_BUDGET}-byte "
             "log-tail budget — move detail into the BENCH_DETAIL.json sidecar"
         )
     # the round trip itself: re-serialization must be lossless JSON
