@@ -25,10 +25,12 @@ from .core import random
 from .core import version
 from .core.version import __version__
 
-# runtime counters: layout rebalances / ragged exchanges / compiles+transfers
+# runtime counters: layout rebalances / ragged exchanges /
+# compiles+transfers / supervised-recovery activity
 from .core.dndarray import LAYOUT_STATS
 from .parallel.flatmove import MOVE_STATS
 from .analysis.sanitizer import COMPILE_STATS
+from .resilience.supervisor import RECOVERY_STATS
 
 
 def __getattr__(name: str):

@@ -22,7 +22,11 @@ hang, a silent recompile storm, or a host-transfer stall at scale:
   different programs;
 - a broad ``except`` that ignores the caught error swallows the
   ``ResilienceError`` hierarchy and turns detected divergence into
-  silent corruption.
+  silent corruption;
+- a direct ``open(..., "w")`` on a durability-critical path (the
+  resilience package, ``core/io.py``) bypasses ``core._atomic``'s
+  temp-file + fsync + rename commit — a crash mid-write leaves a torn
+  file that the checkpoint checksum layer then has to reject.
 
 This module is **pure stdlib** (``ast`` only — no jax import) so the
 CLI in ``tools/graftlint.py`` can lint without initializing a backend.
@@ -35,7 +39,9 @@ line or in the contiguous comment block directly above, where
 ``<token>`` is the rule id
 (``G004``), the rule tag (``host-sync``), or ``all``.  File-level
 pragmas: ``# graftlint: skip-file`` disables the file entirely;
-``# graftlint: hot-path`` opts a file into the G004 hot-path set.
+``# graftlint: hot-path`` opts a file into the G004 hot-path set;
+``# graftlint: durable-path`` opts a file into the G007 durable-write
+set (the resilience package and ``core/io.py`` are in it by location).
 """
 from __future__ import annotations
 
@@ -84,6 +90,8 @@ RULES: Dict[str, Rule] = {
              "iteration over an unordered set feeds collective ordering or cache keys"),
         Rule("G006", "swallow", 32,
              "broad except ignores the caught error (swallows the ResilienceError hierarchy)"),
+        Rule("G007", "durable-write", 64,
+             "direct write-mode open() on a durable path bypasses core._atomic's crash-safe commit"),
     )
 }
 
@@ -155,7 +163,7 @@ def _parse_waivers(source: str) -> Tuple[Dict[int, Set[str]], Set[str]]:
                 continue
             token = token.split("=", 1)[-1]  # tolerate disable=G001 spelling
             low = token.lower()
-            if low in ("skip-file", "hot-path"):
+            if low in ("skip-file", "hot-path", "durable-path"):
                 pragmas.add(low)
             elif low == "all":
                 ids.add("all")
@@ -179,6 +187,18 @@ def _is_hot(path: str, pragmas: Set[str]) -> bool:
     if "/heat_tpu/core/" in p and os.path.basename(p) in HOT_CORE_MODULES:
         return True
     return False
+
+
+# G007 durable-write set: files whose writes MUST go through the
+# temp-file + fsync + rename commit in core._atomic (which is itself the
+# one legitimate direct writer and therefore not in the set).
+def _is_durable(path: str, pragmas: Set[str]) -> bool:
+    if "durable-path" in pragmas:
+        return True
+    p = "/" + path.replace(os.sep, "/").lstrip("/")
+    if "/heat_tpu/resilience/" in p:
+        return True
+    return p.endswith("/heat_tpu/core/io.py")
 
 
 # --------------------------------------------------------------------- helpers
@@ -232,9 +252,11 @@ def _exception_names(type_node: Optional[ast.expr]) -> List[str]:
 
 # --------------------------------------------------------------------- checker
 class _Checker(ast.NodeVisitor):
-    def __init__(self, path: str, hot: bool):
+    def __init__(self, path: str, hot: bool, durable: bool = False):
         self.path = path
         self.hot = hot
+        self.durable = durable
+        self._atomic_names: Set[str] = set()
         self.findings: List[Finding] = []
         self._func_stack: List[ast.AST] = []
         self._local_defs: List[Set[str]] = []
@@ -249,6 +271,17 @@ class _Checker(ast.NodeVisitor):
         for node in ast.walk(tree):
             for child in ast.iter_child_nodes(node):
                 self._parents[id(child)] = node
+            # names bound by ``with atomic_write(...) as tmp`` are staged
+            # temp paths: opening THEM for write is the sanctioned pattern
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    ce = item.context_expr
+                    if (
+                        isinstance(ce, ast.Call)
+                        and _call_name(ce.func) == "atomic_write"
+                        and isinstance(item.optional_vars, ast.Name)
+                    ):
+                        self._atomic_names.add(item.optional_vars.id)
         self._check_module_caches(tree)
         self.visit(tree)
         return self.findings
@@ -360,6 +393,7 @@ class _Checker(ast.NodeVisitor):
                 )
         # lambda smuggled into an executable-cache key
         self._check_sync_call(node)
+        self._check_durable_open(node)
         self.generic_visit(node)
 
     def visit_Subscript(self, node: ast.Subscript) -> None:
@@ -496,6 +530,33 @@ class _Checker(ast.NodeVisitor):
                 "with '# graftlint: host-sync'",
             )
 
+    # -- G007: direct write-mode open() on a durable path ---------------------
+    def _check_durable_open(self, node: ast.Call) -> None:
+        if not self.durable:
+            return
+        if not (isinstance(node.func, ast.Name) and node.func.id == "open"):
+            return
+        mode = node.args[1] if len(node.args) > 1 else None
+        for kw in node.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+        # no/dynamic mode: default "r", or unprovable — only a literal
+        # write-capable mode is a definite bypass of the atomic layer
+        if not (isinstance(mode, ast.Constant) and isinstance(mode.value, str)):
+            return
+        if not any(c in mode.value for c in "wax+"):
+            return
+        target = node.args[0] if node.args else None
+        if isinstance(target, ast.Name) and target.id in self._atomic_names:
+            return  # staged temp path from ``with atomic_write(...) as <name>``
+        self._emit(
+            "G007", node,
+            f"open(..., {mode.value!r}) on a durable path writes in place — a "
+            "crash mid-write leaves a torn file; stage through core._atomic "
+            "(atomic_write/atomic_write_bytes: temp file + fsync + rename), or "
+            "waive an intentional in-place write with '# graftlint: durable-write'",
+        )
+
     # -- G005: unordered iteration feeding collectives / cache keys -----------
     def _is_set_expr(self, node: ast.expr) -> bool:
         if isinstance(node, ast.Set) or isinstance(node, ast.SetComp):
@@ -594,7 +655,9 @@ def lint_source(
         tree = ast.parse(source, filename=path)
     except SyntaxError as e:
         return [Finding("SYNTAX", path, e.lineno or 0, e.offset or 0, str(e.msg))]
-    checker = _Checker(path, hot=_is_hot(path, pragmas))
+    checker = _Checker(
+        path, hot=_is_hot(path, pragmas), durable=_is_durable(path, pragmas)
+    )
     findings = checker.check(tree)
     lines = source.splitlines()
 
@@ -650,10 +713,10 @@ def lint_paths(
 
 
 def exit_code_for(findings: Iterable[Finding]) -> int:
-    """Per-rule exit bitmask: G001=1, G002=2, ... G006=32; syntax errors=64."""
+    """Per-rule exit bitmask: G001=1, G002=2, ... G007=64; syntax errors=128."""
     code = 0
     for f in findings:
-        code |= RULES[f.rule].bit if f.rule in RULES else 64
+        code |= RULES[f.rule].bit if f.rule in RULES else 128
     return code
 
 
@@ -718,12 +781,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         unknown = select - set(RULES)
         if unknown:
             print(f"graftlint: unknown rule id(s): {sorted(unknown)}", file=sys.stderr)
-            return 64
+            return 128
     try:
         findings, files_checked = lint_paths(args.paths, select=select)
     except OSError as e:
         print(f"graftlint: {e}", file=sys.stderr)
-        return 64
+        return 128
     report = build_report(args.paths, findings, files_checked)
     if args.format == "json":
         print(json.dumps(report, separators=(",", ":"), sort_keys=True))

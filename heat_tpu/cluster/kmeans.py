@@ -20,7 +20,7 @@ import jax.numpy as jnp
 from ..core import types
 from ..core.dndarray import DNDarray
 from ..spatial.distance import _quadratic_expand
-from ._kcluster import _KCluster
+from ._kcluster import _BLOCK_PROGRAMS, _KCluster
 
 __all__ = ["KMeans"]
 
@@ -82,6 +82,33 @@ def _lloyd_fit(xa: jnp.ndarray, centers: jnp.ndarray, k: int, max_iter: int, tol
     return c, labels, i
 
 
+def _lloyd_block_program(k: int):
+    """Cached jitted bounded-chunk Lloyd loop (supervised fits): like
+    :func:`_lloyd_fit` but with a dynamic iteration budget and the shift
+    carried in/out, so chained chunks reproduce the whole-fit sequence."""
+    key = ("kmeans", k)
+    prog = _BLOCK_PROGRAMS.get(key)
+    if prog is None:
+
+        def block(xa, centers, budget, tol, n_valid, shift0):
+            def cond(state):
+                i, _, _, shift = state
+                return jnp.logical_and(i < budget, shift > tol)
+
+            def body(state):
+                i, c, _, _ = state
+                new_c, labels, shift = _lloyd_body(xa, c, k, n_valid)
+                return (i + 1, new_c, labels, shift)
+
+            n = xa.shape[0]
+            state0 = (jnp.int32(0), centers, jnp.zeros((n,), jnp.int32), shift0)
+            i, c, labels, shift = jax.lax.while_loop(cond, body, state0)
+            return c, labels, i, shift
+
+        _BLOCK_PROGRAMS[key] = jax.jit(block)
+        prog = _BLOCK_PROGRAMS[key]
+    return prog
+
 
 class KMeans(_KCluster):
     """K-Means with Lloyd's algorithm (reference ``kmeans.py:21``).
@@ -108,15 +135,35 @@ class KMeans(_KCluster):
             random_state=random_state,
         )
 
-    def fit(self, x: DNDarray) -> "KMeans":
+    def _prep_fit(self, x: DNDarray) -> jnp.ndarray:
+        # keep the padded buffer; _lloyd_body masks with the valid count
+        return x.larray.astype(jnp.promote_types(x.larray.dtype, jnp.float32))
+
+    def _supervised_step(self, xa, centers, budget, tol, shift0, x):
+        prog = _lloyd_block_program(self.n_clusters)
+        return prog(xa, centers, budget, tol, jnp.int32(x.gshape[0]), shift0)
+
+    def _finalize_supervised(self, result) -> None:
+        x = result.data[0]  # on the final (possibly shrunken) mesh
+        xa = self._prep_fit(x)
+        self._inertia = float(
+            _inertia(xa, self._cluster_centers.larray.astype(xa.dtype),
+                     self.n_clusters, x.gshape[0])
+        )
+
+    def fit(self, x: DNDarray, supervisor=None, block_iters: int = 16) -> "KMeans":
         """Lloyd iterations until the centroid shift drops below tol
-        (reference ``kmeans.py:102-135``)."""
+        (reference ``kmeans.py:102-135``). With ``supervisor`` the fit
+        runs as a self-healing supervised step loop (one step = one
+        jitted chunk of up to ``block_iters`` iterations)."""
         if not isinstance(x, DNDarray):
             raise TypeError(f"input needs to be a DNDarray, but was {type(x)}")
         if x.ndim != 2:
             raise ValueError(f"input needs to be 2D, but was {x.ndim}D")
         if self.max_iter < 1:
             raise ValueError(f"max_iter must be >= 1, got {self.max_iter}")
+        if supervisor is not None:
+            return self._fit_supervised(x, supervisor, block_iters, "kmeans.fit")
         k = self.n_clusters
         xa = x.larray.astype(jnp.promote_types(x.larray.dtype, jnp.float32))
         n = x.gshape[0]

@@ -15,7 +15,7 @@ import jax.numpy as jnp
 from ..core import types
 from ..core.dndarray import DNDarray
 from ..spatial.distance import _manhattan as _l1_distance
-from ._kcluster import _KCluster
+from ._kcluster import _BLOCK_PROGRAMS, _KCluster, _block_fit
 
 __all__ = ["KMedians"]
 
@@ -43,6 +43,22 @@ def _median_fit(xa: jnp.ndarray, centers: jnp.ndarray, k: int, max_iter, tol):
     return _whole_fit(lambda x, c: _median_step(x, c, k), xa, centers, max_iter, tol)
 
 
+def _median_block_program(k: int):
+    """Cached jitted bounded-chunk median loop (supervised fits)."""
+    key = ("kmedians", k)
+    prog = _BLOCK_PROGRAMS.get(key)
+    if prog is None:
+
+        def block(xa, centers, budget, tol, shift0):
+            return _block_fit(
+                lambda x, c: _median_step(x, c, k), xa, centers, budget, tol, shift0
+            )
+
+        _BLOCK_PROGRAMS[key] = jax.jit(block)
+        prog = _BLOCK_PROGRAMS[key]
+    return prog
+
+
 class KMedians(_KCluster):
     """K-Medians (reference ``kmedians.py:12``)."""
 
@@ -63,12 +79,19 @@ class KMedians(_KCluster):
             random_state=random_state,
         )
 
-    def fit(self, x: DNDarray) -> "KMedians":
-        """reference ``kmedians.py``"""
+    def _supervised_step(self, xa, centers, budget, tol, shift0, x):
+        prog = _median_block_program(self.n_clusters)
+        return prog(xa, centers, budget, tol, shift0)
+
+    def fit(self, x: DNDarray, supervisor=None, block_iters: int = 16) -> "KMedians":
+        """reference ``kmedians.py``; with ``supervisor`` the fit runs as
+        a self-healing supervised step loop."""
         if not isinstance(x, DNDarray):
             raise TypeError(f"input needs to be a DNDarray, but was {type(x)}")
         if self.max_iter < 1:
             raise ValueError(f"max_iter must be >= 1, got {self.max_iter}")
+        if supervisor is not None:
+            return self._fit_supervised(x, supervisor, block_iters, "kmedians.fit")
         k = self.n_clusters
         xa = x._logical().astype(jnp.promote_types(x.larray.dtype, jnp.float32))
         centers = self._initialize_cluster_centers(x).astype(xa.dtype)

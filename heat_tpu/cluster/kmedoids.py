@@ -15,7 +15,7 @@ import jax.numpy as jnp
 from ..core import types
 from ..core.dndarray import DNDarray
 from ..spatial.distance import _manhattan as _l1_distance
-from ._kcluster import _KCluster
+from ._kcluster import _BLOCK_PROGRAMS, _KCluster, _block_fit
 
 __all__ = ["KMedoids"]
 
@@ -51,6 +51,22 @@ def _medoid_fit(xa: jnp.ndarray, centers: jnp.ndarray, k: int, max_iter):
     )
 
 
+def _medoid_block_program(k: int):
+    """Cached jitted bounded-chunk medoid loop (supervised fits)."""
+    key = ("kmedoids", k)
+    prog = _BLOCK_PROGRAMS.get(key)
+    if prog is None:
+
+        def block(xa, centers, budget, tol, shift0):
+            return _block_fit(
+                lambda x, c: _medoid_step(x, c, k), xa, centers, budget, tol, shift0
+            )
+
+        _BLOCK_PROGRAMS[key] = jax.jit(block)
+        prog = _BLOCK_PROGRAMS[key]
+    return prog
+
+
 class KMedoids(_KCluster):
     """K-Medoids with snap-to-point update (reference ``kmedoids.py:12``)."""
 
@@ -70,12 +86,19 @@ class KMedoids(_KCluster):
             random_state=random_state,
         )
 
-    def fit(self, x: DNDarray) -> "KMedoids":
-        """reference ``kmedoids.py``"""
+    def _supervised_step(self, xa, centers, budget, tol, shift0, x):
+        prog = _medoid_block_program(self.n_clusters)
+        return prog(xa, centers, budget, tol, shift0)
+
+    def fit(self, x: DNDarray, supervisor=None, block_iters: int = 16) -> "KMedoids":
+        """reference ``kmedoids.py``; with ``supervisor`` the fit runs as
+        a self-healing supervised step loop."""
         if not isinstance(x, DNDarray):
             raise TypeError(f"input needs to be a DNDarray, but was {type(x)}")
         if self.max_iter < 1:
             raise ValueError(f"max_iter must be >= 1, got {self.max_iter}")
+        if supervisor is not None:
+            return self._fit_supervised(x, supervisor, block_iters, "kmedoids.fit")
         k = self.n_clusters
         xa = x._logical().astype(jnp.promote_types(x.larray.dtype, jnp.float32))
         centers = self._initialize_cluster_centers(x).astype(xa.dtype)

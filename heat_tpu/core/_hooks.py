@@ -43,11 +43,13 @@ def fault_point(name: str, **ctx) -> Dict:
     """Declare a fault-injection site.
 
     ``name`` is a dotted site id (``"io.open"``, ``"io.commit"``,
-    ``"collective.assemble"``, ``"checkpoint.shard_bytes"`` ...). The
-    installed injector may raise (OSError, TimeoutError, ...) to simulate
-    a failure at this site, or mutate mutable ``ctx`` entries (e.g. a
-    ``bytearray`` payload) to simulate corruption. Returns ``ctx`` so call
-    sites can read mutated values back.
+    ``"collective.assemble"``, ``"checkpoint.shard_bytes"``,
+    ``"supervisor.step"`` — the last fires before every supervised step,
+    the injection point for step-level faults including simulated device
+    loss). The installed injector may raise (OSError, TimeoutError, ...)
+    to simulate a failure at this site, or mutate mutable ``ctx`` entries
+    (e.g. a ``bytearray`` payload) to simulate corruption. Returns ``ctx``
+    so call sites can read mutated values back.
     """
     if _OBSERVERS:
         # the existing fault sites double as instrumentation points: every
@@ -122,8 +124,10 @@ def remove_observer(fn):
 
 def observe(event: str, **ctx) -> None:
     """Report an instrumentation event (``"cache.insert"``,
-    ``"host.gather"``, ...). Free when no observer is installed: one
-    falsy check on the hot path."""
+    ``"host.gather"``, ... — and the ``"recovery.*"`` family emitted by
+    :mod:`heat_tpu.resilience.supervisor`, which its ``RECOVERY_STATS``
+    observer counts). Free when no observer is installed: one falsy
+    check on the hot path."""
     if _OBSERVERS:
         for fn in tuple(_OBSERVERS):
             fn(event, ctx)

@@ -69,8 +69,18 @@ class RetryPolicy:
         Only these are retried; anything else propagates immediately.
     seed : int, optional
         Seeds the jitter stream for reproducible delay sequences.
+    max_elapsed : float, optional
+        Total wall-clock budget in seconds across ALL attempts. A retry
+        whose backoff sleep would carry the elapsed time past the budget
+        is not taken: the policy gives up immediately with a
+        :class:`RetryError` instead. This bounds the worst case of a
+        retry storm — a supervised step's retries can never outlast its
+        checkpoint interval. ``None`` (default) means unbounded.
     sleep : callable
         Injection point for tests (defaults to ``time.sleep``).
+    clock : callable
+        Monotonic-time source for the ``max_elapsed`` budget (injection
+        point for tests; defaults to ``time.monotonic``).
     """
 
     max_attempts: int = 3
@@ -80,13 +90,17 @@ class RetryPolicy:
     jitter: float = 0.1
     retry_on: Tuple[Type[BaseException], ...] = (OSError, TimeoutError)
     seed: Optional[int] = None
+    max_elapsed: Optional[float] = None
     sleep: Callable[[float], None] = field(default=_time.sleep, repr=False)
+    clock: Callable[[], float] = field(default=_time.monotonic, repr=False)
 
     def __post_init__(self):
         if self.max_attempts < 1:
             raise ValueError(f"max_attempts must be >= 1, got {self.max_attempts}")
         if self.base_delay < 0 or self.max_delay < 0:
             raise ValueError("delays must be non-negative")
+        if self.max_elapsed is not None and self.max_elapsed < 0:
+            raise ValueError(f"max_elapsed must be >= 0, got {self.max_elapsed}")
 
     def delays(self) -> List[float]:
         """The (deterministic given ``seed``) delay schedule: one entry per
@@ -109,11 +123,24 @@ class RetryPolicy:
         label = label or getattr(fn, "__name__", "operation")
         attempts: List[Tuple[int, BaseException, Optional[float]]] = []
         schedule = self.delays()
+        t0 = self.clock()
         for i in range(self.max_attempts):
             try:
                 return fn(*args, **kwargs)
             except self.retry_on as exc:
                 delay = schedule[i] if i < len(schedule) else None
+                if delay is not None and self.max_elapsed is not None:
+                    # a sleep that would carry us past the budget is never
+                    # taken: give up NOW, so a retry storm is bounded by
+                    # max_elapsed rather than by the full attempt schedule
+                    if (self.clock() - t0) + delay > self.max_elapsed:
+                        attempts.append((i, exc, None))
+                        err = RetryError(
+                            f"{label} (wall-clock budget max_elapsed="
+                            f"{self.max_elapsed}s exhausted)",
+                            attempts,
+                        )
+                        raise err from exc
                 attempts.append((i, exc, delay))
                 if delay is None:
                     err = RetryError(label, attempts)

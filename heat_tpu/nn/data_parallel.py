@@ -19,10 +19,32 @@ from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core import random as ht_random
 from ..core.communication import MeshCommunication, sanitize_comm
 from ..core.dndarray import DNDarray
+
+
+def _flatten_tree(prefix: str, tree) -> dict:
+    """Pytree -> flat ``{prefix/keypath: numpy leaf}`` dict (host values)."""
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        out[prefix + jax.tree_util.keystr(path)] = np.asarray(jax.device_get(leaf))
+    return out
+
+
+def _load_tree(prefix: str, tree, d: dict):
+    """Replace ``tree``'s leaves with the matching entries of ``d``
+    (missing keys keep the live leaf; dtypes are preserved)."""
+
+    def restore(path, leaf):
+        key = prefix + jax.tree_util.keystr(path)
+        if key not in d:
+            return leaf
+        return jnp.asarray(d[key], dtype=leaf.dtype)
+
+    return jax.tree_util.tree_map_with_path(restore, tree)
 
 __all__ = ["DataParallel", "DataParallelMultiGPU"]
 
@@ -192,6 +214,82 @@ class DataParallel:
         )
         self._last_loss = loss
         return loss
+
+    # -- resumable training ---------------------------------------------------
+    def state_dict(self) -> dict:
+        """Model + optimizer state as a flat dict of host numpy arrays
+        (keys are pytree key-paths) plus JSON scalars — the checkpointable
+        unit for a supervised ``fit``."""
+        if self.params is None:
+            raise RuntimeError("init must be called before state_dict")
+        d = _flatten_tree("params", self.params)
+        if self._opt_state is not None:
+            d.update(_flatten_tree("opt", self._opt_state))
+        d["seed"] = self._seed
+        return d
+
+    def load_state_dict(self, d: dict) -> "DataParallel":
+        """Restore :meth:`state_dict` output into an initialized model
+        (the live pytree structure provides the placement; values come
+        from ``d``)."""
+        if self.params is None:
+            raise RuntimeError("init must be called before load_state_dict")
+        self.params = _load_tree("params", self.params, d)
+        if self._opt_state is not None:
+            self._opt_state = _load_tree("opt", self._opt_state, d)
+        self._last_loss = None
+        return self
+
+    def fit(
+        self,
+        loss_fn: Callable,
+        batch,
+        labels,
+        n_steps: int,
+        supervisor=None,
+        steps_per_block: int = 8,
+    ) -> "DataParallel":
+        """Run ``n_steps`` of :meth:`train_step`.
+
+        With ``supervisor`` the loop runs as a self-healing supervised
+        step loop: one supervised step = ``steps_per_block`` train steps,
+        and the block boundary is where the model state is checkpointed
+        and restored. A ``version`` token in the state detects restores —
+        when the supervisor rewinds, the checkpointed state is loaded
+        back into the model before training resumes.
+        """
+        if self.params is None:
+            self.init(batch)
+        if supervisor is None:
+            for _ in range(n_steps):
+                self.train_step(loss_fn, batch, labels)
+            return self
+        if steps_per_block < 1:
+            raise ValueError(f"steps_per_block must be >= 1, got {steps_per_block}")
+
+        self._fit_version = 0
+        state = dict(self.state_dict())
+        state["step"] = 0
+        state["version"] = 0
+
+        def step_fn(st, data, blk):
+            if st["version"] != self._fit_version:
+                # this state came from a checkpoint, not the live model
+                self.load_state_dict(st)
+                self._fit_version = st["version"]
+            n_do = min(steps_per_block, n_steps - st["step"])
+            for _ in range(n_do):
+                self.train_step(loss_fn, *data)
+            new = dict(self.state_dict())
+            new["step"] = st["step"] + n_do
+            new["version"] = st["version"] + 1
+            self._fit_version = new["version"]
+            return new, new["step"] >= n_steps
+
+        result = supervisor.run(step_fn, state, data=(batch, labels), label="nn.fit")
+        if result.state is not None and result.state["version"] != self._fit_version:
+            self.load_state_dict(result.state)
+        return self
 
     # -- reference-API conveniences ------------------------------------------
     def eval(self):

@@ -60,6 +60,15 @@ class DataParallelOptimizer:
         self.batches_completed += 1
         return loss
 
+    def state_dict(self) -> dict:
+        """Bookkeeping state (the wrapped transformation's state lives in
+        the bound model's ``state_dict``)."""
+        return {"batches_completed": self.batches_completed}
+
+    def load_state_dict(self, d: dict) -> "DataParallelOptimizer":
+        self.batches_completed = int(d.get("batches_completed", 0))
+        return self
+
     def zero_grad(self) -> None:
         """No-op: JAX gradients are functional, never accumulated in place."""
 
@@ -309,6 +318,53 @@ class DASO:
         # Callers fetch lazily when they actually need the number; the
         # whole step is transfer-free (asserted in test_nn_optim).
         return params, loss
+
+    def state_dict(self, params=None) -> dict:
+        """Schedule counters + optimizer state (+ the replica-stacked
+        ``params`` when given) as a flat host dict, the checkpointable
+        unit for a supervised DASO training loop. An in-flight delayed
+        average (``_pending``) is intentionally NOT captured: on restore
+        the replicas simply train until the next scheduled sync, which is
+        within DASO's stale-update semantics anyway."""
+        from ..nn.data_parallel import _flatten_tree
+
+        d = {
+            "global_skip": self.global_skip,
+            "batches_to_wait": self.batches_to_wait,
+            "epoch": self.epoch,
+            "batch": self._batch,
+        }
+        if self._opt_state is not None:
+            d.update(_flatten_tree("opt", self._opt_state))
+        if params is not None:
+            d.update(_flatten_tree("params", params))
+        return d
+
+    def load_state_dict(self, d: dict, params=None):
+        """Restore :meth:`state_dict` output into an ``init``-ed DASO.
+        Returns the restored replica-stacked params when ``params`` (a
+        live tree supplying structure/placement) is given, else None."""
+        from ..nn.data_parallel import _load_tree
+
+        self.global_skip = int(d["global_skip"])
+        self.batches_to_wait = int(d["batches_to_wait"])
+        self.epoch = int(d["epoch"])
+        self._batch = int(d["batch"])
+        self._pending = None
+        self._last_loss = None
+        if self._opt_state is not None:
+            # capture the live placement BEFORE swapping values in, then
+            # re-put so restored leaves land exactly where the old ones were
+            shardings = jax.tree_util.tree_map(lambda x: x.sharding, self._opt_state)
+            self._opt_state = jax.device_put(
+                _load_tree("opt", self._opt_state, d), shardings
+            )
+        if params is not None:
+            restored = _load_tree("params", params, d)
+            if self._param_shardings is not None:
+                restored = jax.device_put(restored, self._param_shardings)
+            return restored
+        return None
 
     def consolidated_params(self, params):
         """Average the replicas into a single parameter tree (end of

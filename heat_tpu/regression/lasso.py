@@ -70,6 +70,27 @@ def _cd_fit(X: jnp.ndarray, y: jnp.ndarray, theta: jnp.ndarray, lam, tol, max_it
     return th, i
 
 
+@jax.jit
+def _cd_block(X, y, theta, lam, tol, budget, diff0):
+    """One bounded chunk of :func:`_cd_fit`: up to ``budget`` sweeps with
+    the convergence ``diff`` carried in/out, so chained chunks execute
+    exactly the whole-fit sweep sequence. This is the supervised-fit unit
+    — the chunk boundary is where a supervisor checkpoints ``theta`` and
+    recovers from faults. Returns (theta, sweeps_done, diff)."""
+
+    def cond(carry):
+        i, _, diff = carry
+        return jnp.logical_and(i < budget, diff >= tol)
+
+    def body(carry):
+        i, th, _ = carry
+        nt = _cd_sweep(X, y, th, lam)
+        return (i + 1, nt, jnp.max(jnp.abs(nt - th)))
+
+    i, th, diff = jax.lax.while_loop(cond, body, (jnp.int32(0), theta, diff0))
+    return th, i, diff
+
+
 class Lasso(BaseEstimator, RegressionMixin):
     """L1-regularized linear regression via coordinate descent (reference
     ``lasso.py:10``).
@@ -113,12 +134,79 @@ class Lasso(BaseEstimator, RegressionMixin):
         diff = gt._logical().ravel() - yest._logical().ravel()
         return float(jnp.sqrt(jnp.mean(diff * diff)))
 
-    def fit(self, x: DNDarray, y: DNDarray) -> "Lasso":
-        """reference ``lasso.py:fit``"""
+    def state_dict(self) -> dict:
+        """Fitted + hyper state as plain host values."""
+        d = {"lam": self.lam, "max_iter": self.max_iter, "tol": self.tol,
+             "n_iter": self.n_iter}
+        if self.__theta is not None:
+            d["theta"] = self.__theta.numpy()
+        return d
+
+    def load_state_dict(self, d: dict, comm=None) -> "Lasso":
+        """Restore :meth:`state_dict` output onto the current mesh."""
+        self.lam = float(d["lam"])
+        self.max_iter = int(d["max_iter"])
+        self.tol = d["tol"]
+        self.n_iter = d.get("n_iter")
+        th = d.get("theta")
+        self.__theta = None if th is None else DNDarray(th, split=None, comm=comm)
+        return self
+
+    def _fit_supervised(self, x: DNDarray, y: DNDarray, supervisor, block_iters: int):
+        """Drive coordinate descent as a supervised step loop: one step =
+        one jitted chunk of up to ``block_iters`` sweeps (see
+        :func:`_cd_block`); the supervisor checkpoints ``theta`` at chunk
+        boundaries and recovers per its fault policy."""
+        if block_iters < 1:
+            raise ValueError(f"block_iters must be >= 1, got {block_iters}")
+        max_iter = self.max_iter
+        tol = float(self.tol)
+        X0 = x._logical().astype(jnp.promote_types(x.larray.dtype, jnp.float32))
+        m = X0.shape[1]
+        state = {
+            "theta": DNDarray(jnp.zeros((m, 1), X0.dtype), split=None,
+                              device=x.device, comm=x.comm),
+            "diff": float("inf"),
+            "n_iter": 0,
+        }
+
+        def step_fn(st, data, step):
+            xd, yd = data
+            X = xd._logical().astype(jnp.promote_types(xd.larray.dtype, jnp.float32))
+            Y = yd._logical().astype(X.dtype).ravel()
+            theta = st["theta"].larray.astype(X.dtype).ravel()
+            budget = min(block_iters, max_iter - st["n_iter"])
+            th, sweeps, diff = _cd_block(
+                X, Y, theta,
+                jnp.asarray(self.lam, X.dtype),
+                jnp.asarray(tol, X.dtype),
+                jnp.int32(budget),
+                jnp.asarray(st["diff"], X.dtype),
+            )
+            diff_val = float(jax.device_get(diff))
+            new = dict(st)
+            new["theta"] = DNDarray(th.reshape(-1, 1), split=None,
+                                    device=xd.device, comm=xd.comm)
+            new["diff"] = diff_val
+            new["n_iter"] = st["n_iter"] + int(jax.device_get(sweeps))
+            return new, diff_val < tol or new["n_iter"] >= max_iter
+
+        result = supervisor.run(step_fn, state, data=(x, y), label="lasso.fit")
+        final = result.state
+        self.n_iter = int(final["n_iter"])
+        self.__theta = final["theta"]
+        return self
+
+    def fit(self, x: DNDarray, y: DNDarray, supervisor=None,
+            block_iters: int = 16) -> "Lasso":
+        """reference ``lasso.py:fit``; with ``supervisor`` the fit runs as
+        a self-healing supervised step loop."""
         if not isinstance(x, DNDarray) or not isinstance(y, DNDarray):
             raise TypeError(f"input needs to be DNDarrays, but were {type(x)}, {type(y)}")
         if x.ndim != 2:
             raise ValueError(f"x needs to be 2D, but was {x.ndim}D")
+        if supervisor is not None:
+            return self._fit_supervised(x, y, supervisor, block_iters)
         X = x._logical().astype(jnp.promote_types(x.larray.dtype, jnp.float32))
         Y = y._logical().astype(X.dtype).ravel()
         m = X.shape[1]

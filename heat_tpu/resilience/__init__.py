@@ -31,17 +31,30 @@ Runtime guards (PR 2):
   mesh over the surviving devices and redistribute live arrays (elastic
   restore logic), so a bad device means a smaller mesh, not a dead job.
 
+Supervised execution (PR 6):
+
+- :mod:`~heat_tpu.resilience.supervisor` — the self-healing loop that
+  composes all of the above: :class:`Supervisor` /
+  :func:`supervise` drive any iterative workload as a checkpointed step
+  loop (:class:`CheckpointSchedule` cadence + keep-last-k retention)
+  with a fault-classification policy — transient I/O retried, divergence
+  and collective timeouts restored from the last good checkpoint, lost
+  devices recovered by probe + shrink + elastic restore onto the
+  surviving mesh. Recovery activity is counted in
+  :data:`RECOVERY_STATS`.
+
 Chaos (:mod:`~heat_tpu.resilience.chaos`) injects every failure class
 deterministically — I/O errors, torn writes, silent corruption,
-timeouts, stragglers, replica divergence — so all of the above is
-testable on CPU.
+timeouts, stragglers, replica divergence, device loss — either
+probabilistically (:class:`chaos`) or as an exact scripted
+:class:`FaultSchedule`, so all of the above is testable on CPU.
 
 Every guard-layer failure derives from :class:`ResilienceError`
 (:mod:`~heat_tpu.resilience.errors`); see ``docs/RESILIENCE.md`` for the
 failure taxonomy, manifest format, and chaos recipes.
 """
 from . import chaos as _chaos_mod  # noqa: F401
-from .chaos import Injection, chaos
+from .chaos import FaultSchedule, Injection, chaos
 from .checkpoint import (
     CHECKPOINT_FORMAT,
     CheckpointCorruptionError,
@@ -69,6 +82,15 @@ from .errors import (
 from .guard import Fingerprint, Guard, fingerprint, guarded
 from .guard import check as check_divergence
 from .retry import DEFAULT_CHECKPOINT_POLICY, NO_RETRY, RetryError, RetryPolicy
+from .supervisor import (
+    RECOVERY_STATS,
+    CheckpointSchedule,
+    Supervisor,
+    SupervisorError,
+    SupervisorResult,
+    reset_recovery_stats,
+    supervise,
+)
 from .validate import ValidationError, validate
 from .watchdog import deadlines, with_deadline
 
@@ -76,6 +98,7 @@ __all__ = [
     # chaos
     "chaos",
     "Injection",
+    "FaultSchedule",
     # checkpoint
     "save_checkpoint",
     "load_checkpoint",
@@ -114,4 +137,12 @@ __all__ = [
     "healthy_devices",
     "probe",
     "shrink_to_healthy",
+    # supervisor
+    "Supervisor",
+    "SupervisorError",
+    "SupervisorResult",
+    "supervise",
+    "CheckpointSchedule",
+    "RECOVERY_STATS",
+    "reset_recovery_stats",
 ]

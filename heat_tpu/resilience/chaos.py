@@ -28,28 +28,39 @@ single uniform draw):
   ``replica`` index): the host bytes of a NON-primary replica are
   perturbed silently, so the same logical shard digests differently
   across its replica group — the injected silently-diverged replica that
-  :func:`~heat_tpu.resilience.guard.guarded` must catch.
+  :func:`~heat_tpu.resilience.guard.guarded` must catch;
+- ``device_loss`` — supervisor sites only (``supervisor.step``): one
+  healthy device of the default mesh is marked unhealthy
+  (:func:`~heat_tpu.resilience.degrade.mark_unhealthy`) and a
+  ``RuntimeError`` is raised mid-step — the simulated died-accelerator
+  that only probe + :func:`shrink_to_healthy` can recover from.
 
 ``max_faults`` caps the total number of injected faults, after which all
 sites pass — the standard recipe for "transient" faults that a
 RetryPolicy must survive: ``chaos(io_error=1.0, max_faults=2)`` fails the
 first two attempts and lets the third through, deterministically.
+
+For recovery *proofs* the probabilistic stream is the wrong tool — "the
+soak injected at least one device loss" cannot be guaranteed by any
+probability below 1. :class:`FaultSchedule` is the deterministic
+complement: an explicit list of ``(site, nth_hit, kind)`` events, each
+fired exactly once when its site is hit the scheduled number of times.
 """
 from __future__ import annotations
 
 import random
 import time
 from dataclasses import dataclass, field
-from typing import List, Optional, Sequence
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..core import _hooks
 
-__all__ = ["chaos", "Injection"]
+__all__ = ["chaos", "Injection", "FaultSchedule"]
 
 # site categories a chaos context can target (site id prefix before ".")
-_KNOWN_TARGETS = ("io", "collective", "checkpoint", "guard", "degrade")
+_KNOWN_TARGETS = ("io", "collective", "checkpoint", "guard", "degrade", "supervisor")
 
 
 @dataclass
@@ -59,6 +70,21 @@ class Injection:
     site: str
     kind: str
     detail: str = ""
+
+
+def _lose_device(u: float) -> Optional[int]:
+    """Mark one healthy device of the default mesh unhealthy; returns its
+    id, or None when fewer than two devices survive (losing the last
+    device would make every recovery impossible by construction — chaos
+    simulates faults the stack is supposed to absorb)."""
+    from . import degrade  # runtime import: chaos sits below degrade's users
+
+    devs = degrade.healthy_devices()
+    if len(devs) <= 1:
+        return None
+    dev = devs[int(u * 997) % len(devs)]
+    degrade.mark_unhealthy(dev)
+    return int(dev.id)
 
 
 @dataclass
@@ -86,6 +112,7 @@ class chaos:
     corrupt: float = 0.0
     straggler: float = 0.0
     divergence: float = 0.0
+    device_loss: float = 0.0
     straggler_delay: float = 0.05
     targets: Sequence[str] = _KNOWN_TARGETS
     max_faults: Optional[int] = None
@@ -96,7 +123,8 @@ class chaos:
         unknown = set(self.targets) - set(_KNOWN_TARGETS)
         if unknown:
             raise ValueError(f"unknown chaos targets {sorted(unknown)}; known: {_KNOWN_TARGETS}")
-        for knob in ("io_error", "timeout", "torn_write", "corrupt", "straggler", "divergence"):
+        for knob in ("io_error", "timeout", "torn_write", "corrupt", "straggler",
+                     "divergence", "device_loss"):
             p = getattr(self, knob)
             if not 0.0 <= p <= 1.0:
                 raise ValueError(f"{knob} must be a probability in [0, 1], got {p}")
@@ -177,9 +205,163 @@ class chaos:
                 Injection(site, "straggler", f"slept {self.straggler_delay}s")
             )
             time.sleep(self.straggler_delay)  # then proceed: slow, not dead
+            return
+        if site.startswith("supervisor."):
+            threshold += self.device_loss
+            if u < threshold:
+                dev = _lose_device(u)
+                if dev is not None:
+                    self.injected.append(Injection(site, "device_loss", f"device {dev}"))
+                    raise RuntimeError(
+                        f"chaos[{site}]: device {dev} lost (simulated accelerator failure)"
+                    )
 
     # -- reporting ---------------------------------------------------------
     def report(self) -> str:
         lines = [f"chaos(seed={self.seed}): {len(self.injected)} fault(s) in {self.draws} draw(s)"]
         lines += [f"  {i.kind:>10} @ {i.site} {i.detail}".rstrip() for i in self.injected]
+        return "\n".join(lines)
+
+
+_SCHEDULED_KINDS = (
+    "io_error", "timeout", "torn_write", "corrupt", "straggler",
+    "divergence", "device_loss",
+)
+
+
+def _apply_fault(kind: str, site: str, ctx: dict, u: float, straggler_delay: float) -> Optional[str]:
+    """Apply one fault ``kind``'s effect at ``site``. Returns a detail
+    string when the fault actually fired, or None when the site cannot
+    carry that kind (e.g. a torn write at a payload-less site) — the
+    caller keeps the event pending for a later eligible hit."""
+    payload = ctx.get("payload")
+    array = ctx.get("array")
+    replica = ctx.get("replica")
+    if kind == "io_error":
+        raise OSError(f"chaos[{site}]: injected I/O failure")
+    if kind == "timeout":
+        raise TimeoutError(f"chaos[{site}]: injected timeout")
+    if kind == "straggler":
+        time.sleep(straggler_delay)
+        return f"slept {straggler_delay}s"
+    if kind == "torn_write":
+        if payload is None:
+            return None
+        cut = max(1, len(payload) // 2)
+        del payload[cut:]
+        detail = f"truncated to {cut}B"
+        err = OSError(f"chaos[{site}]: torn write (crashed mid-buffer)")
+        err.chaos_detail = detail
+        raise err
+    if kind == "corrupt":
+        if payload is not None and len(payload):
+            pos = min(len(payload) - 1, 128 + int(u * 1000) % max(1, len(payload) - 128))
+            payload[pos] ^= 0xFF
+            return f"flipped byte {pos}"
+        if array is not None and np.issubdtype(array.dtype, np.floating) and array.size:
+            flat = array.reshape(-1)
+            flat[int(u * 1000) % flat.size] = np.nan
+            return "planted NaN"
+        return None
+    if kind == "divergence":
+        # only a NON-primary replica diverges (see chaos docs above)
+        if array is None or replica in (None, 0) or not array.size:
+            return None
+        view = array.reshape(-1).view(np.uint8)
+        pos = int(u * 1000) % view.size
+        view[pos] ^= 0xFF
+        return f"replica {replica} byte {pos}"
+    if kind == "device_loss":
+        dev = _lose_device(u)
+        if dev is None:
+            return None
+        err = RuntimeError(
+            f"chaos[{site}]: device {dev} lost (simulated accelerator failure)"
+        )
+        err.chaos_detail = f"device {dev}"
+        raise err
+    raise ValueError(f"unknown scheduled fault kind {kind!r}; known: {_SCHEDULED_KINDS}")
+
+
+@dataclass
+class FaultSchedule:
+    """Deterministic fault injection from an explicit event list.
+
+    ``events`` is a sequence of ``(site, nth_hit, kind)`` triples: when the
+    fault point ``site`` (exact id, or a prefix ending in ``.``) is hit for
+    the ``nth_hit``-th time inside the context, fault ``kind`` fires — once.
+    An event whose site cannot carry the kind at that hit (a torn write at
+    a payload-less site, a divergence at the primary replica) stays pending
+    for the next eligible hit of the same site, so a scheduled fault is
+    never silently dropped.
+
+    This is the recovery-*proof* complement of :class:`chaos`: the soak
+    harness (``tools/chaos_soak.py``) asserts "≥1 device loss, ≥1
+    divergence, ≥1 torn write were injected AND recovered", which only a
+    guaranteed schedule can promise. Same recording surface as chaos:
+    ``.injected`` holds one :class:`Injection` per fired event, and
+    ``.pending()`` lists events that never found an eligible hit (the soak
+    treats a non-empty pending list as a failed proof).
+    """
+
+    events: Sequence[Tuple[str, int, str]]
+    straggler_delay: float = 0.05
+    seed: int = 0
+    injected: List[Injection] = field(default_factory=list, init=False)
+
+    def __post_init__(self):
+        for site, nth, kind in self.events:
+            if kind not in _SCHEDULED_KINDS:
+                raise ValueError(f"unknown fault kind {kind!r}; known: {_SCHEDULED_KINDS}")
+            if nth < 1:
+                raise ValueError(f"nth_hit is 1-based, got {nth} for {site!r}")
+
+    def __enter__(self) -> "FaultSchedule":
+        self._hits: dict = {}
+        self._fired = [False] * len(self.events)
+        self._rng = random.Random(self.seed)
+        self.injected = []
+        self._prev = _hooks.set_injector(self._inject)
+        return self
+
+    def __exit__(self, *exc):
+        _hooks.set_injector(self._prev)
+        return False
+
+    def pending(self) -> List[Tuple[str, int, str]]:
+        """Events that have not fired (empty after a complete schedule)."""
+        return [e for e, fired in zip(self.events, self._fired) if not fired]
+
+    def _matches(self, pattern: str, site: str) -> bool:
+        return site == pattern or (pattern.endswith(".") and site.startswith(pattern))
+
+    def _inject(self, site: str, ctx: dict) -> None:
+        hits = self._hits[site] = self._hits.get(site, 0) + 1
+        for idx, (pattern, nth, kind) in enumerate(self.events):
+            if self._fired[idx] or not self._matches(pattern, site):
+                continue
+            if hits < nth:
+                continue
+            # at (or past, for a previously ineligible hit) the scheduled
+            # count: try to fire; an ineligible site keeps the event pending
+            u = self._rng.random()
+            try:
+                detail = _apply_fault(kind, site, ctx, u, self.straggler_delay)
+            except Exception as err:
+                self._fired[idx] = True
+                self.injected.append(
+                    Injection(site, kind, getattr(err, "chaos_detail", ""))
+                )
+                raise
+            if detail is not None:
+                self._fired[idx] = True
+                self.injected.append(Injection(site, kind, detail))
+            return  # at most one event per hit
+
+    def report(self) -> str:
+        lines = [
+            f"FaultSchedule: {len(self.injected)}/{len(self.events)} event(s) fired"
+        ]
+        lines += [f"  {i.kind:>11} @ {i.site} {i.detail}".rstrip() for i in self.injected]
+        lines += [f"  PENDING {kind} @ {site} (hit {nth})" for site, nth, kind in self.pending()]
         return "\n".join(lines)

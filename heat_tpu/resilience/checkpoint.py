@@ -204,7 +204,38 @@ def save_checkpoint(
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices("heat_tpu_checkpoint_manifest")
+    if jax.process_index() == 0:
+        _gc_stale_shards(directory, entries)
     return manifest_path
+
+
+def _gc_stale_shards(directory: str, entries: List[Dict]) -> int:
+    """Remove shard files not named by the just-committed manifest.
+
+    Re-saving into an existing directory from a smaller world writes fewer
+    (larger) shards at different offsets; without this sweep the previous
+    save's files survive next to the new manifest, and a later save at yet
+    another geometry could alias a stale offset. Runs after the manifest
+    commit, so a crash mid-GC leaves extra-but-ignored files, never a
+    broken checkpoint. Returns the number of files removed.
+    """
+    keep = {e["file"] for e in entries} | {MANIFEST_NAME}
+    removed = 0
+    for name in sorted(os.listdir(directory)):
+        if not (name.startswith("shard_") and name.endswith(".npy")):
+            continue
+        if name in keep:
+            continue
+        try:
+            os.remove(os.path.join(directory, name))
+            removed += 1
+        except OSError:
+            # best effort: a straggling file is ignored by the loader (it
+            # reads only manifest-named shards), so never fail the save
+            continue
+    if removed:
+        _hooks.observe("checkpoint.gc", directory=directory, removed=removed)
+    return removed
 
 
 def read_manifest(directory: str) -> Dict:

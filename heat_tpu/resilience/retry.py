@@ -9,7 +9,10 @@ defaults:
 - :data:`DEFAULT_CHECKPOINT_POLICY` — 3 attempts with exponential backoff,
   the default for checkpoint I/O, where transient filesystem hiccups
   (NFS/GCS flakiness) are the common failure and a retry is always safe
-  because every write is atomic (write-temp-then-rename).
+  because every write is atomic (write-temp-then-rename). The policy
+  carries a ``max_elapsed`` wall-clock budget so a retry storm across
+  many shard writes can never exceed a supervisor checkpoint interval
+  (see :mod:`heat_tpu.resilience.supervisor`).
 """
 from __future__ import annotations
 
@@ -18,5 +21,6 @@ from ..core._retry import NO_RETRY, RetryError, RetryPolicy
 __all__ = ["RetryPolicy", "RetryError", "NO_RETRY", "DEFAULT_CHECKPOINT_POLICY"]
 
 DEFAULT_CHECKPOINT_POLICY = RetryPolicy(
-    max_attempts=3, base_delay=0.05, max_delay=2.0, multiplier=2.0, jitter=0.1, seed=0
+    max_attempts=3, base_delay=0.05, max_delay=2.0, multiplier=2.0, jitter=0.1,
+    seed=0, max_elapsed=10.0,
 )

@@ -1,0 +1,637 @@
+"""Self-healing supervised execution: detect -> recover -> resume.
+
+PRs 1-2 shipped the resilience *primitives* — elastic sharded checkpoints,
+retry/backoff, chaos injection, divergence guards, collective watchdogs,
+``shrink_to_healthy`` — but composing them was still a human replaying the
+script after a crash. :class:`Supervisor` closes the loop: it drives any
+iterative workload as a checkpointed step loop with a fault-classification
+policy, so the job finishes *by itself* on whatever mesh survives.
+
+Fault classification (the policy table, also in ``docs/RESILIENCE.md``):
+
+======================================  =====================================
+fault class                             action
+======================================  =====================================
+transient I/O (``OSError`` /            re-run the step under the
+``TimeoutError`` outside the            :class:`RetryPolicy` backoff
+ResilienceError tree)                   schedule
+``DivergenceError`` /                   restore the last good checkpoint,
+``CollectiveTimeout`` (and other        resume at its recorded step
+``ResilienceError``)
+repeated restores at the same step      escalate to probe + shrink
+``RuntimeError`` (a died device         ``probe`` -> ``shrink_to_healthy``
+surfaces as an XLA runtime error)       -> elastic ``load_checkpoint`` onto
+                                        the surviving mesh -> resume at the
+                                        recorded step
+``NoHealthyDevicesError`` / anything    fatal: re-raised (wrapped in
+else / recovery budget exhausted        :class:`SupervisorError` where the
+                                        supervisor itself gives up)
+======================================  =====================================
+
+The step contract is ``step_fn(state, data, step) -> (state, done)`` where
+``state`` is a dict of checkpointable entries (DNDarrays, numpy arrays,
+JSON scalars) and ``data`` is a tuple of live input DNDarrays — inputs are
+*moved* on a shrink but never checkpointed. :class:`CheckpointSchedule`
+decides cadence (every N steps and/or every T seconds) and retention
+(keep-last-k with atomic GC of stale checkpoint directories).
+
+Recovery activity is counted in :data:`RECOVERY_STATS`, exported beside
+``LAYOUT_STATS`` / ``MOVE_STATS`` / ``COMPILE_STATS`` and fed through the
+same passive ``core._hooks`` observer slot (the supervisor emits
+``recovery.*`` events; the module observer counts them).
+
+Zero-overhead contract: with no directory/schedule configured, ``run`` is
+a bare Python loop around ``step_fn`` — no extra XLA compiles, no extra
+host syncs, no jax work at all per step (counter-asserted in
+``tests/test_supervisor.py``).
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..core import _hooks
+from ..core._atomic import atomic_write_bytes
+from ..core.communication import sanitize_comm
+from ..core.dndarray import DNDarray
+from .checkpoint import load_checkpoint, save_checkpoint
+from .degrade import probe, shrink_to_healthy, unhealthy_devices
+from .errors import NoHealthyDevicesError, ResilienceError
+from .guard import check as check_divergence
+from .retry import DEFAULT_CHECKPOINT_POLICY, RetryPolicy
+
+__all__ = [
+    "CheckpointSchedule",
+    "RECOVERY_STATS",
+    "Supervisor",
+    "SupervisorError",
+    "SupervisorResult",
+    "reset_recovery_stats",
+    "supervise",
+]
+
+STATE_NAME = "state.json"
+SUPERVISOR_FORMAT = "heat_tpu.supervisor.v1"
+_STEP_DIR_RE = re.compile(r"^step-(\d{8})$")
+
+# default backoff for transient step errors: fast, deterministic, bounded
+DEFAULT_STEP_POLICY = RetryPolicy(
+    max_attempts=3, base_delay=0.05, max_delay=1.0, multiplier=2.0, jitter=0.1,
+    seed=0, max_elapsed=30.0,
+)
+
+
+class SupervisorError(ResilienceError):
+    """The supervisor exhausted its recovery options (budget, no
+    checkpoint to rewind to, or nothing left to shrink onto)."""
+
+
+# process-lifetime recovery totals, sibling of LAYOUT/MOVE/COMPILE_STATS
+RECOVERY_STATS: Dict[str, float] = {
+    "detections": 0,             # faults the supervisor caught (any class)
+    "retries": 0,                # transient step re-runs
+    "restores": 0,               # checkpoint restores (state rewinds)
+    "shrinks": 0,                # probe + shrink mesh recoveries
+    "checkpoints": 0,            # committed checkpoints
+    "checkpoint_failures": 0,    # saves absorbed (previous good kept)
+    "gc_removed": 0,             # stale checkpoint dirs GC'd
+    "recovery_seconds_total": 0.0,  # sum of detect -> recovered durations
+}
+
+_STATS_KEYS = tuple(RECOVERY_STATS)
+
+
+def reset_recovery_stats() -> None:
+    """Zero the running totals (per-run numbers live on SupervisorResult)."""
+    for k in _STATS_KEYS:
+        RECOVERY_STATS[k] = 0 if k != "recovery_seconds_total" else 0.0
+
+
+def _on_observe(event: str, ctx: dict) -> None:
+    if not event.startswith("recovery."):
+        return
+    kind = event.split(".", 1)[1]
+    if kind == "detect":
+        RECOVERY_STATS["detections"] += 1
+    elif kind == "retry":
+        RECOVERY_STATS["retries"] += 1
+    elif kind == "restore":
+        RECOVERY_STATS["restores"] += 1
+    elif kind == "shrink":
+        RECOVERY_STATS["shrinks"] += 1
+    elif kind == "checkpoint":
+        RECOVERY_STATS["checkpoints"] += 1
+    elif kind == "checkpoint_failure":
+        RECOVERY_STATS["checkpoint_failures"] += 1
+    elif kind == "gc":
+        RECOVERY_STATS["gc_removed"] += int(ctx.get("removed", 1))
+    elif kind == "complete":
+        RECOVERY_STATS["recovery_seconds_total"] += float(ctx.get("elapsed", 0.0))
+
+
+_installed = False
+_install_lock = threading.Lock()
+
+
+def _install() -> None:
+    """Register the recovery observer once per process (idempotent)."""
+    global _installed
+    with _install_lock:
+        if _installed:
+            return
+        _hooks.add_observer(_on_observe)
+        _installed = True
+
+
+_install()
+
+
+@dataclass(frozen=True)
+class CheckpointSchedule:
+    """When to checkpoint and how much history to keep.
+
+    ``every_steps`` / ``every_seconds`` are OR'd: a checkpoint is due when
+    either interval has elapsed since the last commit (a baseline is
+    always written at step 0 before the first step runs, so a restore
+    target exists from the start). ``keep_last`` bounds retention: after
+    each commit, older checkpoint directories beyond the newest k are
+    atomically renamed aside and deleted — keeping k > 1 lets a restore
+    fall back to an older checkpoint when the newest is corrupt.
+    """
+
+    every_steps: Optional[int] = None
+    every_seconds: Optional[float] = None
+    keep_last: int = 3
+
+    def __post_init__(self):
+        if self.every_steps is None and self.every_seconds is None:
+            raise ValueError("schedule needs every_steps and/or every_seconds")
+        if self.every_steps is not None and self.every_steps < 1:
+            raise ValueError(f"every_steps must be >= 1, got {self.every_steps}")
+        if self.every_seconds is not None and self.every_seconds < 0:
+            raise ValueError(f"every_seconds must be >= 0, got {self.every_seconds}")
+        if self.keep_last < 1:
+            raise ValueError(f"keep_last must be >= 1, got {self.keep_last}")
+
+    def due(self, step: int, last_step: int, now: float, last_time: float) -> bool:
+        if self.every_steps is not None and step - last_step >= self.every_steps:
+            return True
+        if self.every_seconds is not None and now - last_time >= self.every_seconds:
+            return True
+        return False
+
+
+@dataclass
+class SupervisorResult:
+    """What a supervised run produced, plus its per-run recovery counters."""
+
+    state: Optional[dict]
+    steps: int
+    recoveries: int
+    counters: Dict[str, float] = field(default_factory=dict)
+    detached: bool = False  # this process owns no devices in the final mesh
+    comm: object = None
+    data: tuple = ()  # the live inputs, moved onto the final mesh on shrink
+
+
+def _classify(exc: BaseException) -> str:
+    """Map an exception to a recovery class (see the module policy table)."""
+    if isinstance(exc, NoHealthyDevicesError):
+        return "fatal"
+    if isinstance(exc, ResilienceError):
+        # DivergenceError / CollectiveTimeout / corrupt checkpoints: state
+        # is suspect — rewind to the last good checkpoint. Checked BEFORE
+        # OSError/TimeoutError because CollectiveTimeout subclasses
+        # TimeoutError and must not be retried in place.
+        return "restore"
+    if isinstance(exc, (OSError, TimeoutError)):
+        return "retry"
+    if isinstance(exc, RuntimeError):
+        # a died accelerator surfaces as an XLA runtime error
+        return "probe"
+    return "fatal"
+
+
+class Supervisor:
+    """Drives ``step_fn`` as a checkpointed, self-healing step loop.
+
+    Parameters
+    ----------
+    directory : str, optional
+        Checkpoint root. ``None`` disables checkpointing (retry and
+        shrink recovery still work; restore-class faults become fatal).
+    schedule : CheckpointSchedule, optional
+        Cadence/retention; defaults to every step when a directory is set.
+    retry : RetryPolicy
+        Backoff schedule for transient step errors
+        (:data:`DEFAULT_STEP_POLICY`; sleeps come from ``retry.sleep`` so
+        tests can run storm scenarios without wall-clock cost).
+    checkpoint_retry : RetryPolicy, optional
+        Passed through to checkpoint I/O (default
+        :data:`DEFAULT_CHECKPOINT_POLICY`).
+    max_recoveries : int
+        Total recovery budget per ``run``; exhaustion raises
+        :class:`SupervisorError`.
+    max_restores_per_step : int
+        Restores allowed at one step before escalating to probe+shrink.
+    divergence_check : bool
+        Verify replicated state arrays with
+        :func:`~heat_tpu.resilience.guard.check` before each checkpoint
+        commit (the detection point for silent replica divergence). Only
+        runs at checkpoint boundaries, so the no-checkpoint path stays
+        zero-overhead.
+    set_default_on_shrink : bool
+        Install the shrunken communicator as the process default.
+    """
+
+    def __init__(
+        self,
+        directory: Optional[str] = None,
+        schedule: Optional[CheckpointSchedule] = None,
+        *,
+        retry: RetryPolicy = DEFAULT_STEP_POLICY,
+        checkpoint_retry: Optional[RetryPolicy] = None,
+        max_recoveries: int = 8,
+        max_restores_per_step: int = 2,
+        divergence_check: bool = True,
+        set_default_on_shrink: bool = True,
+    ):
+        if max_recoveries < 0:
+            raise ValueError(f"max_recoveries must be >= 0, got {max_recoveries}")
+        self.directory = directory
+        self.schedule = schedule or (
+            CheckpointSchedule(every_steps=1) if directory else None
+        )
+        if directory is None and schedule is not None:
+            raise ValueError("a schedule without a directory cannot checkpoint")
+        self.retry = retry
+        self.checkpoint_retry = checkpoint_retry or DEFAULT_CHECKPOINT_POLICY
+        self.max_recoveries = max_recoveries
+        self.max_restores_per_step = max_restores_per_step
+        self.divergence_check = divergence_check
+        self.set_default_on_shrink = set_default_on_shrink
+
+    # ------------------------------------------------------------------ run
+    def run(
+        self,
+        step_fn: Callable,
+        state: dict,
+        *,
+        data: Sequence[DNDarray] = (),
+        n_steps: Optional[int] = None,
+        label: str = "supervised",
+        resume: bool = False,
+    ) -> SupervisorResult:
+        """Run ``step_fn(state, data, step) -> (state, done)`` to completion.
+
+        Steps until ``done`` is truthy (or ``n_steps`` is reached),
+        surviving transient errors, divergence/timeouts, and device loss
+        per the classification policy. Returns a :class:`SupervisorResult`
+        whose ``state`` is the final state dict.
+
+        ``resume=True`` adopts the newest committed checkpoint already in
+        ``directory`` (a restarted job picks up where the dead one left
+        off); the default treats the directory as owned by this run —
+        stale ``step-*`` checkpoints from a previous run are removed and
+        never restored into the new run's state.
+        """
+        if not isinstance(state, dict):
+            raise TypeError(f"state must be a dict of named entries, got {type(state)}")
+        data = tuple(data)
+        before = dict(RECOVERY_STATS)
+        self._comm = self._infer_comm(state, data)
+        self._recoveries = 0
+        self._retry_counts: Dict[int, int] = {}
+        self._retry_first_failure: Dict[int, float] = {}
+        self._restore_counts: Dict[int, int] = {}
+        self._retry_delays = self.retry.delays()
+        self._last_ckpt_step = -1
+        self._last_ckpt_time = time.monotonic()
+        self._checkpointing_on = self.directory is not None
+        self._run_steps: set = set()  # checkpoint steps THIS run may restore
+        detached = False
+
+        step = 0
+        if self._checkpointing_on:
+            existing = self._valid_dirs()
+            if resume and existing:
+                self._run_steps.update(s for s, _ in existing)
+                loaded = self._restore_latest()
+                if loaded is not None:
+                    state, step = loaded
+                    self._last_ckpt_step = step
+            else:
+                if existing and jax.process_index() == 0:
+                    # a fresh run owns the directory: stale checkpoints
+                    # from a previous run must never restore into it
+                    self._gc(keep=0, just_wrote="")
+                # baseline: a restore target exists before the first step
+                self._maybe_checkpoint(state, 0, force=True)
+        while n_steps is None or step < n_steps:
+            try:
+                _hooks.fault_point("supervisor.step", step=step, label=label)
+                state, done = step_fn(state, data, step)
+                step += 1
+                self._retry_counts.pop(step - 1, None)
+                self._retry_first_failure.pop(step - 1, None)
+                if self._checkpointing_on:
+                    self._maybe_checkpoint(state, step, force=bool(done))
+            except Exception as exc:  # noqa: BLE001 - classified, never ignored
+                state, data, step, detached = self._recover(
+                    exc, state, data, step, label
+                )
+                if detached:
+                    break
+                continue
+            if done:
+                break
+
+        counters = {
+            k: RECOVERY_STATS[k] - before[k] for k in _STATS_KEYS
+        }
+        return SupervisorResult(
+            state=None if detached else state,
+            steps=step,
+            recoveries=self._recoveries,
+            counters=counters,
+            detached=detached,
+            comm=self._comm,
+            data=data,
+        )
+
+    # ------------------------------------------------------------- recovery
+    def _recover(self, exc, state, data, step, label):
+        t0 = time.monotonic()
+        klass = _classify(exc)
+        _hooks.observe(
+            "recovery.detect", kind=type(exc).__name__, klass=klass, step=step
+        )
+        if klass == "fatal":
+            raise exc
+        self._recoveries += 1
+        if self._recoveries > self.max_recoveries:
+            raise SupervisorError(
+                f"{label}: recovery budget exhausted after {self.max_recoveries} "
+                f"recoveries (last failure at step {step}: {type(exc).__name__}: {exc})"
+            ) from exc
+
+        if klass == "retry":
+            handled = self._recover_retry(exc, step)
+            if handled:
+                self._complete(t0, "retry", step)
+                return state, data, step, False
+            klass = "restore"  # retry budget exhausted: escalate
+
+        if klass == "restore":
+            if self._restore_counts.get(step, 0) >= self.max_restores_per_step:
+                klass = "probe"  # same step keeps failing: suspect a device
+            else:
+                loaded = self._restore_latest()
+                if loaded is not None:
+                    self._restore_counts[step] = self._restore_counts.get(step, 0) + 1
+                    state, step = loaded
+                    _hooks.observe("recovery.restore", step=step)
+                    self._complete(t0, "restore", step)
+                    return state, data, step, False
+                raise SupervisorError(
+                    f"{label}: {type(exc).__name__} at step {step} needs a checkpoint "
+                    "restore but no checkpoint directory is configured (or none was "
+                    "ever committed)"
+                ) from exc
+
+        # probe + shrink: the device-loss path
+        state, data, step, detached = self._recover_shrink(exc, state, data, step)
+        self._complete(t0, "shrink", step)
+        return state, data, step, detached
+
+    def _complete(self, t0: float, action: str, step: int) -> None:
+        _hooks.observe(
+            "recovery.complete", elapsed=time.monotonic() - t0, action=action, step=step
+        )
+
+    def _recover_retry(self, exc, step: int) -> bool:
+        """Transient error: sleep per the policy schedule and re-run the
+        step. Returns False when the attempt or wall-clock budget is out."""
+        n = self._retry_counts.get(step, 0)
+        if n >= len(self._retry_delays):
+            return False
+        delay = self._retry_delays[n]
+        now = time.monotonic()
+        first = self._retry_first_failure.setdefault(step, now)
+        if self.retry.max_elapsed is not None and (now - first) + delay > self.retry.max_elapsed:
+            return False
+        self._retry_counts[step] = n + 1
+        _hooks.observe("recovery.retry", step=step, attempt=n + 1, delay=delay)
+        self.retry.sleep(delay)
+        return True
+
+    def _recover_shrink(self, exc, state, data, step):
+        probe(self._comm)  # mark devices that actually fail a round-trip
+        if not unhealthy_devices():
+            # probe says the mesh is fine: the RuntimeError (or repeated
+            # restore failure) is not a device problem — surface it
+            raise exc
+        arrays = list(data)
+        dnd_keys = [k for k, v in state.items() if isinstance(v, DNDarray)]
+        have_ckpt = any(s in self._run_steps for s, _ in self._valid_dirs())
+        if not have_ckpt:
+            # no durable state: the live state arrays must move too
+            arrays += [state[k] for k in dnd_keys]
+        new_comm, moved = shrink_to_healthy(
+            self._comm, arrays, set_default=self.set_default_on_shrink
+        )
+        _hooks.observe(
+            "recovery.shrink", step=step, old=self._comm.size, new=new_comm.size
+        )
+        data = tuple(moved[: len(data)])
+        self._comm = new_comm
+
+        # a mesh that no longer spans every process cannot run collective
+        # checkpoint barriers; processes with no surviving devices detach
+        procs = sorted({int(d.process_index) for d in new_comm.mesh.devices.ravel()})
+        if len(procs) < jax.process_count():  # pragma: no cover - multihost only
+            self._checkpointing_on = False
+            if jax.process_index() not in procs:
+                return state, data, step, True
+
+        if have_ckpt:
+            loaded = self._restore_latest()
+            if loaded is not None:
+                state, step = loaded
+                return state, data, step, False
+        # fall back to the live-moved state at the current step
+        for k, v in zip(dnd_keys, moved[len(data):]):
+            state[k] = v
+        return state, data, step, False
+
+    # ---------------------------------------------------------- checkpoints
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, f"step-{step:08d}")
+
+    def _valid_dirs(self) -> List[Tuple[int, str]]:
+        """(step, path) of committed checkpoints, newest first."""
+        if self.directory is None or not os.path.isdir(self.directory):
+            return []
+        out = []
+        for name in os.listdir(self.directory):
+            m = _STEP_DIR_RE.match(name)
+            if not m:
+                continue
+            path = os.path.join(self.directory, name)
+            if os.path.exists(os.path.join(path, STATE_NAME)):
+                out.append((int(m.group(1)), path))
+        out.sort(reverse=True)
+        return out
+
+    def _maybe_checkpoint(self, state: dict, step: int, force: bool = False) -> None:
+        now = time.monotonic()
+        if not force and not self.schedule.due(
+            step, self._last_ckpt_step, now, self._last_ckpt_time
+        ):
+            return
+        if step == self._last_ckpt_step:
+            return  # a forced final checkpoint may coincide with a due one
+        # detection point: never persist silently-diverged replicated state
+        if self.divergence_check:
+            for name, val in sorted(state.items()):
+                if isinstance(val, DNDarray):
+                    check_divergence(val, label=f"supervisor.{name}")
+        target = self._step_dir(step)
+        try:
+            self._save_state(state, step, target)
+        except OSError:
+            # an absorbed save: the previous good checkpoint still stands
+            _hooks.observe("recovery.checkpoint_failure", step=step)
+            shutil.rmtree(target, ignore_errors=True)
+            return
+        self._last_ckpt_step = step
+        self._last_ckpt_time = now
+        self._run_steps.add(step)
+        _hooks.observe("recovery.checkpoint", step=step)
+        if jax.process_index() == 0:
+            self._gc(keep=self.schedule.keep_last, just_wrote=target)
+
+    def _save_state(self, state: dict, step: int, target: str) -> None:
+        os.makedirs(target, exist_ok=True)
+        arrays: Dict[str, str] = {}
+        scalars: Dict[str, object] = {}
+        for name, val in sorted(state.items()):
+            if isinstance(val, DNDarray):
+                save_checkpoint(
+                    val, os.path.join(target, "arrays", name), retry=self.checkpoint_retry
+                )
+                arrays[name] = "dndarray"
+            elif isinstance(val, np.ndarray):
+                wrapped = DNDarray(val, split=None, comm=self._comm)
+                save_checkpoint(
+                    wrapped, os.path.join(target, "arrays", name), retry=self.checkpoint_retry
+                )
+                arrays[name] = "ndarray"
+            else:
+                scalars[name] = val  # must be JSON-serializable
+        payload = json.dumps(
+            {
+                "format": SUPERVISOR_FORMAT,
+                "step": step,
+                "arrays": arrays,
+                "scalars": scalars,
+            },
+            indent=1,
+        ).encode()
+        # state.json is the commit point, written LAST: a crash mid-save
+        # leaves a directory without it, which discovery ignores
+        if jax.process_index() == 0:
+            self.checkpoint_retry.call(
+                atomic_write_bytes,
+                os.path.join(target, STATE_NAME),
+                payload,
+                label=f"supervisor state step {step}",
+            )
+        if jax.process_count() > 1:  # pragma: no cover - exercised on real pods
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("heat_tpu_supervisor_state")
+
+    def _restore_latest(self) -> Optional[Tuple[dict, int]]:
+        """Load the newest committed checkpoint, falling back to older ones
+        when a load fails verification; None when nothing is loadable."""
+        for ckpt_step, path in self._valid_dirs():
+            if ckpt_step not in self._run_steps:
+                continue  # a stale dir from another run is not ours to restore
+            try:
+                with open(os.path.join(path, STATE_NAME), "rb") as f:
+                    meta = json.loads(f.read().decode())
+                state: dict = dict(meta.get("scalars", {}))
+                for name, kind in sorted(meta.get("arrays", {}).items()):
+                    arr = load_checkpoint(
+                        os.path.join(path, "arrays", name),
+                        comm=self._comm,
+                        retry=self.checkpoint_retry,
+                    )
+                    state[name] = arr.numpy() if kind == "ndarray" else arr
+                return state, int(meta.get("step", ckpt_step))
+            except ResilienceError:
+                continue  # corrupt/unreadable: try the next older checkpoint
+            except (OSError, ValueError):
+                continue
+        return None
+
+    def _gc(self, keep: int, just_wrote: str) -> None:
+        """Retention: drop committed checkpoints beyond the newest ``keep``
+        and any uncommitted (state-less) directory that is not the one just
+        written. Removal is rename-then-delete so a crashed GC leaves a
+        ``.trash-*`` directory that discovery already ignores."""
+        valid = self._valid_dirs()
+        keep_paths = {p for _, p in valid[:keep]} | {just_wrote}
+        doomed = [p for _, p in valid[keep:]]
+        for name in os.listdir(self.directory):
+            path = os.path.join(self.directory, name)
+            if _STEP_DIR_RE.match(name) and path not in keep_paths and path not in doomed:
+                if not os.path.exists(os.path.join(path, STATE_NAME)):
+                    doomed.append(path)  # a dead partial save
+        removed = 0
+        for path in doomed:
+            trash = f"{path}.trash-{os.getpid()}"
+            try:
+                os.replace(path, trash)
+                shutil.rmtree(trash, ignore_errors=True)
+                removed += 1
+            except OSError:
+                continue
+        if removed:
+            _hooks.observe("recovery.gc", removed=removed)
+
+    # -------------------------------------------------------------- helpers
+    def _infer_comm(self, state: dict, data: Sequence[DNDarray]):
+        for x in list(data) + list(state.values()):
+            if isinstance(x, DNDarray):
+                return x.comm
+        return sanitize_comm(None)
+
+
+def supervise(
+    step_fn: Callable,
+    state: dict,
+    *,
+    data: Sequence[DNDarray] = (),
+    n_steps: Optional[int] = None,
+    directory: Optional[str] = None,
+    schedule: Optional[CheckpointSchedule] = None,
+    label: str = "supervised",
+    resume: bool = False,
+    **kwargs,
+) -> SupervisorResult:
+    """One-shot convenience: build a :class:`Supervisor` and ``run`` it."""
+    sup = Supervisor(directory=directory, schedule=schedule, **kwargs)
+    return sup.run(
+        step_fn, state, data=data, n_steps=n_steps, label=label, resume=resume
+    )

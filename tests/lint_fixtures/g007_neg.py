@@ -1,0 +1,34 @@
+# graftlint-fixture: G007=0
+# graftlint: durable-path
+"""Near-miss negatives for G007 (same durable-path pragma as the
+positive): reads, the sanctioned atomic_write staging pattern, a waived
+intentional in-place write, and a dynamic mode the checker cannot prove."""
+from heat_tpu.core._atomic import atomic_write
+
+
+def read_default(path):
+    with open(path) as fh:  # default mode is "r"
+        return fh.read()
+
+
+def read_binary(path):
+    with open(path, "rb") as fh:
+        return fh.read()
+
+
+def staged_update(path, payload):
+    # the sanctioned pattern: the write targets the staged temp path,
+    # which atomic_write fsyncs and renames over the destination on exit
+    with atomic_write(path) as tmp:
+        with open(tmp, "r+b") as fh:
+            fh.write(payload)
+
+
+def lock_marker(path):
+    # contents are worthless; a torn write here is harmless by design
+    with open(path, "w"):  # graftlint: durable-write - empty lock marker
+        pass
+
+
+def caller_chosen_mode(path, mode):
+    return open(path, mode)  # unprovable: only literal modes are flagged

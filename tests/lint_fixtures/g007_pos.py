@@ -1,0 +1,30 @@
+# graftlint-fixture: G007=4
+# graftlint: durable-path
+"""True positives for G007: direct write-mode open() on a durable path.
+
+The pragma above opts this file into the durable-write set (in the real
+tree that set is heat_tpu/resilience/** plus heat_tpu/core/io.py). Every
+open below writes IN PLACE: a crash between the open and the final flush
+leaves a torn file where a committed one used to be.
+"""
+
+
+def overwrite_manifest(path, text):
+    with open(path, "w") as fh:  # clobbers the committed manifest in place
+        fh.write(text)
+
+
+def overwrite_shard(path, payload):
+    with open(path, "wb") as fh:
+        fh.write(payload)
+
+
+def append_journal(path, line):
+    with open(path, "a") as fh:  # append is still an uncommitted mutation
+        fh.write(line)
+
+
+def patch_header(path, header):
+    fh = open(path, mode="r+b")  # keyword mode, update-in-place
+    fh.write(header)
+    fh.close()

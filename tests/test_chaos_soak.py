@@ -1,0 +1,19 @@
+"""Tier-1 wrapper for tools/chaos_soak.py --quick: the bounded recovery
+soak (>=1 device loss + >=1 divergence + >=1 torn write per workload,
+recovered models equivalent to the fault-free fits)."""
+import os
+import sys
+import unittest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+
+class TestChaosSoak(unittest.TestCase):
+    def test_quick_soak_passes(self):
+        import chaos_soak
+
+        self.assertEqual(chaos_soak.main(["--quick"]), 0)
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -37,7 +37,7 @@ def _expected_counts(path):
 
 def test_fixture_corpus_is_complete():
     """Every rule has at least one positive and one negative fixture."""
-    assert len(FIXTURES) >= 12
+    assert len(FIXTURES) >= 14
     for rid in gl.RULES:
         stem = rid.lower()
         assert f"{stem}_pos.py" in FIXTURES, f"missing positive fixture for {rid}"
@@ -148,10 +148,29 @@ def test_g006_resilience_first_then_broad_ok():
     assert not gl.lint_source(src)
 
 
+def test_g007_scoped_by_location():
+    src = 'def f(p, b):\n    with open(p, "wb") as fh:\n        fh.write(b)\n'
+    assert [f.rule for f in gl.lint_source(src, path="heat_tpu/resilience/journal.py")] == ["G007"]
+    assert [f.rule for f in gl.lint_source(src, path="heat_tpu/core/io.py")] == ["G007"]
+    # out of scope: the rest of the tree, and the atomic layer itself
+    assert not gl.lint_source(src, path="heat_tpu/cluster/kmeans.py")
+    assert not gl.lint_source(src, path="heat_tpu/core/_atomic.py")
+
+
+def test_g007_atomic_write_staging_exempt():
+    src = (
+        "def f(p, b):\n"
+        "    with atomic_write(p) as tmp:\n"
+        '        with open(tmp, "wb") as fh:\n'
+        "            fh.write(b)\n"
+    )
+    assert not gl.lint_source(src, path="heat_tpu/resilience/journal.py")
+
+
 def test_syntax_error_reported_not_raised():
     findings = gl.lint_source("def f(:\n")
     assert [f.rule for f in findings] == ["SYNTAX"]
-    assert gl.exit_code_for(findings) == 64
+    assert gl.exit_code_for(findings) == 128
 
 
 # ------------------------------------------------------------- exit codes
@@ -161,7 +180,8 @@ def test_exit_code_bitmask():
     assert gl.exit_code_for([mk("G001")]) == 1
     assert gl.exit_code_for([mk("G004"), mk("G004")]) == 8
     assert gl.exit_code_for([mk("G001"), mk("G006")]) == 33
-    assert gl.exit_code_for([mk(r) for r in gl.RULES]) == 63
+    assert gl.exit_code_for([mk("G007")]) == 64
+    assert gl.exit_code_for([mk(r) for r in gl.RULES]) == 127
 
 
 def test_select_subset():
@@ -188,5 +208,5 @@ def test_cli_on_fixture_corpus():
         for rid, n in _expected_counts(os.path.join(FIXTURE_DIR, name)).items():
             want[rid] += n
     assert report["counts"] == want
-    assert proc.returncode == 63  # every rule bit set by its positive fixture
-    assert report["exit_code"] == 63
+    assert proc.returncode == 127  # every rule bit set by its positive fixture
+    assert report["exit_code"] == 127

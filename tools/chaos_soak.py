@@ -1,0 +1,309 @@
+"""Chaos soak: recovery *proofs* for self-healing supervised execution.
+
+``tools/chaos_smoke.py`` sweeps probabilistic fault mixes and accepts any
+of several outcomes; this harness is the deterministic complement for the
+supervisor (PR 6). Each trial drives a REAL estimator fit —
+``KMeans.fit(x, supervisor=...)`` and ``Lasso.fit(x, y, supervisor=...)``
+— under a seeded :class:`~heat_tpu.resilience.chaos.FaultSchedule` that
+guarantees, per trial:
+
+- **>= 1 device loss** at a ``supervisor.step`` boundary (probe + shrink +
+  elastic restore onto the surviving mesh),
+- **>= 1 silent replica divergence** during a checkpoint's pre-save guard
+  pass (detect + rewind to the last good checkpoint),
+- **>= 1 torn write** in the checkpoint byte stream (absorbed by the
+  checkpoint RetryPolicy; the commit-last discipline keeps durable state
+  intact).
+
+and then asserts the *proof*: the schedule fully fired
+(``pending() == []``), the per-trial ``RECOVERY_STATS`` deltas show at
+least one shrink and one restore, and the recovered model matches both a
+fault-free supervised run and the plain unsupervised fit to numpy-oracle
+tolerance. MTTR (mean time to recovery) and the recovery counters are
+emitted as one JSON line per trial plus a final summary line.
+
+Fault-point hit offsets are *calibrated*, not hard-coded: a clean
+supervised run of the same workload counts ``guard.shard`` / ``io.write``
+hits per checkpoint block through the observer slot, and the schedule
+places the divergence in checkpoint-1's guard pass (on a non-primary
+replica) and the torn write in checkpoint-1's write stream — never in the
+baseline block, where a rewind would have no committed target.
+
+Run directly (full soak), or the bounded quick tier (single seed per
+workload, small problems, <= 60 s — the tier-1 entry point via
+``tests/test_chaos_soak.py``):
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python tools/chaos_soak.py [--quick] [--seeds N]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu import resilience as rz
+from heat_tpu.cluster import KMeans
+from heat_tpu.core import _hooks
+from heat_tpu.core import communication as comm_mod
+from heat_tpu.regression import Lasso
+from heat_tpu.resilience.supervisor import RECOVERY_STATS
+
+# soak sleeps are simulated: the backoff schedule still applies, the wall
+# clock does not
+NOSLEEP = rz.RetryPolicy(max_attempts=4, base_delay=0.001, seed=0, sleep=lambda s: None)
+
+COUNTER_KEYS = (
+    "detections", "retries", "restores", "shrinks",
+    "checkpoints", "checkpoint_failures",
+)
+
+
+class _Calibrator:
+    """Counts fault-point hits per checkpoint block during a clean run.
+
+    ``guard_blocks[i]`` / ``io_blocks[i]`` are the ``guard.shard`` /
+    ``io.write`` hit counts between checkpoint commits i-1 and i (block 0
+    is the baseline checkpoint); ``steps`` counts ``supervisor.step``
+    hits. The faulted run replays the identical program, so these offsets
+    place scheduled faults in exact checkpoint windows.
+    """
+
+    def __init__(self):
+        self.guard_blocks: list = []
+        self.io_blocks: list = []
+        self.steps = 0
+        self._guard = 0
+        self._io = 0
+
+    def __call__(self, event: str, ctx: dict) -> None:
+        if event == "guard.shard":
+            self._guard += 1
+        elif event == "io.write":
+            self._io += 1
+        elif event == "supervisor.step":
+            self.steps += 1
+        elif event == "recovery.checkpoint":
+            self.guard_blocks.append(self._guard)
+            self.io_blocks.append(self._io)
+            self._guard = self._io = 0
+
+
+def _build_schedule(seed: int, calib: _Calibrator) -> rz.FaultSchedule:
+    """Three guaranteed faults at seed-randomized positions inside
+    calibrated windows (see module docs for why checkpoint-1, never the
+    baseline)."""
+    rng = random.Random(seed)
+    ndev = jax.device_count()
+    g0, io0 = calib.guard_blocks[0], calib.io_blocks[0]
+    io1 = calib.io_blocks[1]
+    events = [
+        # checkpoint-1 guard pass checks the first (sorted) state array —
+        # a split=None DNDarray replicated ndev-ways — first: hit g0+1+r
+        # is its replica r, and r >= 1 is the injectable non-primary copy
+        ("guard.shard", g0 + 1 + rng.randint(1, ndev - 1), "divergence"),
+        ("io.write", io0 + 1 + rng.randint(0, io1 - 1), "torn_write"),
+        # step hit 2 is the first loop entry after the divergence rewind;
+        # hit 3 additionally requires a second supervised step, which the
+        # calibrated clean run proves exists
+        ("supervisor.step", rng.randint(2, 3 if calib.steps >= 2 else 2), "device_loss"),
+    ]
+    return rz.FaultSchedule(events=events, seed=seed)
+
+
+def _supervisor(directory: str) -> rz.Supervisor:
+    return rz.Supervisor(
+        directory,
+        rz.CheckpointSchedule(every_steps=1, keep_last=3),
+        retry=NOSLEEP,
+        checkpoint_retry=NOSLEEP,
+    )
+
+
+def _assert_close(got, want, label: str, rtol=1e-4, atol=1e-4):
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=rtol, atol=atol, err_msg=label
+    )
+
+
+# --------------------------------------------------------------- workloads
+def trial_kmeans(seed: int, quick: bool) -> dict:
+    n, f, k = (64, 3, 3) if quick else (160, 4, 4)
+    rng = np.random.default_rng(1000 + seed)
+    blob_centers = rng.normal(size=(k, f)) * 5.0
+    pts = blob_centers[rng.integers(0, k, size=n)] + rng.normal(size=(n, f)) * 0.3
+    x = ht.array(pts.astype(np.float32), split=0)
+
+    def mk():
+        return KMeans(n_clusters=k, init="random", max_iter=20, tol=0.0,
+                      random_state=seed)
+
+    oracle = mk().fit(x)
+
+    # fault-free supervised run: the equivalence target AND the hit-count
+    # calibration source for the fault schedule
+    calib = _Calibrator()
+    _hooks.add_observer(calib)
+    try:
+        with tempfile.TemporaryDirectory() as d:
+            clean = mk().fit(x, supervisor=_supervisor(d), block_iters=1)
+    finally:
+        _hooks.remove_observer(calib)
+    assert calib.steps >= 2, f"kmeans converged in {calib.steps} step(s); too easy to soak"
+    _assert_close(clean.cluster_centers_.numpy(), oracle.cluster_centers_.numpy(),
+                  "clean supervised kmeans != unsupervised")
+
+    sched = _build_schedule(seed, calib)
+    before = dict(RECOVERY_STATS)
+    with tempfile.TemporaryDirectory() as d, sched:
+        model = mk().fit(x, supervisor=_supervisor(d), block_iters=1)
+    delta = {c: RECOVERY_STATS[c] - before[c] for c in COUNTER_KEYS}
+    delta["recovery_seconds_total"] = (
+        RECOVERY_STATS["recovery_seconds_total"] - before["recovery_seconds_total"]
+    )
+
+    _assert_close(model.cluster_centers_.numpy(), oracle.cluster_centers_.numpy(),
+                  f"seed={seed}: recovered kmeans centers drifted from fault-free fit")
+    got_labels = model.labels_.numpy().ravel()
+    want_labels = oracle.labels_.numpy().ravel()
+    mismatch = int((got_labels != want_labels).sum())
+    assert mismatch == 0, (
+        f"seed={seed}: {mismatch}/{n} labels differ after recovery"
+    )
+    _assert_close(model.inertia_, oracle.inertia_, "recovered inertia", rtol=1e-3)
+    return {"schedule": sched, "delta": delta, "clean_steps": calib.steps,
+            "extra": {"n_iter": model.n_iter_, "oracle_n_iter": oracle.n_iter_}}
+
+
+def trial_lasso(seed: int, quick: bool) -> dict:
+    n, m = (64, 6) if quick else (160, 10)
+    rng = np.random.default_rng(2000 + seed)
+    X = rng.normal(size=(n, m))
+    X[:, 0] = 1.0  # intercept column, reference-style
+    w = np.zeros(m)
+    w[1:4] = (1.5, -2.0, 0.7)
+    yv = X @ w + rng.normal(size=n) * 0.05
+    x = ht.array(X.astype(np.float32), split=0)
+    y = ht.array(yv.astype(np.float32).reshape(-1, 1), split=0)
+
+    def mk():
+        # tol=0 pins the sweep count to max_iter: every run (clean,
+        # faulted, replayed) executes the identical iteration sequence
+        return Lasso(lam=0.01, max_iter=8, tol=0.0)
+
+    oracle = mk().fit(x, y)
+
+    calib = _Calibrator()
+    _hooks.add_observer(calib)
+    try:
+        with tempfile.TemporaryDirectory() as d:
+            clean = mk().fit(x, y, supervisor=_supervisor(d), block_iters=1)
+    finally:
+        _hooks.remove_observer(calib)
+    assert calib.steps >= 2, f"lasso ran only {calib.steps} supervised step(s)"
+    _assert_close(clean.theta.numpy(), oracle.theta.numpy(),
+                  "clean supervised lasso != unsupervised")
+
+    sched = _build_schedule(seed, calib)
+    before = dict(RECOVERY_STATS)
+    with tempfile.TemporaryDirectory() as d, sched:
+        model = mk().fit(x, y, supervisor=_supervisor(d), block_iters=1)
+    delta = {c: RECOVERY_STATS[c] - before[c] for c in COUNTER_KEYS}
+    delta["recovery_seconds_total"] = (
+        RECOVERY_STATS["recovery_seconds_total"] - before["recovery_seconds_total"]
+    )
+
+    _assert_close(model.theta.numpy(), oracle.theta.numpy(),
+                  f"seed={seed}: recovered lasso theta drifted from fault-free fit")
+    assert model.n_iter == oracle.n_iter, (model.n_iter, oracle.n_iter)
+    return {"schedule": sched, "delta": delta, "clean_steps": calib.steps,
+            "extra": {"n_iter": model.n_iter}}
+
+
+WORKLOADS = (("kmeans", trial_kmeans), ("lasso", trial_lasso))
+
+
+# ------------------------------------------------------------------ driver
+def run_trial(name: str, fn, seed: int, quick: bool) -> dict:
+    """One trial: returns the JSON record; raises on any failed proof."""
+    orig_comm = comm_mod.sanitize_comm(None)
+    t0 = time.monotonic()
+    try:
+        out = fn(seed, quick)
+        sched, delta = out["schedule"], out["delta"]
+        assert sched.pending() == [], f"schedule incomplete:\n{sched.report()}"
+        kinds = sorted(i.kind for i in sched.injected)
+        assert kinds == ["device_loss", "divergence", "torn_write"], kinds
+        assert delta["shrinks"] >= 1, f"no shrink recovery counted: {delta}"
+        assert delta["restores"] >= 1, f"no checkpoint restore counted: {delta}"
+        assert delta["detections"] >= 2, f"too few detections: {delta}"
+        assert delta["checkpoints"] >= 2, f"too few commits: {delta}"
+        recoveries = delta["shrinks"] + delta["restores"] + delta["retries"]
+        mttr = delta.pop("recovery_seconds_total") / max(1, recoveries)
+        final_mesh = comm_mod.sanitize_comm(None).size
+        return {
+            "workload": name,
+            "seed": seed,
+            "ok": True,
+            "faults": {i.kind: i.site for i in sched.injected},
+            "recoveries": delta,
+            "mttr_s": round(mttr, 4),
+            "mesh": f"{orig_comm.size}->{final_mesh}",
+            "clean_steps": out["clean_steps"],
+            "wall_s": round(time.monotonic() - t0, 2),
+            **out["extra"],
+        }
+    finally:
+        # undo the trial's simulated damage: original mesh back as the
+        # default, no devices left marked unhealthy
+        comm_mod.use_comm(orig_comm)
+        rz.clear_unhealthy()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    parser.add_argument("--quick", action="store_true",
+                        help="bounded tier-1 soak: 1 seed/workload, small problems")
+    parser.add_argument("--seeds", type=int, default=None,
+                        help="seeds per workload (default 3; quick forces 1)")
+    args = parser.parse_args(argv)
+    seeds = range(1 if args.quick else (args.seeds or 3))
+
+    records, failures = [], 0
+    for name, fn in WORKLOADS:
+        for seed in seeds:
+            try:
+                rec = run_trial(name, fn, seed, args.quick)
+            except Exception as e:  # noqa: BLE001 - report-all tool
+                failures += 1
+                rec = {"workload": name, "seed": seed, "ok": False,
+                       "error": f"{type(e).__name__}: {e}"}
+            records.append(rec)
+            print(json.dumps(rec))
+    oks = [r for r in records if r["ok"]]
+    summary = {
+        "summary": True,
+        "trials": len(records),
+        "failures": failures,
+        "shrinks": sum(r["recoveries"]["shrinks"] for r in oks),
+        "restores": sum(r["recoveries"]["restores"] for r in oks),
+        "mean_mttr_s": round(sum(r["mttr_s"] for r in oks) / max(1, len(oks)), 4),
+    }
+    print(json.dumps(summary))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

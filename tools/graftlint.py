@@ -6,7 +6,7 @@ Usage::
     python tools/graftlint.py --list-rules
 
 or, installed, as the ``graftlint`` entry point (``pyproject.toml``).
-Exit code is a per-rule bitmask (G001=1 ... G006=32, errors=64), so a CI
+Exit code is a per-rule bitmask (G001=1 ... G007=64, errors=128), so a CI
 step can tell *which* invariant class regressed from the status alone.
 
 The checker itself lives in ``heat_tpu/analysis/graftlint.py`` and is
