@@ -481,18 +481,26 @@ def main():
     import sys
 
     reps = int(os.environ.get("HEAT_TPU_BENCH_REPS", "3"))
+    from heat_tpu import analysis
+
     runs = []
-    for _ in range(reps):
-        runs.append(
-            {
-                **kmeans_bench(),
-                **cdist_bench(),
-                **moments_bench(),
-                **qr_matmul_bench(),
-                **solve_bench(),
-                **lasso_bench(),
-            }
-        )
+    # the timed section runs under the collective-lockstep sanitizer:
+    # recording is pure host bookkeeping (zero extra compiles/syncs,
+    # counter-asserted in tests/test_lockstep.py), and on a multi-process
+    # pod the exit check turns a rank that lost lockstep into a hard
+    # LockstepError instead of a silently skewed headline number
+    with analysis.lockstep() as _ls:
+        for _ in range(reps):
+            runs.append(
+                {
+                    **kmeans_bench(),
+                    **cdist_bench(),
+                    **moments_bench(),
+                    **qr_matmul_bench(),
+                    **solve_bench(),
+                    **lasso_bench(),
+                }
+            )
     merged = _merge_median(runs)
     tracked = HEADLINE + KERNEL_TRACKED
     best = {
@@ -530,6 +538,8 @@ def main():
     if violations:
         out["floor_violations"] = violations
     out["suite_seconds"] = _suite_seconds()
+    out["lockstep_events"] = _ls.events
+    out["lockstep_divergences"] = int(analysis.LOCKSTEP_STATS["divergences"])
     # once per invocation, not per rep: the workload is its own subprocess
     # with its own repeats, and its gate is the asserted exchange counts
     out.update(ragged_bench())
@@ -731,6 +741,8 @@ def _compact_summary(out, detail_path):
         "ragged_new_moves_per_trip",
         "ragged_seed_moves_per_trip",
         "ragged_error",
+        "lockstep_events",
+        "lockstep_divergences",
     ):
         if k in out:
             compact[k] = out[k]

@@ -26,10 +26,12 @@ from .core import version
 from .core.version import __version__
 
 # runtime counters: layout rebalances / ragged exchanges /
-# compiles+transfers / supervised-recovery activity
+# compiles+transfers / collective-lockstep checks / supervised-recovery
+# activity
 from .core.dndarray import LAYOUT_STATS
 from .parallel.flatmove import MOVE_STATS
 from .analysis.sanitizer import COMPILE_STATS
+from .analysis.lockstep import LOCKSTEP_STATS
 from .resilience.supervisor import RECOVERY_STATS
 
 

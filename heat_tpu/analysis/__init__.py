@@ -1,16 +1,26 @@
 """Static + runtime enforcement of the SPMD/JAX invariants.
 
-Two halves (full rule reference and failure stories: ``docs/ANALYSIS.md``):
+Four pieces (full rule reference and failure stories: ``docs/ANALYSIS.md``):
 
 - :mod:`heat_tpu.analysis.graftlint` — pure-stdlib AST checker (rules
   G001–G006: retrace leaks, unbounded executable caches, divergent
   collectives, hot-path host syncs, unordered iteration, swallowed
   ResilienceError).  CLI: ``python tools/graftlint.py heat_tpu/``.
+- :mod:`heat_tpu.analysis.graftflow` — flow-sensitive SPMD taint
+  analyzer (rules F001–F004: divergent collective schedules, tainted
+  cache keys, tainted loop bounds, divergent early exits) — the semantic
+  upgrade of G003/G005.  CLI: ``python tools/graftflow.py heat_tpu/``.
 - :mod:`heat_tpu.analysis.sanitizer` — runtime region accounting of
   compiles, host transfers, and collective dispatches
   (:data:`COMPILE_STATS`, :func:`sanitizer`).
+- :mod:`heat_tpu.analysis.lockstep` — runtime cross-process
+  collective-lockstep sanitizer (:data:`LOCKSTEP_STATS`,
+  :func:`lockstep`), raising ``LockstepError`` instead of hanging when
+  ranks dispatch divergent collective sequences.
 """
+from . import graftflow
 from . import graftlint
+from .lockstep import LOCKSTEP_STATS, lockstep, reset_lockstep_stats
 from .sanitizer import (
     COMPILE_STATS,
     Region,
@@ -20,10 +30,14 @@ from .sanitizer import (
 )
 
 __all__ = [
+    "graftflow",
     "graftlint",
     "COMPILE_STATS",
+    "LOCKSTEP_STATS",
     "Region",
     "SanitizerError",
+    "lockstep",
     "reset_compile_stats",
+    "reset_lockstep_stats",
     "sanitizer",
 ]

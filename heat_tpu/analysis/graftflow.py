@@ -1,0 +1,920 @@
+"""graftflow — flow-sensitive SPMD taint analysis for the heat_tpu tree.
+
+graftlint (PR 4) catches *syntactic* shapes of cross-rank divergence:
+G003 fires when a collective sits under a branch whose test literally
+mentions ``comm.rank`` or ``.item()``.  That net has two holes, in
+opposite directions:
+
+- **misses** — one assignment defeats it.  ``r = comm.rank`` followed by
+  ``if r == 0: psum(x)`` is the exact deadlock, invisible to G003;
+- **false positives** — ``if comm.rank == 0: y = psum(x)
+  else: y = psum(x)`` dispatches the *same* collective sequence on both
+  arms.  No rank can hang, yet G003 flags both calls.
+
+graftflow closes both by doing real dataflow.  It taint-tracks
+*process-dependent* values — rank identity, ``.larray``/local-shard
+access, per-host I/O and filesystem probes, host clocks, un-seeded
+RNG — through assignments, calls (with a small interprocedural summary
+table for heat_tpu internals), and containers, flow-sensitively through
+``if``/``while``/``for``/``try``.  Values laundered through a
+replicating collective (``process_allgather``, ``psum``, …) become
+clean: every process holds the same result afterwards, so branching on
+it cannot diverge.
+
+On top of the taint facts it extracts per-function **collective
+schedules** (the ordered sequence of collective call sites) and flags
+only the shapes that actually hang a mesh:
+
+- **F001** ``divergent-collective`` — a process-dependent branch whose
+  two arms dispatch *different* collective schedules (one-sided psum,
+  the canonical deadlock).  Symmetric arms are clean.
+- **F002** ``tainted-key`` — a process-dependent value used as an
+  executable-cache key: each process compiles and caches its own
+  program, so caches drift apart and collective programs mismatch.
+- **F003** ``divergent-loop`` — a ``while``/``for`` whose trip count is
+  process-dependent and whose body dispatches collectives: ranks run
+  different numbers of rendezvous rounds.
+- **F004** ``divergent-exit`` — an early ``return`` taken under a
+  process-dependent condition that skips collectives dispatched later
+  in the function: the returning rank truncates its schedule.
+
+This module is **pure stdlib** (``ast`` only — no jax import, no
+imports from the rest of the package) so ``tools/graftflow.py`` can
+analyze without initializing a backend.  Finding IDs ride the same
+waiver grammar, bitmask exit codes, and one-line JSON report contract
+as graftlint; user-facing reference: ``docs/ANALYSIS.md``.
+
+Waivers
+-------
+``# graftflow: <token>`` (the ``# graftlint:`` spelling is honored too,
+so a mixed line can carry one comment) on the same line or in the
+contiguous comment block directly above, where ``<token>`` is a rule id
+(``F001``), a tag (``divergent-collective``), or ``all``.  File-level
+pragma ``# graftflow: skip-file`` disables the file.  The
+``# graftflow-fixture:`` header spelling used by the test corpus is
+deliberately not matched by the waiver grammar.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    "RULES",
+    "Finding",
+    "analyze_source",
+    "analyze_file",
+    "analyze_paths",
+    "collective_schedules",
+    "build_report",
+    "exit_code_for",
+    "iter_python_files",
+]
+
+SCHEMA_VERSION = 1
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    tag: str
+    bit: int
+    summary: str
+
+
+RULES: Dict[str, Rule] = {
+    r.id: r
+    for r in (
+        Rule("F001", "divergent-collective", 1,
+             "branch on a process-dependent value dispatches different collective schedules per arm"),
+        Rule("F002", "tainted-key", 2,
+             "process-dependent value used as an executable-cache key (per-process program drift)"),
+        Rule("F003", "divergent-loop", 4,
+             "loop with a process-dependent trip count dispatches collectives in its body"),
+        Rule("F004", "divergent-exit", 8,
+             "early return under a process-dependent condition skips later collectives"),
+    )
+}
+
+TAG_TO_ID = {r.tag: r.id for r in RULES.values()}
+
+# Same collective vocabulary as graftlint (kept in sync by
+# tests/test_graftflow.py::test_collective_vocabulary_matches_graftlint).
+COLLECTIVE_NAMES = {
+    "ppermute", "psum", "pmax", "pmin", "pmean", "all_gather", "all_to_all",
+    "pshuffle", "process_allgather", "ragged_process_allgather",
+    "ragged_move", "reshape_via_flatmove", "strided_take",
+    "broadcast_one_to_all", "sync_global_devices", "assemble_local_shards",
+    "nonzero_scan", "unique_scan",
+}
+
+# ---------------------------------------------------------------- taint tables
+# Attribute access that is process-dependent regardless of the base:
+# rank identity and local-shard views.  (process_count / device counts
+# are replicated-uniform and deliberately absent — same policy as G003.
+# ``.process_index`` the *attribute* is also absent: in this tree it is
+# only ever read off device objects iterated from the replicated global
+# mesh (``d.process_index``) — replicated placement metadata, not the
+# caller's identity.  Self-identity is the ``process_index()`` call or
+# ``.rank``, which G003 cannot distinguish and flags both.)
+TAINT_ATTRS = {
+    "rank": "rank identity (.rank)",
+    "local_rank": "rank identity (.local_rank)",
+    "larray": "local shard (.larray)",
+    "lcounts": "per-shard layout (.lcounts)",
+    "lshape": "local shard shape (.lshape)",
+    "addressable_shards": "local shard view (.addressable_shards)",
+    "addressable_data": "local shard view (.addressable_data)",
+}
+
+# Replicated metadata of a distributed container: reading these off a
+# tainted base yields the same value on every process (a jax.Array's
+# ``.shape`` is the GLOBAL shape; addressability is a property of the
+# sharding, uniform across hosts), so they launder the base's taint.
+REPLICATED_ATTRS = {
+    "shape", "dtype", "ndim", "size", "sharding", "is_fully_addressable",
+    "gshape", "split", "device", "comm", "mesh",
+}
+
+# Calls whose *result* is process-dependent no matter the arguments.
+TAINT_CALLS = {
+    "process_index": "rank identity (process_index())",
+    "axis_index": "rank identity (axis_index())",
+    "local_devices": "per-host device list (local_devices())",
+    "local_device_count": "per-host device count (local_device_count())",
+    "getpid": "per-process pid (getpid())",
+    "gethostname": "per-host name (gethostname())",
+    "open": "per-host file I/O (open())",
+}
+
+# Host clocks: wall time differs across processes, so a time-based
+# decision is a divergence hazard exactly like a rank-based one.
+CLOCK_CALLS = {"time", "time_ns", "monotonic", "monotonic_ns",
+               "perf_counter", "perf_counter_ns"}
+
+# Per-host filesystem probes: each host sees its own disk.
+FS_CALLS = {"listdir", "scandir", "glob", "iglob", "exists", "isfile",
+            "isdir", "stat", "getmtime", "getsize", "walk"}
+
+# Un-seeded RNG: a no-argument constructor draws entropy per process.
+RNG_FACTORIES = {"default_rng", "Random", "RandomState"}
+# Module-level draws from the global (per-process) stream, e.g.
+# ``random.random()`` or ``np.random.randint(...)``.
+RNG_DRAWS = {"random", "randint", "randrange", "uniform", "normal",
+             "standard_normal", "rand", "randn", "choice", "shuffle",
+             "permutation", "sample", "getrandbits"}
+RNG_MODULES = {"random"}
+
+# Interprocedural summary table for heat_tpu internals — calls that
+# *launder* taint.  A replicating collective returns the same value on
+# every process, so its result is clean even when fed tainted input;
+# metadata helpers below return replicated layout facts by contract.
+LAUNDER_CALLS = {
+    "process_allgather", "ragged_process_allgather", "all_gather",
+    "psum", "pmax", "pmin", "pmean", "broadcast_one_to_all",
+    "sync_global_devices", "assemble_local_shards", "replicated_decision",
+    "process_count", "device_count",
+    "lshape_map", "counts_displs_shape",
+}
+
+# heat_tpu internals that dispatch collectives *inside* (summary table):
+# they count as schedule events for F001/F003/F004 even though the
+# rendezvous itself is a call or two deeper.  save/load_checkpoint run
+# sync_global_devices + a ragged allgather; check_divergence reduces
+# per-shard digests; replicated_decision is a one-bool host allgather.
+COLLECTIVE_WRAPPERS = {
+    "save_checkpoint", "load_checkpoint", "check_divergence",
+    "replicated_decision",
+}
+
+CACHE_NAME_RE = re.compile(r"(?i)(^|_)caches?$")
+WAIVER_RE = re.compile(r"#\s*graft(?:flow|lint):\s*([A-Za-z0-9_,\s=-]+)")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def as_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+        }
+
+
+# --------------------------------------------------------------------- waivers
+def _parse_waivers(source: str) -> Tuple[Dict[int, Set[str]], Set[str]]:
+    """(line -> waived rule ids, file-level pragma tokens)."""
+    per_line: Dict[int, Set[str]] = {}
+    pragmas: Set[str] = set()
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = WAIVER_RE.search(text)
+        if not m:
+            continue
+        ids: Set[str] = set()
+        for token in re.split(r"[,\s]+", m.group(1).strip()):
+            if not token or token == "-":
+                continue
+            token = token.split("=", 1)[-1]
+            low = token.lower()
+            if low == "skip-file":
+                pragmas.add(low)
+            elif low == "all":
+                ids.add("all")
+            elif token.upper() in RULES:
+                ids.add(token.upper())
+            elif low in TAG_TO_ID:
+                ids.add(TAG_TO_ID[low])
+            # graftlint ids/tags and free prose after the token land here
+            # and are ignored — the two tools share one comment namespace
+        if ids:
+            per_line[i] = ids
+    return per_line, pragmas
+
+
+# --------------------------------------------------------------------- helpers
+def _call_name(func: ast.expr) -> Optional[str]:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _attr_base_name(func: ast.expr) -> Optional[str]:
+    """For ``a.b.c`` return ``b`` (the immediate base of the attribute)."""
+    if isinstance(func, ast.Attribute):
+        v = func.value
+        if isinstance(v, ast.Name):
+            return v.id
+        if isinstance(v, ast.Attribute):
+            return v.attr
+    return None
+
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+
+
+def _ordered_walk(node: ast.AST) -> Iterable[ast.AST]:
+    """Source-ordered walk that does not descend into nested scopes
+    (their code does not run at this program point)."""
+    for child in ast.iter_child_nodes(node):
+        yield child
+        if not isinstance(child, _SCOPE_NODES):
+            yield from _ordered_walk(child)
+
+
+def _schedule(stmts: Sequence[ast.stmt]) -> List[Tuple[str, int]]:
+    """Ordered collective call sites reachable in a statement list."""
+    out: List[Tuple[str, int]] = []
+    for stmt in stmts:
+        for n in [stmt, *_ordered_walk(stmt)]:
+            if isinstance(n, ast.Call):
+                name = _call_name(n.func)
+                if name in COLLECTIVE_NAMES or name in COLLECTIVE_WRAPPERS:
+                    out.append((name, n.lineno))
+    return out
+
+
+def _schedule_names(stmts: Sequence[ast.stmt]) -> List[str]:
+    return [name for name, _ in _schedule(stmts)]
+
+
+def _first_difference(a: List[str], b: List[str]) -> str:
+    for x, y in zip(a, b):
+        if x != y:
+            return x
+    longer = a if len(a) > len(b) else b
+    return longer[min(len(a), len(b))]
+
+
+# ------------------------------------------------------------------ the engine
+class _FlowAnalyzer:
+    """Flow-sensitive intraprocedural taint propagation for one scope.
+
+    State maps variable name -> human-readable taint reason.  A name
+    absent from the state is clean; assignment of a clean value kills
+    taint; branch merge is the union of arm states (conservative)."""
+
+    def __init__(self, checker: "_FileChecker"):
+        self.checker = checker
+
+    # -- driver ---------------------------------------------------------------
+    def run(self, body: Sequence[ast.stmt], init_state: Dict[str, str]) -> None:
+        self.block(list(body), dict(init_state), rest=[])
+
+    def block(self, stmts: List[ast.stmt], state: Dict[str, str],
+              rest: List[str]) -> Dict[str, str]:
+        for i, stmt in enumerate(stmts):
+            rest_here = _schedule_names(stmts[i + 1:]) + rest
+            self.stmt(stmt, state, rest_here)
+        return state
+
+    # -- statements -----------------------------------------------------------
+    def stmt(self, node: ast.stmt, state: Dict[str, str], rest: List[str]) -> None:
+        if isinstance(node, ast.Assign):
+            t = self.expr(node.value, state)
+            for target in node.targets:
+                self.bind(target, t, state)
+        elif isinstance(node, ast.AnnAssign):
+            if node.value is not None:
+                self.bind(node.target, self.expr(node.value, state), state)
+        elif isinstance(node, ast.AugAssign):
+            t = self.expr(node.value, state)
+            if isinstance(node.target, ast.Name):
+                prior = state.get(node.target.id)
+                self.bind(node.target, t or prior, state)
+            else:
+                self.bind(node.target, t, state)
+        elif isinstance(node, ast.Expr):
+            self.expr(node.value, state)
+            self._container_mutation(node.value, state)
+        elif isinstance(node, ast.If):
+            self._if(node, state, rest)
+        elif isinstance(node, ast.While):
+            self._loop(node, node.test, state, rest, kind="while")
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            t_iter = self.expr(node.iter, state)
+            body_state = dict(state)
+            self.bind(node.target, t_iter, body_state)
+            if t_iter is not None and _schedule(node.body):
+                first = _schedule_names(node.body)[0]
+                self.checker.emit(
+                    "F003", node,
+                    f"for-loop over a process-dependent iterable [{t_iter}] "
+                    f"dispatches collective {first!r} in its body — ranks run "
+                    "different numbers of rendezvous rounds; iterate a "
+                    "replicated quantity instead",
+                )
+            self._fixpoint_body(node.body, body_state, rest)
+            for h in node.orelse:
+                self.stmt(h, body_state, rest)
+            self._merge(state, body_state)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            st = state
+            for item in node.items:
+                t = self.expr(item.context_expr, st)
+                if item.optional_vars is not None:
+                    self.bind(item.optional_vars, t, st)
+            self.block(list(node.body), st, rest)
+        elif isinstance(node, ast.Try):
+            pre = dict(state)
+            self.block(list(node.body), state, rest)
+            for handler in node.handlers:
+                h_state = dict(pre)
+                self.block(list(handler.body), h_state, rest)
+                self._merge(state, h_state)
+            self.block(list(node.orelse), state, rest)
+            self.block(list(node.finalbody), state, rest)
+        elif isinstance(node, ast.Return):
+            if node.value is not None:
+                self.expr(node.value, state)
+        elif isinstance(node, (ast.Raise, ast.Assert, ast.Delete)):
+            for n in ast.iter_child_nodes(node):
+                if isinstance(n, ast.expr):
+                    self.expr(n, state)
+            if isinstance(node, ast.Delete):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        state.pop(t.id, None)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # closure capture: the nested function sees the taint facts
+            # live at its definition point
+            self.checker.analyze_scope(node.body, dict(state))
+        elif isinstance(node, ast.ClassDef):
+            self.checker.analyze_scope(node.body, dict(state))
+        elif isinstance(node, ast.Match) if hasattr(ast, "Match") else False:
+            self.expr(node.subject, state)
+            for case in node.cases:
+                c_state = dict(state)
+                self.block(list(case.body), c_state, rest)
+                self._merge(state, c_state)
+        else:
+            for n in ast.iter_child_nodes(node):
+                if isinstance(n, ast.expr):
+                    self.expr(n, state)
+
+    def _if(self, node: ast.If, state: Dict[str, str], rest: List[str]) -> None:
+        t_test = self.expr(node.test, state)
+        if t_test is not None:
+            body_sched = _schedule_names(node.body)
+            else_sched = _schedule_names(node.orelse)
+            if body_sched != else_sched:
+                diff = _first_difference(body_sched, else_sched)
+                self.checker.emit(
+                    "F001", node,
+                    f"branch on a process-dependent value [{t_test}] dispatches "
+                    f"different collective schedules per arm "
+                    f"({body_sched or 'none'} vs {else_sched or 'none'}; first "
+                    f"divergent: {diff!r}) — ranks disagreeing on the test hang "
+                    "at the unmatched rendezvous; make the schedule symmetric "
+                    "or the predicate replicated",
+                )
+            if rest:
+                for arm in (node.body, node.orelse):
+                    for n in arm:
+                        for sub in [n, *_ordered_walk(n)]:
+                            if isinstance(sub, ast.Return):
+                                self.checker.emit(
+                                    "F004", sub,
+                                    f"early return under a process-dependent "
+                                    f"condition [{t_test}] skips {len(rest)} later "
+                                    f"collective(s) (first: {rest[0]!r}) — the "
+                                    "returning rank truncates its collective "
+                                    "schedule while the others wait",
+                                )
+                                break
+        body_state = dict(state)
+        else_state = dict(state)
+        self.block(list(node.body), body_state, rest)
+        self.block(list(node.orelse), else_state, rest)
+        merged = dict(else_state)
+        self._merge(merged, body_state)
+        state.clear()
+        state.update(merged)
+
+    def _loop(self, node: ast.While, test: ast.expr, state: Dict[str, str],
+              rest: List[str], kind: str) -> None:
+        t_test = self.expr(test, state)
+        if t_test is not None and _schedule(node.body):
+            first = _schedule_names(node.body)[0]
+            self.checker.emit(
+                "F003", node,
+                f"{kind}-loop with a process-dependent trip count [{t_test}] "
+                f"dispatches collective {first!r} in its body — ranks run "
+                "different numbers of rendezvous rounds and the shorter ones "
+                "hang the rest; derive the bound from a replicated value",
+            )
+        body_state = dict(state)
+        self._fixpoint_body(node.body, body_state, rest)
+        for h in node.orelse:
+            self.stmt(h, body_state, rest)
+        # re-evaluate the test after one body pass: loop-carried taint in
+        # the condition still counts
+        if t_test is None and self.expr(test, body_state) is not None \
+                and _schedule(node.body):
+            first = _schedule_names(node.body)[0]
+            self.checker.emit(
+                "F003", node,
+                f"{kind}-loop condition becomes process-dependent after the "
+                f"first iteration [{self.expr(test, body_state)}] and the body "
+                f"dispatches collective {first!r} — divergent trip counts",
+            )
+        self._merge(state, body_state)
+
+    def _fixpoint_body(self, body: Sequence[ast.stmt], state: Dict[str, str],
+                       rest: List[str]) -> None:
+        # two passes reach a fixpoint for loop-carried taint because the
+        # state lattice only grows and chains are short
+        before = None
+        for _ in range(2):
+            self.block(list(body), state, rest)
+            snapshot = dict(state)
+            if snapshot == before:
+                break
+            before = snapshot
+
+    @staticmethod
+    def _merge(into: Dict[str, str], other: Dict[str, str]) -> None:
+        for k, v in other.items():
+            into.setdefault(k, v)
+
+    # -- binding --------------------------------------------------------------
+    def bind(self, target: ast.expr, taint: Optional[str],
+             state: Dict[str, str]) -> None:
+        if isinstance(target, ast.Name):
+            if taint is None:
+                state.pop(target.id, None)
+            else:
+                state[target.id] = taint
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                inner = elt.value if isinstance(elt, ast.Starred) else elt
+                self.bind(inner, taint, state)
+        elif isinstance(target, ast.Subscript):
+            self._check_cache_key(target, state)
+            base = target.value
+            if taint is not None and isinstance(base, ast.Name):
+                state[base.id] = taint  # container absorbs the taint
+            self.expr(target.slice, state)
+        elif isinstance(target, ast.Attribute):
+            self.expr(target.value, state)
+
+    def _container_mutation(self, node: ast.expr, state: Dict[str, str]) -> None:
+        """``xs.append(tainted)`` / ``.add`` / ``.extend`` / ``.update``
+        taints the container name."""
+        if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+            return
+        if node.func.attr not in ("append", "add", "extend", "update", "insert"):
+            return
+        base = node.func.value
+        if not isinstance(base, ast.Name):
+            return
+        for arg in node.args:
+            t = self.expr(arg, state)
+            if t is not None:
+                state[base.id] = t
+                return
+
+    # -- expressions ----------------------------------------------------------
+    def expr(self, node: Optional[ast.expr], state: Dict[str, str]) -> Optional[str]:
+        """Taint reason of an expression (None = clean).  Also emits F002
+        findings for tainted cache keys encountered along the way."""
+        if node is None:
+            return None
+        if isinstance(node, ast.Name):
+            return state.get(node.id)
+        if isinstance(node, ast.Constant):
+            return None
+        if isinstance(node, ast.Attribute):
+            base_t = self.expr(node.value, state)
+            if node.attr in TAINT_ATTRS:
+                return TAINT_ATTRS[node.attr]
+            if node.attr in REPLICATED_ATTRS:
+                return None
+            return base_t
+        if isinstance(node, ast.Call):
+            return self._call(node, state)
+        if isinstance(node, ast.Subscript):
+            self._check_cache_key(node, state)
+            t = self.expr(node.value, state)
+            ts = self.expr(node.slice, state)
+            return t or ts
+        if isinstance(node, ast.BinOp):
+            return self.expr(node.left, state) or self.expr(node.right, state)
+        if isinstance(node, ast.BoolOp):
+            for v in node.values:
+                t = self.expr(v, state)
+                if t is not None:
+                    return t
+            return None
+        if isinstance(node, ast.UnaryOp):
+            return self.expr(node.operand, state)
+        if isinstance(node, ast.Compare):
+            t = self.expr(node.left, state)
+            for c in node.comparators:
+                t = t or self.expr(c, state)
+            return t
+        if isinstance(node, ast.IfExp):
+            return (self.expr(node.test, state)
+                    or self.expr(node.body, state)
+                    or self.expr(node.orelse, state))
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            for elt in node.elts:
+                inner = elt.value if isinstance(elt, ast.Starred) else elt
+                t = self.expr(inner, state)
+                if t is not None:
+                    return t
+            return None
+        if isinstance(node, ast.Dict):
+            for k in node.keys:
+                t = self.expr(k, state)
+                if t is not None:
+                    return t
+            for v in node.values:
+                t = self.expr(v, state)
+                if t is not None:
+                    return t
+            return None
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)):
+            comp_state = dict(state)
+            t_any = None
+            for gen in node.generators:
+                t_iter = self.expr(gen.iter, comp_state)
+                self.bind(gen.target, t_iter, comp_state)
+                t_any = t_any or t_iter
+                for cond in gen.ifs:
+                    self.expr(cond, comp_state)
+            if isinstance(node, ast.DictComp):
+                t_any = (t_any or self.expr(node.key, comp_state)
+                         or self.expr(node.value, comp_state))
+            else:
+                t_any = t_any or self.expr(node.elt, comp_state)
+            return t_any
+        if isinstance(node, ast.JoinedStr):
+            for v in node.values:
+                if isinstance(v, ast.FormattedValue):
+                    t = self.expr(v.value, state)
+                    if t is not None:
+                        return t
+            return None
+        if isinstance(node, ast.Starred):
+            return self.expr(node.value, state)
+        if isinstance(node, ast.NamedExpr):
+            t = self.expr(node.value, state)
+            self.bind(node.target, t, state)
+            return t
+        if isinstance(node, ast.Lambda):
+            return None
+        if isinstance(node, ast.Await):
+            return self.expr(node.value, state)
+        # conservative default for rare nodes: taint if any child is
+        t_any = None
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                t_any = t_any or self.expr(child, state)
+        return t_any
+
+    def _call(self, node: ast.Call, state: Dict[str, str]) -> Optional[str]:
+        fname = _call_name(node.func)
+        base = _attr_base_name(node.func)
+        arg_taints = [self.expr(a, state) for a in node.args]
+        kw_taints = [self.expr(kw.value, state) for kw in node.keywords]
+        base_taint = (self.expr(node.func.value, state)
+                      if isinstance(node.func, ast.Attribute) else None)
+        any_arg = next((t for t in [*arg_taints, *kw_taints] if t), None)
+
+        # replicating collectives / metadata helpers launder everything
+        if fname in LAUNDER_CALLS:
+            return None
+        # unconditional process-dependent sources
+        if fname in TAINT_CALLS:
+            return TAINT_CALLS[fname]
+        # getattr with a literal name behaves like the attribute access
+        if fname == "getattr" and len(node.args) >= 2 and isinstance(
+                node.args[1], ast.Constant) and isinstance(node.args[1].value, str):
+            attr = node.args[1].value
+            if attr in TAINT_ATTRS:
+                return TAINT_ATTRS[attr]
+            if attr in REPLICATED_ATTRS:
+                return None
+            return arg_taints[0]
+        if fname in CLOCK_CALLS and base in ("time",):
+            return f"host clock (time.{fname}())"
+        if fname in FS_CALLS and base in ("os", "path", "glob", "shutil"):
+            return f"per-host filesystem ({base}.{fname}())"
+        if fname in RNG_FACTORIES and not node.args and not any(
+                kw.arg in ("seed", "x") for kw in node.keywords):
+            return f"un-seeded RNG ({fname}())"
+        if fname in RNG_DRAWS and base in RNG_MODULES:
+            return f"per-process RNG stream ({base}.{fname}())"
+        # comm.chunk() defaults rank to *this* process; an explicit
+        # untainted rank argument makes the result deterministic
+        if fname == "chunk":
+            rank_arg = node.args[2] if len(node.args) > 2 else None
+            for kw in node.keywords:
+                if kw.arg == "rank":
+                    rank_arg = kw.value
+            if rank_arg is None or (
+                    isinstance(rank_arg, ast.Constant) and rank_arg.value is None):
+                return "this process's chunk (chunk() with default rank)"
+            return self.expr(rank_arg, state)
+        # method on a tainted object (rng.random(), fh.read(), …)
+        if base_taint is not None:
+            return base_taint
+        return any_arg
+
+    # -- F002 -----------------------------------------------------------------
+    def _check_cache_key(self, node: ast.Subscript, state: Dict[str, str]) -> None:
+        name = (node.value.id if isinstance(node.value, ast.Name)
+                else _call_name(node.value))
+        if not (name and CACHE_NAME_RE.search(name)):
+            return
+        t = self.expr(node.slice, state)
+        if t is not None:
+            self.checker.emit(
+                "F002", node,
+                f"cache key for {name!r} contains a process-dependent value "
+                f"[{t}] — each process compiles and caches its own program, "
+                "so executables drift apart across ranks; key by replicated "
+                "statics only",
+            )
+
+
+class _FileChecker:
+    """Drives the flow analyzer over every scope of one file."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.findings: List[Finding] = []
+        self._seen: Set[Tuple[str, int, int]] = set()
+
+    def emit(self, rule: str, node: ast.AST, message: str) -> None:
+        key = (rule, getattr(node, "lineno", 0), getattr(node, "col_offset", 0))
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self.findings.append(Finding(rule, self.path, key[1], key[2], message))
+
+    def analyze_scope(self, body: Sequence[ast.stmt],
+                      init_state: Dict[str, str]) -> None:
+        _FlowAnalyzer(self).run(body, init_state)
+
+    def check(self, tree: ast.Module) -> List[Finding]:
+        self.analyze_scope(tree.body, {})
+        return self.findings
+
+
+# -------------------------------------------------------- schedule extraction
+def collective_schedules(source: str) -> Dict[str, List[Tuple[str, int]]]:
+    """Per-function collective schedules: qualified function name ->
+    ordered ``(collective, line)`` call sites.  The module's own
+    top-level schedule is keyed ``"<module>"``."""
+    tree = ast.parse(source)
+    out: Dict[str, List[Tuple[str, int]]] = {"<module>": _schedule(tree.body)}
+
+    def visit(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                out[qual] = _schedule(child.body)
+                visit(child, qual + ".")
+            elif isinstance(child, ast.ClassDef):
+                visit(child, f"{prefix}{child.name}.")
+            else:
+                visit(child, prefix)
+
+    visit(tree, "")
+    return out
+
+
+# ------------------------------------------------------------------ public API
+def analyze_source(
+    source: str, path: str = "<string>", select: Optional[Set[str]] = None
+) -> List[Finding]:
+    """Analyze one source string; returns unwaived findings."""
+    waivers, pragmas = _parse_waivers(source)
+    if "skip-file" in pragmas:
+        return []
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding("SYNTAX", path, e.lineno or 0, e.offset or 0, str(e.msg))]
+    findings = _FileChecker(path).check(tree)
+    lines = source.splitlines()
+
+    def _waived(lineno: int) -> Set[str]:
+        ids = set(waivers.get(lineno, ()))
+        i = lineno - 1
+        while 1 <= i <= len(lines) and lines[i - 1].lstrip().startswith("#"):
+            ids |= waivers.get(i, set())
+            i -= 1
+        return ids
+
+    out = []
+    for f in findings:
+        if select is not None and f.rule not in select and f.rule != "SYNTAX":
+            continue
+        waived = _waived(f.line)
+        if f.rule in waived or "all" in waived:
+            continue
+        out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def analyze_file(path: str, select: Optional[Set[str]] = None) -> List[Finding]:
+    with open(path, "r", encoding="utf-8") as fh:
+        return analyze_source(fh.read(), path=path, select=select)
+
+
+def iter_python_files(paths: Sequence[str]) -> List[str]:
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+                files.extend(
+                    os.path.join(root, n) for n in sorted(names) if n.endswith(".py")
+                )
+        else:
+            files.append(p)
+    return files
+
+
+def analyze_paths(
+    paths: Sequence[str], select: Optional[Set[str]] = None
+) -> Tuple[List[Finding], int]:
+    """(findings, files_checked) over files and/or directory trees."""
+    files = iter_python_files(paths)
+    findings: List[Finding] = []
+    for f in files:
+        findings.extend(analyze_file(f, select=select))
+    return findings, len(files)
+
+
+def exit_code_for(findings: Iterable[Finding]) -> int:
+    """Per-rule exit bitmask: F001=1, F002=2, F003=4, F004=8; syntax
+    errors / internal failures = 128 (same bit as graftlint)."""
+    code = 0
+    for f in findings:
+        code |= RULES[f.rule].bit if f.rule in RULES else 128
+    return code
+
+
+def build_report(paths: Sequence[str], findings: List[Finding], files_checked: int) -> dict:
+    """Machine-readable output; same key contract as graftlint's report
+    (pinned by tests/test_flow_clean.py::test_cli_json_contract)."""
+    counts = {rid: 0 for rid in RULES}
+    for f in findings:
+        if f.rule in counts:
+            counts[f.rule] += 1
+    return {
+        "tool": "graftflow",
+        "schema_version": SCHEMA_VERSION,
+        "paths": list(paths),
+        "files_checked": files_checked,
+        "rules": [
+            {"id": r.id, "tag": r.tag, "bit": r.bit, "summary": r.summary}
+            for r in RULES.values()
+        ],
+        "findings": [f.as_dict() for f in findings],
+        "counts": counts,
+        "total": len(findings),
+        "exit_code": exit_code_for(findings),
+    }
+
+
+def render_text(report: dict) -> str:
+    lines = []
+    for f in report["findings"]:
+        lines.append(f"{f['path']}:{f['line']}:{f['col']}: {f['rule']} {f['message']}")
+    lines.append(
+        f"graftflow: {report['total']} finding(s) in {report['files_checked']} file(s)"
+        + (" — clean" if report["total"] == 0 else "")
+    )
+    return "\n".join(lines)
+
+
+def render_github(report: dict) -> str:
+    """GitHub workflow-annotation lines (::error file=...,line=...)."""
+    lines = []
+    for f in report["findings"]:
+        msg = f["message"].replace("%", "%25").replace("\n", "%0A")
+        lines.append(
+            f"::error file={f['path']},line={f['line']},col={f['col']},"
+            f"title=graftflow {f['rule']}::{msg}"
+        )
+    return "\n".join(lines)
+
+
+_EXIT_EPILOG = (
+    "exit code is a bitmask: "
+    + ", ".join(f"{r.bit}={r.id}" for r in RULES.values())
+    + ", 128=syntax/internal error; 0 means clean "
+    "(table: docs/ANALYSIS.md)"
+)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="graftflow",
+        description="flow-sensitive SPMD taint analysis for the heat_tpu tree "
+        "(finding reference: docs/ANALYSIS.md)",
+        epilog=_EXIT_EPILOG,
+    )
+    parser.add_argument("paths", nargs="*", default=["heat_tpu"], help="files or directories")
+    parser.add_argument("--format", choices=("text", "json", "github"), default="text")
+    parser.add_argument(
+        "--select", default=None,
+        help="comma-separated finding ids to run (default: all)",
+    )
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for r in RULES.values():
+            print(f"{r.id}  [{r.tag}]  exit-bit {r.bit}: {r.summary}")
+        return 0
+
+    select = None
+    if args.select:
+        select = {s.strip().upper() for s in args.select.split(",") if s.strip()}
+        unknown = select - set(RULES)
+        if unknown:
+            print(f"graftflow: unknown finding id(s): {sorted(unknown)}", file=sys.stderr)
+            return 128
+    try:
+        findings, files_checked = analyze_paths(args.paths, select=select)
+    except OSError as e:
+        print(f"graftflow: {e}", file=sys.stderr)
+        return 128
+    report = build_report(args.paths, findings, files_checked)
+    if args.format == "json":
+        print(json.dumps(report, separators=(",", ":"), sort_keys=True))
+    elif args.format == "github":
+        out = render_github(report)
+        if out:
+            print(out)
+        print(f"graftflow: {report['total']} finding(s) in {report['files_checked']} file(s)")
+    else:
+        print(render_text(report))
+    return report["exit_code"]
+
+
+if __name__ == "__main__":
+    sys.exit(main())

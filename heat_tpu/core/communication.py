@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import math
 from contextlib import contextmanager
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -293,6 +293,20 @@ def init_distributed(
     intra-host collectives ride ICI, inter-host DCN (XLA routes per edge).
     """
     global _default_comm
+    # Multi-process groups on the CPU platform (tests, local smoke runs)
+    # need a host-side collectives layer armed BEFORE the backend comes up:
+    # XLA's bare CPU client rejects cross-process programs outright
+    # ("Multiprocess computations aren't implemented on the CPU backend").
+    # TPU/GPU platforms never enter this branch, and an explicit user
+    # choice (e.g. "mpi") is left alone.
+    try:
+        if (
+            jax.config.jax_platforms == "cpu"
+            and jax.config._read("jax_cpu_collectives_implementation") == "none"
+        ):
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except AttributeError:
+        pass  # this jax build predates (or renamed) the flag: nothing to arm
     kwargs = {
         k: v
         for k, v in dict(
@@ -387,7 +401,12 @@ def _assemble_from_chunks(read_chunk, gshape, split, comm, np_dtype):
 def _assemble_from_chunks_impl(read_chunk, gshape, split, comm, np_dtype):
     from . import _hooks
 
-    _hooks.fault_point("collective.assemble", gshape=tuple(gshape), split=split)
+    _hooks.fault_point(
+        "collective.assemble",
+        gshape=tuple(gshape),
+        split=split,
+        dtype=str(np.dtype(np_dtype)),
+    )
     pshape = comm.padded_shape(gshape, split)
     sharding = comm.array_sharding(pshape, split)
     block_shape = list(pshape)
@@ -435,7 +454,11 @@ def _ragged_process_allgather_impl(arr: np.ndarray, axis: int = 0):
 
     from . import _hooks
 
-    _hooks.fault_point("collective.allgather", shape=tuple(np.asarray(arr).shape))
+    _hooks.fault_point(
+        "collective.allgather",
+        shape=tuple(np.asarray(arr).shape),
+        dtype=str(np.asarray(arr).dtype),
+    )
     nproc = jax.process_count()
     moved = np.moveaxis(np.asarray(arr), axis, 0)
     counts = np.asarray(
@@ -452,6 +475,40 @@ def _ragged_process_allgather_impl(arr: np.ndarray, axis: int = 0):
     return [
         np.moveaxis(gathered[p, : int(counts[p])], 0, axis) for p in range(nproc)
     ]
+
+
+def replicated_decision(flag, *, active: bool = True) -> bool:
+    """Make a host-side boolean rendezvous-safe: every process returns the
+    OR of all processes' flags, so a branch guarded by the result is
+    taken identically everywhere even when the local inputs (wall clocks,
+    filesystem probes) disagree.  THE sanctioned way to branch a
+    collective-dispatching path on a process-local predicate.
+
+    ``active=False`` — or a single-process world — returns ``bool(flag)``
+    without dispatching anything, so callers whose predicate is already
+    replicated (step counters, global metadata) pay nothing.  graftflow
+    models this call as laundering taint (its summary table), which is
+    exactly its contract."""
+    flag = bool(flag)
+    if not active or jax.process_count() == 1:
+        return flag
+    from . import _hooks
+
+    return _hooks.guarded_call(
+        "collective.replicated_decision", _replicated_decision_impl, flag
+    )
+
+
+def _replicated_decision_impl(flag: bool) -> bool:
+    from jax.experimental import multihost_utils
+
+    from . import _hooks
+
+    _hooks.fault_point(
+        "collective.replicated_decision", shape=(1,), dtype="bool"
+    )
+    votes = multihost_utils.process_allgather(np.asarray([flag], dtype=np.bool_))
+    return bool(np.asarray(votes).any())
 
 
 def _split_ranks(comm: MeshCommunication):
@@ -506,22 +563,27 @@ def _assemble_local_shards_impl(local: np.ndarray, split: int, comm: MeshCommuni
     gshape[split] = sum(sizes)
     gshape = tuple(gshape)
 
-    dpp = jax.local_device_count()
     block = comm.padded_shape(gshape, split)[split] // comm.size
     # is_split semantics: the global array is the pid-ordered concatenation
-    # of the local shards. The local-only fast path requires every one of
-    # THIS process's device blocks (rank r covers global rows
-    # [r*block, (r+1)*block)) to fall inside this process's own rows —
-    # true for equal, locally-divisible extents on a process-major mesh,
-    # checked explicitly so permuted meshes fall back to the allgather.
-    my_ranks = sorted(
-        {r for r, d in _split_ranks(comm) if d.process_index == pid}
-    )
+    # of the local shards. The local-only fast path requires every device
+    # block (rank r covers global rows [r*block, (r+1)*block)) to fall
+    # inside its OWN process's rows — true for equal, divisible extents on
+    # a process-major mesh. The decision is computed from the REPLICATED
+    # (rank, device) placement of the whole mesh, never from this
+    # process's local view: a per-host check here diverges on a partially
+    # permuted mesh, stranding the aligned hosts while the misaligned
+    # ones enter the allgather below (graftflow F001).
+    placement = _split_ranks(comm)
+    per_proc: Dict[int, int] = {}
+    for _r, d in placement:
+        per_proc[d.process_index] = per_proc.get(d.process_index, 0) + 1
+    dpp = next(iter(per_proc.values()))
     aligned = (
         len(set(sizes)) == 1
+        and len(set(per_proc.values())) == 1
         and sizes[0] % dpp == 0
         and sizes[0] // dpp == block
-        and all(r * block // sizes[0] == pid for r in my_ranks)
+        and all(r * block // sizes[0] == d.process_index for r, d in placement)
     )
     if aligned:
         offset = pid * sizes[0]  # this process's rows in global coordinates

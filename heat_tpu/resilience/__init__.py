@@ -76,6 +76,7 @@ from .errors import (
     CollectiveTimeout,
     DegradeError,
     DivergenceError,
+    LockstepError,
     NoHealthyDevicesError,
     ResilienceError,
 )
@@ -119,6 +120,7 @@ __all__ = [
     "ResilienceError",
     "DivergenceError",
     "CollectiveTimeout",
+    "LockstepError",
     "DegradeError",
     "NoHealthyDevicesError",
     # guard

@@ -33,7 +33,14 @@ single uniform draw):
   healthy device of the default mesh is marked unhealthy
   (:func:`~heat_tpu.resilience.degrade.mark_unhealthy`) and a
   ``RuntimeError`` is raised mid-step — the simulated died-accelerator
-  that only probe + :func:`shrink_to_healthy` can recover from.
+  that only probe + :func:`shrink_to_healthy` can recover from;
+- ``lockstep_divergence`` — collective sites only, and only while a
+  :class:`heat_tpu.analysis.lockstep.lockstep` sanitizer is recording:
+  the event the sanitizer just recorded for this site is silently
+  dropped on the injecting process, so its order digest reads as if the
+  rank *skipped* the collective — the simulated cross-rank control-flow
+  divergence that only the lockstep cross-check can catch (the
+  collective itself still runs, so the mesh never actually wedges).
 
 ``max_faults`` caps the total number of injected faults, after which all
 sites pass — the standard recipe for "transient" faults that a
@@ -113,6 +120,7 @@ class chaos:
     straggler: float = 0.0
     divergence: float = 0.0
     device_loss: float = 0.0
+    lockstep_divergence: float = 0.0
     straggler_delay: float = 0.05
     targets: Sequence[str] = _KNOWN_TARGETS
     max_faults: Optional[int] = None
@@ -124,7 +132,7 @@ class chaos:
         if unknown:
             raise ValueError(f"unknown chaos targets {sorted(unknown)}; known: {_KNOWN_TARGETS}")
         for knob in ("io_error", "timeout", "torn_write", "corrupt", "straggler",
-                     "divergence", "device_loss"):
+                     "divergence", "device_loss", "lockstep_divergence"):
             p = getattr(self, knob)
             if not 0.0 <= p <= 1.0:
                 raise ValueError(f"{knob} must be a probability in [0, 1], got {p}")
@@ -206,6 +214,14 @@ class chaos:
             )
             time.sleep(self.straggler_delay)  # then proceed: slow, not dead
             return
+        if site.startswith("collective."):
+            threshold += self.lockstep_divergence
+            if u < threshold:
+                if _drop_lockstep_event():
+                    self.injected.append(
+                        Injection(site, "lockstep_divergence", "dropped recorded event")
+                    )
+                return  # silent either way: detection is the sanitizer's job
         if site.startswith("supervisor."):
             threshold += self.device_loss
             if u < threshold:
@@ -223,9 +239,18 @@ class chaos:
         return "\n".join(lines)
 
 
+def _drop_lockstep_event() -> bool:
+    """Drop the newest event an active lockstep sanitizer recorded for
+    the current process (runtime import: chaos sits below analysis's
+    users, and the sanitizer may never be loaded at all)."""
+    from ..analysis.lockstep import _drop_last_event
+
+    return _drop_last_event()
+
+
 _SCHEDULED_KINDS = (
     "io_error", "timeout", "torn_write", "corrupt", "straggler",
-    "divergence", "device_loss",
+    "divergence", "device_loss", "lockstep_divergence",
 )
 
 
@@ -271,6 +296,13 @@ def _apply_fault(kind: str, site: str, ctx: dict, u: float, straggler_delay: flo
         pos = int(u * 1000) % view.size
         view[pos] ^= 0xFF
         return f"replica {replica} byte {pos}"
+    if kind == "lockstep_divergence":
+        # only collective sites carry lockstep events, and only while a
+        # sanitizer is actually recording — otherwise keep the event
+        # pending (same contract as a torn write at a payload-less site)
+        if not site.startswith("collective.") or not _drop_lockstep_event():
+            return None
+        return "dropped recorded event"
     if kind == "device_loss":
         dev = _lose_device(u)
         if dev is None:

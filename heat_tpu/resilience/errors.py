@@ -10,6 +10,9 @@ Every failure the runtime guard layer can surface derives from
 - :class:`CollectiveTimeout` — a deadline-wrapped blocking collective or
   resharding path exceeded its budget (hang bounded by
   :mod:`~heat_tpu.resilience.watchdog`);
+- :class:`LockstepError` — processes dispatched *different* collective
+  sequences (cross-rank control-flow divergence caught by
+  :mod:`~heat_tpu.analysis.lockstep` before it becomes a silent hang);
 - :class:`DegradeError` / :class:`NoHealthyDevicesError` — elastic
   shrink-to-healthy cannot proceed
   (:mod:`~heat_tpu.resilience.degrade`).
@@ -27,6 +30,7 @@ __all__ = [
     "ResilienceError",
     "DivergenceError",
     "CollectiveTimeout",
+    "LockstepError",
     "DegradeError",
     "NoHealthyDevicesError",
 ]
@@ -90,6 +94,50 @@ class CollectiveTimeout(ResilienceError, TimeoutError):
         if detail:
             msg += f": {detail}"
         super().__init__(msg)
+
+
+class LockstepError(ResilienceError):
+    """Processes dispatched divergent collective sequences.
+
+    Raised by the lockstep sanitizer (:mod:`heat_tpu.analysis.lockstep`)
+    when the per-process order digests of the recorded ``collective.*``
+    events disagree — the SPMD bug that would otherwise surface as a
+    silent mesh-wide hang or a corrupted reduction.
+
+    Attributes
+    ----------
+    seq : int
+        Sequence number of the first divergent event (0-based, counted
+        from sanitizer entry).
+    site : str
+        The fault-point site THIS process recorded at ``seq`` (e.g.
+        ``"collective.allgather"``), or ``""`` when this process recorded
+        fewer events than a peer (it *skipped* a collective).
+    process_index : int
+        This process's index.
+    counts : tuple of int
+        Per-process recorded event counts at check time — unequal counts
+        are themselves proof of divergence.
+    label : str
+        Where the check ran (``"exit"``, ``"check"``, or a caller label).
+    """
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        seq: int = -1,
+        site: str = "",
+        process_index: int = 0,
+        counts: Sequence[int] = (),
+        label: str = "check",
+    ):
+        super().__init__(message)
+        self.seq = int(seq)
+        self.site = site
+        self.process_index = int(process_index)
+        self.counts = tuple(int(c) for c in counts)
+        self.label = label
 
 
 class DegradeError(ResilienceError):

@@ -61,7 +61,7 @@ import numpy as np
 
 from ..core import _hooks
 from ..core._atomic import atomic_write_bytes
-from ..core.communication import sanitize_comm
+from ..core.communication import replicated_decision, sanitize_comm
 from ..core.dndarray import DNDarray
 from .checkpoint import load_checkpoint, save_checkpoint
 from .degrade import probe, shrink_to_healthy, unhealthy_devices
@@ -494,9 +494,16 @@ class Supervisor:
 
     def _maybe_checkpoint(self, state: dict, step: int, force: bool = False) -> None:
         now = time.monotonic()
-        if not force and not self.schedule.due(
-            step, self._last_ckpt_step, now, self._last_ckpt_time
-        ):
+        due = self.schedule.due(step, self._last_ckpt_step, now, self._last_ckpt_time)
+        # Wall clocks drift across hosts: an every_seconds cadence can be
+        # due on one process and not yet on its peers, and _save_state
+        # dispatches collectives (sync_global_devices, shard allgathers) —
+        # the early-returning ranks would strand the rest at the barrier
+        # (graftflow F004). One one-bool rendezvous makes the decision
+        # identical everywhere; a pure step cadence is already lockstep
+        # and pays nothing.
+        due = replicated_decision(due, active=self.schedule.every_seconds is not None)
+        if not force and not due:
             return
         if step == self._last_ckpt_step:
             return  # a forced final checkpoint may coincide with a due one
@@ -571,6 +578,13 @@ class Supervisor:
                 with open(os.path.join(path, STATE_NAME), "rb") as f:
                     meta = json.loads(f.read().decode())
                 state: dict = dict(meta.get("scalars", {}))
+                # ``meta`` is read from this host's view of the checkpoint
+                # directory, but the directory is shared storage by the
+                # checkpoint layer's contract and STATE_NAME is committed
+                # atomically (core._atomic), so every host parses the SAME
+                # manifest and issues the same load_checkpoint sequence —
+                # sorted() pins the order (G005).
+                # graftflow: F003 - shared atomic manifest, identical everywhere
                 for name, kind in sorted(meta.get("arrays", {}).items()):
                     arr = load_checkpoint(
                         os.path.join(path, "arrays", name),

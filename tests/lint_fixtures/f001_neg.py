@@ -1,0 +1,40 @@
+# graftlint-fixture: G003=2
+# graftflow-fixture: F001=0
+"""Near-miss negatives for F001 — the measured false-positive reduction
+over the syntactic G003.
+
+``symmetric_arms_same_schedule`` is flagged by G003 twice (a collective
+lexically under a rank-mentioning branch, once per arm) and must be
+waived there; the flow-sensitive F001 compares the per-arm collective
+SCHEDULES, sees they are identical, and stays silent. The fixture pins
+that delta: G003=2, F001=0.
+"""
+import jax
+import numpy as np
+
+
+def symmetric_arms_same_schedule(comm, x):
+    # every rank dispatches exactly one psum whichever arm it takes —
+    # divergent control flow, identical collective schedule: no hang
+    if comm.rank == 0:
+        y = psum(x)
+    else:
+        y = psum(x)
+    return y
+
+
+def laundered_predicate_then_collective(x, flag):
+    # the branch decision is itself the result of a replicating
+    # collective, so every rank computes the SAME bool: the collective
+    # below fires on all ranks or none
+    ok = bool(np.asarray(process_allgather(np.asarray([flag]))).any())
+    if ok:
+        x = psum(x)
+    return x
+
+
+def replicated_metadata_predicate(x, xs):
+    # global shape/dtype are identical on every rank by construction
+    if x.shape[0] > 4:
+        return process_allgather(xs)
+    return xs

@@ -1,0 +1,38 @@
+# graftlint-fixture: G003=0
+# graftflow-fixture: F001=3
+"""True positives for F001: collectives under flow-tainted branches.
+
+Never executed — parsed by tests/test_graftflow.py. Every site here is
+invisible to the syntactic G003 (the rank test is hidden behind an
+assignment, a container, or per-host I/O), which is the point: the taint
+engine follows the VALUE, not the spelling.
+"""
+import os
+
+import jax
+
+
+def assignment_hides_the_rank_test(xs):
+    # G003 looks for rank mentions in the if-test itself; the taint
+    # survives the assignment and still gates the collective
+    pid = jax.process_index()
+    leader = pid == 0
+    if leader:
+        return process_allgather(xs)
+    return xs
+
+
+def taint_through_a_container(xs):
+    flags = [jax.process_index(), 0]
+    if flags[0]:
+        psum(xs)
+    return xs
+
+
+def fs_probe_gates_a_barrier(xs, path):
+    # filesystem state is per-host: one host sees the file, another
+    # doesn't, and only some ranks reach the collective
+    have = os.path.exists(path)
+    if have:
+        xs = psum(xs)
+    return xs

@@ -1,0 +1,32 @@
+# graftlint-fixture: G002=1
+# graftflow-fixture: F002=0
+"""Near-miss negatives for F002: replicated values are fine cache keys.
+
+Global shape/dtype/split are identical on every rank by construction —
+even when read off a process-local handle like ``.larray`` (a jax global
+array's ``.shape`` is the global shape).
+"""
+import jax
+
+
+_EXEC_CACHE = {}
+
+
+def cache_keyed_by_global_metadata(x, build):
+    key = (x.shape, str(x.dtype), x.split)
+    _EXEC_CACHE[key] = build(x)
+    return _EXEC_CACHE[key]
+
+
+def cache_keyed_through_larray_shape(x, build):
+    # .larray is tainted (local handle) but its .shape is the GLOBAL
+    # shape of the jax array — replicated, so the key is safe
+    key = x.larray.shape
+    _EXEC_CACHE[key] = build(x)
+    return _EXEC_CACHE[key]
+
+
+def cache_keyed_by_world_size(x, build):
+    key = (jax.process_count(), x.shape)
+    _EXEC_CACHE[key] = build(x)
+    return _EXEC_CACHE[key]

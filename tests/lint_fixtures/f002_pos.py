@@ -1,0 +1,28 @@
+# graftlint-fixture: G002=2
+# graftflow-fixture: F002=4
+"""True positives for F002: process-dependent values in cache keys.
+
+Never executed — parsed by tests/test_graftflow.py. A cache keyed by a
+per-process value silently misses (or worse, hits) differently on every
+rank: compiled-executable caches keyed this way retrace per process, and
+plan caches return different plans to different ranks.
+"""
+import jax
+
+
+_EXEC_CACHE = {}
+_PLAN_CACHE = {}
+
+
+def cache_keyed_by_process_index(x, build):
+    key = (jax.process_index(), x.shape)
+    _EXEC_CACHE[key] = build(x)
+    return _EXEC_CACHE[key]
+
+
+def plan_cache_keyed_by_local_counts(x, plan):
+    # lcounts is the per-process shard layout: a valid key only if every
+    # rank agrees on it, which nothing here establishes
+    counts = tuple(x.lcounts)
+    _PLAN_CACHE[counts] = plan
+    return _PLAN_CACHE[counts]

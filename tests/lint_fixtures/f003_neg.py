@@ -1,0 +1,33 @@
+# graftlint-fixture: G003=0
+# graftflow-fixture: F003=0
+"""Near-miss negatives for F003.
+
+A tainted trip count is only a bug when the body dispatches collectives;
+a replicated trip count may loop over collectives freely.
+"""
+import os
+
+import jax
+
+
+def replicated_trip_count(x):
+    # process_count() is identical everywhere: same trip count, same
+    # collective schedule on every rank
+    for _ in range(jax.process_count()):
+        x = psum(x)
+    return x
+
+
+def tainted_loop_without_collectives(dirname):
+    # per-host trip count, but the body is pure local compute — ranks
+    # may do different amounts of work, nobody blocks
+    total = 0
+    for name in sorted(os.listdir(dirname)):
+        total += len(name)
+    return total
+
+
+def global_shape_trip_count(x):
+    for _ in range(x.shape[0]):
+        x = psum(x)
+    return x

@@ -1,0 +1,29 @@
+# graftlint-fixture: G003=0
+# graftflow-fixture: F003=2
+"""True positives for F003: collectives inside loops with per-process
+trip counts.
+
+Never executed — parsed by tests/test_graftflow.py. If rank 0 iterates 3
+times and rank 1 iterates 2, the third collective has no partner: hang.
+"""
+import os
+
+import jax
+
+
+def drain_local_directory(dirname, x):
+    # os.listdir is per-host state: different hosts see different file
+    # sets, so the loop dispatches a different number of collectives —
+    # sorted() fixes the ORDER (G005) but not the per-host COUNT
+    for name in sorted(os.listdir(dirname)):
+        x = psum(x)
+    return x
+
+
+def while_over_local_shard_count(x):
+    n = len(x.lcounts)
+    i = 0
+    while i < n:
+        x = process_allgather(x)
+        i += 1
+    return x

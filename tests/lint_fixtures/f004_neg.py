@@ -1,0 +1,32 @@
+# graftlint-fixture: G003=0
+# graftflow-fixture: F004=0
+"""Near-miss negatives for F004.
+
+An early exit is only divergent when (a) the predicate is per-process
+AND (b) collectives remain after the exit point.
+"""
+import os
+
+import jax
+
+
+def early_exit_after_all_collectives(x, path):
+    y = process_allgather(x)
+    if not os.path.exists(path):
+        return None  # nothing left to skip: every barrier already passed
+    return y
+
+
+def replicated_early_exit(x, n):
+    # global metadata predicate: every rank returns together or not at all
+    if x.shape[0] < n:
+        return None
+    return psum(x)
+
+
+def laundered_early_exit(x, flag):
+    # the exit decision itself went through a replicating collective
+    ok = process_allgather(flag)
+    if not ok:
+        return None
+    return psum(x)

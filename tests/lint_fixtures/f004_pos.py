@@ -1,0 +1,26 @@
+# graftlint-fixture: G003=0
+# graftflow-fixture: F004=2
+"""True positives for F004: tainted early exits that skip later
+collectives.
+
+Never executed — parsed by tests/test_graftflow.py. The rank that
+returns early never reaches the barrier below; everyone else waits on it
+forever. The arms themselves dispatch nothing, so F001 has nothing to
+say — the divergence is in what comes AFTER.
+"""
+import os
+
+import jax
+
+
+def fs_probe_skips_the_barrier(x, path):
+    if not os.path.exists(path):
+        return None
+    return process_allgather(x)
+
+
+def rank_gated_early_exit(x):
+    pid = jax.process_index()
+    if pid != 0:
+        return x
+    return psum(x)

@@ -1,4 +1,5 @@
 # graftlint-fixture: G001=0
+# graftflow-fixture: F001=0
 """Near-miss negatives for G001: the same shapes, memoized correctly."""
 from functools import lru_cache
 

@@ -1,4 +1,5 @@
 # graftlint-fixture: G001=4
+# graftflow-fixture: F001=0
 """True positives for G001: per-call callables traced into jit/caches.
 
 Never executed — parsed by tests/test_graftlint.py. Each flagged site is

@@ -1,4 +1,5 @@
 # graftlint-fixture: G002=0
+# graftflow-fixture: F002=0
 """Near-miss negatives for G002: bounded or non-cache containers."""
 from functools import lru_cache
 

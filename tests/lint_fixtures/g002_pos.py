@@ -1,4 +1,5 @@
 # graftlint-fixture: G002=3
+# graftflow-fixture: F002=0
 """True positives for G002: unbounded executable caches."""
 import functools
 from functools import lru_cache

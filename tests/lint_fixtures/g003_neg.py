@@ -1,4 +1,5 @@
 # graftlint-fixture: G003=0
+# graftflow-fixture: F001=0
 """Near-miss negatives for G003."""
 import jax
 

@@ -1,4 +1,5 @@
 # graftlint-fixture: G003=3
+# graftflow-fixture: F001=2
 """True positives for G003: collectives under divergent control flow.
 
 Ranks taking different branches dispatch different collective sequences:

@@ -1,4 +1,5 @@
 # graftlint-fixture: G004=0
+# graftflow-fixture: F001=0
 # graftlint: hot-path
 """Near-miss negatives for G004 (same hot-path pragma as the positive)."""
 import numpy as np

@@ -1,4 +1,5 @@
 # graftlint-fixture: G004=4
+# graftflow-fixture: F001=0
 # graftlint: hot-path
 """True positives for G004: implicit host syncs on a hot path.
 

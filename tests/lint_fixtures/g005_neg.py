@@ -1,4 +1,5 @@
 # graftlint-fixture: G005=0
+# graftflow-fixture: F002=0
 """Near-miss negatives for G005."""
 from heat_tpu.core._cache import ExecutableCache
 

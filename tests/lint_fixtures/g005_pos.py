@@ -1,4 +1,5 @@
 # graftlint-fixture: G005=2
+# graftflow-fixture: F002=0
 """True positives for G005: unordered iteration feeding collectives/keys.
 
 Set iteration order depends on hash randomization, so each host walks a

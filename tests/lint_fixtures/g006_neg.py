@@ -1,4 +1,5 @@
 # graftlint-fixture: G006=0
+# graftflow-fixture: F004=0
 """Near-miss negatives for G006: broad handlers that actually handle."""
 from heat_tpu.resilience.errors import ResilienceError
 

@@ -1,4 +1,5 @@
 # graftlint-fixture: G006=3
+# graftflow-fixture: F004=0
 """True positives for G006: broad handlers that ignore the caught error.
 
 A DivergenceError or CollectiveTimeout raised inside the try would be

@@ -1,4 +1,5 @@
 # graftlint-fixture: G007=0
+# graftflow-fixture: F003=0
 # graftlint: durable-path
 """Near-miss negatives for G007 (same durable-path pragma as the
 positive): reads, the sanctioned atomic_write staging pattern, a waived

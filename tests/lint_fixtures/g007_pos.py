@@ -1,4 +1,5 @@
 # graftlint-fixture: G007=4
+# graftflow-fixture: F003=0
 # graftlint: durable-path
 """True positives for G007: direct write-mode open() on a durable path.
 

@@ -30,6 +30,8 @@ def _synthetic_out():
         "ragged_elementwise_speedup": 2.7,
         "ragged_new_moves_per_trip": 0,
         "ragged_seed_moves_per_trip": 2,
+        "lockstep_events": 42,
+        "lockstep_divergences": 0,
         "api_over_kernel": {},
         "vs_best": {},
         "vs_best_median": {},
@@ -55,6 +57,8 @@ class TestCompactSummary:
         assert obj["detail"] == "BENCH_DETAIL.json"
         assert obj["suite_seconds"] == 321.4
         assert obj["ragged_elementwise_speedup"] == 2.7
+        assert obj["lockstep_events"] == 42
+        assert obj["lockstep_divergences"] == 0
         # every headline metric made it into the line
         for k in bench.HEADLINE[1:]:
             assert obj[k] == 99.9
@@ -103,6 +107,17 @@ class TestBenchCheck:
         # one byte under the budget passes
         base["pad"] = "x" * (pad - 1)
         assert bench_check.check(json.dumps(base))["value"] == 1.0
+
+    def test_rejects_lockstep_divergences(self):
+        # a bench whose sanitizer caught ranks out of lockstep produced
+        # numbers under a broken mesh: the whole run is invalid
+        out = _synthetic_out()
+        out["lockstep_divergences"] = 2
+        with pytest.raises(ValueError, match="lockstep"):
+            bench_check.check(json.dumps(bench._compact_summary(out, "d.json")))
+        out["lockstep_divergences"] = "2"
+        with pytest.raises(ValueError, match="must be an int"):
+            bench_check.check(json.dumps(bench._compact_summary(out, "d.json")))
 
     def test_rejects_missing_keys(self):
         with pytest.raises(ValueError, match="missing required keys"):

@@ -1,0 +1,136 @@
+"""Tier-1 gate: the tree must stay graftflow-clean, and the CLI's JSON
+output contract must hold (mirrors ``test_lint_clean.py`` — same schema
+assertions, so a report regression fails the suite rather than the CI
+consumer).
+
+A true finding is fixed (two were, in this PR: the per-host ``aligned``
+decision in ``core/communication.py`` and the wall-clock checkpoint
+cadence in ``resilience/supervisor.py``); an intentional exception is
+waived in place with a ``# graftflow: <tag>`` comment that documents WHY
+(see docs/ANALYSIS.md). Either way the gate stays green — what it
+forbids is silent drift.
+"""
+import json
+import os
+import subprocess
+import sys
+
+from heat_tpu.analysis import graftflow as gf
+from heat_tpu.analysis import graftlint as gl
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# same gated surface as the graftlint gate
+GATED_PATHS = ["heat_tpu", "tools", "bench.py", "examples"]
+
+CLEAN_LINE_BUDGET = 2048
+
+REQUIRED_KEYS = (
+    "tool", "schema_version", "paths", "files_checked", "rules",
+    "findings", "counts", "total", "exit_code",
+)
+
+
+def test_tree_is_flow_clean():
+    findings, files_checked = gf.analyze_paths(
+        [os.path.join(REPO, p) for p in GATED_PATHS]
+    )
+    assert files_checked > 90  # the walker actually saw the tree
+    assert not findings, "graftflow found unwaived violations:\n" + "\n".join(
+        f"  {f.path}:{f.line}:{f.col}: {f.rule} {f.message}" for f in findings
+    )
+
+
+def test_collective_vocabulary_matches_graftlint():
+    """graftflow keeps its own copy of the collective-name set (both
+    halves must stay importable without the other); the copies must not
+    drift."""
+    assert gf.COLLECTIVE_NAMES == gl.COLLECTIVE_NAMES
+
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join("tools", "graftflow.py"), *args],
+        capture_output=True, text=True, cwd=REPO,
+    )
+
+
+def test_cli_clean_exit_zero():
+    proc = _run_cli(*GATED_PATHS)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "clean" in proc.stdout
+
+
+def test_cli_json_contract():
+    proc = _run_cli(*GATED_PATHS, "--format", "json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    assert len(lines) == 1, "JSON mode must emit exactly one line"
+    line = lines[0]
+    assert len(line) <= CLEAN_LINE_BUDGET
+    obj = json.loads(line)
+    missing = [k for k in REQUIRED_KEYS if k not in obj]
+    assert not missing, f"report missing keys: {missing}"
+    assert obj["tool"] == "graftflow"
+    assert obj["schema_version"] == gf.SCHEMA_VERSION
+    assert obj["total"] == 0 and obj["exit_code"] == 0
+    assert sorted(obj["counts"]) == sorted(gf.RULES)
+    assert all(v == 0 for v in obj["counts"].values())
+    assert isinstance(obj["files_checked"], int) and obj["files_checked"] > 90
+    assert {r["id"] for r in obj["rules"]} == set(gf.RULES)
+    for r in obj["rules"]:
+        assert set(r) == {"id", "tag", "bit", "summary"}
+    # the round trip itself: re-serialization must be lossless
+    assert json.loads(json.dumps(obj)) == obj
+
+
+def test_cli_github_format_clean_tree():
+    """A clean tree emits no ::error annotation, just the summary line;
+    a seeded finding emits the workflow-annotation shape."""
+    proc = _run_cli("heat_tpu", "--format", "github")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "::error" not in proc.stdout
+    assert "graftflow:" in proc.stdout
+    report = gf.build_report(
+        ["x.py"],
+        gf.analyze_source(
+            "import jax\n"
+            "def f(xs):\n"
+            "    if jax.process_index() == 0:\n"
+            "        jax.experimental.multihost_utils.process_allgather(xs)\n",
+            "x.py",
+        ),
+        1,
+    )
+    out = gf.render_github(report)
+    assert out.startswith("::error file=x.py,line=")
+    assert "title=graftflow F001" in out
+
+
+def test_cli_report_matches_api():
+    """The CLI is a thin shell over the library: same findings, same code."""
+    proc = _run_cli("heat_tpu", "--format", "json")
+    obj = json.loads(proc.stdout.strip().splitlines()[-1])
+    findings, files_checked = gf.analyze_paths([os.path.join(REPO, "heat_tpu")])
+    assert obj["total"] == len(findings)
+    assert obj["files_checked"] == files_checked
+    assert proc.returncode == gf.exit_code_for(findings)
+
+
+def test_cli_runs_without_jax():
+    """Flow analysis must work on machines with no accelerator runtime:
+    the CLI pulls the analyzer in by file path and never imports
+    heat_tpu/jax."""
+    proc = subprocess.run(
+        [
+            sys.executable, "-c",
+            "import sys; sys.argv = ['graftflow', 'heat_tpu/analysis'];\n"
+            "import tools.graftflow as cli\n"
+            "rc = cli.main(['heat_tpu/analysis'])\n"
+            "assert 'jax' not in sys.modules, 'flow analysis imported jax!'\n"
+            "assert 'heat_tpu' not in sys.modules, 'flow analysis imported heat_tpu!'\n"
+            "sys.exit(rc)",
+        ],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr

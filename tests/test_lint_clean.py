@@ -16,8 +16,10 @@ from heat_tpu.analysis import graftlint as gl
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# the gated surface: the package itself plus the repo tooling
-GATED_PATHS = ["heat_tpu", "tools", "bench.py"]
+# the gated surface: the package itself, the repo tooling, and the
+# runnable examples (user-facing code teaches idiom — it must model the
+# same invariants the library enforces)
+GATED_PATHS = ["heat_tpu", "tools", "bench.py", "examples"]
 
 # a JSON report with zero findings must stay a compact single line; with
 # findings it grows, but the clean-tree gate keeps CI in the small case

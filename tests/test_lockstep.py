@@ -1,0 +1,214 @@
+"""Lockstep sanitizer unit tests (analysis.lockstep).
+
+Single-process here: recording, the ring buffer, the chaos
+``lockstep_divergence`` fault kind, the divergence finder (driven with
+synthetic peer payloads — the real two-process path runs in
+``tests/test_multihost.py``), and the acceptance counter-assert that a
+recording-only sanitizer adds zero compiles and zero host syncs on a
+warm region.
+"""
+import sys
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu import analysis, resilience
+from heat_tpu.analysis import LOCKSTEP_STATS, lockstep, reset_lockstep_stats
+from heat_tpu.analysis import sanitizer
+from heat_tpu.core import _hooks, communication
+
+# the module itself (the package attribute `analysis.lockstep` is the
+# context-manager class, same name-shadow convention as resilience.chaos)
+lk_mod = sys.modules["heat_tpu.analysis.lockstep"]
+
+
+@pytest.fixture(autouse=True)
+def _fresh_stats():
+    reset_lockstep_stats()
+    yield
+
+
+def _dispatch_collectives(n=3):
+    """Fire n real collective fault points (single-process allgathers)."""
+    for i in range(n):
+        communication.ragged_process_allgather(np.arange(i + 1))
+
+
+class TestRecording:
+    def test_stats_exposed_at_package_level(self):
+        assert ht.LOCKSTEP_STATS is LOCKSTEP_STATS
+        assert set(LOCKSTEP_STATS) == {"events", "checks", "divergences", "dropped"}
+
+    def test_collective_events_recorded_in_order(self):
+        with lockstep(check_at_exit=False) as ls:
+            _dispatch_collectives(3)
+        assert ls.events == 3
+        entries = ls.entries()
+        assert [seq for seq, _, _ in entries] == [0, 1, 2]
+        assert all(site == "collective.allgather" for _, site, _ in entries)
+        # shapes differ per dispatch, so the fingerprints must too
+        assert len({fp for _, _, fp in entries}) == 3
+        assert LOCKSTEP_STATS["events"] == 3
+
+    def test_identical_dispatches_fingerprint_identically(self):
+        with lockstep(check_at_exit=False) as ls:
+            communication.ragged_process_allgather(np.arange(4))
+            communication.ragged_process_allgather(np.arange(4))
+        (_, _, fp1), (_, _, fp2) = ls.entries()
+        assert fp1 == fp2
+
+    def test_shard_site_and_non_collectives_excluded(self):
+        with lockstep(check_at_exit=False) as ls:
+            _hooks.fault_point("collective.shard", array=np.zeros(2), rank=0)
+            _hooks.fault_point("io.open", path="/tmp/x")
+            _hooks.fault_point("collective.resplit", gshape=(4,), to_split=0)
+        assert ls.events == 1
+        assert ls.entries()[0][1] == "collective.resplit"
+
+    def test_ring_capacity_bounds_memory_but_not_seq(self):
+        with lockstep(check_at_exit=False, capacity=2) as ls:
+            _dispatch_collectives(5)
+        assert ls.events == 5
+        assert [seq for seq, _, _ in ls.entries()] == [3, 4]
+
+    def test_recording_stops_at_exit(self):
+        with lockstep(check_at_exit=False) as ls:
+            _dispatch_collectives(1)
+        _dispatch_collectives(1)
+        assert ls.events == 1
+
+    def test_single_process_check_is_trivially_clean(self):
+        with lockstep() as ls:  # check_at_exit=True
+            _dispatch_collectives(2)
+        ls.check()
+        assert LOCKSTEP_STATS["checks"] == 2
+        assert LOCKSTEP_STATS["divergences"] == 0
+
+    def test_check_skipped_when_body_raises(self):
+        with pytest.raises(RuntimeError, match="boom"):
+            with lockstep():
+                raise RuntimeError("boom")
+        assert LOCKSTEP_STATS["checks"] == 0
+
+    def test_bad_parameters_rejected(self):
+        with pytest.raises(ValueError, match="check_every"):
+            lockstep(check_every=0)
+        with pytest.raises(ValueError, match="capacity"):
+            lockstep(capacity=0)
+
+
+class TestChaosIntegration:
+    def test_scheduled_drop_simulates_a_skipped_collective(self):
+        with resilience.FaultSchedule(
+            events=[("collective.allgather", 2, "lockstep_divergence")]
+        ) as fs:
+            with lockstep(check_at_exit=False) as ls:
+                _dispatch_collectives(3)
+        assert not fs.pending()
+        assert fs.injected[0].kind == "lockstep_divergence"
+        # event 2 of 3 vanished: the digest now reads like a rank that
+        # dispatched one collective fewer
+        assert ls.events == 2
+        assert LOCKSTEP_STATS["dropped"] == 1
+        assert LOCKSTEP_STATS["events"] == 3  # recorded 3, then one dropped
+
+    def test_drop_without_active_sanitizer_stays_pending(self):
+        with resilience.FaultSchedule(
+            events=[("collective.", 1, "lockstep_divergence")]
+        ) as fs:
+            _dispatch_collectives(1)
+        assert fs.pending() == [("collective.", 1, "lockstep_divergence")]
+        assert LOCKSTEP_STATS["dropped"] == 0
+
+    def test_probabilistic_knob(self):
+        with resilience.chaos(seed=3, lockstep_divergence=1.0) as c:
+            with lockstep(check_at_exit=False) as ls:
+                _dispatch_collectives(2)
+        assert len(c.injected) == 2
+        assert all(i.kind == "lockstep_divergence" for i in c.injected)
+        assert ls.events == 0  # every recorded event was dropped
+
+    def test_non_collective_sites_ineligible(self):
+        with resilience.FaultSchedule(
+            events=[("io.open", 1, "lockstep_divergence")]
+        ) as fs:
+            with lockstep(check_at_exit=False):
+                _hooks.fault_point("io.open", path="/tmp/x")
+        assert fs.pending()  # never eligible at an io site
+
+
+class TestDivergenceFinder:
+    """Drive _compare with synthetic peer payloads — the cross-process
+    gather itself is exercised for real in test_multihost.py."""
+
+    def _rows(self, events, total, pid):
+        rows = [(-1, total, pid)]
+        rows += [
+            (seq, lk_mod._site_crc(site), fp) for seq, site, fp in events
+        ]
+        return np.asarray(rows, dtype=np.int64)
+
+    def _ls_with(self, events):
+        ls = lockstep()
+        for e in events:
+            ls._ring.append(e)
+        ls._seq = len(events)
+        return ls
+
+    def test_identical_digests_are_clean(self):
+        events = [(0, "collective.allgather", 10), (1, "collective.resplit", 20)]
+        ls = self._ls_with(events)
+        ls._compare([self._rows(events, 2, 0), self._rows(events, 2, 1)], "t")
+        assert LOCKSTEP_STATS["divergences"] == 0
+
+    def test_skipped_collective_names_first_divergent_site(self):
+        mine = [(0, "collective.allgather", 10), (1, "collective.resplit", 20)]
+        theirs = [(0, "collective.allgather", 10)]
+        ls = self._ls_with(mine)
+        with pytest.raises(resilience.LockstepError) as ei:
+            ls._compare([self._rows(mine, 2, 0), self._rows(theirs, 1, 1)], "t")
+        err = ei.value
+        assert err.seq == 1
+        assert err.site == "collective.resplit"  # the first divergent call site
+        assert err.counts == (2, 1)
+        assert err.label == "t"
+        assert "collective.resplit" in str(err)
+        assert LOCKSTEP_STATS["divergences"] == 1
+
+    def test_mismatched_operand_names_the_seq(self):
+        mine = [(0, "collective.allgather", 10)]
+        theirs = [(0, "collective.allgather", 99)]  # same site, other shape
+        ls = self._ls_with(mine)
+        with pytest.raises(resilience.LockstepError) as ei:
+            ls._compare([self._rows(mine, 1, 0), self._rows(theirs, 1, 1)], "t")
+        assert ei.value.seq == 0
+
+    def test_lockstep_error_is_a_resilience_error(self):
+        assert issubclass(resilience.LockstepError, resilience.ResilienceError)
+        err = resilience.LockstepError(
+            "m", seq=3, site="collective.x", process_index=1,
+            counts=(4, 3), label="exit",
+        )
+        assert (err.seq, err.site, err.process_index) == (3, "collective.x", 1)
+
+
+class TestOverhead:
+    def test_recording_only_adds_zero_compiles_and_host_syncs(self):
+        """Acceptance: with checking disabled, the sanitizer is pure
+        host-side bookkeeping — a warm region records events but shows
+        zero extra compiles and zero extra host syncs."""
+        x = ht.arange(24, split=0)
+        y = (x * 2 + 1).resplit(None)  # warm every kernel this region uses
+        del y
+        with sanitizer("warm-baseline") as base:
+            y = (x * 2 + 1).resplit(None)
+            del y
+        with lockstep(check_at_exit=False) as ls:
+            with sanitizer("warm-recorded") as rec:
+                y = (x * 2 + 1).resplit(None)
+                del y
+        assert rec.compiles == base.compiles == 0
+        assert rec.host_syncs == base.host_syncs
+        assert rec.collectives == base.collectives
+        assert ls.events == rec.collectives  # it did observe the region

@@ -605,6 +605,118 @@ def test_two_process_supervisor_survives_process_loss(tmp_path):
     assert "n=6 mesh=4" in outs[0]
 
 
+_LOCKSTEP_WORKER = r"""
+import contextlib, sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+pid = int(sys.argv[1]); nproc = int(sys.argv[2]); port = sys.argv[3]
+
+import heat_tpu as ht
+from heat_tpu import analysis, resilience as rz
+from heat_tpu.core import communication
+
+ht.init_distributed(
+    coordinator_address=f"localhost:{port}", num_processes=nproc, process_id=pid
+)
+assert jax.device_count() == 8 and jax.local_device_count() == 4
+
+# --- healthy: identical dispatch on every rank -> clean cross-check ---
+with analysis.lockstep(check_at_exit=False, deadline=60.0) as ls:
+    for i in range(3):
+        communication.ragged_process_allgather(np.arange(i + 1))
+    ls.check("healthy")
+assert ls.events == 3, ls.events
+assert ht.LOCKSTEP_STATS["divergences"] == 0
+
+# --- seeded divergence: chaos drops rank 1's SECOND recorded allgather,
+# so its digest reads exactly like a rank whose control flow skipped that
+# collective. The real collectives still all run (the mesh never wedges:
+# the detector, not the hang, is under test) and the explicit check at a
+# shared program point must convert the skip into a LockstepError on
+# EVERY rank, within the watchdog budget, naming the divergent site. ---
+sched = (
+    rz.FaultSchedule(events=[("collective.allgather", 2, "lockstep_divergence")])
+    if pid == 1
+    else contextlib.nullcontext()
+)
+t0 = time.monotonic()
+err = None
+with sched:
+    with analysis.lockstep(check_at_exit=False, deadline=60.0) as ls:
+        for i in range(3):
+            communication.ragged_process_allgather(np.arange(i + 1))
+        try:
+            ls.check("step-boundary")
+            raise AssertionError("expected LockstepError")
+        except rz.LockstepError as e:
+            err = e
+elapsed = time.monotonic() - t0
+assert elapsed < 60.0, elapsed
+
+# dropping seq 1 shifts rank 1's remaining event down, so BOTH ranks hold
+# an entry at seq 1 with different fingerprints: the first divergent call
+# site is named on both sides, not just on the long rank
+assert err.seq == 1, err.seq
+assert err.site == "collective.allgather", err.site
+assert tuple(err.counts) == (3, 2), err.counts
+assert err.label == "step-boundary", err.label
+assert "collective.allgather" in str(err), err
+assert err.process_index == pid, (err.process_index, pid)
+assert ht.LOCKSTEP_STATS["divergences"] == 1
+if pid == 1:
+    assert ht.LOCKSTEP_STATS["dropped"] == 1
+    assert ls.events == 2, ls.events
+else:
+    assert ht.LOCKSTEP_STATS["dropped"] == 0
+    assert ls.events == 3, ls.events
+
+print(f"WORKER{pid} LOCKSTEP OK seq={err.seq} counts={tuple(err.counts)} "
+      f"elapsed={elapsed:.1f}")
+"""
+
+
+@pytest.mark.skipif(
+    os.environ.get("HEAT_TPU_TEST_DEVICES", "8") != "8",
+    reason="one fixed 2x4 topology is enough for the matrix",
+)
+def test_two_process_lockstep_divergence(tmp_path):
+    """Cross-process collective-lockstep sanitizer under real
+    multi-process execution (PR 7 tentpole): a chaos ``lockstep_divergence``
+    fault makes rank 1's digest skip one allgather; the explicit
+    ``check()`` at a shared program point raises ``LockstepError`` on both
+    ranks — naming the first divergent seq, site, and per-rank counts —
+    instead of the silent mesh-wide hang a real skipped collective causes."""
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+
+    worker = tmp_path / "lockstep_worker.py"
+    worker.write_text(_LOCKSTEP_WORKER)
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env.pop("HEAT_TPU_TEST_DEVICES", None)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(i), "2", str(port)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = [p.communicate(timeout=600)[0] for p in procs]
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out}"
+        assert f"WORKER{i} LOCKSTEP OK" in out, out
+    # both ranks named the SAME divergence point
+    finals = [out.strip().splitlines()[-1].split()[3:6] for out in outs]
+    assert finals[0] == finals[1] == ["seq=1", "counts=(3,", "2)"], finals
+
+
 _PYTEST_DRIVER = r"""
 import os, sys
 import jax

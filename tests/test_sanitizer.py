@@ -16,6 +16,7 @@ from heat_tpu.analysis import (
     SanitizerError,
     sanitizer,
 )
+from heat_tpu.analysis.sanitizer import transfer_guard_active
 from heat_tpu.core import _hooks
 
 
@@ -25,7 +26,7 @@ class TestRegionCounters:
         assert ht.COMPILE_STATS is COMPILE_STATS
         assert set(COMPILE_STATS) == {
             "backend_compiles", "traces", "cache_inserts", "host_syncs",
-            "collectives",
+            "collectives", "transfer_guard_armed",
         }
         assert hasattr(ht, "LAYOUT_STATS") and hasattr(ht, "MOVE_STATS")
 
@@ -115,7 +116,32 @@ class TestRegionCounters:
         ht.sum(x)  # warm
         with sanitizer("guarded", block_host_sync=True) as region:
             _ = ht.sum(x)
+            # the gauge mirrors the region's armed state while inside...
+            assert COMPILE_STATS["transfer_guard_armed"] == int(
+                region.transfer_guard_armed
+            )
+        # ...and always falls back to 0 on exit
+        assert COMPILE_STATS["transfer_guard_armed"] == 0
         region.assert_no_host_sync()
+
+    def test_plain_region_reports_guard_unarmed(self):
+        with sanitizer("plain") as region:
+            pass
+        assert region.transfer_guard_armed is False
+        assert "transfer_guard_armed" not in region.stats()  # gauge, not a delta
+
+    def test_blocked_host_sync_raises_at_call_site(self):
+        """With an EFFECTIVE guard, an implicit device→host conversion
+        inside a blocking region fails at the offending call. Where the
+        guard is inert (backend/version dependent) this scenario is
+        untestable — skip, never vacuously pass."""
+        if not transfer_guard_active():
+            pytest.skip("jax transfer guard is inert on this backend/version")
+        probe = jax.jit(lambda: jnp.zeros(3))()  # device-committed result
+        with pytest.raises(Exception):
+            with sanitizer("hard", block_host_sync=True) as region:
+                assert region.transfer_guard_armed
+                np.asarray(probe)  # implicit transfer: must raise here
 
     def test_running_totals_monotonic(self):
         before = dict(COMPILE_STATS)

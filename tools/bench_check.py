@@ -50,6 +50,16 @@ def validate(line: str, obj: dict) -> None:
         raise ValueError(f"final JSON line is missing required keys: {missing}")
     if not isinstance(obj["value"], (int, float)) or isinstance(obj["value"], bool):
         raise ValueError(f"'value' must be numeric, got {obj['value']!r}")
+    divergences = obj.get("lockstep_divergences", 0)
+    if not isinstance(divergences, int) or isinstance(divergences, bool):
+        raise ValueError(
+            f"'lockstep_divergences' must be an int, got {divergences!r}"
+        )
+    if divergences > 0:
+        raise ValueError(
+            f"bench ran out of collective lockstep: {divergences} divergence(s) "
+            "recorded in LOCKSTEP_STATS — the numbers cannot be trusted"
+        )
     if len(line) >= LINE_BUDGET:
         raise ValueError(
             f"final JSON line is {len(line)} bytes, at or over the {LINE_BUDGET}-byte "

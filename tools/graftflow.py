@@ -1,0 +1,44 @@
+"""CLI for graftflow, the flow-sensitive SPMD taint analyzer.
+
+Usage::
+
+    python tools/graftflow.py [paths...] [--format json|text|github] [--select F001,F004]
+    python tools/graftflow.py --list-rules
+
+or, installed, as the ``graftflow`` entry point (``pyproject.toml``).
+Exit code is a per-finding bitmask (F001=1 ... F004=8, errors=128), so a
+CI step can tell *which* divergence class regressed from the status
+alone; ``--format github`` emits workflow annotations for PR review.
+
+The analyzer itself lives in ``heat_tpu/analysis/graftflow.py`` and is
+pure stdlib; this wrapper loads that file directly so analysis never
+imports ``heat_tpu`` (and therefore never initializes jax or a backend —
+it must be runnable on a machine with no accelerator runtime at all).
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+
+def _load_analyzer():
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "heat_tpu", "analysis", "graftflow.py",
+    )
+    spec = importlib.util.spec_from_file_location("_graftflow_impl", path)
+    mod = importlib.util.module_from_spec(spec)
+    # dataclass processing resolves cls.__module__ through sys.modules, so
+    # the module must be registered before its body executes
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    return _load_analyzer().main(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

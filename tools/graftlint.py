@@ -2,12 +2,13 @@
 
 Usage::
 
-    python tools/graftlint.py [paths...] [--format json|text] [--select G001,G004]
+    python tools/graftlint.py [paths...] [--format json|text|github] [--select G001,G004]
     python tools/graftlint.py --list-rules
 
 or, installed, as the ``graftlint`` entry point (``pyproject.toml``).
 Exit code is a per-rule bitmask (G001=1 ... G007=64, errors=128), so a CI
-step can tell *which* invariant class regressed from the status alone.
+step can tell *which* invariant class regressed from the status alone;
+``--format github`` emits workflow annotations for PR review.
 
 The checker itself lives in ``heat_tpu/analysis/graftlint.py`` and is
 pure stdlib; this wrapper loads that file directly so linting never
