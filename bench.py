@@ -57,6 +57,13 @@ redistribute -> elementwise -> redistribute round trip on a skewed layout,
 new direct-ragged-compute path vs the seed's forced-rebalance path, with
 layout-exchange counts asserted via ``MOVE_STATS``.
 
+A seventh, ``fused_pipeline`` (``bench.py --fused-worker``, same
+8-virtual-device subprocess pattern), times the 3-op standardize chain
+``(x - mu) * isig * w`` through the public API with ``ht.lazy()`` (one
+fused program) against eager dispatch (three programs), plus a raw-jnp
+fused-kernel comparator row; the warm fused trip is counter-asserted in
+the worker to be exactly 1 dispatch, 0 compiles, 0 traces.
+
 Prints exactly ONE compact JSON line (headline numbers + gate state,
 < 2 KB — validated by ``tools/bench_check.py``); the full result dict is
 written to the ``BENCH_DETAIL.json`` sidecar.
@@ -540,9 +547,11 @@ def main():
     out["suite_seconds"] = _suite_seconds()
     out["lockstep_events"] = _ls.events
     out["lockstep_divergences"] = int(analysis.LOCKSTEP_STATS["divergences"])
-    # once per invocation, not per rep: the workload is its own subprocess
-    # with its own repeats, and its gate is the asserted exchange counts
+    # once per invocation, not per rep: these workloads are their own
+    # subprocesses with their own repeats, and their gates are the
+    # asserted exchange/dispatch counts
     out.update(ragged_bench())
+    out.update(fused_bench())
     detail_path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json"
     )
@@ -687,6 +696,144 @@ def ragged_worker():
     )
 
 
+FUSED_ROWS = 1 << 16
+FUSED_COLS = 16
+
+
+def fused_worker():
+    """Subprocess body for the ``fused_pipeline`` workload: the 3-op
+    standardize chain ``(x - mu) * isig * w`` through the public API,
+    ``ht.lazy()`` (ONE fused program per trip) vs eager dispatch (three
+    programs per trip), with a raw-jnp jitted kernel as the structural
+    comparator. The gated number is ``fused_pipeline_speedup`` =
+    fused / eager trips per second.
+
+    Counters are asserted, not assumed: after warmup one fused trip must
+    be exactly 1 fused dispatch served from the program cache with 0 XLA
+    compiles and 0 traces (``Region`` over COMPILE_STATS + FUSE_STATS) —
+    a fusion "speedup" that secretly recompiles per trip would be a lie
+    the timer can't see on a warm chip."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import heat_tpu as ht
+    from heat_tpu.analysis.sanitizer import Region
+    from heat_tpu.core.lazy import FUSE_STATS, reset_fuse_stats
+
+    rows, cols = FUSED_ROWS, FUSED_COLS
+    rng = np.random.default_rng(0)
+    full = rng.normal(size=(rows, cols)).astype(np.float32)
+    x = ht.array(full, split=0)
+    mu = ht.mean(x, axis=0)
+    isig = 1.0 / (ht.std(x, axis=0) + 1e-6)
+    w = ht.array(rng.normal(size=(cols,)).astype(np.float32), split=None)
+
+    def fence(z):
+        # device fence without host assembly (numpy() would gather)
+        float(np.asarray(z._raw[(0,) * z._raw.ndim]))
+
+    def eager_trip():
+        return (x - mu) * isig * w
+
+    def fused_trip():
+        with ht.lazy():
+            return (x - mu) * isig * w
+
+    fence(eager_trip())  # warm both paths
+    fence(fused_trip())
+
+    # the warm-path budget: 1 dispatch, cache-served, 0 compiles/traces
+    reset_fuse_stats()
+    region = Region("warm fused trip")
+    fence(fused_trip())
+    warm_compiles = region.compiles + region.traces
+    warm_dispatches = FUSE_STATS["fused_dispatches"]
+    assert warm_compiles == 0, region.stats()
+    assert warm_dispatches == 1 and FUSE_STATS["cache_hits"] == 1, FUSE_STATS
+    assert FUSE_STATS["eager_fallbacks"] == 0, FUSE_STATS
+
+    def rate(trip, reps=30, attempts=3):
+        best = float("inf")
+        for _ in range(attempts):
+            t0 = time.perf_counter()
+            z = None
+            for _ in range(reps):
+                z = trip()
+            fence(z)
+            best = min(best, time.perf_counter() - t0)
+        return reps / best
+
+    fused_tps = rate(fused_trip)
+    eager_tps = rate(eager_trip)
+
+    # structural comparator: the same chain as ONE hand-fused jnp program
+    # over the raw sharded buffers — the ceiling dispatch can reach
+    kern = jax.jit(lambda xa, m, s, ww: (xa - m) * s * ww)  # graftlint: retrace - built once per bench run
+    xa, m, s, ww = x._raw, mu._raw, isig._raw, w._raw
+    kern(xa, m, s, ww).block_until_ready()
+
+    def kernel_trip():
+        return kern(xa, m, s, ww)
+
+    def kernel_fence(z):
+        float(np.asarray(z[(0,) * z.ndim]))
+
+    def kernel_rate(reps=30, attempts=3):
+        best = float("inf")
+        for _ in range(attempts):
+            t0 = time.perf_counter()
+            z = None
+            for _ in range(reps):
+                z = kernel_trip()
+            kernel_fence(z)
+            best = min(best, time.perf_counter() - t0)
+        return reps / best
+
+    kernel_tps = kernel_rate()
+    print(
+        json.dumps(
+            {
+                "fused_pipeline_speedup": round(fused_tps / eager_tps, 3),
+                "fused_trips_per_sec": round(fused_tps, 2),
+                "eager_trips_per_sec": round(eager_tps, 2),
+                "fused_kernel_trips_per_sec": round(kernel_tps, 2),
+                "fused_warm_compiles": int(warm_compiles),
+                "fused_warm_dispatches": int(warm_dispatches),
+                "fused_unit": (
+                    f"(x-mu)*isig*w standardize trips/s, split=0 "
+                    f"(n={rows}, f={cols}, 8 virtual CPU devices)"
+                ),
+            }
+        )
+    )
+
+
+def fused_bench():
+    """Run the fused_pipeline workload ONCE in a fresh 8-virtual-CPU-
+    device subprocess and fold its JSON line into the output; a failure
+    degrades to a ``fused_error`` field, never kills the bench."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--fused-worker"],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=900,
+        )
+        lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+        if proc.returncode != 0 or not lines:
+            return {"fused_error": (proc.stderr or proc.stdout or "no output")[-400:]}
+        return json.loads(lines[-1])
+    except Exception as e:  # noqa: BLE001 - diagnostics ride in the output
+        return {"fused_error": repr(e)[:400]}
+
+
 def ragged_bench():
     """Run the ragged_elementwise workload ONCE in a fresh 8-virtual-CPU-
     device subprocess and fold its JSON line into the output; a failure
@@ -741,6 +888,10 @@ def _compact_summary(out, detail_path):
         "ragged_new_moves_per_trip",
         "ragged_seed_moves_per_trip",
         "ragged_error",
+        "fused_pipeline_speedup",
+        "fused_warm_compiles",
+        "fused_warm_dispatches",
+        "fused_error",
         "lockstep_events",
         "lockstep_divergences",
     ):
@@ -1372,5 +1523,7 @@ if __name__ == "__main__":
 
     if "--ragged-worker" in sys.argv:
         ragged_worker()
+    elif "--fused-worker" in sys.argv:
+        fused_worker()
     else:
         main()

@@ -27,12 +27,13 @@ from .core.version import __version__
 
 # runtime counters: layout rebalances / ragged exchanges /
 # compiles+transfers / collective-lockstep checks / supervised-recovery
-# activity
+# activity / lazy-fusion captures+dispatches
 from .core.dndarray import LAYOUT_STATS
 from .parallel.flatmove import MOVE_STATS
 from .analysis.sanitizer import COMPILE_STATS
 from .analysis.lockstep import LOCKSTEP_STATS
 from .resilience.supervisor import RECOVERY_STATS
+from .core.lazy import FUSE_STATS
 
 
 def __getattr__(name: str):

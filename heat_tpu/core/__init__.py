@@ -39,6 +39,8 @@ from . import signal
 from .signal import *
 from . import io
 from .io import *
+from . import lazy as _lazy_pkg  # installs the _operations capture hook
+from .lazy import lazy, fuse, LazyDNDarray, FUSE_STATS, reset_fuse_stats
 from .base import *
 from .version import __version__
 

@@ -99,6 +99,53 @@ def guarded_call(label: str, fn, *args, **kwargs):
     return _DEADLINE_RUNNER(label, fn, args, kwargs)
 
 
+# trace-safe mode: a depth counter armed by the lazy-fusion subsystem
+# (:mod:`heat_tpu.core.lazy`) while it replays recorded DNDarray ops under
+# a jax trace (``jax.eval_shape`` metadata probes and the fused-program
+# ``jax.jit``). Two effects, both consulted from core with one integer
+# read: placement helpers (``dndarray._place`` / ``_from_ragged``) skip
+# ``jax.device_put`` — tracers cannot be placed, shardings are pinned via
+# the jit's ``out_shardings`` instead — and host-side data movement
+# (``balance_``, ``flatmove.ragged_move``) raises :class:`TraceBarrierError`
+# so an op that would need a collective exchange under trace is declined
+# at capture time rather than miscompiled. Same layering trick as the
+# slots above: the flag lives down here so core never imports the lazy
+# package at module scope.
+_TRACE_SAFE_DEPTH = 0
+
+
+class TraceBarrierError(RuntimeError):
+    """Raised by host-side data-movement paths entered under trace-safe
+    mode — the signal that an op cannot be captured into a fused program
+    and must take the eager path instead."""
+
+
+def enter_trace_safe() -> None:
+    global _TRACE_SAFE_DEPTH
+    _TRACE_SAFE_DEPTH += 1
+
+
+def exit_trace_safe() -> None:
+    global _TRACE_SAFE_DEPTH
+    _TRACE_SAFE_DEPTH -= 1
+
+
+def in_trace_safe() -> bool:
+    """True while lazy fusion is replaying ops under a jax trace."""
+    return _TRACE_SAFE_DEPTH > 0
+
+
+def trace_barrier(label: str) -> None:
+    """Declare a host-side data-movement site that cannot run under a jax
+    trace (``"balance_"``, ``"ragged_move"``, ...). No-op in normal eager
+    execution; under trace-safe mode raises :class:`TraceBarrierError` so
+    the lazy capture layer falls back to eager for the offending op."""
+    if _TRACE_SAFE_DEPTH > 0:
+        raise TraceBarrierError(
+            f"{label} moves data host-side and cannot run under a jax trace"
+        )
+
+
 # passive event observers: fn(event, ctx) -> None, must not raise. Unlike
 # the injector (which simulates faults) and the deadline runner (which
 # bounds calls), observers only *record*: ``analysis.sanitizer`` registers

@@ -52,6 +52,13 @@ __all__ = [
 
 Scalar = (int, float, bool, complex, np.number, np.bool_)
 
+# Capture hook for the lazy-fusion subsystem: heat_tpu.core.lazy installs
+# its capture module here on import. While a ht.lazy() scope is open the
+# dispatchers below offer each call for capture first; NotImplemented
+# means "not capturable — run eagerly". None (the default, and whenever
+# the lazy package is absent) keeps dispatch on the plain eager path.
+_capture = None
+
 
 def _as_dndarray(x, device=None, comm=None) -> DNDarray:
     from . import factories
@@ -281,6 +288,10 @@ def _binary_op(
 ) -> DNDarray:
     """Apply a binary jnp op with heat promotion/broadcast/split rules
     (reference ``_operations.py:24-205``)."""
+    if _capture is not None and _capture.active():
+        res = _capture.binary(operation, t1, t2, out, where, fn_kwargs)
+        if res is not NotImplemented:
+            return res
     fn_kwargs = fn_kwargs or {}
     if not isinstance(t1, DNDarray) and not isinstance(t2, DNDarray):
         raise TypeError(
@@ -367,6 +378,10 @@ def _local_op(
     ``_operations.py:305-376``). Split, sharding, padding AND raggedness
     are inherited: the op runs on the stored buffer (pad / ragged-invalid
     content stays unspecified), so a ragged array never rebalances here."""
+    if _capture is not None and _capture.active():
+        res = _capture.local(operation, x, out, no_cast, out_dtype, kwargs)
+        if res is not NotImplemented:
+            return res
     if not isinstance(x, DNDarray):
         raise TypeError(f"expected x to be a DNDarray, but was {type(x)}")
     arr = x._raw if x.lcounts is not None else x.larray
@@ -511,6 +526,10 @@ def _reduce_op(
     mask (``ragged_where``). Both modes key the jitted cache by the
     hashable ``(block, lcounts)`` pair — one compile per ragged map.
     """
+    if _capture is not None and _capture.active():
+        res = _capture.reduce(operation, x, axis, out, keepdims, out_dtype, neutral, kwargs)
+        if res is not NotImplemented:
+            return res
     if not isinstance(x, DNDarray):
         raise TypeError(f"expected x to be a DNDarray, but was {type(x)}")
     axis = sanitize_axis(x.shape, axis)
@@ -643,6 +662,10 @@ def _cum_op(
     with the op's identity (``neutral``) first — block order restricted to
     valid positions IS logical order, so every valid prefix is exact.
     """
+    if _capture is not None and _capture.active():
+        res = _capture.cum(operation, x, axis, out, dtype, neutral)
+        if res is not NotImplemented:
+            return res
     if not isinstance(x, DNDarray):
         raise TypeError(f"expected x to be a DNDarray, but was {type(x)}")
     axis = sanitize_axis(x.shape, axis)

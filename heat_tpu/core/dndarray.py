@@ -187,9 +187,13 @@ class DNDarray:
         out._DNDarray__split = split
         out._DNDarray__gshape = gshape
         out._DNDarray__lcounts = lcounts
-        out._DNDarray__array = jax.device_put(
-            buffer, comm.array_sharding(buffer.shape, split)
-        )
+        if _hooks.in_trace_safe():
+            # lazy-fusion replay: see _place — placement is the jit's job
+            out._DNDarray__array = buffer
+        else:
+            out._DNDarray__array = jax.device_put(
+                buffer, comm.array_sharding(buffer.shape, split)
+            )
         return out
 
     # ------------------------------------------------------------------ meta
@@ -703,6 +707,7 @@ class DNDarray:
         cur = tuple(int(c) for c in self.lshape_map[:, split])
         if counts == cur:
             return self
+        _hooks.trace_barrier("redistribute_")
         canonical = self.__comm.counts_displs_shape(self.__gshape, split)[0]
         b_out = max(1, max(counts))
         if counts == tuple(canonical):
@@ -733,6 +738,7 @@ class DNDarray:
         exchanges actually performed (tests hook it to prove hot paths
         stay ragged)."""
         if self.lcounts is not None:
+            _hooks.trace_barrier("balance_")
             LAYOUT_STATS["rebalances"] += 1
             canonical, _, _ = self.__comm.counts_displs_shape(self.__gshape, self.__split)
             self._ragged_redistribute(tuple(canonical))
@@ -1672,6 +1678,10 @@ def _place(
                 f"buffer shape {tuple(array.shape)} matches neither logical {gshape} "
                 f"nor padded {target_shape}"
             )
+    if _hooks.in_trace_safe():
+        # lazy-fusion replay: tracers cannot be device_put; the fused
+        # program's out_shardings pin the final placement instead
+        return array
     target = comm.array_sharding(array.shape, split)
     current = getattr(array, "sharding", None)
     if not force and current is not None and current.is_equivalent_to(target, array.ndim):

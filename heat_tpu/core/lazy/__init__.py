@@ -1,0 +1,36 @@
+"""Opt-in deferred execution: fuse DNDarray op chains into single XLA programs.
+
+Public surface (re-exported as ``ht.lazy`` / ``ht.fuse`` /
+``ht.FUSE_STATS``):
+
+- :func:`~heat_tpu.core.lazy.capture.lazy` — context manager; supported
+  ops inside the scope are recorded instead of dispatched and the whole
+  chain runs as ONE fused ``jax.jit`` program at scope exit;
+- :func:`~heat_tpu.core.lazy.capture.fuse` — decorator form;
+- ``FUSE_STATS`` / :func:`reset_fuse_stats` — capture/dispatch counters.
+
+Importing this package installs the capture hook into
+:mod:`heat_tpu.core._operations`; with no open scope the hook is a single
+``is None``-guarded attribute read per dispatch.
+"""
+from . import capture, evaluate, graph
+from .capture import LazyDNDarray, LazyScope, fuse, lazy
+from .evaluate import META_CACHE, PROGRAM_CACHE
+from .graph import FUSE_STATS, reset_fuse_stats
+
+from .. import _operations
+
+# hand the dispatchers their capture entry points (kept None until this
+# package is imported so _operations has no import-cycle on lazy)
+_operations._capture = capture
+
+__all__ = [
+    "lazy",
+    "fuse",
+    "LazyScope",
+    "LazyDNDarray",
+    "FUSE_STATS",
+    "reset_fuse_stats",
+    "META_CACHE",
+    "PROGRAM_CACHE",
+]

@@ -1,0 +1,337 @@
+"""Capture side of the lazy-fusion subsystem.
+
+:class:`LazyScope` (exposed as ``ht.lazy()``) pushes a scope onto a
+module-level stack; while any scope is active the four generic
+dispatchers in :mod:`heat_tpu.core._operations` offer each call to this
+module *before* dispatching. A supported call is recorded as a
+:class:`~heat_tpu.core.lazy.graph.Node` and answered with a
+:class:`LazyDNDarray` — a real DNDarray whose buffer does not exist yet.
+An unsupported call (``out=``, non-default ``where=``, unhashable
+statics, a per-call closure op, an operand that would need a host-side
+ragged exchange, ...) is *declined*: the dispatcher proceeds eagerly,
+``FUSE_STATS["eager_fallbacks"]`` counts it, and the answer is correct
+either way — capture is a performance path, never a semantics path.
+
+The escape hatch is the buffer property: DNDarray compiles every
+``self.__array`` read to the fixed attribute name ``_DNDarray__array``,
+and LazyDNDarray intercepts exactly that name with a data descriptor.
+*Any* base-class code path that touches real data — ``.numpy()``,
+``print``, ``.item()``, indexing, I/O, resplit, an op outside the
+supported set — therefore forces evaluation of the pending subgraph
+transparently, with zero per-method shimming. Metadata stays free:
+``shape``/``dtype``/``split``/``lcounts``/``lshape_map`` answer from the
+node's inferred layout without materializing.
+"""
+from __future__ import annotations
+
+import functools
+import weakref
+from typing import List, Optional
+
+from .. import _hooks, _operations
+from ..dndarray import DNDarray
+from . import evaluate
+from .graph import FUSE_STATS, Leaf, Node, NodeMeta, scalar_token
+
+__all__ = ["LazyDNDarray", "LazyScope", "lazy", "fuse", "active",
+           "binary", "local", "reduce", "cum"]
+
+# innermost-last stack of open ht.lazy() scopes
+_SCOPES: List["_Scope"] = []
+
+# why the most recent capture was declined (debugging aid; not API)
+_LAST_DECLINE: Optional[str] = None
+
+
+def active() -> bool:
+    """True when dispatcher calls should be offered for capture: some
+    scope is open and we are not inside our own replay/inference (which
+    runs the dispatchers eagerly under trace-safe mode)."""
+    return bool(_SCOPES) and not _hooks.in_trace_safe()
+
+
+class _Scope:
+    __slots__ = ("created",)
+
+    def __init__(self):
+        self.created: List[Node] = []
+
+
+class LazyDNDarray(DNDarray):
+    """A DNDarray whose buffer is a pending node of a captured graph.
+
+    Layout metadata (``gshape``/``dtype``/``split``/``lcounts``) is
+    inferred at capture time by the same dispatcher code the eager path
+    runs, so metadata consumers never force. The physical buffer
+    materializes on first access — through scope exit (fused program),
+    or on demand when base-class code reads ``_DNDarray__array`` (the
+    name-mangled spelling of every ``self.__array`` in dndarray.py,
+    intercepted below by a data descriptor, which takes precedence over
+    the instance dict)."""
+
+    @classmethod
+    def _from_node(cls, node: Node) -> "LazyDNDarray":
+        out = cls.__new__(cls)
+        m = node.meta
+        out._DNDarray__comm = m.comm
+        out._DNDarray__device = m.device
+        out._DNDarray__dtype = m.dtype
+        out._DNDarray__split = m.split
+        out._DNDarray__gshape = m.gshape
+        out._DNDarray__lcounts = m.lcounts
+        out._lazy_node = node
+        node.ref = weakref.ref(out)
+        return out
+
+    # The buffer trap. The getter materializes; the setter (hit by
+    # larray=/-_set_buffer-style rebinds, e.g. in-place operators) simply
+    # detaches this array from its node by storing a concrete buffer.
+    @property
+    def _DNDarray__array(self):
+        buf = self.__dict__.get("_lazy_buf")
+        if buf is None:
+            buf = _force(self)
+        return buf
+
+    @_DNDarray__array.setter
+    def _DNDarray__array(self, value):
+        self.__dict__["_lazy_buf"] = value
+
+    def _lazy_fill(self, buf) -> None:
+        """Install the evaluated buffer (called by the evaluator)."""
+        self.__dict__["_lazy_buf"] = buf
+
+    @property
+    def pshape(self):
+        """Physical buffer shape — from the inferred layout while
+        pending (the base property would read the buffer and force)."""
+        buf = self.__dict__.get("_lazy_buf")
+        if buf is not None:
+            return tuple(buf.shape)
+        return self._lazy_node.meta.pshape
+
+    @property
+    def padded(self) -> bool:
+        return self.lcounts is not None or self.pshape != self.gshape
+
+    @property
+    def is_materialized(self) -> bool:
+        """True once this result's buffer exists (evaluation ran)."""
+        return self.__dict__.get("_lazy_buf") is not None
+
+
+def _force(arr: LazyDNDarray):
+    """Materialize ``arr`` now: evaluate its pending ancestor closure as
+    one fused program. Counted as an eager fallback when it happens
+    inside an open scope (something needed real data mid-capture)."""
+    node = arr._lazy_node
+    if node.buffer is None:
+        if active():
+            FUSE_STATS["eager_fallbacks"] += 1
+        evaluate.evaluate([node])
+    arr.__dict__["_lazy_buf"] = node.buffer
+    return node.buffer
+
+
+# ------------------------------------------------------------------ public API
+class LazyScope:
+    """Context manager recording supported DNDarray ops into a graph.
+
+    On clean exit every still-reachable pending result created in the
+    scope is evaluated in one fused program (per communicator); on an
+    exception the scope is popped *without* evaluating — eager execution
+    is fully restored, and any escaped pending arrays materialize
+    transparently on first access."""
+
+    def __init__(self):
+        self._scope: Optional[_Scope] = None
+
+    def __enter__(self) -> "LazyScope":
+        self._scope = _Scope()
+        _SCOPES.append(self._scope)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        scope, self._scope = self._scope, None
+        try:
+            _SCOPES.remove(scope)
+        except ValueError:  # pragma: no cover - defensive (misnested exit)
+            pass
+        if exc_type is None and scope is not None:
+            targets = [
+                n
+                for n in scope.created
+                if n.buffer is None and n.ref is not None and n.ref() is not None
+            ]
+            if targets:
+                evaluate.evaluate(targets)
+        return False
+
+
+def lazy() -> LazyScope:
+    """Open a lazy-evaluation scope::
+
+        with ht.lazy():
+            z = (x - mu) / sigma      # recorded, not dispatched
+            s = ht.sum(z * z, axis=0)
+        # scope exit: one fused XLA program computes z and s
+
+    Results are bit-identical to eager execution: evaluation replays the
+    recorded calls through the original dispatchers inside one
+    ``jax.jit``. Anything that needs real data mid-scope (``.numpy()``,
+    ``print``, ``.item()``, indexing, an unsupported op) forces the
+    pending subgraph and continues; see docs/PERFORMANCE.md.
+    """
+    return LazyScope()
+
+
+def fuse(fn):
+    """Decorator form of :func:`lazy`: the whole function body records
+    into one scope and its results are evaluated (fused) on return::
+
+        @ht.fuse
+        def standardize(x, mu, sigma):
+            return (x - mu) / sigma
+    """
+
+    @functools.wraps(fn)
+    def fused(*args, **kwargs):
+        with LazyScope():
+            return fn(*args, **kwargs)
+
+    return fused
+
+
+# ------------------------------------------------------------- capture points
+def _decline(reason: str):
+    global _LAST_DECLINE
+    _LAST_DECLINE = reason
+    FUSE_STATS["eager_fallbacks"] += 1
+    return NotImplemented
+
+
+def _op_token_ok(op) -> bool:
+    """Ops key caches by object identity: module-level functions and
+    ``_cache_stable`` closures are stable; per-call closures / partials
+    would make every graph signature unique (the G001 retrace bug) and
+    are declined."""
+    if isinstance(op, functools.partial):
+        return False
+    if "<locals>" in getattr(op, "__qualname__", "") and not getattr(
+        op, "_cache_stable", False
+    ):
+        return False
+    try:
+        hash(op)
+    except TypeError:  # pragma: no cover - defensive
+        return False
+    return True
+
+
+def _operand(t: DNDarray):
+    """Graph wiring for a DNDarray operand: a pending lazy result links
+    by node; anything concrete (including an already-materialized lazy
+    result) snapshots its buffer + layout as a leaf."""
+    if isinstance(t, LazyDNDarray):
+        node = getattr(t, "_lazy_node", None)
+        if (
+            node is not None
+            and node.buffer is None
+            and t.__dict__.get("_lazy_buf") is None
+        ):
+            return ("node", node)
+    return ("leaf", Leaf(t._raw, NodeMeta.of(t)))
+
+
+def _capture(kind: str, op, raw_operands, statics, sig_statics):
+    """Common tail of the four capture points: validate, wire operands,
+    infer the result layout through the eager rules, and hand back a
+    pending LazyDNDarray. Any failure (unhashable statics, an op that
+    would need a host-side exchange under trace, a genuine user error
+    the eager path will re-raise) declines."""
+    if not _op_token_ok(op):
+        return _decline("per-call closure or unhashable op")
+    operands = []
+    comm = None
+    for t in raw_operands:
+        if isinstance(t, DNDarray):
+            if comm is None:
+                comm = t.comm
+            elif t.comm != comm:
+                return _decline("operands on different communicators")
+            operands.append(_operand(t))
+        else:
+            tok = scalar_token(t)
+            if tok is None:
+                return _decline("untokenizable scalar operand")
+            operands.append(("scalar", t))
+    if comm is None:
+        return _decline("no DNDarray operand")
+    try:
+        hash(sig_statics)
+    except TypeError:
+        return _decline("unhashable statics")
+    infer_specs = [
+        (("meta", v.meta) if tag in ("node", "leaf") else (tag, v))
+        for tag, v in operands
+    ]
+    try:
+        meta = evaluate.infer_meta(kind, op, sig_statics, statics, infer_specs, comm)
+    except Exception as e:
+        # includes TraceBarrierError (op needs a host-side exchange) and
+        # genuine user errors, which the eager path will raise identically
+        return _decline(f"{type(e).__name__}: {e}")
+    node = Node(kind, op, operands, statics, sig_statics, meta)
+    _SCOPES[-1].created.append(node)
+    return LazyDNDarray._from_node(node)
+
+
+def binary(operation, t1, t2, out, where, fn_kwargs):
+    if out is not None or where is not True:
+        return _decline("out=/where= not captured")
+    kwargs = dict(fn_kwargs) if fn_kwargs else {}
+    kwargs_key = _operations._kwargs_key(kwargs)
+    if kwargs_key is None:
+        return _decline("unhashable fn_kwargs")
+    if not (isinstance(t1, DNDarray) or isinstance(t2, DNDarray)):
+        return _decline("no DNDarray operand")
+    for t in (t1, t2):
+        if not isinstance(t, (DNDarray,) + _operations.Scalar):
+            return _decline("non-scalar, non-DNDarray operand")
+    return _capture("binary", operation, (t1, t2), (kwargs,), ("b", kwargs_key))
+
+
+def local(operation, x, out, no_cast, out_dtype, kwargs):
+    if out is not None or not isinstance(x, DNDarray):
+        return _decline("out= / non-DNDarray input")
+    kwargs = dict(kwargs)
+    kwargs_key = _operations._kwargs_key(kwargs)
+    if kwargs_key is None:
+        return _decline("unhashable kwargs")
+    return _capture(
+        "local", operation, (x,), (bool(no_cast), out_dtype, kwargs),
+        ("l", bool(no_cast), out_dtype, kwargs_key),
+    )
+
+
+def reduce(operation, x, axis, out, keepdims, out_dtype, neutral, kwargs):
+    if out is not None or not isinstance(x, DNDarray):
+        return _decline("out= / non-DNDarray input")
+    kwargs = dict(kwargs)
+    kwargs_key = _operations._kwargs_key(kwargs)
+    if kwargs_key is None:
+        return _decline("unhashable kwargs")
+    return _capture(
+        "reduce", operation, (x,),
+        (axis, bool(keepdims), out_dtype, neutral, kwargs),
+        ("r", _operations._axis_key(axis), bool(keepdims), out_dtype, neutral, kwargs_key),
+    )
+
+
+def cum(operation, x, axis, out, dtype, neutral):
+    if out is not None or not isinstance(x, DNDarray):
+        return _decline("out= / non-DNDarray input")
+    return _capture(
+        "cum", operation, (x,), (axis, dtype, neutral),
+        ("c", _operations._axis_key(axis), dtype, neutral),
+    )

@@ -1,0 +1,266 @@
+"""Lowering captured graphs into single fused XLA programs.
+
+Two cached abstract-evaluation layers keep the warm path at zero traces
+and zero compiles (region-asserted via ``COMPILE_STATS`` in the tests):
+
+- :func:`infer_meta` answers "what layout does this op produce?" at
+  capture time by running the *original eager dispatcher* on abstract
+  values (``jax.eval_shape``) under trace-safe mode, so a pending
+  result's ``gshape``/``dtype``/``split``/``lcounts`` follow exactly the
+  same rules as eager execution — there is no second copy of the
+  promotion/broadcast/layout logic to drift. Results are cached in a
+  bounded ``ExecutableCache`` keyed by (kind, op, statics, operand
+  layouts), so only the first sighting of an op shape traces.
+
+- :func:`evaluate` lowers a pending subgraph into ONE ``jax.jit``
+  program that reconstructs plain DNDarrays from the leaf buffers and
+  replays the recorded dispatcher calls; XLA fuses the chain and inserts
+  collectives only where the sharded computation actually needs them
+  (e.g. a cross-split reduction). Programs live in a bounded
+  ``ExecutableCache`` keyed by the serialized graph + leaf layouts +
+  communicator, so a warm replay is a single cached dispatch.
+
+Replay correctness leans on one invariant: the functions below never
+re-enter capture (trace-safe mode turns ``capture.active()`` off) and
+never move data host-side (``_hooks.trace_barrier`` sites raise, which
+:mod:`heat_tpu.core.lazy.capture` converts into an eager fallback at
+capture time — such an op is simply never part of a graph).
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+
+from .. import _hooks
+from .._cache import ExecutableCache
+from ..dndarray import DNDarray
+from .graph import FUSE_STATS, Leaf, Node, NodeMeta, scalar_token
+
+__all__ = ["infer_meta", "evaluate", "META_CACHE", "PROGRAM_CACHE"]
+
+# op-shape metadata probes: one eval_shape per distinct (op, layout)
+META_CACHE = ExecutableCache(maxsize=1024)
+# fused executables: one jit per distinct (graph, leaf layouts, comm)
+PROGRAM_CACHE = ExecutableCache(maxsize=256)
+
+
+def _reconstruct(meta: NodeMeta, buf) -> DNDarray:
+    """A plain DNDarray over ``buf`` with ``meta``'s layout. Only called
+    under trace-safe mode, where ``_place``/``_from_ragged`` skip
+    ``device_put`` (tracers cannot be placed; the program's
+    ``out_shardings`` pin final placement)."""
+    if meta.lcounts is not None:
+        return DNDarray._from_ragged(
+            buf, meta.gshape, meta.dtype, meta.split, meta.lcounts, meta.device, meta.comm
+        )
+    return DNDarray._from_buffer(
+        buf, meta.gshape, meta.dtype, meta.split, meta.device, meta.comm
+    )
+
+
+def _replay_one(kind: str, op, statics, args) -> DNDarray:
+    """Re-execute one captured call through the original eager
+    dispatcher (``out=`` and non-default ``where=`` are never captured,
+    so the replay surface is exactly the supported set)."""
+    from .. import _operations as ops
+
+    if kind == "binary":
+        (fn_kwargs,) = statics
+        return ops._binary_op(op, args[0], args[1], fn_kwargs=fn_kwargs or None)
+    if kind == "local":
+        no_cast, out_dtype, kwargs = statics
+        return ops._local_op(op, args[0], no_cast=no_cast, out_dtype=out_dtype, **kwargs)
+    if kind == "reduce":
+        axis, keepdims, out_dtype, neutral, kwargs = statics
+        return ops._reduce_op(
+            op, args[0], axis=axis, keepdims=keepdims, out_dtype=out_dtype,
+            neutral=neutral, **kwargs,
+        )
+    axis, dtype, neutral = statics  # kind == "cum"
+    return ops._cum_op(op, args[0], axis, dtype=dtype, neutral=neutral)
+
+
+def infer_meta(kind: str, op, sig_statics, statics, operands, comm) -> NodeMeta:
+    """Layout of the result of one captured call, without running it.
+
+    ``operands`` is the capture-order list of ``("meta", NodeMeta)`` /
+    ``("scalar", value)`` pairs. Raises whatever the dispatcher would
+    raise for an unsupported combination (including
+    ``TraceBarrierError`` for ops that need a host-side exchange) — the
+    caller turns any failure into an eager fallback."""
+    tokens = tuple(
+        ("m",) + v.token if tag == "meta" else ("s",) + tuple(scalar_token(v))
+        for tag, v in operands
+    )
+    key = (kind, op, sig_statics, tokens, comm)
+    hit = META_CACHE.get(key)
+    if hit is not None:
+        return hit
+
+    structs = [
+        jax.ShapeDtypeStruct(v.pshape, v.dtype.jax_type())
+        for tag, v in operands
+        if tag == "meta"
+    ]
+    box: List[NodeMeta] = []
+
+    def probe(*bufs):
+        it = iter(bufs)
+        args = [
+            _reconstruct(v, next(it)) if tag == "meta" else v for tag, v in operands
+        ]
+        res = _replay_one(kind, op, statics, args)
+        box.append(NodeMeta.of(res))
+        return res._raw
+
+    _hooks.enter_trace_safe()
+    try:
+        jax.eval_shape(probe, *structs)
+    finally:
+        _hooks.exit_trace_safe()
+    meta = box[0]
+    META_CACHE[key] = meta
+    return meta
+
+
+def _collect(targets: Sequence[Node]) -> List[Node]:
+    """Unevaluated ancestor closure of ``targets`` in creation order
+    (creation order IS topological order: operands always precede their
+    consumers)."""
+    found = {}
+    stack = list(targets)
+    while stack:
+        n = stack.pop()
+        if id(n) in found or n.buffer is not None:
+            continue
+        found[id(n)] = n
+        for tag, v in n.inputs:
+            if tag == "node" and v.buffer is None:
+                stack.append(v)
+    return sorted(found.values(), key=lambda n: n.seq)
+
+
+def _build_program(spec, leaf_metas, out_ids, out_metas, comm):
+    """One jitted program replaying ``spec`` over the leaf buffers.
+
+    The spec closes over only plain Python data (ops, statics, layout
+    metadata) — never over leaf buffers — so a cached program pins no
+    device memory beyond its executable. Output shardings are pinned
+    explicitly from the recorded layouts; inputs arrive committed with
+    their eager shardings."""
+    shardings = tuple(comm.array_sharding(m.pshape, m.split) for m in out_metas)
+
+    def run(*bufs):
+        _hooks.enter_trace_safe()
+        try:
+            leaves = [_reconstruct(m, b) for m, b in zip(leaf_metas, bufs)]
+            env: List[DNDarray] = []
+            for kind, op, statics, wiring in spec:
+                args = [
+                    env[v] if tag == "n" else (leaves[v] if tag == "l" else v)
+                    for tag, v in wiring
+                ]
+                env.append(_replay_one(kind, op, statics, args))
+            return tuple(env[i]._raw for i in out_ids)
+        finally:
+            _hooks.exit_trace_safe()
+
+    return jax.jit(run, out_shardings=shardings)
+
+
+def _evaluate_group(comm, targets: Sequence[Node]) -> None:
+    nodes = _collect(targets)
+    if not nodes:
+        return
+    index = {id(n): i for i, n in enumerate(nodes)}
+    target_ids = {id(n) for n in targets}
+
+    leaf_bufs, leaf_metas = [], []
+    leaf_ix = {}
+    spec, sig_nodes = [], []
+    for n in nodes:
+        wiring, sig_args = [], []
+        for tag, v in n.inputs:
+            if tag == "node" and v.buffer is None:
+                wiring.append(("n", index[id(v)]))
+                sig_args.append(("n", index[id(v)]))
+            elif tag == "scalar":
+                wiring.append(("s", v))
+                sig_args.append(("s",) + tuple(scalar_token(v)))
+            else:
+                buf = v.buffer  # Leaf, or an already-evaluated Node
+                meta = v.meta
+                j = leaf_ix.get(id(buf))
+                if j is None:
+                    j = len(leaf_bufs)
+                    leaf_ix[id(buf)] = j
+                    leaf_bufs.append(buf)
+                    leaf_metas.append(meta)
+                wiring.append(("l", j))
+                sig_args.append(("l", j))
+        spec.append((n.kind, n.op, n.statics, tuple(wiring)))
+        sig_nodes.append((n.kind, n.op, n.sig_statics, tuple(sig_args)))
+
+    for buf in leaf_bufs:
+        if buf.is_deleted():
+            raise RuntimeError(
+                "a buffer captured into a lazy graph was donated before "
+                "evaluation (in-place __setitem__ on a source array inside "
+                "a ht.lazy() scope); materialize consumers before mutating "
+                "their inputs"
+            )
+
+    # a node stays a program output while its LazyDNDarray is reachable
+    # (someone may still read it) or it was explicitly forced; dead
+    # intermediates stay fused away inside the program
+    out_ids = tuple(
+        i
+        for i, n in enumerate(nodes)
+        if id(n) in target_ids or (n.ref is not None and n.ref() is not None)
+    )
+    out_metas = [nodes[i].meta for i in out_ids]
+
+    sig = (comm, tuple(m.token for m in leaf_metas), tuple(sig_nodes), out_ids)
+    prog = PROGRAM_CACHE.get(sig)
+    if prog is None:
+        prog = _build_program(spec, leaf_metas, out_ids, out_metas, comm)
+        PROGRAM_CACHE[sig] = prog
+        FUSE_STATS["graphs_captured"] += 1
+    else:
+        FUSE_STATS["cache_hits"] += 1
+    FUSE_STATS["fused_dispatches"] += 1
+
+    outs = prog(*leaf_bufs)
+    for i, buf in zip(out_ids, outs):
+        n = nodes[i]
+        n.buffer = buf
+        arr = n.ref() if n.ref is not None else None
+        if arr is not None:
+            arr._lazy_fill(buf)
+    for n in nodes:
+        if n.buffer is not None:
+            n.release_inputs()
+
+
+def evaluate(targets: Sequence[Node]) -> None:
+    """Materialize ``targets`` (and their unevaluated ancestors), one
+    fused program per communicator (disjoint chains on different meshes
+    cannot share a jit)."""
+    pending, seen = [], set()
+    for n in targets:
+        if n.buffer is None and id(n) not in seen:
+            seen.add(id(n))
+            pending.append(n)
+    if not pending:
+        return
+    groups: List[Tuple[object, List[Node]]] = []
+    for n in pending:
+        for c, lst in groups:
+            if c == n.meta.comm:
+                lst.append(n)
+                break
+        else:
+            groups.append((n.meta.comm, [n]))
+    for c, lst in groups:
+        _evaluate_group(c, lst)

@@ -1,0 +1,181 @@
+"""Expression-graph representation for the lazy-fusion subsystem.
+
+A captured op becomes a :class:`Node`; its operands are other nodes
+(pending captured results), :class:`Leaf` snapshots of concrete
+DNDarray buffers, or plain Python scalars held as statics. The graph is
+deliberately *metadata-complete*: every node carries the full layout
+tuple (``gshape``/``dtype``/``split``/``lcounts``/``pshape``) computed
+at capture time by abstract-evaluating the same dispatcher code the
+eager path runs (see :mod:`heat_tpu.core.lazy.evaluate`), so user code
+can read ``.shape``/``.dtype``/``.lshape_map`` off a pending result
+without forcing it.
+
+Signatures
+----------
+A fused program is cached by a *signature*: the topologically serialized
+graph (op identities, static kwargs, operand wiring) plus the leaf
+layout tuples and the communicator — the ``(graph hash, mesh, split,
+lcounts, dtype)`` key of the graftlint G001/G002 discipline. Scalars are
+tokenized by type and value (floats via ``float.hex`` so a NaN keys
+consistently — nan != nan would make every lookup miss, the
+``_jitted_reduce`` "__nan__" lesson), and ops key by object identity,
+which is stable for ``jnp`` module functions and for module-level
+closures marked ``_cache_stable`` — per-call ``<locals>`` closures are
+declined at capture instead of poisoning the cache.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Optional, Tuple
+
+__all__ = ["FUSE_STATS", "reset_fuse_stats", "NodeMeta", "Leaf", "Node", "scalar_token"]
+
+# Counters for the lazy-fusion subsystem (module-level like LAYOUT_STATS /
+# MOVE_STATS; re-exported as ``heat_tpu.FUSE_STATS``):
+#
+# - ``graphs_captured``   distinct pending subgraphs lowered into a new
+#                         fused program (program-cache misses);
+# - ``fused_dispatches``  fused-program executions (a warm chain is
+#                         exactly one of these);
+# - ``cache_hits``        executions served by a cached executable — on a
+#                         warm replay ``cache_hits`` rises with
+#                         ``fused_dispatches`` while ``graphs_captured``
+#                         and COMPILE_STATS compiles/traces stay flat;
+# - ``eager_fallbacks``   ops inside a ``ht.lazy()`` scope that could not
+#                         be captured (unsupported form, ``out=``, an op
+#                         needing a host-side exchange, ...) plus forced
+#                         mid-scope materializations (``.numpy()``,
+#                         ``print``, indexing, ``.item()``); either way
+#                         the op itself runs eagerly and stays correct.
+FUSE_STATS = {
+    "graphs_captured": 0,
+    "fused_dispatches": 0,
+    "eager_fallbacks": 0,
+    "cache_hits": 0,
+}
+
+
+def reset_fuse_stats() -> None:
+    """Zero all FUSE_STATS counters (test/bench isolation)."""
+    for k in FUSE_STATS:
+        FUSE_STATS[k] = 0
+
+
+_seq = itertools.count()
+
+
+class NodeMeta:
+    """Full layout metadata of a (pending or concrete) DNDarray.
+
+    ``token`` is the hashable signature fragment: physical shape, heat
+    dtype, split axis and ragged ``lcounts`` — everything that changes
+    the traced program. ``comm``/``device`` ride along for
+    reconstruction but the communicator enters the signature once per
+    graph (all nodes of one fused program share it)."""
+
+    __slots__ = ("gshape", "dtype", "split", "lcounts", "pshape", "device", "comm")
+
+    def __init__(self, gshape, dtype, split, lcounts, pshape, device, comm):
+        self.gshape = tuple(gshape)
+        self.dtype = dtype
+        self.split = split
+        self.lcounts = None if lcounts is None else tuple(lcounts)
+        self.pshape = tuple(pshape)
+        self.device = device
+        self.comm = comm
+
+    @property
+    def token(self) -> Tuple:
+        return (self.pshape, self.gshape, self.dtype, self.split, self.lcounts)
+
+    @classmethod
+    def of(cls, x) -> "NodeMeta":
+        """Snapshot a live DNDarray's layout (lazy or concrete — the
+        LazyDNDarray ``pshape``/``lcounts`` overrides answer from node
+        metadata without forcing)."""
+        # graftflow: F002 - lcounts is replicated layout metadata by
+        # construction (set from global layout decisions on every rank),
+        # so a signature keyed by it is rank-uniform; see _operations.
+        return cls(x.gshape, x.dtype, x.split, x.lcounts, x.pshape, x.device, x.comm)
+
+
+class Leaf:
+    """A concrete operand captured by reference: the physical buffer as
+    it was at capture time plus its layout. Holding the ``jax.Array``
+    itself (not the DNDarray) pins the *value*: a later in-place update
+    of the source array rebinds its buffer and cannot retroactively
+    change an already-captured graph. The one sharp edge is donation
+    (basic-index ``__setitem__`` donates the old buffer); evaluation
+    checks ``is_deleted()`` and raises a clear error instead of reading
+    freed memory."""
+
+    __slots__ = ("buffer", "meta")
+
+    def __init__(self, buffer, meta: NodeMeta):
+        self.buffer = buffer
+        self.meta = meta
+
+
+class Node:
+    """One captured dispatcher call.
+
+    ``kind`` selects the replay entry point (``"binary"`` / ``"local"``
+    / ``"reduce"`` / ``"cum"``); ``inputs`` is the operand wiring as
+    ``("node", Node) | ("leaf", Leaf) | ("scalar", value)`` pairs in
+    dispatcher argument order; ``statics`` is the kind-specific tuple of
+    non-array arguments exactly as the dispatcher received them (replay
+    passes them back verbatim); ``sig_statics`` is their hashable
+    tokenized form. ``buffer`` is filled by evaluation; ``ref`` weakly
+    tracks the LazyDNDarray wrapping this node so scope exit knows which
+    pending results are still reachable."""
+
+    __slots__ = ("seq", "kind", "op", "inputs", "statics", "sig_statics",
+                 "meta", "buffer", "ref", "__weakref__")
+
+    def __init__(self, kind, op, inputs, statics, sig_statics, meta):
+        self.seq = next(_seq)
+        self.kind = kind
+        self.op = op
+        self.inputs = tuple(inputs)
+        self.statics = statics
+        self.sig_statics = sig_statics
+        self.meta = meta
+        self.buffer = None
+        self.ref = None
+
+    def release_inputs(self) -> None:
+        """Drop operand references once ``buffer`` is set — evaluated
+        nodes act as leaves for any later program, so keeping the wiring
+        alive would pin ancestor buffers for no reason."""
+        self.inputs = ()
+
+
+def scalar_token(v) -> Optional[Tuple[str, Any]]:
+    """Hashable, value-faithful signature token for a scalar operand, or
+    None when the value cannot be tokenized. The Python type enters the
+    token because promotion is type-sensitive (np.float32(2) and 2.0
+    promote differently); floats key by ``hex()`` so NaN has one stable
+    spelling."""
+    if isinstance(v, bool):
+        return ("bool", v)
+    if isinstance(v, int):
+        return ("int", v)
+    if isinstance(v, float):
+        return ("float", v.hex())
+    if isinstance(v, complex):
+        return ("complex", v.real.hex(), v.imag.hex())
+    try:  # numpy scalars: dtype-qualified, value via float/int round trip
+        import numpy as np
+
+        if isinstance(v, np.bool_):
+            return ("np.bool_", bool(v))
+        if isinstance(v, np.integer):
+            return (type(v).__name__, int(v))
+        if isinstance(v, np.floating):
+            return (type(v).__name__, float(v).hex())
+        if isinstance(v, np.complexfloating):
+            c = complex(v)
+            return (type(v).__name__, c.real.hex(), c.imag.hex())
+    except TypeError:  # pragma: no cover - defensive
+        pass
+    return None

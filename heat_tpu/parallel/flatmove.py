@@ -363,6 +363,7 @@ def ragged_move(
     """Move a split-``split`` padded buffer between arbitrary interval
     partitions (see :func:`ragged_move_executable`). Watchdog-bounded
     (label ``flatmove.ragged``) when ``resilience.deadlines`` is active."""
+    _hooks.trace_barrier("ragged_move")
     fn = ragged_move_executable(
         tuple(buf.shape), buf.dtype, split, in_counts, out_counts, b_out, comm
     )

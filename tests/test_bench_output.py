@@ -30,6 +30,9 @@ def _synthetic_out():
         "ragged_elementwise_speedup": 2.7,
         "ragged_new_moves_per_trip": 0,
         "ragged_seed_moves_per_trip": 2,
+        "fused_pipeline_speedup": 2.1,
+        "fused_warm_compiles": 0,
+        "fused_warm_dispatches": 1,
         "lockstep_events": 42,
         "lockstep_divergences": 0,
         "api_over_kernel": {},
@@ -57,6 +60,9 @@ class TestCompactSummary:
         assert obj["detail"] == "BENCH_DETAIL.json"
         assert obj["suite_seconds"] == 321.4
         assert obj["ragged_elementwise_speedup"] == 2.7
+        assert obj["fused_pipeline_speedup"] == 2.1
+        assert obj["fused_warm_compiles"] == 0
+        assert obj["fused_warm_dispatches"] == 1
         assert obj["lockstep_events"] == 42
         assert obj["lockstep_divergences"] == 0
         # every headline metric made it into the line
@@ -118,6 +124,39 @@ class TestBenchCheck:
         out["lockstep_divergences"] = "2"
         with pytest.raises(ValueError, match="must be an int"):
             bench_check.check(json.dumps(bench._compact_summary(out, "d.json")))
+
+    def test_rejects_fused_regression(self):
+        # a fused/eager ratio below 1.0 means ht.lazy() made the chain
+        # SLOWER than eager dispatch — the perf feature is regressing
+        out = _synthetic_out()
+        out["fused_pipeline_speedup"] = 0.8
+        with pytest.raises(ValueError, match="SLOWER than eager"):
+            bench_check.check(json.dumps(bench._compact_summary(out, "d.json")))
+        out["fused_pipeline_speedup"] = "2.0"
+        with pytest.raises(ValueError, match="must be numeric"):
+            bench_check.check(json.dumps(bench._compact_summary(out, "d.json")))
+
+    def test_rejects_broken_warm_counters(self):
+        # warm fused trips must be 1 cached dispatch, 0 compiles: the
+        # worker asserts it, and the summary carries the proof
+        out = _synthetic_out()
+        out["fused_warm_compiles"] = 3
+        with pytest.raises(ValueError, match="recompiled"):
+            bench_check.check(json.dumps(bench._compact_summary(out, "d.json")))
+        out = _synthetic_out()
+        out["fused_warm_dispatches"] = 2
+        with pytest.raises(ValueError, match="one program execution"):
+            bench_check.check(json.dumps(bench._compact_summary(out, "d.json")))
+
+    def test_fused_error_degrades_gracefully(self):
+        out = _synthetic_out()
+        for k in ("fused_pipeline_speedup", "fused_warm_compiles", "fused_warm_dispatches"):
+            del out[k]
+        out["fused_error"] = "x" * 400
+        line = json.dumps(bench._compact_summary(out, "d.json"))
+        obj = bench_check.check(line)
+        assert "fused_error" in obj
+        assert len(line) < bench_check.LINE_BUDGET
 
     def test_rejects_missing_keys(self):
         with pytest.raises(ValueError, match="missing required keys"):

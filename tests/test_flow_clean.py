@@ -41,6 +41,20 @@ def test_tree_is_flow_clean():
     )
 
 
+def test_lazy_package_is_flow_clean():
+    """Explicit gate over the lazy-fusion subsystem: graph signatures are
+    exactly the rank-divergence surface graftflow taints (lcounts/layout
+    data flowing into cache keys), so its waivers must stay justified and
+    everything else clean."""
+    findings, files_checked = gf.analyze_paths(
+        [os.path.join(REPO, "heat_tpu", "core", "lazy")]
+    )
+    assert files_checked >= 4  # __init__, graph, capture, evaluate
+    assert not findings, "\n".join(
+        f"  {f.path}:{f.line}:{f.col}: {f.rule} {f.message}" for f in findings
+    )
+
+
 def test_collective_vocabulary_matches_graftlint():
     """graftflow keeps its own copy of the collective-name set (both
     halves must stay importable without the other); the copies must not

@@ -1,0 +1,364 @@
+"""Lazy-fusion subsystem (``ht.lazy`` / ``ht.fuse``): oracle equivalence,
+warm-path counter budgets, and escape hatches.
+
+Three claims are enforced here, matching the acceptance criteria:
+
+- **same numerics as eager**: a lazy chain replays the original eager
+  dispatchers inside one ``jax.jit``, so order-specified chains
+  (elementwise, cumulative) must equal eager execution *exactly*
+  (``assert_array_equal``) across splits, ragged layouts and dtypes.
+  Chains containing reductions are held to a few-ULP bound instead —
+  both paths are individually deterministic, but XLA legitimately
+  reassociates reduction accumulation when fusing producers/consumers
+  into the reduce, so cross-program bit-equality is not a property XLA
+  offers (the same caveat applies to any two differently-fused eager
+  programs). The numpy oracle anchors both paths to ground truth;
+- **warm = 1 dispatch, 0 compiles, 0 traces**: replaying a seen chain is
+  a single cached fused-program execution, region-asserted over
+  ``COMPILE_STATS`` + ``FUSE_STATS``;
+- **escape hatches are airtight**: anything a fused program cannot
+  express (materialization mid-scope, ``out=``, ops outside the captured
+  set, exceptions during capture) falls back to eager execution and
+  stays correct — never a wrong answer, never a wedged scope stack.
+
+The ``multihost``-marked test additionally runs inside the real 2/4
+process ``jax.distributed`` subset (``test_multihost.py``), proving fused
+programs stay in collective lockstep across process boundaries.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu import analysis
+from heat_tpu.analysis.sanitizer import Region
+from heat_tpu.core.lazy import FUSE_STATS, LazyDNDarray, reset_fuse_stats
+from heat_tpu.core.lazy import capture as _capture
+
+
+@pytest.fixture(autouse=True)
+def _fresh_counters():
+    reset_fuse_stats()
+    yield
+    # a test must never leak an open scope into the rest of the suite
+    assert not _capture._SCOPES, "test leaked an open ht.lazy() scope"
+
+
+def _delta(before):
+    return {k: FUSE_STATS[k] - before[k] for k in FUSE_STATS}
+
+
+def _data(shape, dtype, seed=0, with_nan=False):
+    x = np.random.default_rng(seed).standard_normal(shape).astype(dtype)
+    if with_nan:
+        x.flat[:: max(1, x.size // 7)] = np.nan
+    return x
+
+
+# ------------------------------------------------------------------- oracle
+# chains through the public API; each returns ONE result DNDarray.
+# "exact" chains are order-specified (elementwise / cumulative): fused
+# must equal eager bit-for-bit. Reduction-bearing chains carry a few-ULP
+# tolerance (reduction accumulation order is XLA's to choose per program).
+CHAINS = {
+    "standardize": lambda x: (x - ht.mean(x, axis=0)) / (ht.std(x, axis=0) + 1.0),
+    "score": lambda x: ht.sum((x * x - 1.0) * 0.5, axis=0),
+    "elementwise": lambda x: ht.exp(-ht.abs(x)) * 2.0 + 1.0,
+    "mean_all": lambda x: x - ht.mean(x),
+    "var_norm": lambda x: x / (ht.var(x, axis=0) + 1.0),
+    "cumsum": lambda x: ht.cumsum(x * 3.0, axis=0),
+    "cumsum_inner": lambda x: ht.cumsum(x, axis=1) - 1.0,
+}
+EXACT_CHAINS = {"elementwise", "cumsum", "cumsum_inner"}
+NAN_CHAINS = {
+    "nansum": lambda x: ht.nansum(x * 2.0, axis=0),
+    "nanmean": lambda x: ht.nanmean(x, axis=0) * 4.0,
+    "nanmax": lambda x: ht.nanmax(x + 1.0, axis=0),
+}
+
+
+class TestOracleEquivalence:
+    @pytest.mark.parametrize("dtype", [np.float32, np.float64], ids=["f32", "f64"])
+    @pytest.mark.parametrize("split", [None, 0, 1])
+    @pytest.mark.parametrize("name", sorted(CHAINS))
+    def test_matches_eager(self, name, split, dtype):
+        chain = CHAINS[name]
+        xn = _data((24, 8), dtype, seed=3)
+        want = chain(ht.array(xn, split=split)).numpy()
+        with ht.lazy():
+            got = chain(ht.array(xn, split=split))
+        assert FUSE_STATS["fused_dispatches"] >= 1
+        if name in EXACT_CHAINS:
+            np.testing.assert_array_equal(got.numpy(), want)
+        elif dtype == np.float64:
+            np.testing.assert_allclose(got.numpy(), want, rtol=1e-12, atol=1e-14)
+        else:
+            np.testing.assert_allclose(got.numpy(), want, rtol=1e-5, atol=1e-6)
+
+    @pytest.mark.parametrize("name", sorted(NAN_CHAINS))
+    def test_nan_family(self, name):
+        chain = NAN_CHAINS[name]
+        xn = _data((24, 8), np.float64, seed=5, with_nan=True)
+        want = chain(ht.array(xn, split=0)).numpy()
+        with ht.lazy():
+            got = chain(ht.array(xn, split=0))
+        np.testing.assert_allclose(
+            got.numpy(), want, rtol=1e-12, atol=1e-14, equal_nan=True
+        )
+
+    def test_world_size_one(self):
+        """SELF-communicator arrays (mesh of one device) fuse too — the
+        ws-1 leg of the oracle sweep."""
+        xn = _data((13, 4), np.float64, seed=8)
+        x = ht.array(xn, split=0, comm=ht.SELF)
+        want = ((x - 1.0) * 2.0).numpy()
+        with ht.lazy():
+            y = ht.array(xn, split=0, comm=ht.SELF)
+            got = (y - 1.0) * 2.0
+        np.testing.assert_array_equal(got.numpy(), want)
+        np.testing.assert_array_equal(got.numpy(), (xn - 1.0) * 2.0)
+
+    def test_ragged_layout_flows_through(self):
+        """A ragged (redistributed) operand computes in its ragged layout
+        inside the fused program — no rebalance, lcounts preserved on the
+        pending result, values bit-identical to the eager ragged path."""
+        counts = (5, 1, 4, 2, 3, 3, 4, 2)
+        xn = _data((sum(counts), 6), np.float64, seed=11)
+        tmap = np.tile(np.array([0, 6], dtype=np.int64), (8, 1))
+        tmap[:, 0] = counts
+
+        def skewed():
+            a = ht.array(xn, split=0)
+            a.redistribute_(target_map=tmap)
+            return a
+
+        want = (skewed() * 2.0 + 1.0).numpy()
+        x = skewed()
+        before = dict(ht.LAYOUT_STATS)
+        with ht.lazy():
+            got = x * 2.0 + 1.0
+            assert got.lcounts == counts
+        assert ht.LAYOUT_STATS["rebalances"] == before["rebalances"]
+        np.testing.assert_array_equal(got.numpy(), want)
+
+    def test_multi_output_scope(self):
+        """Several live results of one scope come out of ONE program."""
+        xn = _data((16, 4), np.float64, seed=2)
+        x = ht.array(xn, split=0)
+        we = (x + 1.0).numpy(), (x * x).numpy(), ht.sum(x, axis=0).numpy()
+        reset_fuse_stats()
+        with ht.lazy():
+            a = x + 1.0
+            b = x * x
+            c = ht.sum(x, axis=0)
+        assert FUSE_STATS["fused_dispatches"] == 1
+        for got, want in zip((a, b, c), we):
+            np.testing.assert_array_equal(got.numpy(), want)
+
+
+# --------------------------------------------------- warm-path counter budget
+class TestWarmPathBudget:
+    def test_warm_chain_is_one_dispatch_zero_compiles(self):
+        """The acceptance counter-assert: replaying a seen chain performs
+        exactly 1 fused dispatch, 0 XLA compiles, 0 traces — the whole
+        point of keying programs by (graph, layouts, comm)."""
+        xn = _data((32, 8), np.float64, seed=4)
+        x = ht.array(xn, split=0)
+        mu, sig = ht.mean(x, axis=0), ht.std(x, axis=0)
+
+        def chain():
+            with ht.lazy():
+                z = (x - mu) / (sig + 1.0)
+                return ht.sum(z * z, axis=0)
+
+        want = chain()  # cold: traces + compiles once
+        reset_fuse_stats()
+        r = Region("warm fused chain")
+        got = chain()
+        assert FUSE_STATS["fused_dispatches"] == 1, FUSE_STATS
+        assert FUSE_STATS["cache_hits"] == 1, FUSE_STATS
+        assert FUSE_STATS["graphs_captured"] == 0, FUSE_STATS
+        assert FUSE_STATS["eager_fallbacks"] == 0, FUSE_STATS
+        r.assert_compiles(0)
+        assert r.traces == 0, r.stats()
+        np.testing.assert_array_equal(got.numpy(), want.numpy())
+
+    def test_redistribute_chain_redistribute(self):
+        """The PR 3 single-exchange guarantee rides through a lazy scope:
+        skewed redistribute -> fused ragged chain -> redistribute back is
+        exactly two ragged exchanges, zero rebalances, and the chain
+        itself is one fused dispatch."""
+        counts = (6, 2, 5, 3, 4, 4, 5, 3)
+        n = sum(counts)
+        xn = _data((n, 4), np.float64, seed=9)
+        tmap = np.tile(np.array([0, 4], dtype=np.int64), (8, 1))
+        tmap[:, 0] = counts
+
+        def run():
+            a = ht.array(xn, split=0)
+            a.redistribute_(target_map=tmap)
+            with ht.lazy():
+                z = (a - 1.0) * 0.5
+            z.redistribute_(target_map=a.comm.lshape_map((n, 4), 0))
+            return z
+
+        want = run().numpy()
+        reset_fuse_stats()
+        moves0 = ht.MOVE_STATS["ragged_moves"]
+        reb0 = ht.LAYOUT_STATS["rebalances"]
+        z = run()
+        assert ht.MOVE_STATS["ragged_moves"] - moves0 == 2
+        assert ht.LAYOUT_STATS["rebalances"] == reb0
+        assert FUSE_STATS["fused_dispatches"] == 1, FUSE_STATS
+        assert FUSE_STATS["eager_fallbacks"] == 0, FUSE_STATS
+        assert z.lcounts is None  # back to the canonical layout
+        np.testing.assert_array_equal(z.numpy(), want)
+        np.testing.assert_array_equal(z.numpy(), (xn - 1.0) * 0.5)
+
+
+# ------------------------------------------------------------- escape hatches
+class TestEscapeHatches:
+    def test_materialization_mid_scope_forces(self):
+        xn = _data((8, 3), np.float64, seed=1)
+        x = ht.array(xn, split=0)
+        with ht.lazy():
+            w = x * 2.0
+            host = w.numpy()  # forces the pending subgraph
+            assert FUSE_STATS["eager_fallbacks"] == 1
+            v = w + 1.0  # capture continues after the force
+        np.testing.assert_array_equal(host, xn * 2.0)
+        np.testing.assert_array_equal(v.numpy(), xn * 2.0 + 1.0)
+
+    def test_indexing_and_item_force(self):
+        xn = _data((8, 3), np.float64, seed=6)
+        x = ht.array(xn, split=0)
+        with ht.lazy():
+            w = x + 1.0
+            row = w[2]
+            assert FUSE_STATS["eager_fallbacks"] >= 1
+        np.testing.assert_array_equal(np.squeeze(row.numpy()), xn[2] + 1.0)
+
+    def test_out_kwarg_declines_to_eager(self):
+        xn = _data((8, 3), np.float64, seed=7)
+        x = ht.array(xn, split=0)
+        o = ht.zeros_like(x)
+        with ht.lazy():
+            res = ht.add(x, x, out=o)
+            assert not isinstance(res, LazyDNDarray)
+            assert FUSE_STATS["eager_fallbacks"] == 1
+        np.testing.assert_array_equal(o.numpy(), xn + xn)
+
+    def test_op_outside_captured_set_forces_operands(self):
+        """Ops that never reach the generic dispatchers (matmul here) see
+        their pending operands forced transparently and run eagerly."""
+        xn = _data((8, 8), np.float64, seed=10)
+        x = ht.array(xn, split=0)
+        with ht.lazy():
+            z = x * 2.0
+            g = z @ z
+        np.testing.assert_array_equal(g.numpy(), (xn * 2.0) @ (xn * 2.0))
+
+    def test_nested_scopes(self):
+        """Inner scope exit evaluates inner results; independent outer
+        results stay pending until the outer exit — two dispatches."""
+        xn = _data((8, 3), np.float64, seed=12)
+        x = ht.array(xn, split=0)
+        with ht.lazy():
+            a = x + 1.0
+            with ht.lazy():
+                b = x * 3.0
+            assert b.is_materialized
+            assert not a.is_materialized
+        assert FUSE_STATS["fused_dispatches"] == 2, FUSE_STATS
+        np.testing.assert_array_equal(a.numpy(), xn + 1.0)
+        np.testing.assert_array_equal(b.numpy(), xn * 3.0)
+
+    def test_nested_scope_evaluates_outer_ancestors(self):
+        """An inner result depending on an outer pending node pulls the
+        ancestor into its program — one dispatch, nothing recomputed at
+        the outer exit."""
+        xn = _data((8, 3), np.float64, seed=13)
+        x = ht.array(xn, split=0)
+        with ht.lazy():
+            a = x + 1.0
+            with ht.lazy():
+                b = a * 2.0
+            assert a.is_materialized and b.is_materialized
+        assert FUSE_STATS["fused_dispatches"] == 1, FUSE_STATS
+        np.testing.assert_array_equal(b.numpy(), (xn + 1.0) * 2.0)
+
+    def test_exception_during_capture_restores_eager(self):
+        """An exception unwinding through the scope pops it WITHOUT
+        evaluating: eager dispatch is fully restored, and a pending array
+        that escaped the broken scope still materializes on access."""
+        xn = _data((8, 3), np.float64, seed=14)
+        x = ht.array(xn, split=0)
+        escaped = {}
+        with pytest.raises(RuntimeError, match="boom"):
+            with ht.lazy():
+                escaped["w"] = x * 5.0
+                raise RuntimeError("boom")
+        assert not _capture._SCOPES
+        # eager is restored: new ops return plain DNDarrays
+        y = x + 1.0
+        assert not isinstance(y, LazyDNDarray)
+        # the escaped pending result still evaluates, correctly
+        np.testing.assert_array_equal(escaped["w"].numpy(), xn * 5.0)
+
+    def test_fuse_decorator(self):
+        xn = _data((16, 4), np.float64, seed=15)
+
+        @ht.fuse
+        def standardize(a):
+            return (a - ht.mean(a, axis=0)) / (ht.std(a, axis=0) + 1.0)
+
+        x = ht.array(xn, split=0)
+        want = ((x - ht.mean(x, axis=0)) / (ht.std(x, axis=0) + 1.0)).numpy()
+        reset_fuse_stats()
+        got = standardize(x)
+        assert got.is_materialized  # evaluated at function return
+        assert FUSE_STATS["fused_dispatches"] == 1
+        np.testing.assert_array_equal(got.numpy(), want)
+
+    def test_metadata_does_not_force(self):
+        xn = _data((12, 4), np.float64, seed=16)
+        x = ht.array(xn, split=0)
+        with ht.lazy():
+            z = ht.mean(x * x, axis=0)
+            assert z.shape == (4,)
+            assert z.split is None
+            assert z.dtype == ht.float64
+            assert z.lshape_map.shape == (z.comm.size, 1)
+            assert not z.is_materialized  # none of the above forced
+        assert FUSE_STATS["eager_fallbacks"] == 0
+
+
+# ------------------------------------------------------------------ multihost
+@pytest.mark.multihost
+def test_fused_programs_stay_in_lockstep():
+    """Fused dispatch must not desynchronize ranks: a skewed ragged
+    exchange followed by a fused chain and a host gather performs the
+    same collective sequence on every process (real 2/4-process
+    ``jax.distributed`` legs via test_multihost.py)."""
+    size = ht.WORLD.size
+    n = 3 * size + min(2, size - 1)  # non-divisible where it hurts
+    xn = _data((n, 4), np.float32, seed=17)
+    base = [n // size] * size
+    base[0] += n - sum(base)
+    if size > 1:  # skew: shift a row between neighbouring ranks
+        base[0] -= 1
+        base[1] += 1
+    tmap = np.tile(np.array([0, 4], dtype=np.int64), (size, 1))
+    tmap[:, 0] = base
+
+    want = None
+    with analysis.lockstep(check_at_exit=False, deadline=60.0) as ls:
+        x = ht.array(xn, split=0)
+        x.redistribute_(target_map=tmap)
+        with ht.lazy():
+            z = (x - 1.0) * 2.0
+            s = ht.sum(z, axis=0)
+        want = s.numpy()
+        ls.check("fused-chain")
+    np.testing.assert_allclose(want, ((xn - 1.0) * 2.0).sum(axis=0), rtol=1e-5)

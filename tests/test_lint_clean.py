@@ -41,6 +41,20 @@ def test_tree_is_lint_clean():
     )
 
 
+def test_lazy_package_is_lint_clean():
+    """The lazy-fusion subsystem is exactly the kind of code graftlint
+    exists for (per-call jit closures, unbounded executable caches): gate
+    it explicitly so a refactor that drops it from the tree walk cannot
+    silently un-gate it."""
+    findings, files_checked = gl.lint_paths(
+        [os.path.join(REPO, "heat_tpu", "core", "lazy")]
+    )
+    assert files_checked >= 4  # __init__, graph, capture, evaluate
+    assert not findings, "\n".join(
+        f"  {f.path}:{f.line}:{f.col}: {f.rule} {f.message}" for f in findings
+    )
+
+
 def _run_cli(*args):
     return subprocess.run(
         [sys.executable, os.path.join("tools", "graftlint.py"), *args],

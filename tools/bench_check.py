@@ -60,6 +60,30 @@ def validate(line: str, obj: dict) -> None:
             f"bench ran out of collective lockstep: {divergences} divergence(s) "
             "recorded in LOCKSTEP_STATS — the numbers cannot be trusted"
         )
+    if "fused_pipeline_speedup" in obj:
+        speedup = obj["fused_pipeline_speedup"]
+        if not isinstance(speedup, (int, float)) or isinstance(speedup, bool):
+            raise ValueError(
+                f"'fused_pipeline_speedup' must be numeric, got {speedup!r}"
+            )
+        if speedup < 1.0:
+            raise ValueError(
+                f"fused_pipeline_speedup {speedup} < 1.0: a lazy scope made the "
+                "standardize chain SLOWER than eager dispatch — fusion is "
+                "regressing, not optimizing"
+            )
+        # the worker asserts these before timing; their presence in the
+        # summary is the contract that the assertion actually ran
+        if obj.get("fused_warm_compiles") != 0:
+            raise ValueError(
+                f"fused_warm_compiles must be 0, got {obj.get('fused_warm_compiles')!r}: "
+                "a warm fused trip recompiled/retraced"
+            )
+        if obj.get("fused_warm_dispatches") != 1:
+            raise ValueError(
+                f"fused_warm_dispatches must be 1, got {obj.get('fused_warm_dispatches')!r}: "
+                "a warm fused chain must be exactly one program execution"
+            )
     if len(line) >= LINE_BUDGET:
         raise ValueError(
             f"final JSON line is {len(line)} bytes, at or over the {LINE_BUDGET}-byte "
