@@ -20,6 +20,7 @@ from . import analysis
 from . import regression
 from . import resilience
 from . import spatial
+from . import stream
 from . import utils
 from .core import random
 from .core import version
@@ -27,13 +28,14 @@ from .core.version import __version__
 
 # runtime counters: layout rebalances / ragged exchanges /
 # compiles+transfers / collective-lockstep checks / supervised-recovery
-# activity / lazy-fusion captures+dispatches
+# activity / lazy-fusion captures+dispatches / streaming-pipeline chunks
 from .core.dndarray import LAYOUT_STATS
 from .parallel.flatmove import MOVE_STATS
 from .analysis.sanitizer import COMPILE_STATS
 from .analysis.lockstep import LOCKSTEP_STATS
 from .resilience.supervisor import RECOVERY_STATS
 from .core.lazy import FUSE_STATS
+from .stream import STREAM_STATS
 
 
 def __getattr__(name: str):

@@ -25,13 +25,19 @@ from ._kcluster import _BLOCK_PROGRAMS, _KCluster
 __all__ = ["KMeans"]
 
 
-def _lloyd_body(xa: jnp.ndarray, centers: jnp.ndarray, k: int, n_valid):
-    """One Lloyd iteration: (assign, update, shift) fused into one program.
+def _assign_stats(xa: jnp.ndarray, centers: jnp.ndarray, k: int, n_valid):
+    """Assignment sufficient statistics, fused: per-cluster ``sums``
+    (k, f) and ``counts`` (k,) plus per-row ``labels`` and the summed
+    min-distance ``inertia``.
 
     The distance+argmin runs on the sharded data; the one-hot update is an
     MXU matmul whose reduction XLA psums over ICI. Rows past ``n_valid``
     are buffer tail padding: their one-hot weight is zeroed so they never
-    touch counts or sums (labels in the padded rows are dead values).
+    touch counts, sums or inertia (labels in the padded rows are dead
+    values). This is THE assignment kernel: the eager Lloyd body below
+    consumes it whole (XLA dead-code-eliminates the unused inertia), and
+    the streaming per-chunk programs (:mod:`heat_tpu.cluster.streaming`)
+    accumulate its raw sums/counts across chunks.
     """
     d2 = _quadratic_expand(xa, centers)  # (n, k), sharded on n
     labels = jnp.argmin(d2, axis=1).astype(jnp.int32)
@@ -42,6 +48,14 @@ def _lloyd_body(xa: jnp.ndarray, centers: jnp.ndarray, k: int, n_valid):
     xa_safe = jnp.where(valid[:, None], xa, 0.0)
     counts = jnp.sum(onehot, axis=0)  # (k,)
     sums = onehot.T @ xa_safe  # (k, f) — MXU matmul + psum
+    inertia = jnp.sum(jnp.where(valid, jnp.min(d2, axis=1), 0.0))
+    return sums, counts, labels, inertia
+
+
+def _lloyd_body(xa: jnp.ndarray, centers: jnp.ndarray, k: int, n_valid):
+    """One Lloyd iteration: (assign, update, shift) fused into one program
+    over the shared :func:`_assign_stats` kernel."""
+    sums, counts, labels, _ = _assign_stats(xa, centers, k, n_valid)
     new_centers = jnp.where(
         counts[:, None] > 0, sums / jnp.maximum(counts, 1.0)[:, None], centers
     )

@@ -175,10 +175,14 @@ def remove_observer(fn):
 
 def observe(event: str, **ctx) -> None:
     """Report an instrumentation event (``"cache.insert"``,
-    ``"host.gather"``, ... — and the ``"recovery.*"`` family emitted by
+    ``"host.gather"``, ... — plus the ``"recovery.*"`` family emitted by
     :mod:`heat_tpu.resilience.supervisor`, which its ``RECOVERY_STATS``
-    observer counts). Free when no observer is installed: one falsy
-    check on the hot path."""
+    observer counts, and the ``"stream.*"`` family — ``stream.chunk``
+    (``rows``, ``nbytes``), ``stream.prefetch_hit``, ``stream.stall``,
+    ``stream.overlap`` (``seconds``) — emitted by the chunked pipeline
+    layer and folded into ``STREAM_STATS`` by
+    :mod:`heat_tpu.stream._stats`). Free when no observer is installed:
+    one falsy check on the hot path."""
     if _OBSERVERS:
         for fn in tuple(_OBSERVERS):
             fn(event, ctx)

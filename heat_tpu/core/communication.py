@@ -46,6 +46,7 @@ __all__ = [
     "SPLIT_AXIS",
     "MPICommunication",
     "CUDA_AWARE_MPI",
+    "collective_lockstep",
 ]
 
 # canonical mesh-axis name carrying the DNDarray ``split`` dimension
@@ -509,6 +510,25 @@ def _replicated_decision_impl(flag: bool) -> bool:
     )
     votes = multihost_utils.process_allgather(np.asarray([flag], dtype=np.bool_))
     return bool(np.asarray(votes).any())
+
+
+def collective_lockstep(tree):
+    """Pin a collective-bearing dispatch to completion under
+    multi-controller execution; a transparent pass-through otherwise.
+
+    XLA matches cross-process collectives by launch order per device, but
+    two *independent* programs (no data dependency — e.g. the moments and
+    cov folds of the same streamed chunk) may execute concurrently on the
+    runtime thread pool, interleaving their collectives differently on
+    each process: the rendezvous then deadlocks or silently mixes data
+    across programs. Blocking on each such program before launching the
+    next independent one restores a total cross-process order. Eager op
+    *chains* don't need this — data dependencies already serialize them —
+    and with one process there is no rendezvous, so this returns
+    immediately and full async dispatch is preserved."""
+    if jax.process_count() > 1:
+        jax.block_until_ready(tree)
+    return tree
 
 
 def _split_ranks(comm: MeshCommunication):

@@ -1686,4 +1686,19 @@ def _place(
     current = getattr(array, "sharding", None)
     if not force and current is not None and current.is_equivalent_to(target, array.ndim):
         return array
+    if not target.is_fully_addressable and getattr(array, "is_fully_addressable", True):
+        # Multi-controller staging: device_put of a process-local value onto
+        # a process-spanning sharding makes jax issue a blocking
+        # broadcast_one_to_all (its cross-process equality check), which can
+        # deadlock against async collectives already in flight. Assemble the
+        # global array from per-device local shards instead — no collective;
+        # the value-replicated-across-processes contract is documented at
+        # the factories/chunked-reader host boundary.
+        host = np.asarray(array)
+        return jax.make_array_from_callback(
+            # np.array: own the shard memory (callback results may be aliased
+            # zero-copy) without promoting 0-d shards the way
+            # ascontiguousarray would
+            host.shape, target, lambda idx: np.array(host[idx], copy=True)
+        )
     return jax.device_put(array, target)

@@ -15,10 +15,54 @@ import jax
 import jax.numpy as jnp
 
 from ..core import types
+from ..core._cache import ExecutableCache
 from ..core.base import BaseEstimator, RegressionMixin
+from ..core.communication import collective_lockstep
 from ..core.dndarray import DNDarray
 
 __all__ = ["Lasso"]
+
+# streaming partial_fit programs — one jitted proximal-SGD step, compiled
+# once per chunk geometry and reused for every subsequent chunk
+_SGD_PROGRAMS = ExecutableCache(maxsize=8)
+
+
+def _sgd_program():
+    """Cached jitted proximal-SGD step for :meth:`Lasso.partial_fit`.
+
+    The optimizer is the :mod:`heat_tpu.optim` SGD passthrough (optax) at
+    unit learning rate; the actual ``lr`` arrives as a traced scalar by
+    pre-scaling the gradient, so changing it does not retrace. The L1
+    penalty is applied as a proximal soft-threshold of ``lr * lam`` after
+    the gradient step (ISTA), with coordinate 0 — the intercept column —
+    left unregularized exactly like :func:`_cd_sweep`. Both therefore
+    minimize the same objective ``(1/2n)||X@theta - y||^2 + lam*||theta[1:]||_1``.
+    Rows past ``n_valid`` are buffer tail padding and are masked out of
+    both the residual and the gradient normalization.
+    """
+    key = "lasso_sgd"
+    prog = _SGD_PROGRAMS.get(key)
+    if prog is None:
+        from .. import optim
+
+        tx = optim.sgd(1.0)
+
+        def step(X, yv, theta, lam, lr, n_valid):
+            valid = jnp.arange(X.shape[0]) < n_valid
+            Xs = jnp.where(valid[:, None], X, 0.0)
+            ys = jnp.where(valid, yv, 0.0)
+            nv = jnp.maximum(n_valid.astype(X.dtype), 1.0)
+            resid = Xs @ theta - ys
+            grad = (Xs.T @ resid) / nv
+            opt_state = tx.init(theta)  # stateless for sgd: pure inside jit
+            updates, _ = tx.update(grad * lr, opt_state, theta)
+            th = optim.apply_updates(theta, updates)
+            soft = jnp.sign(th) * jnp.maximum(jnp.abs(th) - lr * lam, 0.0)
+            return jnp.where(jnp.arange(th.shape[0]) == 0, th, soft)
+
+        _SGD_PROGRAMS[key] = jax.jit(step)
+        prog = _SGD_PROGRAMS[key]
+    return prog
 
 
 @partial(jax.jit, static_argnames=())
@@ -222,6 +266,58 @@ class Lasso(BaseEstimator, RegressionMixin):
             jnp.int32(self.max_iter),
         )
         self.n_iter = int(n_iter)
+        self.__theta = DNDarray(theta.reshape(-1, 1), split=None, device=x.device, comm=x.comm)
+        return self
+
+    def partial_fit(self, x: DNDarray, y: DNDarray, lr: float = 0.01) -> "Lasso":
+        """One proximal-SGD step on a single chunk (streaming fit).
+
+        Feed row-block chunks (e.g. from a
+        :class:`~heat_tpu.stream.chunked.ChunkIterator`, optionally behind
+        a :class:`~heat_tpu.stream.prefetch.Prefetcher`) and the model
+        converges to the same L1 objective the batch :meth:`fit` solves by
+        coordinate descent — see :func:`_sgd_program`. The step runs on the
+        PADDED device buffers so every full-size chunk reuses one compiled
+        program (0 traces / 0 compiles warm); the valid row count masks the
+        tail. ``theta`` persists across calls (and across a prior
+        :meth:`fit`), so interleaving or resuming is fine.
+        """
+        if not isinstance(x, DNDarray) or not isinstance(y, DNDarray):
+            raise TypeError(f"input needs to be DNDarrays, but were {type(x)}, {type(y)}")
+        if x.ndim != 2:
+            raise ValueError(f"x needs to be 2D, but was {x.ndim}D")
+        X = x.larray.astype(jnp.promote_types(x.larray.dtype, jnp.float32))
+        n_pad, m = X.shape
+        if y.split == x.split and y.split is not None:
+            # same axis-0 padding as x — use the padded buffer directly
+            yv = y.larray.astype(X.dtype).reshape(y.larray.shape[0], -1)[:, 0]
+            if yv.shape[0] != n_pad:
+                raise ValueError(
+                    f"y padded rows {yv.shape[0]} != x padded rows {n_pad}"
+                )
+        else:
+            yv = y._logical().astype(X.dtype).ravel()
+            if yv.shape[0] != x.gshape[0]:
+                raise ValueError(f"y has {yv.shape[0]} rows, x has {x.gshape[0]}")
+            if yv.shape[0] < n_pad:  # masked anyway; pad to the buffer shape
+                yv = jnp.pad(yv, (0, n_pad - yv.shape[0]))
+        if self.__theta is None:
+            theta = jnp.zeros(m, dtype=X.dtype)
+        else:
+            theta = self.__theta.larray.astype(X.dtype).ravel()
+            if theta.shape[0] != m:
+                raise ValueError(f"x has {m} features, fitted theta has {theta.shape[0]}")
+        theta = collective_lockstep(
+            _sgd_program()(
+                X,
+                yv,
+                theta,
+                jnp.asarray(self.lam, X.dtype),
+                jnp.asarray(lr, X.dtype),
+                jnp.int32(x.gshape[0]),
+            )
+        )
+        self.n_iter = (self.n_iter or 0) + 1
         self.__theta = DNDarray(theta.reshape(-1, 1), split=None, device=x.device, comm=x.comm)
         return self
 

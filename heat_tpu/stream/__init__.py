@@ -1,0 +1,42 @@
+"""Out-of-core streaming: chunked pipelines over datasets larger than HBM.
+
+Public surface (see ``docs/STREAMING.md`` for the walkthrough):
+
+- :class:`~heat_tpu.stream.chunked.ChunkIterator` — yields split-axis
+  row-block DNDarrays from a file (HDF5/netCDF/CSV row-window reads) or
+  an in-memory array;
+- :class:`~heat_tpu.stream.prefetch.Prefetcher` — async double-buffered
+  prefetch: a producer thread reads + device-stages chunk k+1 while the
+  consumer computes on chunk k, bounded queue + clean exception
+  propagation; ``depth <= 0`` is the synchronous comparator;
+- :class:`~heat_tpu.stream.estimators.StreamingMoments` /
+  :class:`~heat_tpu.stream.estimators.StreamingCov` /
+  :class:`~heat_tpu.stream.estimators.StreamingHistogram` — single-pass
+  estimators via pairwise merge formulas, oracle-equal to the in-memory
+  ``ht.mean/var/cov/histogram``;
+- ``STREAM_STATS`` / :func:`reset_stream_stats` — chunk/prefetch/overlap
+  counters riding the :mod:`heat_tpu.core._hooks` observer slot.
+
+The minibatch ML ports live with their eager families:
+``heat_tpu.cluster.StreamingKMeans`` and ``Lasso.partial_fit``.
+
+Memory model: device-resident staging is bounded at ``prefetch_depth``
+chunks ahead of the consumer (plus the chunk being consumed) no matter
+how large the dataset is; the warm chunk loop re-dispatches cached
+executables — 0 traces / 0 compiles per chunk.
+"""
+from . import chunked, estimators, prefetch
+from ._stats import STREAM_STATS, reset_stream_stats
+from .chunked import ChunkIterator
+from .estimators import StreamingCov, StreamingHistogram, StreamingMoments
+from .prefetch import Prefetcher
+
+__all__ = [
+    "ChunkIterator",
+    "Prefetcher",
+    "StreamingMoments",
+    "StreamingCov",
+    "StreamingHistogram",
+    "STREAM_STATS",
+    "reset_stream_stats",
+]

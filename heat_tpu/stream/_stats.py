@@ -1,0 +1,52 @@
+"""Streaming-pipeline counters riding the :mod:`heat_tpu.core._hooks`
+observer slot, beside LAYOUT/MOVE/COMPILE/FUSE_STATS.
+
+The pipeline emits passive ``stream.*`` events (see
+:func:`heat_tpu.core._hooks.observe`):
+
+- ``stream.chunk`` (``rows``, ``nbytes``) — a chunk was read and staged;
+- ``stream.prefetch_hit`` — the consumer found the next chunk already
+  buffered (the overlap worked);
+- ``stream.stall`` — the consumer had to wait for the producer (I/O
+  bound, or the prefetch depth is too shallow);
+- ``stream.overlap`` (``seconds``) — wall-clock seconds of producer I/O
+  hidden behind consumer compute, reported once per pipeline.
+
+One module-level observer folds them into :data:`STREAM_STATS`; events
+from other families pass through untouched.
+"""
+from __future__ import annotations
+
+from ..core import _hooks
+
+__all__ = ["STREAM_STATS", "reset_stream_stats"]
+
+STREAM_STATS = {
+    "chunks": 0,
+    "bytes_read": 0,
+    "prefetch_hits": 0,
+    "stalls": 0,
+    "overlap_seconds": 0.0,
+}
+
+
+def reset_stream_stats() -> None:
+    """Zero :data:`STREAM_STATS` (counter-asserting tests bracket with this)."""
+    STREAM_STATS.update(
+        chunks=0, bytes_read=0, prefetch_hits=0, stalls=0, overlap_seconds=0.0
+    )
+
+
+def _observer(event: str, ctx: dict) -> None:
+    if event == "stream.chunk":
+        STREAM_STATS["chunks"] += 1
+        STREAM_STATS["bytes_read"] += int(ctx.get("nbytes", 0))
+    elif event == "stream.prefetch_hit":
+        STREAM_STATS["prefetch_hits"] += 1
+    elif event == "stream.stall":
+        STREAM_STATS["stalls"] += 1
+    elif event == "stream.overlap":
+        STREAM_STATS["overlap_seconds"] += float(ctx.get("seconds", 0.0))
+
+
+_hooks.add_observer(_observer)

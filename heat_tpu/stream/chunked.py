@@ -1,0 +1,239 @@
+"""Chunked sources: yield row-block DNDarrays from a file or array.
+
+:class:`ChunkIterator` is the producer side of the streaming layer: it
+walks a dataset ``chunk_rows`` rows at a time and yields each window as a
+split-axis :class:`~heat_tpu.core.dndarray.DNDarray`. Each window goes
+through two strictly separated stages:
+
+- :meth:`ChunkIterator.iter_raw` — the HOST half: read (and decompress /
+  parse) one window into a numpy array. Pure host I/O, never touches
+  jax, so it is safe to run on a
+  :class:`~heat_tpu.stream.prefetch.Prefetcher`'s producer thread even
+  in a multi-controller mesh.
+- :meth:`ChunkIterator._stage` — the DEVICE half: wrap a raw window as a
+  split DNDarray (the host→device copy). Device work MUST stay on the
+  thread that dispatches the consumer's XLA programs: with multiple
+  controller processes, device/collective calls issued concurrently from
+  two threads interleave differently per process and deadlock (or
+  silently corrupt) the collective stream.
+
+Plain iteration fuses the two (read then stage, same thread); the
+Prefetcher splits them across its producer/consumer threads so raw reads
+overlap compute without ever racing the dispatch stream.
+
+Sources:
+
+- a path (``.h5/.hdf5``, ``.nc/.nc4/.netcdf``, ``.csv``) — each chunk is
+  a ``start``/``stop`` row-window read through the :mod:`heat_tpu.core.io`
+  loaders, so only ``chunk_rows`` rows are ever host-resident per read;
+- an in-memory array (numpy / jax array / DNDarray / nested sequence) —
+  the oracle-test source: same chunk geometry, no disk.
+
+Chunk geometry is deliberately coarse: every chunk has ``chunk_rows``
+rows except a single tail, so a whole pass sees at most TWO distinct
+shapes and per-chunk jitted programs compile at most twice, then run
+0-trace/0-compile warm (the ``ExecutableCache`` / ``COMPILE_STATS``
+discipline the estimators assert).
+
+The iterator is RE-ITERABLE (each ``iter()`` restarts from row 0), which
+is what multi-epoch consumers like ``StreamingKMeans.fit`` rely on.
+
+Host-boundary note (VERDICT round 5): like the underlying loaders, every
+process opens ``path`` itself — the file must be visible to all hosts
+(shared filesystem or identical local copies). Raw windows are read
+WHOLE on every process (per-process host memory and I/O are bounded by
+``chunk_rows``, not dataset size); the split applies at staging. See the
+loader docstrings in :mod:`heat_tpu.core.io`.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..core import _hooks, factories, io as _io, types
+from ..core.dndarray import DNDarray
+
+__all__ = ["ChunkIterator"]
+
+
+def _csv_count_rows(path: str, header_lines: int, encoding: str) -> int:
+    """Number of data rows: one O(n) line scan (no parse, bounded memory)."""
+    n = 0
+    with open(path, "r", encoding=encoding) as fh:
+        for i, line in enumerate(fh):
+            if i >= header_lines and line.strip():
+                n += 1
+    return n
+
+
+class ChunkIterator:
+    """Iterate a dataset as ``chunk_rows``-row DNDarray blocks.
+
+    Parameters
+    ----------
+    source : str | array-like | DNDarray
+        File path (HDF5 / netCDF / CSV by extension) or an in-memory
+        array. 2-D (or 1-D) data, chunked on axis 0.
+    chunk_rows : int
+        Rows per chunk (the last chunk may be shorter).
+    dataset : str, optional
+        HDF5 dataset / netCDF variable name (required for those formats).
+    split : int or None
+        Split axis of the yielded DNDarrays (default 0: each chunk is
+        sharded over the mesh rows-first, like the loaders).
+    dtype, device, comm :
+        Forwarded to the loaders / constructor.
+    header_lines, sep, encoding :
+        CSV options, forwarded to :func:`heat_tpu.core.io.load_csv`.
+    """
+
+    def __init__(
+        self,
+        source,
+        chunk_rows: int,
+        *,
+        dataset: Optional[str] = None,
+        split: Optional[int] = 0,
+        dtype=types.float32,
+        device=None,
+        comm=None,
+        header_lines: int = 0,
+        sep: str = ",",
+        encoding: str = "utf-8",
+    ):
+        if chunk_rows < 1:
+            raise ValueError(f"chunk_rows must be >= 1, got {chunk_rows}")
+        self.chunk_rows = int(chunk_rows)
+        self.split = split
+        self.dtype = types.canonical_heat_type(dtype)
+        self.device = device
+        self.comm = comm
+        self._csv_opts = (int(header_lines), sep, encoding)
+        self._path = None
+        self._dataset = dataset
+        self._array = None
+        if isinstance(source, str):
+            if not os.path.exists(source):
+                raise FileNotFoundError(f"no such file: {source!r}")
+            ext = os.path.splitext(source)[-1].strip().lower()
+            if ext in (".h5", ".hdf5", ".nc", ".nc4", ".netcdf") and dataset is None:
+                raise ValueError("dataset= is required for HDF5/netCDF sources")
+            if ext not in (".h5", ".hdf5", ".nc", ".nc4", ".netcdf", ".csv"):
+                raise ValueError(f"Unsupported file extension {ext}")
+            self._path = source
+            self._ext = ext
+            self.n_rows = self._probe_rows()
+        else:
+            if isinstance(source, DNDarray):
+                source = source.numpy()
+            self._array = np.asarray(source)
+            if self._array.ndim == 0:
+                raise ValueError("source must have at least one dimension")
+            self.n_rows = int(self._array.shape[0])
+
+    # ------------------------------------------------------------ probing
+    def _probe_rows(self) -> int:
+        if self._ext in (".h5", ".hdf5"):
+            import h5py
+
+            with h5py.File(self._path, "r") as handle:
+                return int(handle[self._dataset].shape[0])
+        if self._ext == ".csv":
+            header_lines, _, encoding = self._csv_opts
+            return _csv_count_rows(self._path, header_lines, encoding)
+        # netCDF: real library, classic parser, or the h5py fallback —
+        # mirror load_netcdf's dispatch for the shape probe
+        try:
+            import netCDF4 as nc  # pragma: no cover - not in this image
+
+            with nc.Dataset(self._path, "r") as handle:
+                return int(handle[self._dataset].shape[0])
+        except ImportError:
+            pass
+        from ..core._netcdf3 import NetCDF3File, is_classic_netcdf
+
+        if is_classic_netcdf(self._path):
+            return int(NetCDF3File(self._path).shape(self._dataset)[0])
+        import h5py
+
+        with h5py.File(self._path, "r") as handle:
+            return int(handle[self._dataset].shape[0])
+
+    # ---------------------------------------------------------- iteration
+    def __len__(self) -> int:
+        """Number of chunks in one pass."""
+        return -(-self.n_rows // self.chunk_rows)
+
+    def _read_raw(self, start: int, stop: int) -> np.ndarray:
+        """One window as a host numpy array. NO jax/device calls in here —
+        this is the half the Prefetcher runs on its producer thread (see
+        the module docstring for why that boundary is load-bearing)."""
+        if self._array is not None:
+            return np.asarray(self._array[start:stop])
+        if self._ext in (".h5", ".hdf5"):
+            import h5py
+
+            with h5py.File(self._path, "r") as handle:
+                return np.asarray(handle[self._dataset][start:stop])
+        if self._ext == ".csv":
+            header_lines, sep, encoding = self._csv_opts
+            # same dispatch as load_csv's windowed path: loadtxt with
+            # skiprows/max_rows, reference-exact parser as the fallback
+            if len(sep) == 1:
+                try:
+                    return np.loadtxt(
+                        self._path, delimiter=sep, skiprows=header_lines + start,
+                        dtype=np.float64, encoding=encoding, ndmin=2,
+                        max_rows=stop - start,
+                    )
+                except ValueError:
+                    pass
+            return np.asarray(
+                _io._float_fields_parse(
+                    self._path, header_lines, sep, encoding, self.dtype,
+                    start=start, max_rows=stop - start,
+                )
+            )
+        # netCDF: mirror load_netcdf's backend dispatch
+        try:
+            import netCDF4 as nc  # pragma: no cover - not in this image
+
+            with nc.Dataset(self._path, "r") as handle:
+                return np.asarray(handle[self._dataset][start:stop])
+        except ImportError:
+            pass
+        from ..core._netcdf3 import NetCDF3File, is_classic_netcdf
+
+        if is_classic_netcdf(self._path):
+            return np.asarray(NetCDF3File(self._path).read(self._dataset, start, stop))
+        import h5py
+
+        with h5py.File(self._path, "r") as handle:
+            return np.asarray(handle[self._dataset][start:stop])
+
+    def iter_raw(self):
+        """Host-side read pass: yield each window as a raw numpy array,
+        in order, without touching the device. Producer-thread safe."""
+        for start in range(0, self.n_rows, self.chunk_rows):
+            stop = min(start + self.chunk_rows, self.n_rows)
+            yield self._read_raw(start, stop)
+
+    def _stage(self, raw: np.ndarray) -> DNDarray:
+        """Device-side half: split-shard one raw window (the host→device
+        copy) and count it. Must run on the consumer's dispatch thread."""
+        chunk = factories.array(
+            raw, dtype=self.dtype, split=self.split, device=self.device,
+            comm=self.comm,
+        )
+        nbytes = int(
+            np.prod(chunk.gshape, dtype=np.int64)
+            * np.dtype(chunk.dtype.jax_type()).itemsize
+        )
+        _hooks.observe("stream.chunk", rows=chunk.gshape[0], nbytes=nbytes)
+        return chunk
+
+    def __iter__(self):
+        for raw in self.iter_raw():
+            yield self._stage(raw)

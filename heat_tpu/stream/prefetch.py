@@ -1,0 +1,219 @@
+"""Async double-buffered prefetch: overlap chunk I/O with chunk compute.
+
+:class:`Prefetcher` wraps a chunk source (normally a
+:class:`~heat_tpu.stream.chunked.ChunkIterator`) and runs its HOST half
+on a producer daemon thread: while the consumer computes on chunk k, the
+producer reads (and decompresses/parses) chunk k+1's raw window. The
+DEVICE half — staging the raw window as a split DNDarray — happens on
+the consumer thread, inside ``__next__``: in a multi-controller mesh,
+device/collective calls issued concurrently from two threads interleave
+differently per process and deadlock (or silently corrupt) the
+collective stream, so only raw host I/O may run off-thread. For a
+generic iterable of already-staged chunks the producer thread would be
+doing device work; that stays enabled in a single-process session (one
+controller, no lockstep to break) but degrades to synchronous inline
+iteration when ``jax.process_count() > 1``. Backpressure comes from a
+bounded queue:
+
+- with ``depth >= 2`` the queue holds ``depth - 1`` read-ahead chunks
+  and the producer holds at most one more in flight, so **at most
+  ``depth`` chunks are buffered ahead of the consumer** — host read-ahead
+  memory is bounded at ``depth`` raw windows, and device memory at the
+  one staged chunk being consumed, independent of dataset size (the
+  "HBM holds ≤ prefetch_depth chunks" memory model in
+  ``docs/STREAMING.md``);
+- ``depth <= 0`` is the synchronous comparator: no thread, each chunk is
+  read inline when the consumer asks for it (what the bench's
+  prefetch-on vs synchronous ratio measures).
+
+The producer NEVER strands the consumer: reader exceptions are caught,
+enqueued, and re-raised from ``__next__`` (then the iterator is
+exhausted); a terminal sentinel always follows. Early teardown is safe —
+``close()`` (also called by ``__exit__``/``__del__``) signals the
+producer, drains the queue so a blocked ``put`` wakes, and joins the
+thread. All queue puts poll a stop event instead of blocking forever.
+
+Counters (see :mod:`heat_tpu.stream._stats`): each consumer fetch that
+finds a chunk already buffered is a ``prefetch_hit``; an empty-queue wait
+is a ``stall``; at exhaustion the pipeline reports ``overlap_seconds``
+once — producer read time not spent making the consumer wait, i.e. I/O
+hidden behind compute.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Iterable
+
+import jax
+
+from ..core import _hooks
+from .chunked import ChunkIterator
+
+__all__ = ["Prefetcher"]
+
+_ITEM, _ERR, _DONE = "item", "err", "done"
+
+
+class Prefetcher:
+    """Single-use iterator: prefetches ``chunks`` ``depth`` ahead.
+
+    Parameters
+    ----------
+    chunks : iterable
+        The chunk source; iterated exactly once, on the producer thread.
+    depth : int
+        Prefetch depth (default 2: double buffering). ``<= 0`` disables
+        the thread entirely (synchronous passthrough).
+    """
+
+    def __init__(self, chunks: Iterable, depth: int = 2):
+        self.depth = int(depth)
+        self._closed = False
+        self._reported = False
+        self._exhausted = False
+        self._producer_busy = 0.0
+        self._consumer_wait = 0.0
+        self._stager = None
+        source = chunks
+        if isinstance(chunks, ChunkIterator):
+            # split the pipeline at the host/device boundary: the producer
+            # thread runs the raw read pass, staging happens in __next__
+            self._stager = chunks._stage
+            source = chunks.iter_raw()
+        elif self.depth > 0 and jax.process_count() > 1:
+            # already-staged chunks: iterating them on the producer thread
+            # would issue device work concurrently with the consumer's
+            # collective dispatch — a cross-process deadlock. Degrade to
+            # synchronous inline iteration; only ChunkIterator sources
+            # (raw host reads) can overlap under multiple controllers.
+            self.depth = 0
+        if self.depth <= 0:
+            self._thread = None
+            self._it = iter(source)
+            return
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, self.depth - 1))
+        self._stop = threading.Event()
+        self._source = source
+        self._thread = threading.Thread(target=self._producer, daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------ producer
+    def _put(self, msg) -> bool:
+        while not self._stop.is_set():
+            try:
+                self._q.put(msg, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _producer(self) -> None:
+        try:
+            it = iter(self._source)
+            while not self._stop.is_set():
+                t0 = time.perf_counter()
+                try:
+                    item = next(it)
+                except StopIteration:
+                    break
+                self._producer_busy += time.perf_counter() - t0
+                if not self._put((_ITEM, item)):
+                    return
+        except BaseException as exc:  # noqa: BLE001 - surfaced to the consumer
+            self._put((_ERR, exc))
+        finally:
+            self._put((_DONE, None))
+
+    # ------------------------------------------------------------ consumer
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._exhausted or self._closed:
+            raise StopIteration
+        if self._thread is None:  # synchronous comparator: read inline
+            try:
+                item = next(self._it)
+            except StopIteration:
+                self._exhausted = True
+                self._report()
+                raise
+            if self._stager is not None:
+                item = self._stager(item)
+            return item
+        try:
+            tag, item = self._q.get_nowait()
+            hit = True
+        except queue.Empty:
+            _hooks.observe("stream.stall")
+            hit = False
+            t0 = time.perf_counter()
+            while True:
+                try:
+                    tag, item = self._q.get(timeout=0.1)
+                    break
+                except queue.Empty:
+                    if not self._thread.is_alive():
+                        # producer died without its sentinel (should not
+                        # happen; defensive against a hung __next__)
+                        self._exhausted = True
+                        self._report()
+                        raise StopIteration from None
+            self._consumer_wait += time.perf_counter() - t0
+        if tag is _DONE:
+            self._exhausted = True
+            self._report()
+            raise StopIteration
+        if tag is _ERR:
+            self._exhausted = True
+            self._report()
+            raise item
+        if hit:
+            _hooks.observe("stream.prefetch_hit")
+        if self._stager is not None:
+            # the device half, on the consumer's dispatch thread
+            item = self._stager(item)
+        return item
+
+    # ------------------------------------------------------------ teardown
+    def _report(self) -> None:
+        if not self._reported:
+            self._reported = True
+            _hooks.observe(
+                "stream.overlap",
+                seconds=max(0.0, self._producer_busy - self._consumer_wait),
+            )
+
+    def close(self) -> None:
+        """Stop the producer and join its thread. Idempotent; called by
+        ``__exit__`` and ``__del__``, and safe mid-iteration (the
+        iterator then raises StopIteration)."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._thread is not None:
+            self._stop.set()
+            # drain so a producer blocked in put() observes the stop flag
+            while self._thread.is_alive():
+                try:
+                    self._q.get_nowait()
+                except queue.Empty:
+                    pass
+                self._thread.join(timeout=0.05)
+        self._report()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close()
+        # graftlint: G006 - interpreter teardown: modules may already be gone
+        except BaseException:  # noqa: BLE001
+            pass

@@ -86,17 +86,43 @@ class PartialH5Dataset:
 
 class PartialH5DataLoaderIter:
     """Background-prefetching slab iterator (reference
-    ``partial_dataset.py:224``)."""
+    ``partial_dataset.py:224``).
+
+    Hardened against the classic producer-thread leaks: the bounded queue
+    is fed with interruptible timed puts (never a blocking ``put`` into a
+    full queue the consumer has abandoned), reader exceptions travel
+    through the queue and re-raise in the consumer's ``__next__`` (the
+    ``None`` sentinel still follows, so iteration can never hang on a dead
+    producer), and :meth:`close` — also run by ``__del__`` and the context
+    manager — stops the producer, drains the queue, and joins the thread
+    on early teardown (``break`` out of a loop mid-epoch).
+    """
 
     def __init__(self, dataset: PartialH5Dataset):
         self.dataset = dataset
+        # maxsize bounds staging to 2 slabs beyond the one being consumed
         self._q: "queue.Queue" = queue.Queue(maxsize=2)
         self._offsets = list(range(0, dataset.total_size, dataset.load_len))
+        self._stop = threading.Event()
+        self._closed = False
         self._thread = queue_thread(self._q, self._producer)
+
+    def _put(self, item) -> bool:
+        """Timed-put loop: blocks only until the queue drains OR the
+        consumer signals stop — the producer can always exit."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def _producer(self) -> None:
         try:
             for start in self._offsets:
+                if self._stop.is_set():
+                    return
                 stop = min(start + self.dataset.load_len, self.dataset.total_size)
                 slab = self.dataset._read_slab(start, stop)
                 out = []
@@ -105,19 +131,54 @@ class PartialH5DataLoaderIter:
                     if t is not None:
                         j = t(j)
                     out.append(jax.device_put(j))  # async H2D, overlaps next read
-                self._q.put(out[0] if len(out) == 1 else tuple(out))
+                if not self._put(out[0] if len(out) == 1 else tuple(out)):
+                    return
         except BaseException as exc:  # noqa: BLE001 - surfaced to the consumer
-            self._q.put(exc)
+            self._put(exc)
         finally:
-            self._q.put(None)
+            self._put(None)
 
     def __iter__(self):
         return self
 
     def __next__(self):
-        item = self._q.get()
+        while True:
+            try:
+                item = self._q.get(timeout=0.1)
+                break
+            except queue.Empty:
+                if not self._thread.is_alive():
+                    # producer died without delivering its sentinel (e.g.
+                    # interpreter teardown killed the daemon) — never hang
+                    raise StopIteration
         if item is None:
             raise StopIteration
         if isinstance(item, BaseException):
             raise item
         return item
+
+    def close(self) -> None:
+        """Stop the producer and join its thread; safe to call twice."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        while self._thread.is_alive():
+            try:  # drain so a blocked timed put can complete and exit
+                self._q.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=0.05)
+
+    def __enter__(self) -> "PartialH5DataLoaderIter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        # graftlint: G006 - interpreter teardown: modules may already be gone
+        except Exception:
+            pass

@@ -33,6 +33,13 @@ def _synthetic_out():
         "fused_pipeline_speedup": 2.1,
         "fused_warm_compiles": 0,
         "fused_warm_dispatches": 1,
+        "stream_speedup": 1.42,
+        "stream_gbps": 0.51,
+        "stream_sync_gbps": 0.36,
+        "stream_prefetch_hits": 5,
+        "stream_warm_compiles": 0,
+        "stream_divergences": 0,
+        "stream_unit": "u" * 60,
         "lockstep_events": 42,
         "lockstep_divergences": 0,
         "api_over_kernel": {},
@@ -63,6 +70,10 @@ class TestCompactSummary:
         assert obj["fused_pipeline_speedup"] == 2.1
         assert obj["fused_warm_compiles"] == 0
         assert obj["fused_warm_dispatches"] == 1
+        assert obj["stream_speedup"] == 1.42
+        assert obj["stream_gbps"] == 0.51
+        assert obj["stream_warm_compiles"] == 0
+        assert obj["stream_divergences"] == 0
         assert obj["lockstep_events"] == 42
         assert obj["lockstep_divergences"] == 0
         # every headline metric made it into the line
@@ -156,6 +167,53 @@ class TestBenchCheck:
         line = json.dumps(bench._compact_summary(out, "d.json"))
         obj = bench_check.check(line)
         assert "fused_error" in obj
+        assert len(line) < bench_check.LINE_BUDGET
+
+    def test_rejects_stream_no_overlap(self):
+        # prefetch-on barely different from synchronous means the double
+        # buffer bought nothing — the pipeline feature is regressing
+        out = _synthetic_out()
+        out["stream_speedup"] = 1.05
+        with pytest.raises(ValueError, match="not overlapping"):
+            bench_check.check(json.dumps(bench._compact_summary(out, "d.json")))
+        out["stream_speedup"] = "1.4"
+        with pytest.raises(ValueError, match="must be numeric"):
+            bench_check.check(json.dumps(bench._compact_summary(out, "d.json")))
+
+    def test_rejects_stream_divergence_and_recompiles(self):
+        out = _synthetic_out()
+        out["stream_divergences"] = 1
+        with pytest.raises(ValueError, match="in-memory oracle"):
+            bench_check.check(json.dumps(bench._compact_summary(out, "d.json")))
+        out = _synthetic_out()
+        out["stream_warm_compiles"] = 2
+        with pytest.raises(ValueError, match="warm chunk loop"):
+            bench_check.check(json.dumps(bench._compact_summary(out, "d.json")))
+        out = _synthetic_out()
+        out["stream_gbps"] = 0.0
+        with pytest.raises(ValueError, match="moved no data"):
+            bench_check.check(json.dumps(bench._compact_summary(out, "d.json")))
+
+    def test_stream_single_core_omits_comparator(self):
+        # on a 1-CPU host the worker reports throughput/correctness but no
+        # prefetch-vs-sync ratio (both legs share the core) — absent key,
+        # no gate, the line still validates
+        out = _synthetic_out()
+        del out["stream_speedup"]
+        del out["stream_sync_gbps"]
+        obj = bench_check.check(json.dumps(bench._compact_summary(out, "d.json")))
+        assert "stream_speedup" not in obj
+        assert obj["stream_gbps"] == 0.51
+
+    def test_stream_error_degrades_gracefully(self):
+        out = _synthetic_out()
+        for k in list(out):
+            if k.startswith("stream_"):
+                del out[k]
+        out["stream_error"] = "x" * 400
+        line = json.dumps(bench._compact_summary(out, "d.json"))
+        obj = bench_check.check(line)
+        assert "stream_error" in obj
         assert len(line) < bench_check.LINE_BUDGET
 
     def test_rejects_missing_keys(self):

@@ -55,6 +55,19 @@ def test_lazy_package_is_flow_clean():
     )
 
 
+def test_stream_package_is_flow_clean():
+    """Explicit gate over the out-of-core streaming layer: chunk shapes
+    and validity counts flow into jitted per-chunk programs, which is the
+    rank-divergence surface graftflow taints."""
+    findings, files_checked = gf.analyze_paths(
+        [os.path.join(REPO, "heat_tpu", "stream")]
+    )
+    assert files_checked >= 5  # __init__, _stats, chunked, estimators, prefetch
+    assert not findings, "\n".join(
+        f"  {f.path}:{f.line}:{f.col}: {f.rule} {f.message}" for f in findings
+    )
+
+
 def test_collective_vocabulary_matches_graftlint():
     """graftflow keeps its own copy of the collective-name set (both
     halves must stay importable without the other); the copies must not

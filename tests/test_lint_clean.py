@@ -55,6 +55,20 @@ def test_lazy_package_is_lint_clean():
     )
 
 
+def test_stream_package_is_lint_clean():
+    """Explicit gate over the out-of-core streaming layer: the per-chunk
+    estimator/cluster programs are exactly where a per-call jit closure
+    or an unbounded executable cache would silently reintroduce per-chunk
+    recompiles."""
+    findings, files_checked = gl.lint_paths(
+        [os.path.join(REPO, "heat_tpu", "stream")]
+    )
+    assert files_checked >= 5  # __init__, _stats, chunked, estimators, prefetch
+    assert not findings, "\n".join(
+        f"  {f.path}:{f.line}:{f.col}: {f.rule} {f.message}" for f in findings
+    )
+
+
 def _run_cli(*args):
     return subprocess.run(
         [sys.executable, os.path.join("tools", "graftlint.py"), *args],

@@ -1,0 +1,139 @@
+"""Direct unit tests for ``heat_tpu.utils.data.partial_dataset`` (PR 9
+satellite): lockstep multi-dataset slab iteration, transforms, and the
+producer-thread hardening — reader exceptions surface in the consumer,
+early teardown joins the thread, and a dead producer can never hang
+``__next__``.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+h5py = pytest.importorskip("h5py")
+
+from heat_tpu.utils.data.partial_dataset import (  # noqa: E402
+    PartialH5Dataset,
+    PartialH5DataLoaderIter,
+)
+
+ROWS = 57
+
+
+@pytest.fixture(scope="module")
+def h5file(tmp_path_factory):
+    rng = np.random.default_rng(7)
+    data = rng.normal(size=(ROWS, 4)).astype(np.float32)
+    labels = rng.integers(0, 3, size=(ROWS,)).astype(np.int32)
+    path = str(tmp_path_factory.mktemp("pd") / "pd.h5")
+    with h5py.File(path, "w") as fh:
+        fh.create_dataset("data", data=data)
+        fh.create_dataset("labels", data=labels)
+    return path, data, labels
+
+
+class TestIteration:
+    def test_single_dataset_slabs(self, h5file):
+        path, data, _ = h5file
+        ds = PartialH5Dataset(path, dataset_names="data", initial_load=20)
+        assert len(ds) == ROWS
+        slabs = [np.asarray(s) for s in ds]
+        assert [s.shape[0] for s in slabs] == [20, 20, 17]
+        np.testing.assert_allclose(np.concatenate(slabs), data, rtol=1e-6)
+
+    def test_multi_dataset_lockstep(self, h5file):
+        path, data, labels = h5file
+        ds = PartialH5Dataset(
+            path, dataset_names=["data", "labels"], initial_load=20
+        )
+        xs, ys = [], []
+        for x, y in ds:
+            assert x.shape[0] == y.shape[0]  # the lockstep contract
+            xs.append(np.asarray(x))
+            ys.append(np.asarray(y))
+        np.testing.assert_allclose(np.concatenate(xs), data, rtol=1e-6)
+        np.testing.assert_array_equal(np.concatenate(ys), labels)
+
+    def test_transform_applies(self, h5file):
+        path, data, _ = h5file
+        ds = PartialH5Dataset(
+            path, dataset_names="data", initial_load=20,
+            transforms=lambda a: a * 2.0,
+        )
+        got = np.concatenate([np.asarray(s) for s in ds])
+        np.testing.assert_allclose(got, data * 2.0, rtol=1e-5)
+
+    def test_reiterable(self, h5file):
+        path, data, _ = h5file
+        ds = PartialH5Dataset(path, dataset_names="data", initial_load=30)
+        for _ in range(2):
+            got = np.concatenate([np.asarray(s) for s in ds])
+            np.testing.assert_allclose(got, data, rtol=1e-6)
+
+
+class TestHardening:
+    def test_transform_exception_surfaces_then_stops(self, h5file):
+        path, _, _ = h5file
+
+        def bad(a):
+            raise RuntimeError("boom in transform")
+
+        ds = PartialH5Dataset(
+            path, dataset_names="data", initial_load=20, transforms=bad
+        )
+        it = iter(ds)
+        with pytest.raises(RuntimeError, match="boom in transform"):
+            next(it)
+        # the sentinel still follows the exception: no hang, clean stop
+        with pytest.raises(StopIteration):
+            next(it)
+
+    def test_early_close_joins_producer(self, h5file):
+        path, _, _ = h5file
+        before = threading.active_count()
+        ds = PartialH5Dataset(path, dataset_names="data", initial_load=5)
+        it = iter(ds)
+        next(it)
+        it.close()
+        assert not it._thread.is_alive()
+        it.close()  # idempotent
+        assert threading.active_count() == before
+
+    def test_context_manager_joins(self, h5file):
+        path, _, _ = h5file
+        ds = PartialH5Dataset(path, dataset_names="data", initial_load=5)
+        with iter(ds) as it:
+            assert isinstance(it, PartialH5DataLoaderIter)
+            next(it)
+        assert not it._thread.is_alive()
+
+    def test_queue_bounds_readahead(self, h5file):
+        path, _, _ = h5file
+        ds = PartialH5Dataset(path, dataset_names="data", initial_load=5)
+        it = iter(ds)
+        # 12 slabs total, but the producer can buffer at most 2 + 1 in
+        # flight — it must be blocked in its timed put, not done
+        import time
+
+        time.sleep(0.5)
+        assert it._q.qsize() <= 2
+        assert it._thread.is_alive()
+        it.close()
+
+    def test_dead_producer_never_hangs_next(self, h5file):
+        path, _, _ = h5file
+        ds = PartialH5Dataset(path, dataset_names="data", initial_load=20)
+        it = iter(ds)
+        # simulate a producer killed without its sentinel (interpreter
+        # teardown): stop it, drain everything it managed to enqueue
+        it._stop.set()
+        it._thread.join(timeout=5)
+        assert not it._thread.is_alive()
+        for _ in range(10):
+            try:
+                it._q.get_nowait()
+            except Exception:
+                break
+        with pytest.raises(StopIteration):
+            next(it)

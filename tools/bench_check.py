@@ -84,6 +84,36 @@ def validate(line: str, obj: dict) -> None:
                 f"fused_warm_dispatches must be 1, got {obj.get('fused_warm_dispatches')!r}: "
                 "a warm fused chain must be exactly one program execution"
             )
+    if "stream_gbps" in obj:
+        gbps = obj["stream_gbps"]
+        if not isinstance(gbps, (int, float)) or isinstance(gbps, bool) or gbps <= 0:
+            raise ValueError(
+                f"'stream_gbps' must be a positive number, got {gbps!r}: the "
+                "chunked pipeline moved no data"
+            )
+        if obj.get("stream_divergences") != 0:
+            raise ValueError(
+                f"stream_divergences must be 0, got {obj.get('stream_divergences')!r}: "
+                "a streaming estimator disagreed with its in-memory oracle — "
+                "the throughput numbers describe a wrong answer"
+            )
+        if obj.get("stream_warm_compiles") != 0:
+            raise ValueError(
+                f"stream_warm_compiles must be 0, got {obj.get('stream_warm_compiles')!r}: "
+                "the warm chunk loop recompiled/retraced per chunk"
+            )
+    if "stream_speedup" in obj:
+        # reported only on hosts with a core to run the producer on (the
+        # worker emits a stream_overlap note instead on single-core hosts)
+        speedup = obj["stream_speedup"]
+        if not isinstance(speedup, (int, float)) or isinstance(speedup, bool):
+            raise ValueError(f"'stream_speedup' must be numeric, got {speedup!r}")
+        if speedup < 1.15:
+            raise ValueError(
+                f"stream_speedup {speedup} < 1.15: double-buffered prefetch is "
+                "not overlapping reads with compute — the pipeline is running "
+                "synchronously with extra thread overhead"
+            )
     if len(line) >= LINE_BUDGET:
         raise ValueError(
             f"final JSON line is {len(line)} bytes, at or over the {LINE_BUDGET}-byte "
