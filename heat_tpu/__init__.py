@@ -28,7 +28,8 @@ from .core.version import __version__
 
 # runtime counters: layout rebalances / ragged exchanges /
 # compiles+transfers / collective-lockstep checks / supervised-recovery
-# activity / lazy-fusion captures+dispatches / streaming-pipeline chunks
+# activity / lazy-fusion captures+dispatches / streaming-pipeline chunks /
+# fused-kernel vs fallback dispatch decisions
 from .core.dndarray import LAYOUT_STATS
 from .parallel.flatmove import MOVE_STATS
 from .analysis.sanitizer import COMPILE_STATS
@@ -36,6 +37,7 @@ from .analysis.lockstep import LOCKSTEP_STATS
 from .resilience.supervisor import RECOVERY_STATS
 from .core.lazy import FUSE_STATS
 from .stream import STREAM_STATS
+from .core.kernels import KERNEL_STATS
 
 
 def __getattr__(name: str):

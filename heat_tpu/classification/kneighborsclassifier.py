@@ -63,6 +63,9 @@ class KNeighborsClassifier(BaseEstimator, ClassificationMixin):
             _, idx_nd = nearest_neighbors(x, self.x, self.n_neighbors)
             idx = idx_nd._logical()
         else:
+            from ..core.kernels import record_dispatch
+
+            record_dispatch("topk_distance", "fallback")
             Xq = x._logical().astype(jnp.float32)
             Xt = self.x._logical().astype(jnp.float32)
             d2 = _quadratic_expand(Xq, Xt)  # (nq, nt)

@@ -25,6 +25,30 @@ from ._kcluster import _BLOCK_PROGRAMS, _KCluster
 __all__ = ["KMeans"]
 
 
+def _assign_choice(x: DNDarray, xa: jnp.ndarray):
+    """(mode, mesh) for the Lloyd assignment at this call boundary.
+
+    The fused pallas kernel (``kernels.lloyd``) needs a single-device
+    buffer or even split-0 shards (its shard_map derives each shard's
+    validity window from its rank); anything else — feature split, uneven
+    shards — stays on the fused-XLA ``_assign_stats`` path. ``interpret``
+    only ever arrives via ``kernels.forced_mode`` (parity tests)."""
+    from ..core.kernels import dispatch_mode
+
+    mode = dispatch_mode("lloyd_fused")
+    mesh = None
+    p = x.comm.size
+    if mode in ("pallas", "interpret"):
+        if x.split == 0 and p > 1:
+            if xa.shape[0] % p == 0:
+                mesh = x.comm.mesh
+            else:
+                mode = "fallback"
+        elif x.split is not None and p > 1:
+            mode = "fallback"
+    return mode, mesh
+
+
 def _assign_stats(xa: jnp.ndarray, centers: jnp.ndarray, k: int, n_valid):
     """Assignment sufficient statistics, fused: per-cluster ``sums``
     (k, f) and ``counts`` (k,) plus per-row ``labels`` and the summed
@@ -52,10 +76,27 @@ def _assign_stats(xa: jnp.ndarray, centers: jnp.ndarray, k: int, n_valid):
     return sums, counts, labels, inertia
 
 
-def _lloyd_body(xa: jnp.ndarray, centers: jnp.ndarray, k: int, n_valid):
+def _assign_stats_dispatch(xa, centers, k: int, n_valid, mode: str, mesh):
+    """The :func:`_assign_stats` contract via the mode chosen at the call
+    boundary: the fused pallas kernel (one HBM pass, compiled or
+    interpreted) or the fused-XLA fallback. ``mode``/``mesh`` are static
+    under jit — the choice is baked into the compiled program."""
+    if mode in ("pallas", "interpret"):
+        from ..core.kernels import lloyd_local, lloyd_sharded
+
+        interpret = mode != "pallas"
+        nv = xa.shape[0] if n_valid is None else n_valid
+        if mesh is not None:
+            return lloyd_sharded(xa, centers, nv, mesh, interpret=interpret)
+        return lloyd_local(xa, centers, nv, interpret=interpret)
+    return _assign_stats(xa, centers, k, n_valid)
+
+
+def _lloyd_body(xa: jnp.ndarray, centers: jnp.ndarray, k: int, n_valid,
+                mode: str = "fallback", mesh=None):
     """One Lloyd iteration: (assign, update, shift) fused into one program
-    over the shared :func:`_assign_stats` kernel."""
-    sums, counts, labels, _ = _assign_stats(xa, centers, k, n_valid)
+    over the shared :func:`_assign_stats` kernel (or its pallas twin)."""
+    sums, counts, labels, _ = _assign_stats_dispatch(xa, centers, k, n_valid, mode, mesh)
     new_centers = jnp.where(
         counts[:, None] > 0, sums / jnp.maximum(counts, 1.0)[:, None], centers
     )
@@ -73,8 +114,9 @@ def _inertia(xa: jnp.ndarray, centers: jnp.ndarray, k: int, n_valid=None) -> jnp
     return jnp.sum(jnp.where(valid, per_row, 0.0))
 
 
-@partial(jax.jit, static_argnames=("k", "max_iter"))
-def _lloyd_fit(xa: jnp.ndarray, centers: jnp.ndarray, k: int, max_iter: int, tol: float, n_valid=None):
+@partial(jax.jit, static_argnames=("k", "max_iter", "mode", "mesh"))
+def _lloyd_fit(xa: jnp.ndarray, centers: jnp.ndarray, k: int, max_iter: int, tol: float,
+               n_valid=None, mode: str = "fallback", mesh=None):
     """The whole fit as ONE device program: a ``lax.while_loop`` over fused
     Lloyd iterations with the tol check on device. A full fit is a single
     dispatch — essential when the host drives the TPU over a network
@@ -86,7 +128,7 @@ def _lloyd_fit(xa: jnp.ndarray, centers: jnp.ndarray, k: int, max_iter: int, tol
 
     def body(state):
         i, c, _, _ = state
-        new_c, labels, shift = _lloyd_body(xa, c, k, nv)
+        new_c, labels, shift = _lloyd_body(xa, c, k, nv, mode, mesh)
         return (i + 1, new_c, labels, shift)
 
     n = xa.shape[0]
@@ -96,11 +138,11 @@ def _lloyd_fit(xa: jnp.ndarray, centers: jnp.ndarray, k: int, max_iter: int, tol
     return c, labels, i
 
 
-def _lloyd_block_program(k: int):
+def _lloyd_block_program(k: int, mode: str = "fallback", mesh=None):
     """Cached jitted bounded-chunk Lloyd loop (supervised fits): like
     :func:`_lloyd_fit` but with a dynamic iteration budget and the shift
     carried in/out, so chained chunks reproduce the whole-fit sequence."""
-    key = ("kmeans", k)
+    key = ("kmeans", k, mode, mesh)
     prog = _BLOCK_PROGRAMS.get(key)
     if prog is None:
 
@@ -111,7 +153,7 @@ def _lloyd_block_program(k: int):
 
             def body(state):
                 i, c, _, _ = state
-                new_c, labels, shift = _lloyd_body(xa, c, k, n_valid)
+                new_c, labels, shift = _lloyd_body(xa, c, k, n_valid, mode, mesh)
                 return (i + 1, new_c, labels, shift)
 
             n = xa.shape[0]
@@ -154,7 +196,11 @@ class KMeans(_KCluster):
         return x.larray.astype(jnp.promote_types(x.larray.dtype, jnp.float32))
 
     def _supervised_step(self, xa, centers, budget, tol, shift0, x):
-        prog = _lloyd_block_program(self.n_clusters)
+        from ..core.kernels import record_dispatch
+
+        mode, mesh = _assign_choice(x, xa)
+        record_dispatch("lloyd_fused", mode)
+        prog = _lloyd_block_program(self.n_clusters, mode, mesh)
         return prog(xa, centers, budget, tol, jnp.int32(x.gshape[0]), shift0)
 
     def _finalize_supervised(self, result) -> None:
@@ -184,7 +230,13 @@ class KMeans(_KCluster):
         centers = self._initialize_cluster_centers(x).astype(xa.dtype)
 
         tol = -1.0 if self.tol is None else float(self.tol)
-        centers, labels, n_iter = _lloyd_fit(xa, centers, k, self.max_iter, tol, n)
+        from ..core.kernels import record_dispatch
+
+        mode, mesh = _assign_choice(x, xa)
+        record_dispatch("lloyd_fused", mode)  # call boundary: once per fit
+        centers, labels, n_iter = _lloyd_fit(
+            xa, centers, k, self.max_iter, tol, n, mode=mode, mesh=mesh
+        )
 
         self._cluster_centers = DNDarray(centers, split=None, device=x.device, comm=x.comm)
         labels = labels.astype(jnp.int64)

@@ -35,20 +35,20 @@ from ..core.dndarray import DNDarray
 from ..spatial.distance import _quadratic_expand
 from ._kcluster import _BLOCK_PROGRAMS, _KCluster
 from ..stream.prefetch import Prefetcher
-from .kmeans import _assign_stats
+from .kmeans import _assign_choice, _assign_stats_dispatch
 
 __all__ = ["StreamingKMeans"]
 
 
-def _accum_program(k: int):
+def _accum_program(k: int, mode: str = "fallback", mesh=None):
     """Cached per-chunk accumulator: fold one chunk's assignment stats
     into the epoch's running (sums, counts, inertia)."""
-    key = ("streaming_kmeans_accum", k)
+    key = ("streaming_kmeans_accum", k, mode, mesh)
     prog = _BLOCK_PROGRAMS.get(key)
     if prog is None:
 
         def block(xa, centers, n_valid, sums, counts, inertia):
-            s, c, _, i = _assign_stats(xa, centers, k, n_valid)
+            s, c, _, i = _assign_stats_dispatch(xa, centers, k, n_valid, mode, mesh)
             return sums + s, counts + c, inertia + i
 
         _BLOCK_PROGRAMS[key] = jax.jit(block)
@@ -56,15 +56,15 @@ def _accum_program(k: int):
     return prog
 
 
-def _minibatch_program(k: int):
+def _minibatch_program(k: int, mode: str = "fallback", mesh=None):
     """Cached per-chunk minibatch step: move each assigned center toward
     its chunk mean with learning rate ``counts / new_totals``."""
-    key = ("streaming_kmeans_minibatch", k)
+    key = ("streaming_kmeans_minibatch", k, mode, mesh)
     prog = _BLOCK_PROGRAMS.get(key)
     if prog is None:
 
         def block(xa, centers, totals, n_valid):
-            sums, counts, _, inertia = _assign_stats(xa, centers, k, n_valid)
+            sums, counts, _, inertia = _assign_stats_dispatch(xa, centers, k, n_valid, mode, mesh)
             new_totals = totals + counts
             eta = (counts / jnp.maximum(new_totals, 1.0))[:, None]
             target = sums / jnp.maximum(counts, 1.0)[:, None]
@@ -117,6 +117,7 @@ class StreamingKMeans(_KCluster):
         self._centers_dev = None  # replicated jnp array between chunks
         self._totals = None  # minibatch per-center sample counts
         self._placement = None  # (device, comm) from the first chunk
+        self._choice = ("fallback", None)  # assignment (mode, mesh) per chunk
 
     def _chunk_view(self, chunk: DNDarray):
         """Padded device buffer + valid count, float32-promoted (the
@@ -130,6 +131,11 @@ class StreamingKMeans(_KCluster):
         if self._centers_dev is None:
             self._placement = (chunk.device, chunk.comm)
             self._centers_dev = self._initialize_cluster_centers(chunk).astype(xa.dtype)
+        from ..core.kernels import record_dispatch
+
+        # per-chunk call boundary: pick (and count) the assignment mode
+        self._choice = _assign_choice(chunk, xa)
+        record_dispatch("lloyd_fused", self._choice[0])
         return xa, jnp.int32(chunk.gshape[0])
 
     def _publish(self) -> None:
@@ -147,7 +153,7 @@ class StreamingKMeans(_KCluster):
         if self._totals is None:
             self._totals = jnp.zeros((k,), xa.dtype)
         self._centers_dev, self._totals, inertia = collective_lockstep(
-            _minibatch_program(k)(xa, self._centers_dev, self._totals, nv)
+            _minibatch_program(k, *self._choice)(xa, self._centers_dev, self._totals, nv)
         )
         self._inertia = float(inertia)
         self._n_iter = (self._n_iter or 0) + 1
@@ -184,7 +190,9 @@ class StreamingKMeans(_KCluster):
                     if self._totals is None:
                         self._totals = jnp.zeros((k,), xa.dtype)
                     self._centers_dev, self._totals, inertia = collective_lockstep(
-                        _minibatch_program(k)(xa, self._centers_dev, self._totals, nv)
+                        _minibatch_program(k, *self._choice)(
+                            xa, self._centers_dev, self._totals, nv
+                        )
                     )
                     continue
                 if sums is None:
@@ -193,7 +201,9 @@ class StreamingKMeans(_KCluster):
                     counts = jnp.zeros((k,), xa.dtype)
                     inertia = jnp.zeros((), xa.dtype)
                 sums, counts, inertia = collective_lockstep(
-                    _accum_program(k)(xa, self._centers_dev, nv, sums, counts, inertia)
+                    _accum_program(k, *self._choice)(
+                        xa, self._centers_dev, nv, sums, counts, inertia
+                    )
                 )
             if not seen:
                 if epoch == 0:

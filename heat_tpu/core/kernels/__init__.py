@@ -2,15 +2,60 @@
 
 The reference delegates its inner loops to libtorch kernels (SURVEY §2:
 "native under the hood"). On TPU most of those loops compile to optimal
-XLA programs already (the fused Lloyd step measures at one HBM pass over
-the data per iteration — the roofline). The kernels here cover the cases
-XLA cannot reach:
+XLA programs already; the kernels here cover the cases XLA cannot reach,
+each registered on the :mod:`._dispatch` registry (per-kernel probe,
+declared fallback mode, ``KERNEL_STATS`` dispatch counters):
 
 - :func:`nearest_neighbors` — fused pairwise-distance + running top-k that
   never materializes the (n, m) distance matrix (the flash-attention trick
   applied to ``cdist`` + ``top_k``), for kNN on training sets where the
   (n, m) intermediate would not fit in HBM.
+- :func:`lloyd_local` / :func:`lloyd_sharded` — fused distance + argmin +
+  centroid-update for the Lloyd iteration: one HBM pass per iteration,
+  sidestepping the MXU-narrow-output (k×n)@(n×f) update matmul.
+- :func:`moments_local` / :func:`moments_sharded` / :func:`chunk_moments`
+  — one-pass Welford (count, mean, M2): a single data read where the
+  naive ``mean`` + ``std`` sequence takes three.
+- :func:`cholesky_blocked` — blocked panel-fused Cholesky: panel factor,
+  triangular solve and trailing update in one VMEM residency.
 """
-from .topk_distance import nearest_neighbors, pallas_supported
+from ._dispatch import (
+    KERNEL_STATS,
+    KERNELS,
+    dispatch_mode,
+    forced_mode,
+    kernel_spec,
+    pallas_supported,
+    record_dispatch,
+    register_kernel,
+    reset_kernel_stats,
+)
+from .lloyd import LLOYD_KERNEL, lloyd_local, lloyd_sharded
+from .moments import MOMENTS_KERNEL, chunk_moments, merge_moments, moments_local, moments_sharded
+from .panel_update import CHOL_KERNEL, MAX_FUSED_N, cholesky_blocked
+from .topk_distance import TOPK_KERNEL, nearest_neighbors
 
-__all__ = ["nearest_neighbors", "pallas_supported"]
+__all__ = [
+    "CHOL_KERNEL",
+    "KERNELS",
+    "KERNEL_STATS",
+    "LLOYD_KERNEL",
+    "MAX_FUSED_N",
+    "MOMENTS_KERNEL",
+    "TOPK_KERNEL",
+    "cholesky_blocked",
+    "chunk_moments",
+    "dispatch_mode",
+    "forced_mode",
+    "kernel_spec",
+    "lloyd_local",
+    "lloyd_sharded",
+    "merge_moments",
+    "moments_local",
+    "moments_sharded",
+    "nearest_neighbors",
+    "pallas_supported",
+    "record_dispatch",
+    "register_kernel",
+    "reset_kernel_stats",
+]

@@ -1,0 +1,202 @@
+"""Fused distance + argmin + centroid-update Pallas kernel (Lloyd step).
+
+The XLA Lloyd iteration (``cluster.kmeans._assign_stats``) is two HBM
+passes over the data: the fused distance+argmin pass, then — because the
+argmin→one-hot dependency blocks fusion — a separate ``onehotᵀ @ X``
+update matmul that re-reads X. At k=8 that matmul also drives the MXU at
+8-of-128 output lanes (the BENCH_r05 floor probe's bound). This kernel
+streams X row tiles through VMEM ONCE: distances, argmin, the one-hot
+update matmul, per-cluster counts and the inertia all happen while the
+tile is resident, accumulating (sums, counts, inertia) across the
+sequential TPU grid. Centers are padded to 128 rows so the per-tile
+update matmul runs at full MXU width on operands already in VMEM.
+
+Roofline: one read of the (n, f) buffer + O(n) label writes per Lloyd
+iteration — half the unfused path's traffic. Comparator: the fused-XLA
+``_assign_stats`` program (``kmeans_floor_probe``'s decomposition floor
+is the unfused treatment both beat).
+
+Parity: distances use the same quadratic expansion as
+``spatial.distance._quadratic_expand`` and ties break toward the lower
+index (matching ``jnp.argmin``), so labels are bit-identical; sums and
+inertia accumulate per tile, so centroids match the XLA path to float32
+re-association (~1e-6 relative, the documented tolerance).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ._dispatch import register_kernel
+
+try:  # pallas TPU backend is optional at import time (CPU test meshes)
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+__all__ = ["lloyd_local", "lloyd_sharded", "LLOYD_KERNEL"]
+
+_INT_MAX = 2**31 - 1
+
+LLOYD_KERNEL = register_kernel(
+    "lloyd_fused",
+    fallback="fallback",
+    comparator="fused-XLA _assign_stats (distance pass + separate update matmul)",
+    roofline="one HBM read of X per Lloyd iteration vs two unfused — bandwidth bound",
+)
+
+
+def _lloyd_kernel(nv_ref, x_ref, c_ref, labels_ref, sums_ref, cnt_ref, in_ref,
+                  *, k: int, tile_n: int):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        sums_ref[:] = jnp.zeros(sums_ref.shape, sums_ref.dtype)
+        cnt_ref[:] = jnp.zeros(cnt_ref.shape, cnt_ref.dtype)
+        in_ref[:] = jnp.zeros(in_ref.shape, in_ref.dtype)
+
+    x = x_ref[:]
+    c = c_ref[:]
+    xc = jnp.dot(x, c.T, preferred_element_type=jnp.float32)
+    x2 = jnp.sum(x * x, axis=1, keepdims=True)
+    c2 = jnp.sum(c * c, axis=1)[None, :]
+    d2 = jnp.maximum(x2 + c2 - 2.0 * xc, 0.0)
+    col = jax.lax.broadcasted_iota(jnp.int32, d2.shape, 1)
+    d2 = jnp.where(col < k, d2, jnp.inf)  # padded center rows can never win
+    mval = jnp.min(d2, axis=1, keepdims=True)
+    # argmin with ties toward the lower index, matching jnp.argmin
+    labels = jnp.min(
+        jnp.where(d2 == mval, col, jnp.int32(_INT_MAX)), axis=1, keepdims=True
+    )
+    labels_ref[:] = labels
+    row = jax.lax.broadcasted_iota(jnp.int32, (x.shape[0], 1), 0) + i * tile_n
+    valid = row < nv_ref[0, 0]
+    # zero both factors for padded rows: 0-weight x garbage would be nan
+    onehot = jnp.where(valid & (col == labels), 1.0, 0.0).astype(x.dtype)
+    xs = jnp.where(valid, x, 0.0)
+    sums_ref[:] += jnp.dot(onehot.T, xs, preferred_element_type=jnp.float32)
+    cnt_ref[:] += jnp.sum(onehot, axis=0, keepdims=True)
+    in_ref[0, 0] += jnp.sum(jnp.where(valid[:, 0], mval[:, 0], 0.0))
+
+
+@functools.partial(jax.jit, static_argnames=("k", "tile_n", "interpret"))
+def _lloyd_call(xa, centers, n_valid, k: int, tile_n: int, interpret: bool):
+    n, f = xa.shape
+    kp = ((k + 127) // 128) * 128  # full MXU width for the update matmul
+    fp = (-f) % 128
+    xp = jnp.pad(xa, ((0, (-n) % tile_n), (0, fp)))
+    cp = jnp.pad(centers, ((0, kp - k), (0, fp)))
+    grid = (xp.shape[0] // tile_n,)
+    if pltpu is not None and not interpret:
+        vmem = pltpu.VMEM
+    else:  # interpreter path (CPU test meshes) has no TPU memory spaces
+        vmem = pl.ANY
+    # zero index-map components derive from the grid arg (i - i): this
+    # Mosaic build mis-legalizes i64 index-map constants (see topk_distance)
+    amap = lambda i: (i - i, i - i)
+    kwargs = {}
+    if pltpu is not None and not interpret:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            vmem_limit_bytes=64 * 1024 * 1024
+        )
+    labels, sums, cnt, inertia = pl.pallas_call(
+        functools.partial(_lloyd_kernel, k=k, tile_n=tile_n),
+        grid=grid,
+        **kwargs,
+        in_specs=[
+            pl.BlockSpec((1, 1), amap, memory_space=vmem),
+            pl.BlockSpec((tile_n, xp.shape[1]), lambda i: (i, i - i), memory_space=vmem),
+            pl.BlockSpec((kp, xp.shape[1]), amap, memory_space=vmem),
+        ],
+        out_specs=[
+            pl.BlockSpec((tile_n, 1), lambda i: (i, i - i), memory_space=vmem),
+            pl.BlockSpec((kp, xp.shape[1]), amap, memory_space=vmem),
+            pl.BlockSpec((1, kp), amap, memory_space=vmem),
+            pl.BlockSpec((1, 1), amap, memory_space=vmem),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((xp.shape[0], 1), jnp.int32),
+            jax.ShapeDtypeStruct((kp, xp.shape[1]), jnp.float32),
+            jax.ShapeDtypeStruct((1, kp), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(jnp.asarray(n_valid, jnp.float32).reshape(1, 1), xp, cp)
+    return sums[:k, :f], cnt[0, :k], labels[:n, 0], inertia[0, 0]
+
+
+def lloyd_local(
+    xa: jnp.ndarray,
+    centers: jnp.ndarray,
+    n_valid=None,
+    *,
+    tile_n: int = 512,
+    interpret: bool | None = None,
+):
+    """Fused Lloyd assignment statistics of a local (n, f) buffer.
+
+    Returns ``(sums, counts, labels, inertia)`` with the exact contract
+    of ``cluster.kmeans._assign_stats``: per-cluster sums (k, f), counts
+    (k,), per-row labels (n,) int32 and the summed min-distance inertia.
+    """
+    if xa.ndim != 2 or centers.ndim != 2 or xa.shape[1] != centers.shape[1]:
+        raise ValueError(f"bad operand shapes {xa.shape} x {centers.shape}")
+    from ._dispatch import pallas_supported
+
+    if interpret is None:
+        interpret = not pallas_supported(LLOYD_KERNEL)
+    xa = xa.astype(jnp.float32)
+    centers = centers.astype(jnp.float32)
+    if n_valid is None:
+        n_valid = xa.shape[0]
+    tile_n = max(8, min(tile_n, max(8, xa.shape[0])))
+    return _lloyd_call(xa, centers, n_valid, centers.shape[0], tile_n, interpret)
+
+
+def lloyd_sharded(
+    xa,
+    centers,
+    n_valid,
+    mesh,
+    *,
+    tile_n: int = 512,
+    interpret: bool | None = None,
+):
+    """Fused Lloyd assignment statistics of a split-0 sharded buffer.
+
+    Each shard runs :func:`lloyd_local` over its rows (validity window
+    derived from the shard's position and the GLOBAL ``n_valid``); sums,
+    counts and inertia psum over the mesh axis, labels stay sharded.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..communication import SPLIT_AXIS
+
+    p = mesh.devices.size
+    mi = xa.shape[0] // p
+
+    def local(xs, cs, nv_g):
+        r = jax.lax.axis_index(SPLIT_AXIS)
+        nv = jnp.clip(nv_g - r * mi, 0, mi)
+        sums, cnt, labels, inertia = lloyd_local(
+            xs, cs, nv, tile_n=tile_n, interpret=interpret
+        )
+        return (
+            jax.lax.psum(sums, SPLIT_AXIS),
+            jax.lax.psum(cnt, SPLIT_AXIS),
+            labels,
+            jax.lax.psum(inertia, SPLIT_AXIS),
+        )
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(SPLIT_AXIS, None), P(None, None), P()),
+        out_specs=(P(), P(), P(SPLIT_AXIS), P()),
+        check_vma=False,  # pallas_call out_shapes carry no vma info
+    )(xa, centers, jnp.asarray(n_valid, jnp.int32))

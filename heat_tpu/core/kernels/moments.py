@@ -1,0 +1,218 @@
+"""Fused one-pass Welford moments kernel (count / mean / M2 in one read).
+
+The public two-call sequence ``ht.mean(x)`` + ``ht.std(x)`` used to read
+the data three times (mean; then std's own mean + centered pass) while
+the fused bench probe showed a single-read sweep at the HBM roofline
+(VERDICT round 5: 562 GB/s fused vs ~250 through the API). This module
+is the single-read path:
+
+- :func:`moments_local` — a pallas kernel that streams row tiles of a
+  local (n, f) buffer through VMEM and Chan-merges each tile's
+  (count, mean, M2) into a carried accumulator: exactly one HBM pass,
+  compiled on TPU, interpreted on CPU test meshes (parity tests only —
+  the interpreter is far slower than XLA);
+- :func:`chunk_moments` — the raw-jnp twin of the same dataflow
+  (shifted one-pass sums, one fused XLA program, still a single read),
+  the default fast path off-TPU and the building block
+  ``stream.StreamingMoments``' fold and ``ht.mean``/``ht.var``/
+  ``ht.std``'s moments panel dispatch through;
+- :func:`moments_sharded` — shard_map wrapper combining per-shard
+  moments with the parallel Chan formulas (psum of counts and
+  count-weighted means, then M2 correction).
+
+Roofline: axis-0 moments of an (n, f) f32 buffer move ``4nf`` bytes and
+do O(nf) FLOPs — pure HBM bandwidth. One read is the floor; this kernel
+is at it. Comparator: ``jnp.mean`` + ``jnp.std`` (three reads).
+
+Numerics: per-tile/per-chunk sums use the first valid row as a shift
+(variance is shift-invariant), so M2 matches the two-pass oracle to
+float32 re-association (~1e-6 relative — the documented tolerance in
+the parity tests). Merging follows Chan et al., the same formulas as
+``stream.estimators``.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ._dispatch import register_kernel
+
+try:  # pallas TPU backend is optional at import time (CPU test meshes)
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+__all__ = ["chunk_moments", "moments_local", "moments_sharded", "MOMENTS_KERNEL"]
+
+MOMENTS_KERNEL = register_kernel(
+    "moments_onepass",
+    fallback="xla",
+    comparator="jnp.mean + jnp.std (three data reads)",
+    roofline="one HBM read of the (n, f) buffer; O(nf) FLOPs — bandwidth bound",
+)
+
+
+def chunk_moments(xa: jnp.ndarray, n_valid):
+    """(count, mean, M2) per column of a padded (n, f) buffer, one read.
+
+    Traceable raw-jnp twin of the pallas kernel: the shifted one-pass
+    sums ``s1 = Σ(x - x₀)`` and ``s2 = Σ(x - x₀)²`` fuse into a single
+    XLA loop over the buffer (no dependent second pass — ``jnp.var``'s
+    ``mean`` → ``mean((x - mean)²)`` chain cannot fuse). Rows at index
+    ``>= n_valid`` are masked out; ``n_valid`` may be a traced scalar.
+    """
+    row = jax.lax.broadcasted_iota(jnp.int32, (xa.shape[0], 1), 0)
+    valid = row < n_valid
+    shift = xa[0:1, :]  # first row is always logically valid
+    xs = jnp.where(valid, xa - shift, 0.0)
+    nb = jnp.sum(valid.astype(xa.dtype))
+    nb1 = jnp.maximum(nb, 1.0)
+    s1 = jnp.sum(xs, axis=0)
+    s2 = jnp.sum(xs * xs, axis=0)
+    mean = shift[0] + s1 / nb1
+    m2 = jnp.maximum(s2 - s1 * s1 / nb1, 0.0)
+    return nb, mean, m2
+
+
+def merge_moments(na, mean_a, m2_a, nb, mean_b, m2_b):
+    """Chan pairwise combine of two (count, mean, M2) states (traceable)."""
+    n = na + nb
+    n1 = jnp.maximum(n, 1.0)
+    delta = mean_b - mean_a
+    mean = mean_a + delta * (nb / n1)
+    m2 = m2_a + m2_b + delta * delta * (na * nb / n1)
+    return n, mean, m2
+
+
+def _moments_kernel(nv_ref, x_ref, cnt_ref, mean_ref, m2_ref, *, tile_n: int):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        cnt_ref[:] = jnp.zeros(cnt_ref.shape, cnt_ref.dtype)
+        mean_ref[:] = jnp.zeros(mean_ref.shape, mean_ref.dtype)
+        m2_ref[:] = jnp.zeros(m2_ref.shape, m2_ref.dtype)
+
+    x = x_ref[:]
+    row = jax.lax.broadcasted_iota(jnp.int32, (x.shape[0], 1), 0) + i * tile_n
+    valid = row < nv_ref[0, 0]
+    xs = jnp.where(valid, x, 0.0)
+    nb = jnp.sum(valid.astype(x.dtype))
+    nb1 = jnp.maximum(nb, 1.0)
+    mean_b = jnp.sum(xs, axis=0, keepdims=True) / nb1
+    d = jnp.where(valid, x - mean_b, 0.0)  # tile stays in VMEM: still one HBM read
+    m2_b = jnp.sum(d * d, axis=0, keepdims=True)
+    na = cnt_ref[0, 0]
+    n = na + nb
+    n1 = jnp.maximum(n, 1.0)
+    delta = mean_b - mean_ref[:]
+    mean_ref[:] = mean_ref[:] + delta * (nb / n1)
+    m2_ref[:] = m2_ref[:] + m2_b + delta * delta * (na * nb / n1)
+    cnt_ref[0, 0] = n
+
+
+@functools.partial(jax.jit, static_argnames=("tile_n", "interpret"))
+def _moments_call(xa, n_valid, tile_n: int, interpret: bool):
+    n, f = xa.shape
+    fp = -f % 128  # lane-pad: padded columns carry zeros, sliced off below
+    xp = jnp.pad(xa, ((0, (-n) % tile_n), (0, fp)))
+    grid = (xp.shape[0] // tile_n,)
+    if pltpu is not None and not interpret:
+        vmem = pltpu.VMEM
+    else:  # interpreter path (CPU test meshes) has no TPU memory spaces
+        vmem = pl.ANY
+    # zero index-map components derive from the grid arg (i - i): this
+    # Mosaic build mis-legalizes i64 index-map constants (see topk_distance)
+    amap = lambda i: (i - i, i - i)
+    kwargs = {}
+    if pltpu is not None and not interpret:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            vmem_limit_bytes=64 * 1024 * 1024
+        )
+    cnt, mean, m2 = pl.pallas_call(
+        functools.partial(_moments_kernel, tile_n=tile_n),
+        grid=grid,
+        **kwargs,
+        in_specs=[
+            pl.BlockSpec((1, 1), amap, memory_space=vmem),
+            pl.BlockSpec((tile_n, xp.shape[1]), lambda i: (i, i - i), memory_space=vmem),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1), amap, memory_space=vmem),
+            pl.BlockSpec((1, xp.shape[1]), amap, memory_space=vmem),
+            pl.BlockSpec((1, xp.shape[1]), amap, memory_space=vmem),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+            jax.ShapeDtypeStruct((1, xp.shape[1]), jnp.float32),
+            jax.ShapeDtypeStruct((1, xp.shape[1]), jnp.float32),
+        ],
+        interpret=interpret,
+    )(jnp.asarray(n_valid, jnp.float32).reshape(1, 1), xp)
+    return cnt[0, 0], mean[0, :f], m2[0, :f]
+
+
+def moments_local(
+    xa: jnp.ndarray,
+    n_valid=None,
+    *,
+    tile_n: int = 1024,
+    interpret: bool | None = None,
+):
+    """(count, mean, M2) per column of a local (n, f) buffer via the
+    pallas kernel: row tiles stream through VMEM, each tile's moments
+    Chan-merge into the carried accumulator — one HBM pass total.
+
+    ``n_valid`` masks buffer tail padding (defaults to all rows).
+    """
+    if xa.ndim != 2:
+        raise ValueError(f"moments_local expects a 2-D buffer, got {xa.shape}")
+    from ._dispatch import pallas_supported
+
+    if interpret is None:
+        interpret = not pallas_supported(MOMENTS_KERNEL)
+    xa = xa.astype(jnp.float32)
+    if n_valid is None:
+        n_valid = xa.shape[0]
+    tile_n = max(8, min(tile_n, max(8, xa.shape[0])))
+    return _moments_call(xa, n_valid, tile_n, interpret)
+
+
+def moments_sharded(xa, n_valid, mesh, *, tile_n: int = 1024, interpret: bool | None = None):
+    """Global (count, mean, M2) of a split-0 sharded (n, f) buffer.
+
+    Each shard runs :func:`moments_local`; the parallel Chan combine
+    (psum counts and count-weighted means, then correct each shard's M2
+    by its mean's distance to the global mean) runs over the mesh axis.
+    ``n_valid`` is the GLOBAL logical row count; each shard derives its
+    local validity window from its position.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..communication import SPLIT_AXIS
+
+    p = mesh.devices.size
+    mi = xa.shape[0] // p
+
+    def local(xs, nv_g):
+        r = jax.lax.axis_index(SPLIT_AXIS)
+        nv = jnp.clip(nv_g - r * mi, 0, mi)
+        cnt, mean, m2 = moments_local(xs, nv, tile_n=tile_n, interpret=interpret)
+        gcnt = jax.lax.psum(cnt, SPLIT_AXIS)
+        gcnt1 = jnp.maximum(gcnt, 1.0)
+        gmean = jax.lax.psum(cnt * mean, SPLIT_AXIS) / gcnt1
+        dm = mean - gmean
+        gm2 = jax.lax.psum(m2 + cnt * dm * dm, SPLIT_AXIS)
+        return gcnt, gmean, gm2
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(SPLIT_AXIS, None), P()),
+        out_specs=(P(), P(), P()),
+        check_vma=False,  # pallas_call out_shapes carry no vma info
+    )(xa, jnp.asarray(n_valid, jnp.int32))

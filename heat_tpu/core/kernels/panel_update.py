@@ -1,0 +1,168 @@
+"""Blocked panel-fused Cholesky kernel (panel factor + trailing update).
+
+The single-device blocked factorization path runs the panel factor and
+the O(bs·n) trailing GEMM as separate XLA ops, round-tripping the
+trailing submatrix through HBM once per panel — O(n²·nb) bytes. This
+kernel keeps the (padded) matrix resident in VMEM across a sequential
+grid over panels: each step factors the bs×bs diagonal block (masked
+unblocked Cholesky — no LAPACK call exists inside a Mosaic kernel),
+forward-substitutes the full-height panel against it, and applies the
+trailing syrk while everything is still on-chip. HBM traffic: one read
+of A and one write of L, total — the floor.
+
+The trailing update needs no region mask: the panel is zeroed above the
+diagonal block before the ``Lm @ Lmᵀ`` product, so the product is
+already zero outside the trailing submatrix.
+
+Scope: real float32, n ≤ ``MAX_FUSED_N`` (the whole matrix must fit
+VMEM). The distributed (p > 1) factorization keeps the shard_map path —
+its per-panel all_gather between the solve and the trailing update
+cannot live inside one kernel. LU keeps the XLA path too: tournament
+pivoting is collective-bound, not fusion-bound (see docs/PERFORMANCE.md).
+
+Comparator: ``jnp.linalg.cholesky`` on the same buffer. Parity: same
+factor up to float32 re-association (~1e-6 relative); non-SPD inputs
+propagate NaNs like ``jnp.linalg.cholesky``.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ._dispatch import register_kernel
+
+try:  # pallas TPU backend is optional at import time (CPU test meshes)
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+__all__ = ["cholesky_blocked", "CHOL_KERNEL", "MAX_FUSED_N"]
+
+# (n_pad, n_pad) working copy + the input block must fit scoped VMEM
+MAX_FUSED_N = 1024
+
+CHOL_KERNEL = register_kernel(
+    "chol_panel_fused",
+    fallback="fallback",
+    comparator="jnp.linalg.cholesky (separate XLA panel + trailing-update ops)",
+    roofline="one HBM read of A + one write of L; trailing updates stay in VMEM",
+)
+
+
+def _chol_unblocked(Akk: jnp.ndarray, bs: int) -> jnp.ndarray:
+    """Unblocked right-looking Cholesky of a bs×bs block, mask-based
+    (no dynamic indexing — Mosaic-friendly column selection via iota)."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, (bs, bs), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (bs, bs), 1)
+    ridx = rows[:, 0]
+
+    def body(j, A):
+        djj = jnp.sum(jnp.where((rows == j) & (cols == j), A, 0.0))
+        d = jnp.sqrt(djj)
+        colj = jnp.sum(jnp.where(cols == j, A, 0.0), axis=1)
+        lcol = jnp.where(ridx > j, colj / d, 0.0)
+        newcol = jnp.where(ridx == j, d, lcol)
+        A = jnp.where(cols == j, newcol[:, None], A)
+        upd = lcol[:, None] * lcol[None, :]
+        return A - jnp.where((rows > j) & (cols > j), upd, 0.0)
+
+    A = jax.lax.fori_loop(0, bs, body, Akk)
+    return jnp.where(rows >= cols, A, 0.0)
+
+
+def _panel_solve(Lkk: jnp.ndarray, Pfull: jnp.ndarray, bs: int) -> jnp.ndarray:
+    """X with ``X @ Lkkᵀ = Pfull`` (forward substitution over columns,
+    mask-based row selection — runs on the full-height panel)."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, (bs, bs), 0)
+    cidx = jax.lax.broadcasted_iota(jnp.int32, (bs, bs), 1)[0]
+    pcols = jax.lax.broadcasted_iota(jnp.int32, Pfull.shape, 1)
+
+    def body(j, X):
+        lrow = jnp.sum(jnp.where(rows == j, Lkk, 0.0), axis=0)  # Lkk[j, :]
+        w = jnp.where(cidx < j, lrow, 0.0)
+        pj = jnp.sum(jnp.where(pcols == j, Pfull, 0.0), axis=1)
+        acc = jnp.dot(X, w[:, None], preferred_element_type=jnp.float32)[:, 0]
+        ljj = jnp.sum(jnp.where(cidx == j, lrow, 0.0))
+        xj = (pj - acc) / ljj
+        return jnp.where(pcols == j, xj[:, None], X)
+
+    return jax.lax.fori_loop(0, bs, body, jnp.zeros_like(Pfull))
+
+
+def _chol_kernel(a_ref, L_ref, *, bs: int, n_pad: int):
+    kb = pl.program_id(0)
+
+    @pl.when(kb == 0)
+    def _():
+        L_ref[:] = a_ref[:]  # working copy; panels overwrite it in place
+
+    off = (kb * bs).astype(jnp.int32)  # multiple of bs — aligned slices
+    top = off - off  # int32 zero (mixed python-int/traced starts mis-type)
+    Akk = pl.load(L_ref, (pl.ds(off, bs), pl.ds(off, bs)))
+    Lkk = _chol_unblocked(Akk, bs)
+    Pfull = pl.load(L_ref, (pl.ds(top, n_pad), pl.ds(off, bs)))
+    X = _panel_solve(Lkk, Pfull, bs)
+    rown = jax.lax.broadcasted_iota(jnp.int32, (n_pad, 1), 0)
+    below = rown >= off + bs
+    Lm = jnp.where(below, X, 0.0)
+    # panel columns are final: zeros above, Lkk on the block, solve below
+    pl.store(L_ref, (pl.ds(top, n_pad), pl.ds(off, bs)), Lm)
+    pl.store(L_ref, (pl.ds(off, bs), pl.ds(off, bs)), Lkk)
+    # Lm is zero outside the trailing rows, so Lm @ Lmᵀ is already zero
+    # outside the trailing submatrix — subtract without a region mask
+    L_ref[:] = L_ref[:] - jnp.dot(Lm, Lm.T, preferred_element_type=jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("bs", "interpret"))
+def _chol_call(a, bs: int, interpret: bool):
+    n = a.shape[0]
+    n_pad = -(-n // bs) * bs
+    ap = jnp.pad(a, ((0, n_pad - n), (0, n_pad - n)))
+    # identity-extend the padding diagonal: chol([[A, 0], [0, I]]) keeps
+    # the logical factor unchanged and the padded system SPD
+    idx = jnp.arange(n_pad)
+    pad_diag = (idx[:, None] == idx[None, :]) & (idx[:, None] >= n)
+    ap = jnp.where(pad_diag, 1.0, ap)
+    if pltpu is not None and not interpret:
+        vmem = pltpu.VMEM
+    else:  # interpreter path (CPU test meshes) has no TPU memory spaces
+        vmem = pl.ANY
+    amap = lambda i: (i - i, i - i)  # Mosaic i64 index-map workaround
+    kwargs = {}
+    if pltpu is not None and not interpret:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            vmem_limit_bytes=64 * 1024 * 1024
+        )
+    L = pl.pallas_call(
+        functools.partial(_chol_kernel, bs=bs, n_pad=n_pad),
+        grid=(n_pad // bs,),
+        **kwargs,
+        in_specs=[pl.BlockSpec((n_pad, n_pad), amap, memory_space=vmem)],
+        out_specs=pl.BlockSpec((n_pad, n_pad), amap, memory_space=vmem),
+        out_shape=jax.ShapeDtypeStruct((n_pad, n_pad), jnp.float32),
+        interpret=interpret,
+    )(ap)
+    return L[:n, :n]
+
+
+def cholesky_blocked(
+    a: jnp.ndarray, *, bs: int = 128, interpret: bool | None = None
+) -> jnp.ndarray:
+    """Lower Cholesky factor of a local SPD (n, n) f32 buffer via the
+    panel-fused kernel (one VMEM residency for factor + trailing update)."""
+    if a.ndim != 2 or a.shape[0] != a.shape[1]:
+        raise ValueError(f"cholesky_blocked expects a square 2-D buffer, got {a.shape}")
+    if a.shape[0] > MAX_FUSED_N:
+        raise ValueError(
+            f"n={a.shape[0]} exceeds MAX_FUSED_N={MAX_FUSED_N} (matrix must fit VMEM)"
+        )
+    from ._dispatch import pallas_supported
+
+    if interpret is None:
+        interpret = not pallas_supported(CHOL_KERNEL)
+    a = a.astype(jnp.float32)
+    bs = max(8, min(bs, a.shape[0]))
+    return _chol_call(a, bs, interpret)

@@ -21,19 +21,23 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from ._dispatch import pallas_supported, register_kernel
+
 try:  # pallas TPU backend is optional at import time (CPU test meshes)
     from jax.experimental.pallas import tpu as pltpu
 except ImportError:  # pragma: no cover
     pltpu = None
 
-__all__ = ["nearest_neighbors", "pallas_supported"]
+__all__ = ["nearest_neighbors", "pallas_supported", "TOPK_KERNEL"]
 
 _INT_MAX = 2**31 - 1  # python int: jnp constants would be captured consts in kernels
 
-
-def pallas_supported() -> bool:
-    """True when compiled (non-interpreted) pallas kernels can run."""
-    return pltpu is not None and jax.default_backend() == "tpu"
+TOPK_KERNEL = register_kernel(
+    "topk_distance",
+    fallback="fallback",
+    comparator="materializing cdist + jax.lax.top_k ((n, m) distance matrix in HBM)",
+    roofline="one pass over x and y, O(n·k) output — never writes the (n, m) matrix",
+)
 
 
 def _merge_topk(cat_d: jnp.ndarray, cat_i: jnp.ndarray, k: int):
@@ -159,7 +163,7 @@ def nearest_neighbors(
     if not 0 < k <= m:
         raise ValueError(f"k={k} must be in [1, {m}]")
     if interpret is None:
-        interpret = not pallas_supported()
+        interpret = not pallas_supported(TOPK_KERNEL)
     x = x.astype(jnp.float32)
     y = y.astype(jnp.float32)
     tile_n = min(tile_n, max(8, x.shape[0]))

@@ -402,7 +402,24 @@ def cholesky(a: DNDarray, tiles_per_proc: int = 1) -> DNDarray:
         ftype = _float_type(a)
         comm = a.comm
         if not _dist2d(a):
-            L = jnp.linalg.cholesky(a._logical().astype(ftype))
+            from ..kernels import dispatch_mode, record_dispatch
+            from ..kernels.panel_update import MAX_FUSED_N, cholesky_blocked
+
+            arr = a._logical().astype(ftype)
+            mode = dispatch_mode("chol_panel_fused")
+            if not (
+                mode in ("pallas", "interpret")
+                and arr.shape[0] <= MAX_FUSED_N
+                and jnp.dtype(ftype) == jnp.float32  # kernel is f32/MXU only
+            ):
+                mode = "fallback"
+            record_dispatch("chol_panel_fused", mode)
+            if mode == "fallback":
+                L = jnp.linalg.cholesky(arr)
+            else:
+                # panel-fused kernel: factor + trailing update in one VMEM
+                # residency (f32 — its in-kernel solve runs on the MXU)
+                L = cholesky_blocked(arr, interpret=(mode != "pallas")).astype(ftype)
             return DNDarray(L, split=a.split, device=a.device, comm=comm)
         m = a
         if a.split != 0:  # A Hermitian: chol(A) = chol(A^H), A^H is split 0

@@ -10,12 +10,16 @@ skew/kurtosis corrections.
 """
 from __future__ import annotations
 
+import weakref
 from typing import Optional, Tuple, Union
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import types
+from . import _hooks, types
+from . import _operations
+from ._cache import ExecutableCache
 from ._operations import (
     _binary_op,
     _local_op,
@@ -304,9 +308,19 @@ def maximum(x1, x2, out=None) -> DNDarray:
     return _binary_op(jnp.maximum, x1, x2, out=out)
 
 
-def mean(x: DNDarray, axis=None) -> DNDarray:
+def mean(x: DNDarray, axis=None, where=None) -> DNDarray:
     """Arithmetic mean (reference ``statistics.py:891`` — local moments +
-    Allreduce + pairwise merging; one jnp.mean here)."""
+    Allreduce + pairwise merging). Dispatches through the one-pass moments
+    panel (see :func:`_moments_panel`): a following ``ht.std``/``ht.var``
+    on the same buffer reuses the memoized (count, mean, M2) and costs
+    zero additional data reads."""
+    if where is not None and isinstance(x, DNDarray):
+        return _where_moment(jnp.mean, x, axis, where, 0)
+    if isinstance(x, DNDarray):
+        axis_s = sanitize_axis(x.shape, axis)
+        stats = _moments_panel(x, axis_s)
+        if stats is not None:
+            return _wrap_moment(x, axis_s, stats[1])
     return _reduce_op(jnp.mean, x, axis=axis)
 
 
@@ -459,11 +473,215 @@ def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear
     return res
 
 
-def std(x: DNDarray, axis=None, ddof: int = 0, **kwargs) -> DNDarray:
-    """Standard deviation (reference ``statistics.py:1784``)."""
+# --------------------------------------------------------------------------
+# one-pass moments panel (kernels.moments dispatch)
+#
+# ht.mean + ht.std on the same buffer used to read the data three times
+# (mean; std's own mean + centered pass). The panel computes (count, mean,
+# M2) along the requested axis in ONE read — the pallas kernel on TPU, its
+# raw-jnp shifted-sums twin under XLA — and memoizes the tiny result per
+# buffer, so the second call of the pair costs zero data reads. mean /
+# var(ddof) / std all finalize from the same three numbers.
+
+_PANEL_PROGRAMS = ExecutableCache(maxsize=64)
+# id(buffer) -> (weakref, mode, {axis_key: (count, mean, m2)}). Keyed by
+# id() because jax Arrays are weakref-able but NOT hashable (elementwise
+# __eq__); the death callback drops the slot, so a recycled id can never
+# alias a dead buffer, and the identity re-check below guards the rest.
+_PANELS: dict = {}
+_PANELS_CAP = 32  # tiny entries (scalars + one (f,) row); bound per G002
+
+
+def _axis_key(axis_s) -> str:
+    return "all" if axis_s is None else str(axis_s)
+
+
+def _panel_program(ndim: int, split, padded: bool, axis_s):
+    """Jitted one-read shifted-sums moments program for 1-D/2-D buffers:
+    ``s1 = Σ(x−x₀)`` and ``s2 = Σ(x−x₀)²`` fuse into a single XLA
+    traversal (variance is shift-invariant), unlike the dependent
+    ``mean → mean((x−mean)²)`` chain. Sharded operands compile to the
+    local-partial + psum schedule automatically."""
+    key = ("moments_panel", ndim, split, padded, _axis_key(axis_s))
+    prog = _PANEL_PROGRAMS.get(key)
+    if prog is not None:
+        return prog
+
+    def run(xa, n0, n1):
+        x = xa.astype(jnp.promote_types(xa.dtype, jnp.float32))
+        shift = x[(0,) * x.ndim]  # first element is always logically valid
+        if padded:
+            it = jax.lax.broadcasted_iota(jnp.int32, x.shape, split)
+            nv = (n0, n1)[split] if x.ndim == 2 else n0
+            xs = jnp.where(it < nv, x - shift, jnp.asarray(0.0, x.dtype))
+        else:
+            xs = x - shift
+        if axis_s is None and x.ndim == 2:
+            c = n0 * n1
+            s1 = jnp.sum(xs)
+            s2 = jnp.sum(xs * xs)
+        else:
+            ax = 0 if axis_s is None else axis_s
+            c = n1 if (x.ndim == 2 and ax == 1) else n0
+            s1 = jnp.sum(xs, axis=ax)
+            s2 = jnp.sum(xs * xs, axis=ax)
+        c = jnp.asarray(c, x.dtype)
+        mean_ = shift + s1 / c
+        m2 = jnp.maximum(s2 - s1 * s1 / c, 0.0)
+        return c, mean_, m2
+
+    _PANEL_PROGRAMS[key] = jax.jit(run)
+    return _PANEL_PROGRAMS[key]
+
+
+@jax.jit
+def _panel_cols_merge(cnt, mean, m2):
+    """Chan-merge equal-count per-column moments (the pallas kernel's
+    output) into the whole-buffer moments: counts add, the grand mean is
+    the column-mean average, and each column's M2 gains the between-column
+    ``n·(mean_c − gmean)²`` term."""
+    f = mean.shape[0]
+    total = cnt * f
+    gmean = jnp.mean(mean)
+    dm = mean - gmean
+    return total, gmean, jnp.sum(m2) + cnt * jnp.sum(dm * dm)
+
+
+def _panel_kernel_stats(x: DNDarray, arr, interpret: bool):
+    """Axis-0 and whole-buffer moments via the pallas kernel (one read),
+    or None when the kernel's layout preconditions fail (the caller then
+    uses the XLA panel — never a second read of a memoized buffer)."""
+    from .kernels import moments_local, moments_sharded
+
+    buf = arr if arr.ndim == 2 else arr.reshape(-1, 1)
+    p = x.comm.size
+    if x.split == 0 and p > 1:
+        if buf.shape[0] % p:
+            return None
+        cnt, mean_, m2 = moments_sharded(
+            buf, x.gshape[0], x.comm.mesh, interpret=interpret
+        )
+    elif x.split is None or p == 1:
+        cnt, mean_, m2 = moments_local(buf, x.gshape[0], interpret=interpret)
+    else:
+        return None
+    if arr.ndim == 2:
+        return {"0": (cnt, mean_, m2), "all": _panel_cols_merge(cnt, mean_, m2)}
+    return {"all": (cnt, mean_[0], m2[0])}
+
+
+def _moments_panel(x: DNDarray, axis_s):
+    """(count, mean, M2) of ``x`` along ``axis_s`` from the one-pass
+    panel, or None when the panel declines (ragged layouts, int/complex
+    dtypes, >2-D, tuple axes, open lazy scopes, traced contexts — the
+    caller falls back to ``_reduce_op``'s masked paths)."""
+    if x.ndim not in (1, 2) or 0 in tuple(x.gshape):
+        return None
+    if axis_s is not None and not isinstance(axis_s, int):
+        return None
+    if getattr(x, "lcounts", None) is not None:
+        return None
+    if _operations._capture is not None and _operations._capture.active():
+        return None  # lazy scope: _reduce_op's capture hook must see the call
+    arr = x.larray
+    if not isinstance(arr, jax.Array) or isinstance(arr, jax.core.Tracer):
+        return None
+    if _hooks.in_trace_safe():
+        return None
+    if arr.dtype not in (jnp.float32, jnp.float64):
+        return None
+    from .kernels import dispatch_mode, record_dispatch
+
+    mode = dispatch_mode("moments_onepass")
+    akey = _axis_key(axis_s)
+    bid = id(arr)
+    ent = _PANELS.get(bid)
+    if ent is not None and (ent[0]() is not arr or ent[1] != mode):
+        ent = None
+    if ent is not None and akey in ent[2]:
+        record_dispatch("moments_onepass", mode)  # memo hit: zero data reads
+        return ent[2][akey]
+    entries = None
+    if (
+        mode in ("pallas", "interpret")
+        and arr.dtype == jnp.float32
+        and (arr.ndim == 1 or axis_s in (None, 0))
+    ):
+        entries = _panel_kernel_stats(x, arr, interpret=(mode != "pallas"))
+    if entries is None:
+        mode = "xla"
+        n0 = float(x.gshape[0])
+        n1 = float(x.gshape[1]) if x.ndim == 2 else 1.0
+        prog = _panel_program(arr.ndim, x.split, bool(x.padded), axis_s)
+        entries = {akey: prog(arr, n0, n1)}
+    record_dispatch("moments_onepass", mode)
+    if ent is None:
+        if len(_PANELS) >= _PANELS_CAP:
+            _PANELS.pop(next(iter(_PANELS)))  # FIFO bound
+        ent = (weakref.ref(arr, lambda _, bid=bid: _PANELS.pop(bid, None)), mode, {})
+        _PANELS[bid] = ent
+    ent[2].update(entries)
+    return ent[2][akey]
+
+
+def _wrap_moment(x: DNDarray, axis_s, result) -> DNDarray:
+    """Wrap a finalized moment like ``_reduce_op``'s tail: reduced split,
+    reduced gshape, ``_from_buffer`` when the result keeps padded length."""
+    out_split = _reduced_split(x.split, axis_s, x.ndim, False)
+    dtype = types.canonical_heat_type(result.dtype)
+    out_gshape = _reduced_shape(x.gshape, axis_s, False)
+    if out_split is not None and tuple(result.shape) != tuple(out_gshape):
+        return DNDarray._from_buffer(result, out_gshape, dtype, out_split, x.device, x.comm)
+    return DNDarray(
+        result, gshape=out_gshape, dtype=dtype, split=out_split,
+        device=x.device, comm=x.comm,
+    )
+
+
+def _where_moment(op, x: DNDarray, axis, where, ddof: int) -> DNDarray:
+    """``where=``-masked moments, decline-to-eager: a mask buffer cannot
+    key the panel memo (jax Arrays are unhashable and the mask is
+    arbitrary), so the masked reduction runs eagerly on the logical view —
+    the same escape hatch as the lazy layer's unhashable-kwarg fallback."""
+    axis_s = sanitize_axis(x.shape, axis)
+    w = where._logical() if isinstance(where, DNDarray) else jnp.asarray(where)
+    kw = {} if op is jnp.mean else {"ddof": ddof}
+    result = op(
+        x._logical(),
+        axis=axis_s,
+        where=jnp.broadcast_to(w.astype(bool), tuple(x.gshape)),
+        **kw,
+    )
+    return _wrap_moment(x, axis_s, result)
+
+
+def std(x: DNDarray, axis=None, ddof: int = 0, where=None, **kwargs) -> DNDarray:
+    """Standard deviation (reference ``statistics.py:1784``).
+
+    ``ddof`` and ``where=`` both route through the one-pass moments panel
+    when they can; ``where=`` declines to the eager masked reduction."""
+    if where is not None and isinstance(x, DNDarray):
+        return _where_moment(jnp.std, x, axis, where, ddof)
+    if isinstance(x, DNDarray):
+        axis_s = sanitize_axis(x.shape, axis)
+        stats = _moments_panel(x, axis_s)
+        if stats is not None:
+            c, _, m2 = stats
+            return _wrap_moment(x, axis_s, jnp.sqrt(m2 / (c - ddof)))
     return _reduce_op(jnp.std, x, axis=axis, ddof=ddof)
 
 
-def var(x: DNDarray, axis=None, ddof: int = 0, **kwargs) -> DNDarray:
-    """Variance (reference ``statistics.py:1854``)."""
+def var(x: DNDarray, axis=None, ddof: int = 0, where=None, **kwargs) -> DNDarray:
+    """Variance (reference ``statistics.py:1854``).
+
+    ``ddof`` and ``where=`` both route through the one-pass moments panel
+    when they can; ``where=`` declines to the eager masked reduction."""
+    if where is not None and isinstance(x, DNDarray):
+        return _where_moment(jnp.var, x, axis, where, ddof)
+    if isinstance(x, DNDarray):
+        axis_s = sanitize_axis(x.shape, axis)
+        stats = _moments_panel(x, axis_s)
+        if stats is not None:
+            c, _, m2 = stats
+            return _wrap_moment(x, axis_s, m2 / (c - ddof))
     return _reduce_op(jnp.var, x, axis=axis, ddof=ddof)

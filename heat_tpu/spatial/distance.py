@@ -204,9 +204,16 @@ def nearest_neighbors(x: DNDarray, y: DNDarray, k: int):
     indices into ``y``, both with ``x``'s split.
     """
     from ..core.kernels import nearest_neighbors as _nn_local
+    from ..core.kernels import pallas_supported, record_dispatch
 
     if x.ndim != 2 or y.ndim != 2:
         raise NotImplementedError("nearest_neighbors expects 2-D operands")
+    # this entry always runs the kernel (interpreted off-TPU) — record the
+    # decision at the call boundary, outside any traced code
+    record_dispatch(
+        "topk_distance",
+        "pallas" if pallas_supported("topk_distance") else "interpret",
+    )
     if y.split is not None:
         y = y.resplit(None)
     if x.split not in (None, 0):

@@ -57,24 +57,59 @@ def _mask(xa: jnp.ndarray, n_valid):
     return xs, valid, n_valid.astype(xa.dtype)
 
 
-def _moments_program():
-    prog = _PROGRAMS.get("moments")
+def _moments_program(mode: str = "xla", mesh=None):
+    """Per-chunk moments fold, keyed by dispatch mode: the chunk's
+    (count, mean, M2) come from ``kernels.chunk_moments`` (shifted
+    one-pass sums — ONE read of the chunk, where the old fold's
+    ``mean_b`` → ``xa - mean_b`` chain was two) or from the pallas kernel
+    (``moments_local`` / ``moments_sharded``), then Chan-merge into the
+    carried state via ``kernels.merge_moments``."""
+    key = ("moments", mode, mesh)
+    prog = _PROGRAMS.get(key)
     if prog is None:
+        from ..core.kernels import (
+            chunk_moments,
+            merge_moments,
+            moments_local,
+            moments_sharded,
+        )
 
         def step(xa, n_valid, count, mean, m2):
-            xs, valid, nb = _mask(xa, n_valid)
-            mean_b = jnp.sum(xs, axis=0) / jnp.maximum(nb, 1.0)
-            d = jnp.where(valid[:, None], xa - mean_b[None, :], 0.0)
-            m2_b = jnp.sum(d * d, axis=0)
-            n = count + nb
-            delta = mean_b - mean
-            new_mean = mean + delta * (nb / jnp.maximum(n, 1.0))
-            new_m2 = m2 + m2_b + delta * delta * (count * nb / jnp.maximum(n, 1.0))
+            if mode in ("pallas", "interpret"):
+                interp = mode != "pallas"
+                if mesh is not None:
+                    nb, mean_b, m2_b = moments_sharded(xa, n_valid, mesh, interpret=interp)
+                else:
+                    nb, mean_b, m2_b = moments_local(xa, n_valid, interpret=interp)
+            else:
+                nb, mean_b, m2_b = chunk_moments(xa, n_valid)
+            _, new_mean, new_m2 = merge_moments(count, mean, m2, nb, mean_b, m2_b)
             return new_mean, new_m2
 
-        _PROGRAMS["moments"] = jax.jit(step)
-        prog = _PROGRAMS["moments"]
+        _PROGRAMS[key] = jax.jit(step)
+        prog = _PROGRAMS[key]
     return prog
+
+
+def _moments_choice(chunk: DNDarray, xa) -> tuple:
+    """(mode, mesh) for one chunk's moments fold at the call boundary —
+    the same layout gate as the statistics panel: pallas needs a local
+    buffer or even split-0 shards, anything else folds through the
+    one-pass XLA twin."""
+    from ..core.kernels import dispatch_mode
+
+    mode = dispatch_mode("moments_onepass")
+    mesh = None
+    if mode in ("pallas", "interpret"):
+        p = chunk.comm.size
+        if chunk.split == 0 and p > 1:
+            if xa.shape[0] % p == 0:
+                mesh = chunk.comm.mesh
+            else:
+                mode = "xla"
+        elif chunk.split is not None and p > 1:
+            mode = "xla"
+    return mode, mesh
 
 
 def _cov_program():
@@ -173,8 +208,12 @@ class StreamingMoments(_StreamingBase):
         if self._mean is None:
             self._mean = jnp.zeros((xa.shape[1],), xa.dtype)
             self._m2 = jnp.zeros((xa.shape[1],), xa.dtype)
+        from ..core.kernels import record_dispatch
+
+        mode, mesh = _moments_choice(chunk, xa)
+        record_dispatch("moments_onepass", mode)  # once per chunk fold
         self._mean, self._m2 = collective_lockstep(
-            _moments_program()(
+            _moments_program(mode, mesh)(
                 xa, nv, jnp.asarray(float(self._n), xa.dtype), self._mean, self._m2
             )
         )

@@ -42,6 +42,8 @@ def _synthetic_out():
         "stream_unit": "u" * 60,
         "lockstep_events": 42,
         "lockstep_divergences": 0,
+        "kmeans_fused_ratio": 8.87,
+        "moments_onepass_warm_compiles": 0,
         "api_over_kernel": {},
         "vs_best": {},
         "vs_best_median": {},
@@ -215,6 +217,39 @@ class TestBenchCheck:
         obj = bench_check.check(line)
         assert "stream_error" in obj
         assert len(line) < bench_check.LINE_BUDGET
+
+    def test_rejects_fused_kmeans_slower_than_components(self):
+        # the fused Lloyd iteration landing below the unfused floor probe
+        # means the kernel layer made the iteration slower than its parts
+        out = _synthetic_out()
+        out["kmeans_fused_ratio"] = 0.93
+        with pytest.raises(ValueError, match="SLOWER than its own unfused"):
+            bench_check.check(json.dumps(bench._compact_summary(out, "d.json")))
+        out["kmeans_fused_ratio"] = "1.2"
+        with pytest.raises(ValueError, match="must be numeric"):
+            bench_check.check(json.dumps(bench._compact_summary(out, "d.json")))
+
+    def test_rejects_onepass_moments_outside_fused_band(self):
+        # the public mean+std pair must sit within the 1.2x DMA-overlap
+        # band of the unexpressible fused probe — both are one data read
+        out = _synthetic_out()
+        out["kernel_moments_onepass_gbps"] = 50.0  # fused is 99.9
+        with pytest.raises(ValueError, match="more than once"):
+            bench_check.check(json.dumps(bench._compact_summary(out, "d.json")))
+        out = _synthetic_out()
+        out["moments_onepass_warm_compiles"] = 2
+        with pytest.raises(ValueError, match="one-pass moments sweep recompiled"):
+            bench_check.check(json.dumps(bench._compact_summary(out, "d.json")))
+
+    def test_fused_kernel_rows_degrade_gracefully(self):
+        # a CPU/fallback bench emits no fused-kernel rows: absent keys are
+        # not violations (pallas-unavailable degradation)
+        out = _synthetic_out()
+        for k in ("kmeans_fused_ratio", "kernel_moments_onepass_gbps",
+                  "moments_onepass_warm_compiles"):
+            del out[k]
+        obj = bench_check.check(json.dumps(bench._compact_summary(out, "d.json")))
+        assert "kmeans_fused_ratio" not in obj
 
     def test_rejects_missing_keys(self):
         with pytest.raises(ValueError, match="missing required keys"):

@@ -68,6 +68,21 @@ def test_stream_package_is_flow_clean():
     )
 
 
+def test_kernels_package_is_flow_clean():
+    """Explicit gate over the fused-kernel layer: the sharded wrappers
+    derive per-shard validity windows from axis_index inside shard_map —
+    exactly the rank-divergence surface graftflow taints — and the
+    dispatch decisions must stay rank-uniform."""
+    findings, files_checked = gf.analyze_paths(
+        [os.path.join(REPO, "heat_tpu", "core", "kernels")]
+    )
+    # __init__, _dispatch, topk_distance, lloyd, moments, panel_update
+    assert files_checked >= 6
+    assert not findings, "\n".join(
+        f"  {f.path}:{f.line}:{f.col}: {f.rule} {f.message}" for f in findings
+    )
+
+
 def test_collective_vocabulary_matches_graftlint():
     """graftflow keeps its own copy of the collective-name set (both
     halves must stay importable without the other); the copies must not

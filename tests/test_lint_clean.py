@@ -69,6 +69,21 @@ def test_stream_package_is_lint_clean():
     )
 
 
+def test_kernels_package_is_lint_clean():
+    """Explicit gate over the fused-kernel layer: the dispatch registry
+    is HOT_CORE_MODULES-matched (host syncs are hard errors there) and
+    the per-kernel pallas_call wrappers are where an unbounded
+    ExecutableCache or per-call jit closure would cost the most."""
+    findings, files_checked = gl.lint_paths(
+        [os.path.join(REPO, "heat_tpu", "core", "kernels")]
+    )
+    # __init__, _dispatch, topk_distance, lloyd, moments, panel_update
+    assert files_checked >= 6
+    assert not findings, "\n".join(
+        f"  {f.path}:{f.line}:{f.col}: {f.rule} {f.message}" for f in findings
+    )
+
+
 def _run_cli(*args):
     return subprocess.run(
         [sys.executable, os.path.join("tools", "graftlint.py"), *args],

@@ -102,6 +102,44 @@ def validate(line: str, obj: dict) -> None:
                 f"stream_warm_compiles must be 0, got {obj.get('stream_warm_compiles')!r}: "
                 "the warm chunk loop recompiled/retraced per chunk"
             )
+    # fused-kernel layer gates (r8). Keys are absent when the bench ran
+    # without the pallas path (e.g. CPU smoke) — absence is not a
+    # violation, a present-but-failing value is.
+    if "kmeans_fused_ratio" in obj:
+        ratio = obj["kmeans_fused_ratio"]
+        if not isinstance(ratio, (int, float)) or isinstance(ratio, bool):
+            raise ValueError(
+                f"'kmeans_fused_ratio' must be numeric, got {ratio!r}"
+            )
+        if ratio < 1.0:
+            raise ValueError(
+                f"kmeans_fused_ratio {ratio} < 1.0: the fused Lloyd iteration "
+                "is SLOWER than its own unfused dist+argmin/update components "
+                "timed in isolation — fusion is regressing"
+            )
+    if "kernel_moments_onepass_gbps" in obj:
+        onepass = obj["kernel_moments_onepass_gbps"]
+        if not isinstance(onepass, (int, float)) or isinstance(onepass, bool) or onepass <= 0:
+            raise ValueError(
+                f"'kernel_moments_onepass_gbps' must be positive, got {onepass!r}"
+            )
+        fused = obj.get("kernel_moments_fused_gbps")
+        if isinstance(fused, (int, float)) and not isinstance(fused, bool):
+            # the public pair must sit within the DMA-overlap band (1.2x)
+            # of the unexpressible fused 6-in-1 probe: one data read each
+            if onepass < fused / 1.2:
+                raise ValueError(
+                    f"kernel_moments_onepass_gbps {onepass} is below "
+                    f"kernel_moments_fused_gbps/1.2 ({round(fused / 1.2, 2)}): "
+                    "the public one-pass moments path is reading the data "
+                    "more than once"
+                )
+        if obj.get("moments_onepass_warm_compiles") != 0:
+            raise ValueError(
+                "moments_onepass_warm_compiles must be 0, got "
+                f"{obj.get('moments_onepass_warm_compiles')!r}: the warm "
+                "one-pass moments sweep recompiled"
+            )
     if "stream_speedup" in obj:
         # reported only on hosts with a core to run the producer on (the
         # worker emits a stream_overlap note instead on single-core hosts)
