@@ -125,7 +125,7 @@ def _lloyd_call(xa, centers, n_valid, k: int, tile_n: int, interpret: bool):
             jax.ShapeDtypeStruct((1, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(jnp.asarray(n_valid, jnp.float32).reshape(1, 1), xp, cp)
+    )(jnp.asarray(n_valid, jnp.int32).reshape(1, 1), xp, cp)
     return sums[:k, :f], cnt[0, :k], labels[:n, 0], inertia[0, 0]
 
 
@@ -153,7 +153,8 @@ def lloyd_local(
     centers = centers.astype(jnp.float32)
     if n_valid is None:
         n_valid = xa.shape[0]
-    tile_n = max(8, min(tile_n, max(8, xa.shape[0])))
+    # keep the tile a multiple of 8: unaligned block shapes break Mosaic
+    tile_n = max(8, min(tile_n, -(-xa.shape[0] // 8) * 8))
     return _lloyd_call(xa, centers, n_valid, centers.shape[0], tile_n, interpret)
 
 

@@ -151,7 +151,7 @@ def _moments_call(xa, n_valid, tile_n: int, interpret: bool):
             jax.ShapeDtypeStruct((1, xp.shape[1]), jnp.float32),
         ],
         interpret=interpret,
-    )(jnp.asarray(n_valid, jnp.float32).reshape(1, 1), xp)
+    )(jnp.asarray(n_valid, jnp.int32).reshape(1, 1), xp)
     return cnt[0, 0], mean[0, :f], m2[0, :f]
 
 
@@ -177,7 +177,8 @@ def moments_local(
     xa = xa.astype(jnp.float32)
     if n_valid is None:
         n_valid = xa.shape[0]
-    tile_n = max(8, min(tile_n, max(8, xa.shape[0])))
+    # keep the tile a multiple of 8: unaligned block shapes break Mosaic
+    tile_n = max(8, min(tile_n, -(-xa.shape[0] // 8) * 8))
     return _moments_call(xa, n_valid, tile_n, interpret)
 
 

@@ -164,5 +164,6 @@ def cholesky_blocked(
     if interpret is None:
         interpret = not pallas_supported(CHOL_KERNEL)
     a = a.astype(jnp.float32)
-    bs = max(8, min(bs, a.shape[0]))
+    # keep bs a multiple of 8: tile-unaligned pl.ds slices break Mosaic
+    bs = max(8, min(bs, -(-a.shape[0] // 8) * 8))
     return _chol_call(a, bs, interpret)

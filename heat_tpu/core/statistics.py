@@ -567,7 +567,9 @@ def _panel_kernel_stats(x: DNDarray, arr, interpret: bool):
         return None
     if arr.ndim == 2:
         return {"0": (cnt, mean_, m2), "all": _panel_cols_merge(cnt, mean_, m2)}
-    return {"all": (cnt, mean_[0], m2[0])}
+    # axis 0 of a 1-D array IS the whole buffer: serve both keys
+    t = (cnt, mean_[0], m2[0])
+    return {"all": t, "0": t}
 
 
 def _moments_panel(x: DNDarray, axis_s):
@@ -592,16 +594,22 @@ def _moments_panel(x: DNDarray, axis_s):
         return None
     from .kernels import dispatch_mode, record_dispatch
 
-    mode = dispatch_mode("moments_onepass")
+    req_mode = dispatch_mode("moments_onepass")
     akey = _axis_key(axis_s)
     bid = id(arr)
     ent = _PANELS.get(bid)
-    if ent is not None and (ent[0]() is not arr or ent[1] != mode):
+    # entries key by the REQUESTED mode: a panel the kernel declined (and
+    # the XLA program computed) must still hit while dispatch_mode keeps
+    # answering 'pallas' — otherwise every declined axis recomputes and
+    # re-creating the entry drops the buffer's other memoized axes
+    if ent is not None and (ent[0]() is not arr or ent[1] != req_mode):
         ent = None
     if ent is not None and akey in ent[2]:
-        record_dispatch("moments_onepass", mode)  # memo hit: zero data reads
+        # memo hit: zero data reads; report the mode that computed it
+        record_dispatch("moments_onepass", ent[3].get(akey, req_mode))
         return ent[2][akey]
     entries = None
+    mode = req_mode
     if (
         mode in ("pallas", "interpret")
         and arr.dtype == jnp.float32
@@ -618,9 +626,16 @@ def _moments_panel(x: DNDarray, axis_s):
     if ent is None:
         if len(_PANELS) >= _PANELS_CAP:
             _PANELS.pop(next(iter(_PANELS)))  # FIFO bound
-        ent = (weakref.ref(arr, lambda _, bid=bid: _PANELS.pop(bid, None)), mode, {})
+        ent = (
+            weakref.ref(arr, lambda _, bid=bid: _PANELS.pop(bid, None)),
+            req_mode,
+            {},
+            {},
+        )
         _PANELS[bid] = ent
     ent[2].update(entries)
+    for k in entries:
+        ent[3][k] = mode
     return ent[2][akey]
 
 

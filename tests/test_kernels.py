@@ -306,7 +306,9 @@ class TestOnePassStatisticsDispatch(TestCase):
                 ((64, 8), 0, None),
                 ((64, 8), None, 0),
                 ((40,), 0, None),
+                ((40,), 0, 0),
                 ((40,), None, None),
+                ((40,), None, 0),
             ]:
                 x = self._data(shape, seed=13)
                 xd = ht.array(x, split=split)
@@ -348,6 +350,40 @@ class TestOnePassStatisticsDispatch(TestCase):
         self.assertEqual(reg.traces, 0, "warm one-pass moments retraced")
         self.assertEqual(ht.KERNEL_STATS["dispatches"], 3)
         self.assertEqual(ht.KERNEL_STATS["moments_onepass.xla"], 3)
+
+    def test_declined_axis_memoizes_beside_kernel_axes(self):
+        """An axis the kernel declines (axis=1) computes via the XLA
+        panel but memoizes under the REQUESTED mode: later calls are memo
+        hits reporting the mode that computed each axis, and the declined
+        axis does not evict the buffer's kernel-computed axes."""
+        from heat_tpu.core import statistics
+        from heat_tpu.core.kernels import forced_mode, reset_kernel_stats
+
+        x = self._data((64, 8), seed=17)
+        with forced_mode("moments_onepass", "interpret"):
+            xd = ht.array(x)
+            reset_kernel_stats()
+            ht.mean(xd, axis=1)  # kernel declines -> XLA panel
+            ht.mean(xd, axis=0)  # kernel path
+            self.assertEqual(ht.KERNEL_STATS.get("moments_onepass.xla", 0), 1)
+            self.assertEqual(
+                ht.KERNEL_STATS.get("moments_onepass.interpret", 0), 1
+            )
+            ent = statistics._PANELS[id(xd.larray)]
+            self.assertEqual(set(ent[2]), {"0", "1", "all"})
+            reset_kernel_stats()
+            ht.var(xd, axis=1, ddof=1)  # memo hit on the declined axis
+            ht.var(xd, axis=0, ddof=1)  # memo hit on the kernel axis
+            self.assertEqual(ht.KERNEL_STATS.get("moments_onepass.xla", 0), 1)
+            self.assertEqual(
+                ht.KERNEL_STATS.get("moments_onepass.interpret", 0), 1
+            )
+            self.assertIs(statistics._PANELS[id(xd.larray)], ent)
+            np.testing.assert_allclose(
+                ht.var(xd, axis=1, ddof=1).numpy(),
+                x.var(axis=1, ddof=1),
+                rtol=2e-4, atol=2e-4,
+            )
 
     def test_panel_memo_stays_bounded(self):
         """The per-buffer memo is FIFO-bounded (G002): folding many
