@@ -78,6 +78,75 @@ def _offset_row_slices(slices: tuple, r0: int, w_rows: int) -> tuple:
     return (slice(lo, hi),) + tuple(slices[1:])
 
 
+def _check_path_visible(path: str) -> None:
+    """Divergence-proof existence check for multi-process loads.
+
+    ``os.path.exists`` is a per-host answer: when a path exists on one
+    host but not another, the host that sees it proceeds into a backend
+    read (and its collectives) while the other raises — the survivors
+    then hang at the next collective waiting for a process that already
+    left. The allgather makes the verdict REPLICATED: all processes
+    raise together (``FileNotFoundError`` when nobody sees the path, a
+    clear cross-host visibility ``OSError`` when only some do), or all
+    proceed together.
+    """
+    visible = os.path.exists(path)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        vis = np.asarray(
+            multihost_utils.process_allgather(
+                np.asarray([1 if visible else 0], dtype=np.int32)
+            )
+        ).ravel()
+        if not vis.any():
+            raise FileNotFoundError(
+                f"no such file: {path!r} (missing on all {vis.size} processes)"
+            )
+        if not vis.all():
+            raise OSError(
+                f"{path!r} is visible on process(es) "
+                f"{np.nonzero(vis)[0].tolist()} but missing on "
+                f"{np.nonzero(vis == 0)[0].tolist()} — every process must see "
+                "the same path (shared filesystem or identical per-host "
+                "copies); refusing the divergent read that would hang the "
+                "next collective"
+            )
+    elif not visible:
+        raise FileNotFoundError(f"no such file: {path!r}")
+
+
+def _single_writer_commit(label: str, write) -> None:
+    """Single-writer + barrier pattern for whole-array saves.
+
+    Process 0 runs ``write()`` (which must itself be atomic: temp file +
+    ``os.replace``); every other process blocks at the barrier until the
+    commit happened, so a reader on another process can never observe the
+    pre-rename state. The status gather makes failure symmetric: a
+    writer-side error raises on ALL processes instead of stranding the
+    non-writers one collective later.
+    """
+    err = None
+    try:
+        if jax.process_index() == 0:
+            write()
+    except BaseException as e:  # noqa: BLE001 - re-raised after the barrier
+        err = e
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"heat_tpu_{label}")
+        statuses = np.asarray(
+            multihost_utils.process_allgather(np.asarray([0 if err is None else 1]))
+        ).ravel()
+        if err is None and statuses.any():
+            raise OSError(
+                f"{label} failed on process(es) {np.nonzero(statuses)[0].tolist()}"
+            )
+    if err is not None:
+        raise err
+
+
 def supports_hdf5() -> bool:
     """Whether h5py is available (reference ``io.py``)."""
     return __HAS_HDF5
@@ -101,8 +170,7 @@ def load(path: str, *args, retry: Optional[RetryPolicy] = None, **kwargs) -> DND
     """
     if not isinstance(path, str):
         raise TypeError(f"Expected path to be str, but was {type(path)}")
-    if not os.path.exists(path):
-        raise FileNotFoundError(f"no such file: {path!r}")
+    _check_path_visible(path)
     extension = os.path.splitext(path)[-1].strip().lower()
     if extension in (".h5", ".hdf5"):
         backend = load_hdf5
@@ -287,13 +355,14 @@ def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs
         if err is not None:
             raise err
         if statuses.any() or commit.any():
-            raise RuntimeError(
+            raise OSError(
                 f"save_hdf5 failed on process(es) "
                 f"{np.nonzero(statuses | commit)[0].tolist()}"
             )
         return
     arr = data.numpy()
-    if jax.process_index() == 0:
+
+    def write():
         with atomic_write(path) as tmp:
             if mode != "w" and os.path.exists(path):
                 import shutil
@@ -301,6 +370,8 @@ def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs
                 shutil.copy2(path, tmp)  # append modes extend a copy
             with h5py.File(tmp, mode) as handle:
                 handle.create_dataset(dataset, data=arr, **kwargs)
+
+    _single_writer_commit("save_hdf5_commit", write)
 
 
 def load_netcdf(
@@ -483,7 +554,7 @@ def save_netcdf(
                 multihost_utils.process_allgather(np.asarray([0 if err is None else 1]))
             ).ravel()
             if err is None and statuses.any():
-                raise RuntimeError(
+                raise OSError(
                     f"save_netcdf failed on process(es) {np.nonzero(statuses)[0].tolist()}"
                 )
         if err is not None:
@@ -529,7 +600,7 @@ def save_netcdf(
             multihost_utils.process_allgather(np.asarray([0 if err is None else 1]))
         ).ravel()
         if err is None and statuses.any():
-            raise RuntimeError(
+            raise OSError(
                 f"save_netcdf failed on process(es) {np.nonzero(statuses)[0].tolist()}"
             )
     if err is not None:
@@ -829,7 +900,7 @@ def save_csv(
         fmt = f"%.{decimals}f"
     else:
         fmt = "%f"
-    if jax.process_index() == 0:
+    def write():
         header = None
         if header_lines is not None:
             header = "\n".join(header_lines) if not isinstance(header_lines, str) else header_lines
@@ -856,6 +927,8 @@ def save_csv(
                 with open(tmp, "r+", encoding=encoding) as fh:
                     fh.seek(0)
                     np.savetxt(fh, arr, fmt=fmt, delimiter=sep, header=header or "", comments="")
+
+    _single_writer_commit("save_csv_commit", write)
 
 
 def save(data: DNDarray, path: str, *args, retry: Optional[RetryPolicy] = None, **kwargs) -> None:

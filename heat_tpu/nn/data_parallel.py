@@ -30,6 +30,13 @@ def _flatten_tree(prefix: str, tree) -> dict:
     """Pytree -> flat ``{prefix/keypath: numpy leaf}`` dict (host values)."""
     out = {}
     for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            # the leaf spans other processes (e.g. DASO's replica-stacked
+            # params on a multi-host slow axis): gather the global value so
+            # the host dict is complete — and identical — on every process
+            from jax.experimental import multihost_utils
+
+            leaf = multihost_utils.process_allgather(leaf, tiled=True)
         out[prefix + jax.tree_util.keystr(path)] = np.asarray(jax.device_get(leaf))
     return out
 

@@ -45,6 +45,7 @@ import numpy as np
 from ..core import _hooks, devices, types
 from ..core._atomic import atomic_write_bytes
 from ..core.communication import _assemble_from_chunks, sanitize_comm
+from ..core.io import _check_path_visible
 from ..core.dndarray import DNDarray
 from ..core.sanitation import sanitize_in, sanitize_split
 from .errors import ResilienceError
@@ -70,6 +71,31 @@ class CheckpointError(ResilienceError):
 
 class CheckpointCorruptionError(CheckpointError):
     """A shard file's bytes do not match the manifest checksum."""
+
+
+def _replicated_raise(label: str, err: Optional[BaseException]) -> None:
+    """Symmetric-failure barrier: every process learns whether ANY process
+    failed ``label`` and they all raise together (the failing process its
+    real error, the others a :class:`CheckpointError` naming the culprits)
+    — the ``core.io`` discipline. Without this, the process that raised
+    deserts the next collective and the survivors hang forever, which is
+    exactly how a failed multi-process save/load used to present.
+    """
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        statuses = np.asarray(
+            multihost_utils.process_allgather(
+                np.asarray([0 if err is None else 1], dtype=np.int32)
+            )
+        ).ravel()
+        if err is None and statuses.any():
+            raise CheckpointError(
+                f"{label} failed on process(es) {np.nonzero(statuses)[0].tolist()} "
+                "— raising on every process instead of deserting the next collective"
+            )
+    if err is not None:
+        raise err
 
 
 def _digest(data: bytes, algo: str) -> str:
@@ -110,57 +136,76 @@ def save_checkpoint(
     sanitize_in(x)
     policy = retry or DEFAULT_CHECKPOINT_POLICY
     _digest(b"", checksum)  # validate the algorithm name up front
-    os.makedirs(directory, exist_ok=True)
-
-    # (offset, length, payload) for every shard THIS process must write
-    local: List[Tuple[int, np.ndarray]] = []
-    if x.split is None:
-        if jax.process_index() == 0:
-            local.append((0, x.numpy()))
-    else:
-        for start, shard in x._iter_local_shards(dedup=True):
-            local.append((int(start), np.asarray(jax.device_get(shard))))
 
     entries: List[Dict] = []
-    for offset, arr in local:
-        if x.split is not None and arr.shape[x.split] == 0:
-            continue  # empty tail shards carry no data and need no file
-        payload = _npy_bytes(arr)
-        digest = _digest(payload, checksum)  # checksum BEFORE the write path
-        fname = _shard_filename(offset)
-        fpath = os.path.join(directory, fname)
+    err: Optional[BaseException] = None
+    try:
+        os.makedirs(directory, exist_ok=True)
 
-        def write_shard(fpath=fpath, payload=payload, offset=offset):
-            # the fault point sits INSIDE the retried callable: an injected
-            # transient failure here is recovered by the policy, and each
-            # attempt re-stages a fresh copy of the payload (a torn attempt
-            # cannot poison the next one)
-            _hooks.fault_point("checkpoint.shard", path=fpath, offset=offset)
-            atomic_write_bytes(fpath, payload)
+        # (offset, length, payload) for every shard THIS process must write
+        local: List[Tuple[int, np.ndarray]] = []
+        if x.split is None:
+            if jax.process_index() == 0:
+                local.append((0, x.numpy()))
+        else:
+            for start, shard in x._iter_local_shards(dedup=True):
+                local.append((int(start), np.asarray(jax.device_get(shard))))
 
-        policy.call(write_shard, label=f"checkpoint shard {fname}")
-        entries.append(
-            {
-                "file": fname,
-                "offset": offset,
-                "length": int(arr.shape[x.split]) if x.split is not None else 0,
-                "shape": [int(s) for s in arr.shape],
-                "checksum": digest,
-            }
-        )
+        for offset, arr in local:
+            if x.split is not None and arr.shape[x.split] == 0:
+                continue  # empty tail shards carry no data and need no file
+            payload = _npy_bytes(arr)
+            digest = _digest(payload, checksum)  # checksum BEFORE the write path
+            fname = _shard_filename(offset)
+            fpath = os.path.join(directory, fname)
+
+            def write_shard(fpath=fpath, payload=payload, offset=offset):
+                # the fault point sits INSIDE the retried callable: an injected
+                # transient failure here is recovered by the policy, and each
+                # attempt re-stages a fresh copy of the payload (a torn attempt
+                # cannot poison the next one)
+                _hooks.fault_point("checkpoint.shard", path=fpath, offset=offset)
+                atomic_write_bytes(fpath, payload)
+
+            policy.call(write_shard, label=f"checkpoint shard {fname}")
+            entries.append(
+                {
+                    "file": fname,
+                    "offset": offset,
+                    "length": int(arr.shape[x.split]) if x.split is not None else 0,
+                    "shape": [int(s) for s in arr.shape],
+                    "checksum": digest,
+                }
+            )
+    except BaseException as e:  # noqa: BLE001 - re-raised by _replicated_raise
+        err = e
+
+    # retry-exhausted shard writes on ONE process must raise on ALL of
+    # them: the write loop above runs no collectives, so a process that
+    # raised here would otherwise desert the metadata allgather below and
+    # hang the rest of the group (observed as a ws-2 per-test deadline
+    # kill before this barrier existed)
+    _replicated_raise("checkpoint shard write", err)
 
     if jax.process_count() > 1:  # pragma: no cover - exercised on real pods
         from jax.experimental import multihost_utils
 
-        # all shards durable before the manifest commit; exchange entry
-        # metadata so process 0 writes a complete manifest
+        # all shards durable before the manifest commit
         multihost_utils.sync_global_devices("heat_tpu_checkpoint_shards")
-        packed = np.asarray(
-            [[e["offset"], e["length"], int(e["checksum"], 16)] for e in entries],
-            dtype=np.int64,
-        ).reshape(-1, 3)
-        if checksum != "crc32":
-            raise NotImplementedError("multi-host checkpoints support crc32 only")
+    if jax.process_count() > 1 and x.split is not None:
+        # exchange entry metadata so process 0 writes a complete manifest
+        # (split=None already has its single pid-0 shard in `entries`).
+        # Digest hex travels as fixed-width 32-bit words in the int64
+        # gather so every supported algorithm fits (crc32: 1 word,
+        # sha256: 8)
+        hexlen = len(_digest(b"", checksum))
+        nwords = (hexlen + 7) // 8
+        rows = [
+            [int(e["offset"]), int(e["length"])]
+            + [int(e["checksum"][8 * i:8 * (i + 1)].ljust(8, "0"), 16) for i in range(nwords)]
+            for e in entries
+        ]
+        packed = np.asarray(rows, dtype=np.int64).reshape(-1, 2 + nwords)
         from ..core.communication import ragged_process_allgather
 
         blocks = ragged_process_allgather(packed, axis=0)
@@ -168,42 +213,51 @@ def save_checkpoint(
         entries = []
         # replicated shards (multi-axis meshes) appear once per writing
         # process with identical metadata — dedup by the full tuple
-        for offset, length, crc in sorted(set(map(tuple, gathered.tolist()))):
+        for row in sorted(set(map(tuple, gathered.tolist()))):
+            offset, length = int(row[0]), int(row[1])
+            digest = "".join(f"{int(w):08x}" for w in row[2:])[:hexlen]
             shape = list(x.gshape)
-            shape[x.split] = int(length)
+            shape[x.split] = length
             entries.append(
                 {
-                    "file": _shard_filename(int(offset)),
-                    "offset": int(offset),
-                    "length": int(length),
+                    "file": _shard_filename(offset),
+                    "offset": offset,
+                    "length": length,
                     "shape": [int(s) for s in shape],
-                    "checksum": f"{int(crc) & 0xFFFFFFFF:08x}",
+                    "checksum": digest,
                 }
             )
 
     manifest_path = os.path.join(directory, MANIFEST_NAME)
-    if jax.process_index() == 0:
-        mesh = x.comm.mesh
-        manifest = {
-            "format": CHECKPOINT_FORMAT,
-            "gshape": [int(s) for s in x.gshape],
-            "dtype": np.dtype(x.dtype.jax_type()).name,
-            "split": x.split,
-            "mesh": {
-                "axis_sizes": {str(k): int(v) for k, v in mesh.shape.items()},
-                "split_size": int(x.comm.size),
-                "processes": int(jax.process_count()),
-            },
-            "checksum": checksum,
-            "nshards": len(entries),
-            "shards": sorted(entries, key=lambda e: e["offset"]),
-        }
-        payload = json.dumps(manifest, indent=1).encode()
-        policy.call(atomic_write_bytes, manifest_path, payload, label="checkpoint manifest")
+    err = None
+    try:
+        if jax.process_index() == 0:
+            mesh = x.comm.mesh
+            manifest = {
+                "format": CHECKPOINT_FORMAT,
+                "gshape": [int(s) for s in x.gshape],
+                "dtype": np.dtype(x.dtype.jax_type()).name,
+                "split": x.split,
+                "mesh": {
+                    "axis_sizes": {str(k): int(v) for k, v in mesh.shape.items()},
+                    "split_size": int(x.comm.size),
+                    "processes": int(jax.process_count()),
+                },
+                "checksum": checksum,
+                "nshards": len(entries),
+                "shards": sorted(entries, key=lambda e: e["offset"]),
+            }
+            payload = json.dumps(manifest, indent=1).encode()
+            policy.call(atomic_write_bytes, manifest_path, payload, label="checkpoint manifest")
+    except BaseException as e:  # noqa: BLE001 - re-raised by _replicated_raise
+        err = e
     if jax.process_count() > 1:  # pragma: no cover
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices("heat_tpu_checkpoint_manifest")
+    # single-writer + barrier + status gather (io's _single_writer_commit
+    # shape): a failed manifest commit raises on every process, not just 0
+    _replicated_raise("checkpoint manifest commit", err)
     if jax.process_index() == 0:
         _gc_stale_shards(directory, entries)
     return manifest_path
@@ -306,61 +360,80 @@ def load_checkpoint(
     """
     policy = retry or DEFAULT_CHECKPOINT_POLICY
     # a missing manifest is a *missing checkpoint*, not a transient fault:
-    # surface the FileNotFoundError directly instead of retrying it
-    if not os.path.exists(os.path.join(directory, MANIFEST_NAME)):
-        raise FileNotFoundError(
-            f"no checkpoint manifest at {os.path.join(directory, MANIFEST_NAME)} "
-            "(incomplete or missing checkpoint)"
-        )
-    manifest = policy.call(read_manifest, directory, label=f"read manifest {directory}")
-    comm = sanitize_comm(comm)
-    device = devices.sanitize_device(device)
-    dtype = types.canonical_heat_type(manifest["dtype"])
-    np_dtype = np.dtype(dtype.jax_type())
-    gshape = tuple(int(s) for s in manifest["gshape"])
-    split = manifest.get("split")
-    split = sanitize_split(gshape, split) if split is not None else None
-    algo = manifest["checksum"]
-    entries = sorted(manifest["shards"], key=lambda e: e["offset"])
+    # surface the FileNotFoundError directly instead of retrying it. The
+    # existence check is REPLICATED (io's divergence-proof probe): a
+    # manifest visible on only some hosts raises a clear cross-host
+    # visibility error everywhere instead of letting the hosts that see
+    # it sail into the assembly collectives alone
+    _check_path_visible(os.path.join(directory, MANIFEST_NAME))
+
+    err: Optional[BaseException] = None
+    arr = split = None
+    cache: Dict[str, np.ndarray] = {}
+    try:
+        manifest = policy.call(read_manifest, directory, label=f"read manifest {directory}")
+        comm = sanitize_comm(comm)
+        device = devices.sanitize_device(device)
+        dtype = types.canonical_heat_type(manifest["dtype"])
+        np_dtype = np.dtype(dtype.jax_type())
+        gshape = tuple(int(s) for s in manifest["gshape"])
+        split = manifest.get("split")
+        split = sanitize_split(gshape, split) if split is not None else None
+        algo = manifest["checksum"]
+        entries = sorted(manifest["shards"], key=lambda e: e["offset"])
+
+        def shard_array(entry: Dict) -> np.ndarray:
+            if entry["file"] not in cache:
+                cache[entry["file"]] = policy.call(
+                    _read_shard, directory, entry, algo, verify,
+                    label=f"checkpoint shard {entry['file']}",
+                )
+            return cache[entry["file"]]
+
+        if split is None:
+            if len(entries) != 1:
+                raise CheckpointError(
+                    f"split=None checkpoint must have exactly 1 shard, "
+                    f"manifest lists {len(entries)}"
+                )
+            arr = shard_array(entries[0])
+            if tuple(arr.shape) != gshape:
+                raise CheckpointCorruptionError(
+                    f"shard shape {tuple(arr.shape)} != manifest gshape {gshape}"
+                )
+        else:
+            # interval coverage check: shards must tile [0, n) exactly
+            n = gshape[split]
+            cursor = 0
+            for e in entries:
+                if int(e["offset"]) != cursor:
+                    raise CheckpointError(
+                        f"shards do not tile the split axis: expected offset {cursor}, "
+                        f"manifest has {e['offset']} ({e['file']})"
+                    )
+                cursor += int(e["length"])
+            if cursor != n:
+                raise CheckpointError(
+                    f"shards cover [0, {cursor}) but the split extent is {n}"
+                )
+            if jax.process_count() > 1:  # pragma: no cover - real pods
+                # read+verify EVERY shard before any collective: a corrupt
+                # or missing shard then raises the same named error on
+                # every process (the reads are cached for the assembly
+                # below, so nothing is fetched twice). Single-process
+                # loads keep the lazy per-chunk reads.
+                for e in entries:
+                    shard_array(e)
+    except BaseException as e:  # noqa: BLE001 - re-raised by _replicated_raise
+        err = e
+
+    # all processes agree the checkpoint is readable before the first
+    # assembly collective — a one-sided failure above (manifest parse,
+    # coverage, checksum) raises everywhere instead of hanging survivors
+    _replicated_raise("checkpoint load", err)
 
     if split is None:
-        if len(entries) != 1:
-            raise CheckpointError(
-                f"split=None checkpoint must have exactly 1 shard, manifest lists {len(entries)}"
-            )
-        arr = policy.call(
-            _read_shard, directory, entries[0], algo, verify, label="checkpoint shard read"
-        )
-        if tuple(arr.shape) != gshape:
-            raise CheckpointCorruptionError(
-                f"shard shape {tuple(arr.shape)} != manifest gshape {gshape}"
-            )
         return DNDarray(arr.astype(np_dtype), dtype=dtype, split=None, device=device, comm=comm)
-
-    # interval coverage check: shards must tile [0, n) exactly
-    n = gshape[split]
-    cursor = 0
-    for e in entries:
-        if int(e["offset"]) != cursor:
-            raise CheckpointError(
-                f"shards do not tile the split axis: expected offset {cursor}, "
-                f"manifest has {e['offset']} ({e['file']})"
-            )
-        cursor += int(e["length"])
-    if cursor != n:
-        raise CheckpointError(
-            f"shards cover [0, {cursor}) but the split extent is {n}"
-        )
-
-    cache: Dict[str, np.ndarray] = {}
-
-    def shard_array(entry: Dict) -> np.ndarray:
-        if entry["file"] not in cache:
-            cache[entry["file"]] = policy.call(
-                _read_shard, directory, entry, algo, verify,
-                label=f"checkpoint shard {entry['file']}",
-            )
-        return cache[entry["file"]]
 
     def read_chunk(slices) -> np.ndarray:
         lo, hi = slices[split].start, slices[split].stop

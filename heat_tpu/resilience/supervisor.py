@@ -330,10 +330,10 @@ class Supervisor:
                     state, step = loaded
                     self._last_ckpt_step = step
             else:
-                if existing and jax.process_index() == 0:
+                if existing:
                     # a fresh run owns the directory: stale checkpoints
                     # from a previous run must never restore into it
-                    self._gc(keep=0, just_wrote="")
+                    self._gc_replicated(keep=0, just_wrote="")
                 # baseline: a restore target exists before the first step
                 self._maybe_checkpoint(state, 0, force=True)
         while n_steps is None or step < n_steps:
@@ -524,8 +524,7 @@ class Supervisor:
         self._last_ckpt_time = now
         self._run_steps.add(step)
         _hooks.observe("recovery.checkpoint", step=step)
-        if jax.process_index() == 0:
-            self._gc(keep=self.schedule.keep_last, just_wrote=target)
+        self._gc_replicated(keep=self.schedule.keep_last, just_wrote=target)
 
     def _save_state(self, state: dict, step: int, target: str) -> None:
         os.makedirs(target, exist_ok=True)
@@ -599,7 +598,31 @@ class Supervisor:
                 continue
         return None
 
-    def _gc(self, keep: int, just_wrote: str) -> None:
+    def _gc_replicated(self, keep: int, just_wrote: str) -> None:
+        """Process 0 runs retention; every process observes the same
+        removal count and none proceeds until the removal is done, so the
+        directory view and RECOVERY_STATS stay rank-uniform (a rank racing
+        ahead of the purge could list — or worse, write into — a directory
+        mid-trash)."""
+        removed = (
+            self._gc(keep=keep, just_wrote=just_wrote)
+            if jax.process_index() == 0
+            else 0
+        )
+        if jax.process_count() > 1:  # pragma: no cover - via tools/mpirun.py
+            from jax.experimental import multihost_utils
+
+            removed = int(
+                np.asarray(
+                    multihost_utils.process_allgather(
+                        np.asarray([removed], dtype=np.int32)
+                    )
+                ).ravel().sum()
+            )
+        if removed:
+            _hooks.observe("recovery.gc", removed=removed)
+
+    def _gc(self, keep: int, just_wrote: str) -> int:
         """Retention: drop committed checkpoints beyond the newest ``keep``
         and any uncommitted (state-less) directory that is not the one just
         written. Removal is rename-then-delete so a crashed GC leaves a
@@ -621,8 +644,7 @@ class Supervisor:
                 removed += 1
             except OSError:
                 continue
-        if removed:
-            _hooks.observe("recovery.gc", removed=removed)
+        return removed
 
     # -------------------------------------------------------------- helpers
     def _infer_comm(self, state: dict, data: Sequence[DNDarray]):

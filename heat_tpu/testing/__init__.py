@@ -1,0 +1,48 @@
+"""Fault-tolerant multi-process suite execution.
+
+``heat_tpu.testing`` is the library half of ``tools/mpirun.py``: a
+coordinator (:mod:`.runner`) drives pools of long-lived pytest workers
+(:mod:`.worker`) joined through ``jax.distributed``, speaking the
+line-JSON protocol in :mod:`.protocol`, with known-bad tests kept
+visible by :mod:`.quarantine`.
+
+The coordinator-side modules (protocol, quarantine, runner) are pure
+stdlib and never import jax — ``tools/mpirun.py`` loads this package by
+file path without touching ``heat_tpu.__init__``, so supervision stays
+responsive even when a worker's backend wedges. Only :mod:`.worker`
+(which runs in the child processes) imports jax, and only inside
+``main()``.
+"""
+from __future__ import annotations
+
+from . import protocol, quarantine
+from .protocol import decode, encode, merge_rank_results, result_record
+from .quarantine import load_quarantine, match_quarantine, parse_quarantine_text
+from .runner import (
+    GroupCrash,
+    RunnerConfig,
+    RunnerError,
+    SuiteResult,
+    SuiteRunner,
+    WorkerGroup,
+    sample_ids,
+)
+
+__all__ = [
+    "protocol",
+    "quarantine",
+    "decode",
+    "encode",
+    "merge_rank_results",
+    "result_record",
+    "load_quarantine",
+    "match_quarantine",
+    "parse_quarantine_text",
+    "GroupCrash",
+    "RunnerConfig",
+    "RunnerError",
+    "SuiteResult",
+    "SuiteRunner",
+    "WorkerGroup",
+    "sample_ids",
+]

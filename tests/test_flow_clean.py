@@ -83,6 +83,32 @@ def test_kernels_package_is_flow_clean():
     )
 
 
+def test_testing_package_is_flow_clean():
+    """Explicit gate over the fault-tolerant suite runner: the worker
+    drives real collectives from a persistent process, so a laundered
+    per-process branch around its deadline/reset paths would diverge the
+    very groups the runner exists to keep in lockstep."""
+    findings, files_checked = gf.analyze_paths(
+        [os.path.join(REPO, "heat_tpu", "testing")]
+    )
+    assert files_checked >= 5  # __init__, protocol, quarantine, runner, worker
+    assert not findings, "\n".join(
+        f"  {f.path}:{f.line}:{f.col}: {f.rule} {f.message}" for f in findings
+    )
+
+
+def test_suite_runner_cli_is_flow_clean():
+    """tools/mpirun.py rides the ``tools`` tree walk; gate it by name so
+    moving it out of tools/ cannot silently un-gate it."""
+    findings, files_checked = gf.analyze_paths(
+        [os.path.join(REPO, "tools", "mpirun.py")]
+    )
+    assert files_checked == 1
+    assert not findings, "\n".join(
+        f"  {f.path}:{f.line}:{f.col}: {f.rule} {f.message}" for f in findings
+    )
+
+
 def test_collective_vocabulary_matches_graftlint():
     """graftflow keeps its own copy of the collective-name set (both
     halves must stay importable without the other); the copies must not

@@ -5,7 +5,6 @@ acceptance bar is that every recovery path is exercisable with no TPU and
 no real faults, via the seeded chaos injector.
 """
 import os
-import tempfile
 import unittest
 
 import jax
@@ -15,6 +14,7 @@ import heat_tpu as ht
 from heat_tpu import resilience as rz
 from heat_tpu.core import _hooks
 
+from . import _mh_helpers as mh
 from .base import TestCase
 
 
@@ -27,7 +27,7 @@ def fast_policy(attempts=4, seed=0):
 
 class TestCheckpointRoundTrip(TestCase):
     def roundtrip(self, x, **load_kwargs):
-        with tempfile.TemporaryDirectory() as d:
+        with mh.TemporaryDirectory() as d:
             manifest = rz.save_checkpoint(x, d)
             self.assertTrue(os.path.exists(manifest))
             y = rz.load_checkpoint(d, **load_kwargs)
@@ -67,7 +67,7 @@ class TestCheckpointRoundTrip(TestCase):
     def test_restore_onto_fewer_devices(self):
         x = ht.arange(23, dtype=ht.float32, split=0)
         comm4 = ht.MeshCommunication(devices=jax.devices()[:4])
-        with tempfile.TemporaryDirectory() as d:
+        with mh.TemporaryDirectory() as d:
             rz.save_checkpoint(x, d)
             y = rz.load_checkpoint(d, comm=comm4)
         self.assertEqual(y.comm.size, 4)
@@ -76,7 +76,7 @@ class TestCheckpointRoundTrip(TestCase):
     def test_restore_onto_more_devices(self):
         comm2 = ht.MeshCommunication(devices=jax.devices()[:2])
         x = ht.arange(11, dtype=ht.float32, split=0, comm=comm2)
-        with tempfile.TemporaryDirectory() as d:
+        with mh.TemporaryDirectory() as d:
             rz.save_checkpoint(x, d)
             manifest = rz.read_manifest(d)
             self.assertEqual(manifest["mesh"]["split_size"], 2)
@@ -87,7 +87,7 @@ class TestCheckpointRoundTrip(TestCase):
 
     def test_manifest_contents(self):
         x = ht.arange(23, dtype=ht.float32, split=0)
-        with tempfile.TemporaryDirectory() as d:
+        with mh.TemporaryDirectory() as d:
             rz.save_checkpoint(x, d)
             m = rz.read_manifest(d)
             self.assertEqual(m["format"], rz.CHECKPOINT_FORMAT)
@@ -106,7 +106,7 @@ class TestCheckpointRoundTrip(TestCase):
 
     def test_sha256_checksum(self):
         x = ht.arange(10, dtype=ht.float32, split=0)
-        with tempfile.TemporaryDirectory() as d:
+        with mh.TemporaryDirectory() as d:
             rz.save_checkpoint(x, d, checksum="sha256")
             self.assertEqual(rz.read_manifest(d)["checksum"], "sha256")
             y = rz.load_checkpoint(d)
@@ -116,13 +116,17 @@ class TestCheckpointRoundTrip(TestCase):
 class TestCheckpointFailureModes(TestCase):
     def test_corrupt_shard_detected(self):
         x = ht.arange(23, dtype=ht.float32, split=0)
-        with tempfile.TemporaryDirectory() as d:
+        with mh.TemporaryDirectory() as d:
             rz.save_checkpoint(x, d)
             shard = sorted(f for f in os.listdir(d) if f.startswith("shard_"))[1]
             p = os.path.join(d, shard)
-            raw = bytearray(open(p, "rb").read())
-            raw[-3] ^= 0xFF  # single bit-level corruption in the payload
-            open(p, "wb").write(bytes(raw))
+
+            def corrupt():
+                raw = bytearray(open(p, "rb").read())
+                raw[-3] ^= 0xFF  # single bit-level corruption in the payload
+                open(p, "wb").write(bytes(raw))
+
+            mh.on_pid0(corrupt)  # two processes XOR-ing would cancel out
             with self.assertRaises(rz.CheckpointCorruptionError) as cm:
                 rz.load_checkpoint(d, retry=fast_policy(1))
             # the diagnostic names the file and both digests
@@ -131,38 +135,45 @@ class TestCheckpointFailureModes(TestCase):
 
     def test_verify_false_skips_checksum(self):
         x = ht.arange(23, dtype=ht.float32, split=0)
-        with tempfile.TemporaryDirectory() as d:
+        with mh.TemporaryDirectory() as d:
             rz.save_checkpoint(x, d)
             shard = sorted(f for f in os.listdir(d) if f.startswith("shard_"))[0]
             p = os.path.join(d, shard)
-            raw = bytearray(open(p, "rb").read())
-            raw[-1] ^= 0x01
-            open(p, "wb").write(bytes(raw))
+
+            def corrupt():
+                raw = bytearray(open(p, "rb").read())
+                raw[-1] ^= 0x01
+                open(p, "wb").write(bytes(raw))
+
+            mh.on_pid0(corrupt)
             y = rz.load_checkpoint(d, verify=False, retry=fast_policy(1))
             self.assertEqual(tuple(y.shape), (23,))
 
     def test_missing_manifest(self):
-        with tempfile.TemporaryDirectory() as d:
+        with mh.TemporaryDirectory() as d:
             with self.assertRaises(FileNotFoundError) as cm:
                 rz.load_checkpoint(d, retry=fast_policy(1))
             self.assertIn(d, str(cm.exception))
 
     def test_missing_shard_file(self):
         x = ht.arange(23, dtype=ht.float32, split=0)
-        with tempfile.TemporaryDirectory() as d:
+        with mh.TemporaryDirectory() as d:
             rz.save_checkpoint(x, d)
             shard = sorted(f for f in os.listdir(d) if f.startswith("shard_"))[2]
-            os.remove(os.path.join(d, shard))
+            mh.on_pid0(lambda: os.remove(os.path.join(d, shard)))
             with self.assertRaises(rz.CheckpointError) as cm:
                 rz.load_checkpoint(d, retry=fast_policy(1))
             self.assertIn(shard, str(cm.exception))
 
     def test_garbled_manifest(self):
         x = ht.arange(5, dtype=ht.float32, split=0)
-        with tempfile.TemporaryDirectory() as d:
+        with mh.TemporaryDirectory() as d:
             rz.save_checkpoint(x, d)
-            with open(os.path.join(d, rz.MANIFEST_NAME), "w") as f:
-                f.write("{not json")
+            def garble():
+                with open(os.path.join(d, rz.MANIFEST_NAME), "w") as f:
+                    f.write("{not json")
+
+            mh.on_pid0(garble)
             with self.assertRaises(rz.CheckpointCorruptionError):
                 rz.load_checkpoint(d, retry=fast_policy(1))
 
@@ -171,7 +182,7 @@ class TestCheckpointFailureModes(TestCase):
         # save are absorbed by the RetryPolicy; the restored array is
         # bit-identical with the same dtype and split.
         x = ht.reshape(ht.arange(46, dtype=ht.float32), (23, 2)).resplit(0)
-        with tempfile.TemporaryDirectory() as d:
+        with mh.TemporaryDirectory() as d:
             with rz.chaos(seed=3, io_error=1.0, max_faults=2) as c:
                 rz.save_checkpoint(x, d, retry=fast_policy(4))
             self.assertEqual(len(c.injected), 2)  # both faults absorbed
@@ -184,7 +195,7 @@ class TestCheckpointFailureModes(TestCase):
         # corrupt fires AFTER the checksum is computed and BEFORE bytes
         # land on disk: the manifest is honest, the file is not
         x = ht.arange(23, dtype=ht.float32, split=0)
-        with tempfile.TemporaryDirectory() as d:
+        with mh.TemporaryDirectory() as d:
             with rz.chaos(seed=0, corrupt=1.0, targets=("io",)) as c:
                 rz.save_checkpoint(x, d, retry=fast_policy(1))
             self.assertTrue(any(i.kind == "corrupt" for i in c.injected))
@@ -193,7 +204,7 @@ class TestCheckpointFailureModes(TestCase):
 
     def test_torn_write_never_corrupts_committed_checkpoint(self):
         x = ht.arange(23, dtype=ht.float32, split=0)
-        with tempfile.TemporaryDirectory() as d:
+        with mh.TemporaryDirectory() as d:
             rz.save_checkpoint(x, d)
             # a later save of DIFFERENT data dies with torn writes on
             # every attempt; the original checkpoint must stay loadable
@@ -400,7 +411,7 @@ class TestIOResilience(TestCase):
 
     def test_load_retry_recovers_from_transient_faults(self):
         x = ht.arange(12, dtype=ht.float32)
-        with tempfile.TemporaryDirectory() as d:
+        with mh.TemporaryDirectory() as d:
             p = os.path.join(d, "x.csv")
             ht.save(x, p)
             with rz.chaos(seed=0, io_error=1.0, max_faults=2):
@@ -409,7 +420,7 @@ class TestIOResilience(TestCase):
 
     def test_load_without_retry_fails_fast(self):
         x = ht.arange(4, dtype=ht.float32)
-        with tempfile.TemporaryDirectory() as d:
+        with mh.TemporaryDirectory() as d:
             p = os.path.join(d, "x.csv")
             ht.save(x, p)
             with rz.chaos(seed=0, io_error=1.0):
@@ -418,7 +429,7 @@ class TestIOResilience(TestCase):
 
     def test_atomic_csv_save_preserves_file_on_fault(self):
         x = ht.arange(6, dtype=ht.float32)
-        with tempfile.TemporaryDirectory() as d:
+        with mh.TemporaryDirectory() as d:
             p = os.path.join(d, "x.csv")
             ht.save(x, p)
             before = open(p).read()
@@ -433,7 +444,7 @@ class TestIOResilience(TestCase):
     @unittest.skipUnless(ht.io.supports_hdf5(), "h5py not available")
     def test_atomic_hdf5_save_preserves_file_on_fault(self):
         x = ht.arange(8, dtype=ht.float32)
-        with tempfile.TemporaryDirectory() as d:
+        with mh.TemporaryDirectory() as d:
             p = os.path.join(d, "x.h5")
             ht.save(x, p, "data")
             before = ht.load(p, "data").numpy()
@@ -445,7 +456,7 @@ class TestIOResilience(TestCase):
     @unittest.skipUnless(ht.io.supports_hdf5(), "h5py not available")
     def test_save_retry_kwarg(self):
         x = ht.arange(8, dtype=ht.float32)
-        with tempfile.TemporaryDirectory() as d:
+        with mh.TemporaryDirectory() as d:
             p = os.path.join(d, "x.h5")
             with rz.chaos(seed=0, io_error=1.0, max_faults=1):
                 ht.save(x, p, "data", retry=fast_policy(3))
@@ -511,7 +522,7 @@ class TestChunkEdgeCases(TestCase):
     def test_checkpoint_of_empty_tail_layout(self):
         # round-trip an array whose layout has empty tail shards
         x = ht.reshape(ht.arange(27, dtype=ht.float32), (9, 3)).resplit(0)
-        with tempfile.TemporaryDirectory() as d:
+        with mh.TemporaryDirectory() as d:
             rz.save_checkpoint(x, d)
             m = rz.read_manifest(d)
             # no zero-length shard files are written

@@ -7,7 +7,6 @@ Everything runs on the virtual 8-device CPU mesh (conftest); faults are
 simulated (chaos / FaultSchedule / hand-raised exceptions), never real.
 """
 import os
-import tempfile
 import unittest
 
 import jax
@@ -19,6 +18,7 @@ from heat_tpu import resilience as rz
 from heat_tpu.core import communication as comm_mod
 from heat_tpu.resilience.supervisor import RECOVERY_STATS, _classify
 
+from . import _mh_helpers as mh
 from .base import TestCase
 
 
@@ -156,7 +156,7 @@ class TestZeroOverhead(TestCase):
 class TestCheckpointCadence(TestCase):
     def test_every_steps_cadence_exact(self):
         before = snap()
-        with tempfile.TemporaryDirectory() as d:
+        with mh.TemporaryDirectory() as d:
             sup = rz.Supervisor(
                 d, rz.CheckpointSchedule(every_steps=2, keep_last=10),
                 retry=nosleep(), checkpoint_retry=nosleep(),
@@ -174,7 +174,7 @@ class TestCheckpointCadence(TestCase):
             return new, new["n"] >= 3
 
         before = snap()
-        with tempfile.TemporaryDirectory() as d:
+        with mh.TemporaryDirectory() as d:
             sup = rz.Supervisor(
                 d, rz.CheckpointSchedule(every_steps=10, keep_last=10),
                 retry=nosleep(), checkpoint_retry=nosleep(),
@@ -189,7 +189,7 @@ class TestCheckpointCadence(TestCase):
             new, _ = bump(state, data, i)
             return new, new["n"] >= 4
 
-        with tempfile.TemporaryDirectory() as d:
+        with mh.TemporaryDirectory() as d:
             sup = rz.Supervisor(
                 d, rz.CheckpointSchedule(every_seconds=1e9, keep_last=10),
                 retry=nosleep(), checkpoint_retry=nosleep(),
@@ -199,7 +199,7 @@ class TestCheckpointCadence(TestCase):
 
     def test_keep_last_retention_and_gc_counter(self):
         before = snap()
-        with tempfile.TemporaryDirectory() as d:
+        with mh.TemporaryDirectory() as d:
             sup = rz.Supervisor(
                 d, rz.CheckpointSchedule(every_steps=1, keep_last=2),
                 retry=nosleep(), checkpoint_retry=nosleep(),
@@ -211,7 +211,7 @@ class TestCheckpointCadence(TestCase):
         self.assertEqual(dd["gc_removed"], 4)
 
     def test_checkpointed_state_restorable(self):
-        with tempfile.TemporaryDirectory() as d:
+        with mh.TemporaryDirectory() as d:
             sup = rz.Supervisor(d, retry=nosleep(), checkpoint_retry=nosleep())
             sup.run(bump, make_state(), n_steps=3)
             loaded = sup._restore_latest()
@@ -229,7 +229,7 @@ class TestResumeAndOwnership(TestCase):
             calls.append(i)
             return bump(state, data, i)
 
-        with tempfile.TemporaryDirectory() as d:
+        with mh.TemporaryDirectory() as d:
             sup = rz.Supervisor(d, retry=nosleep(), checkpoint_retry=nosleep())
             sup.run(step, make_state(), n_steps=3)
             calls.clear()
@@ -248,7 +248,7 @@ class TestResumeAndOwnership(TestCase):
             assert_bumped(self, res.state, 5)
 
     def test_fresh_run_purges_stale_checkpoints(self):
-        with tempfile.TemporaryDirectory() as d:
+        with mh.TemporaryDirectory() as d:
             sup = rz.Supervisor(d, retry=nosleep(), checkpoint_retry=nosleep())
             sup.run(bump, make_state(), n_steps=4)
             self.assertIn(4, step_dirs(d))
@@ -261,7 +261,7 @@ class TestResumeAndOwnership(TestCase):
     def test_fresh_run_restores_its_own_baseline_not_stale_state(self):
         """A restore-class fault in run 2 must rewind to run 2's own
         checkpoints even though run 1 left newer-looking state behind."""
-        with tempfile.TemporaryDirectory() as d:
+        with mh.TemporaryDirectory() as d:
             rz.Supervisor(d, retry=nosleep(), checkpoint_retry=nosleep()).run(
                 bump, make_state(), n_steps=6
             )
@@ -322,7 +322,7 @@ class TestFaultClassification(TestCase):
             return bump(state, data, i)
 
         before = snap()
-        with tempfile.TemporaryDirectory() as d:
+        with mh.TemporaryDirectory() as d:
             res = rz.Supervisor(d, retry=nosleep(), checkpoint_retry=nosleep()).run(
                 step, make_state(), n_steps=4
             )
@@ -376,7 +376,7 @@ class TestFaultClassification(TestCase):
         rz.clear_unhealthy()
         before = snap()
         try:
-            with tempfile.TemporaryDirectory() as d:
+            with mh.TemporaryDirectory() as d:
                 with self.assertRaises(OSError):
                     rz.Supervisor(
                         d, retry=nosleep(2), checkpoint_retry=nosleep(),
@@ -404,7 +404,7 @@ class TestDeviceLossRecovery(TestCase):
         orig = comm_mod.sanitize_comm(None)
         before = snap()
         try:
-            with tempfile.TemporaryDirectory() as d:
+            with mh.TemporaryDirectory() as d:
                 res = self._run_with_device_loss(d)
             assert_bumped(self, res.state, 5)
             self.assertEqual(res.comm.size, orig.size - 1)
@@ -448,11 +448,12 @@ class TestRestoreFallback(TestCase):
                             fh.seek(-1, os.SEEK_END)
                             fh.write(bytes([b[0] ^ 0xFF]))
 
-        with tempfile.TemporaryDirectory() as d:
+        with mh.TemporaryDirectory() as d:
             def step(state, data, i):
                 if i == 3 and not fired:
                     fired.append(i)
-                    corrupt_newest(d)  # newest commit is step-3
+                    # two ranks XOR-ing the same byte would restore it
+                    mh.on_pid0(lambda: corrupt_newest(d))  # newest commit is step-3
                     raise rz.DivergenceError("suspect state")
                 return bump(state, data, i)
 
@@ -549,7 +550,7 @@ class TestRetryPolicyMaxElapsed(TestCase):
             return bump(state, data, i)
 
         before = snap()
-        with tempfile.TemporaryDirectory() as d:
+        with mh.TemporaryDirectory() as d:
             res = rz.Supervisor(
                 d,
                 retry=rz.RetryPolicy(
@@ -572,7 +573,7 @@ class TestShardGCAcrossWorldSizes(TestCase):
         x8 = ht.arange(24, dtype=ht.float32, split=0)
         comm2 = ht.MeshCommunication(devices=jax.devices()[:2])
         y2 = ht.arange(10, dtype=ht.float32, split=0, comm=comm2) + 100.0
-        with tempfile.TemporaryDirectory() as d:
+        with mh.TemporaryDirectory() as d:
             rz.save_checkpoint(x8, d)
             self.assertEqual(
                 len([f for f in os.listdir(d) if f.startswith("shard_")]), 8
@@ -588,7 +589,7 @@ class TestShardGCAcrossWorldSizes(TestCase):
         comm2 = ht.MeshCommunication(devices=jax.devices()[:2])
         x2 = ht.arange(10, dtype=ht.float32, split=0, comm=comm2)
         y8 = ht.arange(24, dtype=ht.float32, split=0) * 3.0
-        with tempfile.TemporaryDirectory() as d:
+        with mh.TemporaryDirectory() as d:
             rz.save_checkpoint(x2, d)
             rz.save_checkpoint(y8, d)
             named = {e["file"] for e in rz.read_manifest(d)["shards"]}
@@ -728,7 +729,7 @@ class TestNNStateDicts(TestCase):
         dp, loss_fn, X, y = self._fit_fixture()
         dp.fit(loss_fn, X, y, n_steps=6)
         dp2, loss_fn2, _, _ = self._fit_fixture()
-        with tempfile.TemporaryDirectory() as d:
+        with mh.TemporaryDirectory() as d:
             with rz.FaultSchedule(
                 events=[("supervisor.step", 2, "io_error")]
             ) as sched:
@@ -766,6 +767,15 @@ class TestNNStateDicts(TestCase):
             params = daso.init({"w": jnp.zeros((4, 1))}, mesh)
             return daso, params
 
+        def fetch(a):
+            # at ws>1 the params span non-addressable devices; each
+            # process checks its own slow-group's replicas (identical
+            # shardings on both sides, so device order lines up)
+            if a.is_fully_addressable:
+                return np.asarray(a)
+            shards = sorted(a.addressable_shards, key=lambda s: s.device.id)
+            return np.concatenate([np.asarray(s.data).ravel() for s in shards])
+
         daso, params = fresh()
         for _ in range(3):
             params, _ = daso.step(loss_and_grad, params, X, y)
@@ -774,7 +784,7 @@ class TestNNStateDicts(TestCase):
         daso2, params2 = fresh()
         params2 = daso2.load_state_dict(sd, params=params2)
         np.testing.assert_allclose(
-            np.asarray(params2["w"]), np.asarray(params["w"]), rtol=1e-6
+            fetch(params2["w"]), fetch(params["w"]), rtol=1e-6
         )
         self.assertEqual(daso2._batch, daso._batch)
         self.assertEqual(daso2.epoch, daso.epoch)
@@ -782,7 +792,7 @@ class TestNNStateDicts(TestCase):
         params, la = daso.step(loss_and_grad, params, X, y)
         params2, lb = daso2.step(loss_and_grad, params2, X, y)
         np.testing.assert_allclose(
-            np.asarray(params2["w"]), np.asarray(params["w"]), rtol=1e-6
+            fetch(params2["w"]), fetch(params["w"]), rtol=1e-6
         )
         np.testing.assert_allclose(float(la), float(lb), rtol=1e-6)
 
