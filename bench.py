@@ -610,6 +610,7 @@ def main():
     out.update(ragged_bench())
     out.update(fused_bench())
     out.update(stream_bench())
+    out.update(serve_bench())
     detail_path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json"
     )
@@ -1025,6 +1026,165 @@ def stream_worker():
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+SERVE_COLS = 16
+SERVE_CLASSES = 8
+SERVE_REQUESTS = 192
+# offered load is set well above single-request dispatch capacity so
+# BOTH legs run capacity-limited and the speedup is a clean capacity
+# ratio (at lower load the batched leg just keeps up with arrivals and
+# the ratio measures the load generator, not batching)
+SERVE_INTERARRIVAL_S = 0.0004
+SERVE_MAX_BATCH = 32
+
+
+def serve_worker():
+    """Subprocess body for the ``serve_pipeline`` workload: an open-loop
+    load generator against the resident :class:`heat_tpu.serve.ServeService`.
+
+    The request stream is FIXED (seeded row counts in 1..8, fixed
+    interarrival — offered load does not react to completions, the
+    open-loop discipline) and is played twice through the same process:
+    once BATCHED (max_batch=32 shape-bucketed batching, the tentpole
+    path) and once UNBATCHED (max_batch=1: every request dispatches
+    alone, still bucket-padded so both legs replay warm programs). The
+    gated number is ``serve_batched_speedup`` = batched / unbatched
+    completed-requests-per-second at the SAME offered load; p50/p99
+    latency is reported for the batched leg.
+
+    Counters asserted, not assumed: after an explicit warm-up pass over
+    every bucket the measured legs run 0 XLA compiles and 0 traces
+    (``Region``), every batched-leg batch lands in a warm bucket, and
+    the whole phase runs under ``analysis.lockstep()`` with the
+    divergence count reported (0 with one controller by construction,
+    and the same wiring a multi-process run would check for real)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import heat_tpu as ht
+    from heat_tpu import analysis
+    from heat_tpu.analysis.sanitizer import Region
+    from heat_tpu.serve import (
+        SERVE_STATS,
+        BucketPolicy,
+        ServeService,
+        refresh_latency_stats,
+        reset_serve_stats,
+    )
+
+    cols, classes = SERVE_COLS, SERVE_CLASSES
+    rng = np.random.default_rng(11)
+    train = rng.normal(size=(1 << 12, cols)).astype(np.float32)
+    mu = ht.array(train.mean(axis=0))
+    isig = ht.array((1.0 / (train.std(axis=0) + 1e-6)).astype(np.float32))
+    w = ht.array(rng.normal(size=(cols, classes)).astype(np.float32))
+
+    @ht.fuse
+    def predict_pipeline(x):
+        # the canonical captured predict pipeline: standardize -> matmul
+        # -> argmax, ONE fused program per bucket (PR 8 capture extended
+        # to matmul/argreduce in this PR)
+        return ht.argmax((x - mu) * isig @ w, axis=1)
+
+    # one fixed request trace, shared by both legs: open-loop offered
+    # load with seeded mixed row counts
+    req_rows = [int(r) for r in rng.integers(1, 9, size=SERVE_REQUESTS)]
+    payloads = [
+        rng.normal(size=(r, cols)).astype(np.float32) for r in req_rows
+    ]
+    buckets_needed = (1, 2, 4, 8, 16, 32)
+
+    def run_leg(service):
+        """Play the trace open-loop; returns (rps, p50_ms, p99_ms)."""
+        reset_serve_stats()
+        t0 = time.perf_counter()
+        requests = []
+        for i, payload in enumerate(payloads):
+            target = t0 + i * SERVE_INTERARRIVAL_S
+            delay = target - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            requests.append(service.submit("pipe", payload))
+        service.flush()
+        for r in requests:
+            r.result(120)
+        elapsed = time.perf_counter() - t0
+        refresh_latency_stats()
+        return (
+            len(requests) / elapsed,
+            float(SERVE_STATS["p50_latency_ms"]),
+            float(SERVE_STATS["p99_latency_ms"]),
+            dict(SERVE_STATS),
+        )
+
+    with analysis.lockstep():
+        batched = ServeService(
+            policy=BucketPolicy(max_batch=SERVE_MAX_BATCH, max_latency_ms=2.0)
+        )
+        batched.register_endpoint("pipe", predict_pipeline)
+        unbatched = ServeService(policy=BucketPolicy(max_batch=1))
+        unbatched.register_endpoint("pipe", predict_pipeline)
+
+        # cold pass: cover every bucket either leg can form, then assert
+        # the measured phase replays cached programs only. Each warm-up
+        # request drains ALONE (flush sets the barrier without blocking,
+        # so back-to-back submits would coalesce into one grouped batch
+        # and leave the smaller buckets cold).
+        for service in (batched, unbatched):
+            for b in buckets_needed:
+                r = service.submit(
+                    "pipe", rng.normal(size=(b, cols)).astype(np.float32)
+                )
+                service.flush()
+                r.result(120)
+
+        region = Region("warm serve phase")
+        batched_rps, p50_ms, p99_ms, batched_stats = run_leg(batched)
+        unbatched_rps, _, _, _ = run_leg(unbatched)
+        warm_compiles = region.compiles + region.traces
+        assert warm_compiles == 0, region.stats()
+        assert batched_stats["bucket_misses"] == 0, batched_stats
+
+        # correctness spot-check on the warm service: served rows match
+        # the eager pipeline
+        probe = payloads[0]
+        served = batched.submit("pipe", probe).result(120)
+        oracle = np.argmax(
+            (probe - train.mean(axis=0))
+            * (1.0 / (train.std(axis=0) + 1e-6))
+            @ np.asarray(w._raw),
+            axis=1,
+        )
+        assert np.array_equal(served, oracle), (served, oracle)
+
+        batched.close()
+        unbatched.close()
+    divergences = int(analysis.LOCKSTEP_STATS["divergences"])
+
+    occupancy = batched_stats["batched_rows"] / max(1, batched_stats["batches"])
+    hits = batched_stats["bucket_hits"]
+    total_b = hits + batched_stats["bucket_misses"]
+    print(
+        json.dumps(
+            {
+                "serve_batched_speedup": round(batched_rps / unbatched_rps, 3),
+                "serve_requests_per_sec": round(batched_rps, 2),
+                "serve_unbatched_requests_per_sec": round(unbatched_rps, 2),
+                "serve_p50_ms": round(p50_ms, 3),
+                "serve_p99_ms": round(p99_ms, 3),
+                "serve_batch_occupancy": round(occupancy, 2),
+                "serve_bucket_hit_rate": round(hits / max(1, total_b), 3),
+                "serve_warm_compiles": int(warm_compiles),
+                "serve_lockstep_divergences": divergences,
+                "serve_unit": (
+                    f"open-loop predict pipeline requests/s at "
+                    f"{1.0 / SERVE_INTERARRIVAL_S:.0f} req/s offered load "
+                    f"(rows 1..8, f={cols}, 8 virtual CPU devices)"
+                ),
+            }
+        )
+    )
+
+
 def stream_bench():
     """Run the stream_pipeline workload ONCE in a fresh 8-virtual-CPU-
     device subprocess and fold its JSON line into the output; a failure
@@ -1103,6 +1263,32 @@ def ragged_bench():
         return {"ragged_error": repr(e)[:400]}
 
 
+def serve_bench():
+    """Run the serve_pipeline workload ONCE in a fresh 8-virtual-CPU-
+    device subprocess and fold its JSON line into the output; a failure
+    degrades to a ``serve_error`` field, never kills the bench."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--serve-worker"],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=900,
+        )
+        lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+        if proc.returncode != 0 or not lines:
+            return {"serve_error": (proc.stderr or proc.stdout or "no output")[-400:]}
+        return json.loads(lines[-1])
+    except Exception as e:  # noqa: BLE001 - diagnostics ride in the output
+        return {"serve_error": repr(e)[:400]}
+
+
 def _suite_seconds():
     """Tier-1 suite wall clock, recorded by tests/conftest.py into
     SUITE_SECONDS.json next to this file; null when no suite has run."""
@@ -1141,6 +1327,13 @@ def _compact_summary(out, detail_path):
         "stream_warm_compiles",
         "stream_divergences",
         "stream_error",
+        "serve_batched_speedup",
+        "serve_requests_per_sec",
+        "serve_p50_ms",
+        "serve_p99_ms",
+        "serve_warm_compiles",
+        "serve_lockstep_divergences",
+        "serve_error",
         "lockstep_events",
         "lockstep_divergences",
         "kmeans_fused_ratio",
@@ -1887,5 +2080,7 @@ if __name__ == "__main__":
         fused_worker()
     elif "--stream-worker" in sys.argv:
         stream_worker()
+    elif "--serve-worker" in sys.argv:
+        serve_worker()
     else:
         main()

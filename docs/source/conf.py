@@ -1,0 +1,38 @@
+# Sphinx configuration for the heat_tpu user documentation tree.
+#
+# Build (where sphinx is available; it is NOT a runtime dependency and
+# nothing in the library imports it):
+#
+#     sphinx-build -b html docs/source docs/_build/html
+#
+# Mirrors the reference project's doc/source layout: autodoc API
+# reference plus narrative tutorials.
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath("../.."))
+
+project = "heat_tpu"
+author = "heat_tpu contributors"
+copyright = "2026, heat_tpu contributors"
+
+extensions = [
+    "sphinx.ext.autodoc",
+    "sphinx.ext.autosummary",
+    "sphinx.ext.napoleon",   # numpydoc-style docstrings used throughout
+    "sphinx.ext.viewcode",
+]
+
+autosummary_generate = True
+autodoc_default_options = {
+    "members": True,
+    "undoc-members": False,
+    "show-inheritance": True,
+}
+# jax initializes an XLA backend on first use; keep doc builds importable
+# on machines without one
+autodoc_mock_imports = []
+
+templates_path = []
+exclude_patterns = []
+html_theme = "alabaster"

@@ -19,6 +19,7 @@ from . import parallel
 from . import analysis
 from . import regression
 from . import resilience
+from . import serve
 from . import spatial
 from . import stream
 from . import utils
@@ -29,7 +30,7 @@ from .core.version import __version__
 # runtime counters: layout rebalances / ragged exchanges /
 # compiles+transfers / collective-lockstep checks / supervised-recovery
 # activity / lazy-fusion captures+dispatches / streaming-pipeline chunks /
-# fused-kernel vs fallback dispatch decisions
+# fused-kernel vs fallback dispatch decisions / serving queue+batch+latency
 from .core.dndarray import LAYOUT_STATS
 from .parallel.flatmove import MOVE_STATS
 from .analysis.sanitizer import COMPILE_STATS
@@ -38,6 +39,7 @@ from .resilience.supervisor import RECOVERY_STATS
 from .core.lazy import FUSE_STATS
 from .stream import STREAM_STATS
 from .core.kernels import KERNEL_STATS
+from .serve import SERVE_STATS
 
 
 def __getattr__(name: str):

@@ -9,6 +9,7 @@ an evicted shape only costs a retrace, and XLA's own persistent
 compilation cache still dedupes the compile."""
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 
 from . import _hooks
@@ -17,40 +18,60 @@ __all__ = ["ExecutableCache"]
 
 
 class ExecutableCache(OrderedDict):
-    """OrderedDict with LRU eviction; drop-in for the module-level dicts."""
+    """OrderedDict with LRU eviction; drop-in for the module-level dicts.
+
+    Thread-safe for the lookup/insert/evict cycle: the serving layer
+    (:mod:`heat_tpu.serve`) drives PROGRAM_CACHE/META_CACHE from a
+    dispatcher thread while client threads capture concurrently, and an
+    unguarded ``move_to_end`` racing an eviction corrupts the
+    OrderedDict's internal linked list. One re-entrant lock per cache
+    covers every mutating path (``observe`` fires inside it, which is
+    fine — observers only count)."""
 
     def __init__(self, maxsize: int = 256):
         super().__init__()
         self.maxsize = int(maxsize)
+        self._lock = threading.RLock()
 
     def get(self, key, default=None):
-        try:
-            value = super().__getitem__(key)
-        except KeyError:
-            return default
-        self._touch(key)
-        return value
+        with self._lock:
+            try:
+                value = super().__getitem__(key)
+            except KeyError:
+                return default
+            self._touch(key)
+            return value
 
     def __getitem__(self, key):
-        value = super().__getitem__(key)
-        self._touch(key)
-        return value
+        with self._lock:
+            value = super().__getitem__(key)
+            self._touch(key)
+            return value
 
     def __setitem__(self, key, value):
-        is_new = key not in self
-        super().__setitem__(key, value)
-        self.move_to_end(key)
-        # evict oldest-first WITHOUT OrderedDict.popitem: on CPython 3.10
-        # popitem() re-enters the overridden __getitem__ after unlinking
-        # the node, so the LRU touch raised KeyError and corrupted the
-        # cache the first time it ever filled up
-        while len(self) > self.maxsize:
-            del self[next(iter(self))]
-        if is_new:
-            # a new key means a program was (or is about to be) traced for
-            # it — the sanitizer counts these to catch key-design bugs where
-            # repeated logical work never hits
-            _hooks.observe("cache.insert", size=len(self))
+        with self._lock:
+            is_new = key not in self
+            super().__setitem__(key, value)
+            self.move_to_end(key)
+            # evict oldest-first WITHOUT OrderedDict.popitem: on CPython
+            # 3.10 popitem() re-enters the overridden __getitem__ after
+            # unlinking the node, so the LRU touch raised KeyError and
+            # corrupted the cache the first time it ever filled up
+            while len(self) > self.maxsize:
+                del self[next(iter(self))]
+            if is_new:
+                # a new key means a program was (or is about to be) traced
+                # for it — the sanitizer counts these to catch key-design
+                # bugs where repeated logical work never hits
+                _hooks.observe("cache.insert", size=len(self))
+
+    def pop(self, key, *default):
+        with self._lock:
+            return super().pop(key, *default)
+
+    def clear(self):
+        with self._lock:
+            super().clear()
 
     def _touch(self, key) -> None:
         # inherited methods (pop, popitem, ...) may call __getitem__ for a

@@ -15,6 +15,7 @@ registry lives down here and chaos reaches down to install itself.
 """
 from __future__ import annotations
 
+import threading as _threading
 from typing import Callable, Dict, Optional
 
 # the active injector: fn(name, ctx) -> None, may raise to simulate a
@@ -99,8 +100,8 @@ def guarded_call(label: str, fn, *args, **kwargs):
     return _DEADLINE_RUNNER(label, fn, args, kwargs)
 
 
-# trace-safe mode: a depth counter armed by the lazy-fusion subsystem
-# (:mod:`heat_tpu.core.lazy`) while it replays recorded DNDarray ops under
+# trace-safe mode: a PER-THREAD depth counter armed by the lazy-fusion
+# subsystem (:mod:`heat_tpu.core.lazy`) while it replays DNDarray ops under
 # a jax trace (``jax.eval_shape`` metadata probes and the fused-program
 # ``jax.jit``). Two effects, both consulted from core with one integer
 # read: placement helpers (``dndarray._place`` / ``_from_ragged``) skip
@@ -110,8 +111,11 @@ def guarded_call(label: str, fn, *args, **kwargs):
 # so an op that would need a collective exchange under trace is declined
 # at capture time rather than miscompiled. Same layering trick as the
 # slots above: the flag lives down here so core never imports the lazy
-# package at module scope.
-_TRACE_SAFE_DEPTH = 0
+# package at module scope. The depth is THREAD-LOCAL: a serving
+# dispatcher thread replaying a fused program must not flip eager
+# client threads into trace-safe mode (and vice versa) — each thread
+# carries its own capture/replay state.
+_TRACE_SAFE = _threading.local()
 
 
 class TraceBarrierError(RuntimeError):
@@ -121,18 +125,17 @@ class TraceBarrierError(RuntimeError):
 
 
 def enter_trace_safe() -> None:
-    global _TRACE_SAFE_DEPTH
-    _TRACE_SAFE_DEPTH += 1
+    _TRACE_SAFE.depth = getattr(_TRACE_SAFE, "depth", 0) + 1
 
 
 def exit_trace_safe() -> None:
-    global _TRACE_SAFE_DEPTH
-    _TRACE_SAFE_DEPTH -= 1
+    _TRACE_SAFE.depth = getattr(_TRACE_SAFE, "depth", 0) - 1
 
 
 def in_trace_safe() -> bool:
-    """True while lazy fusion is replaying ops under a jax trace."""
-    return _TRACE_SAFE_DEPTH > 0
+    """True while lazy fusion is replaying ops under a jax trace (on the
+    CALLING thread; other threads' replays are invisible here)."""
+    return getattr(_TRACE_SAFE, "depth", 0) > 0
 
 
 def trace_barrier(label: str) -> None:
@@ -140,7 +143,7 @@ def trace_barrier(label: str) -> None:
     trace (``"balance_"``, ``"ragged_move"``, ...). No-op in normal eager
     execution; under trace-safe mode raises :class:`TraceBarrierError` so
     the lazy capture layer falls back to eager for the offending op."""
-    if _TRACE_SAFE_DEPTH > 0:
+    if getattr(_TRACE_SAFE, "depth", 0) > 0:
         raise TraceBarrierError(
             f"{label} moves data host-side and cannot run under a jax trace"
         )

@@ -455,13 +455,19 @@ def _ragged_process_allgather_impl(arr: np.ndarray, axis: int = 0):
 
     from . import _hooks
 
-    _hooks.fault_point(
-        "collective.allgather",
-        shape=tuple(np.asarray(arr).shape),
-        dtype=str(np.asarray(arr).dtype),
-    )
     nproc = jax.process_count()
     moved = np.moveaxis(np.asarray(arr), axis, 0)
+    # per-rank extents along ``axis`` are allowed to differ — that is
+    # this protocol's entire contract — so the lockstep fingerprint must
+    # carry only the rank-invariant context (trailing dims, dtype, axis);
+    # including the local extent would make every legal ragged gather
+    # self-report as a divergence
+    _hooks.fault_point(
+        "collective.allgather",
+        shape=tuple(moved.shape[1:]),
+        axis=int(axis),
+        dtype=str(moved.dtype),
+    )
     counts = np.asarray(
         multihost_utils.process_allgather(np.asarray([moved.shape[0]], np.int64))
     ).reshape(-1)

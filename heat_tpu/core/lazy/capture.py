@@ -25,19 +25,31 @@ node's inferred layout without materializing.
 from __future__ import annotations
 
 import functools
+import threading
 import weakref
 from typing import List, Optional
 
 from .. import _hooks, _operations
 from ..dndarray import DNDarray
 from . import evaluate
-from .graph import FUSE_STATS, Leaf, Node, NodeMeta, scalar_token
+from .graph import Leaf, Node, NodeMeta, scalar_token, stats_inc
 
 __all__ = ["LazyDNDarray", "LazyScope", "lazy", "fuse", "active",
-           "binary", "local", "reduce", "cum"]
+           "binary", "local", "reduce", "cum", "matmul", "argreduce"]
 
-# innermost-last stack of open ht.lazy() scopes
-_SCOPES: List["_Scope"] = []
+# innermost-last stack of open ht.lazy() scopes, PER THREAD: a serving
+# dispatcher thread replaying requests must not see (or append to) a
+# client thread's open scope — concurrent ht.lazy() scopes are
+# independent by construction
+_TLS = threading.local()
+
+
+def _scopes() -> List["_Scope"]:
+    s = getattr(_TLS, "scopes", None)
+    if s is None:
+        s = _TLS.scopes = []
+    return s
+
 
 # why the most recent capture was declined (debugging aid; not API)
 _LAST_DECLINE: Optional[str] = None
@@ -45,9 +57,10 @@ _LAST_DECLINE: Optional[str] = None
 
 def active() -> bool:
     """True when dispatcher calls should be offered for capture: some
-    scope is open and we are not inside our own replay/inference (which
-    runs the dispatchers eagerly under trace-safe mode)."""
-    return bool(_SCOPES) and not _hooks.in_trace_safe()
+    scope is open on THIS thread and we are not inside our own
+    replay/inference (which runs the dispatchers eagerly under
+    trace-safe mode)."""
+    return bool(_scopes()) and not _hooks.in_trace_safe()
 
 
 class _Scope:
@@ -127,7 +140,7 @@ def _force(arr: LazyDNDarray):
     node = arr._lazy_node
     if node.buffer is None:
         if active():
-            FUSE_STATS["eager_fallbacks"] += 1
+            stats_inc("eager_fallbacks")
         evaluate.evaluate([node])
     arr.__dict__["_lazy_buf"] = node.buffer
     return node.buffer
@@ -148,13 +161,13 @@ class LazyScope:
 
     def __enter__(self) -> "LazyScope":
         self._scope = _Scope()
-        _SCOPES.append(self._scope)
+        _scopes().append(self._scope)
         return self
 
     def __exit__(self, exc_type, exc, tb) -> bool:
         scope, self._scope = self._scope, None
         try:
-            _SCOPES.remove(scope)
+            _scopes().remove(scope)
         except ValueError:  # pragma: no cover - defensive (misnested exit)
             pass
         if exc_type is None and scope is not None:
@@ -206,7 +219,7 @@ def fuse(fn):
 def _decline(reason: str):
     global _LAST_DECLINE
     _LAST_DECLINE = reason
-    FUSE_STATS["eager_fallbacks"] += 1
+    stats_inc("eager_fallbacks")
     return NotImplemented
 
 
@@ -282,7 +295,7 @@ def _capture(kind: str, op, raw_operands, statics, sig_statics):
         # genuine user errors, which the eager path will raise identically
         return _decline(f"{type(e).__name__}: {e}")
     node = Node(kind, op, operands, statics, sig_statics, meta)
-    _SCOPES[-1].created.append(node)
+    _scopes()[-1].created.append(node)
     return LazyDNDarray._from_node(node)
 
 
@@ -335,3 +348,32 @@ def cum(operation, x, axis, out, dtype, neutral):
         "cum", operation, (x,), (axis, dtype, neutral),
         ("c", _operations._axis_key(axis), dtype, neutral),
     )
+
+
+def argreduce(operation, x, axis, out):
+    """Capture point for :func:`heat_tpu.core.statistics._arg_reduce`
+    (argmax/argmin) — the tail of the canonical predict pipeline. The
+    whole eager body (padding mask, flat-index remap, int64 cast) is
+    traceable, so it replays verbatim inside the fused jit."""
+    if out is not None or not isinstance(x, DNDarray):
+        return _decline("out= / non-DNDarray input")
+    return _capture(
+        "argreduce", operation, (x,), (axis,),
+        ("a", _operations._axis_key(axis)),
+    )
+
+
+def matmul(a, b, allow_resplit):
+    """Capture point for :func:`heat_tpu.core.linalg.basics.matmul` — the
+    contraction a captured predict pipeline (standardize -> matmul ->
+    argmax) needs to replay as ONE fused program. ``jnp.matmul`` on
+    sharded operands is fully traceable (GSPMD inserts the collectives),
+    so the whole eager path replays under the fused jit; only the
+    explicit-resplit variant moves data host-side and must decline."""
+    if allow_resplit:
+        return _decline("matmul allow_resplit= not captured")
+    if not (isinstance(a, DNDarray) and isinstance(b, DNDarray)):
+        return _decline("matmul needs two DNDarray operands")
+    from ..linalg import basics  # deferred: linalg must not load before core
+
+    return _capture("matmul", basics.matmul, (a, b), (), ("m",))

@@ -35,7 +35,7 @@ import jax
 from .. import _hooks
 from .._cache import ExecutableCache
 from ..dndarray import DNDarray
-from .graph import FUSE_STATS, Leaf, Node, NodeMeta, scalar_token
+from .graph import Leaf, Node, NodeMeta, scalar_token, stats_inc
 
 __all__ = ["infer_meta", "evaluate", "META_CACHE", "PROGRAM_CACHE"]
 
@@ -77,6 +77,15 @@ def _replay_one(kind: str, op, statics, args) -> DNDarray:
             op, args[0], axis=axis, keepdims=keepdims, out_dtype=out_dtype,
             neutral=neutral, **kwargs,
         )
+    if kind == "matmul":
+        # ``op`` IS basics.matmul (it keys the signature); calling it
+        # re-enters its capture hook, which declines under trace-safe
+        return op(args[0], args[1])
+    if kind == "argreduce":
+        from .. import statistics
+
+        (axis,) = statics
+        return statistics._arg_reduce(op, args[0], axis, None)
     axis, dtype, neutral = statics  # kind == "cum"
     return ops._cum_op(op, args[0], axis, dtype=dtype, neutral=neutral)
 
@@ -226,10 +235,10 @@ def _evaluate_group(comm, targets: Sequence[Node]) -> None:
     if prog is None:
         prog = _build_program(spec, leaf_metas, out_ids, out_metas, comm)
         PROGRAM_CACHE[sig] = prog
-        FUSE_STATS["graphs_captured"] += 1
+        stats_inc("graphs_captured")
     else:
-        FUSE_STATS["cache_hits"] += 1
-    FUSE_STATS["fused_dispatches"] += 1
+        stats_inc("cache_hits")
+    stats_inc("fused_dispatches")
 
     outs = prog(*leaf_bufs)
     for i, buf in zip(out_ids, outs):

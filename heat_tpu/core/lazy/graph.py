@@ -26,9 +26,11 @@ declined at capture instead of poisoning the cache.
 from __future__ import annotations
 
 import itertools
+import threading
 from typing import Any, Optional, Tuple
 
-__all__ = ["FUSE_STATS", "reset_fuse_stats", "NodeMeta", "Leaf", "Node", "scalar_token"]
+__all__ = ["FUSE_STATS", "reset_fuse_stats", "stats_inc",
+           "NodeMeta", "Leaf", "Node", "scalar_token"]
 
 # Counters for the lazy-fusion subsystem (module-level like LAYOUT_STATS /
 # MOVE_STATS; re-exported as ``heat_tpu.FUSE_STATS``):
@@ -55,12 +57,28 @@ FUSE_STATS = {
 }
 
 
+# FUSE_STATS is written from every thread that captures or evaluates
+# (the serve dispatcher thread beside any number of client threads);
+# ``d[k] += 1`` is a read-modify-write that loses counts under the GIL's
+# bytecode-level interleaving, so all increments go through this lock.
+_STATS_LOCK = threading.Lock()
+
+
+def stats_inc(key: str, n: int = 1) -> None:
+    """Thread-safe FUSE_STATS increment (the only sanctioned writer)."""
+    with _STATS_LOCK:
+        FUSE_STATS[key] += n
+
+
 def reset_fuse_stats() -> None:
     """Zero all FUSE_STATS counters (test/bench isolation)."""
-    for k in FUSE_STATS:
-        FUSE_STATS[k] = 0
+    with _STATS_LOCK:
+        for k in FUSE_STATS:
+            FUSE_STATS[k] = 0
 
 
+# next(_seq) is atomic at the C level in CPython, so node sequence
+# numbers stay unique across capturing threads without a lock
 _seq = itertools.count()
 
 

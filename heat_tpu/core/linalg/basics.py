@@ -16,6 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import types
+from .. import _operations
 from .._operations import _local_op, _mask_padding, _reduced_split
 from ..dndarray import DNDarray
 from ..stride_tricks import sanitize_axis
@@ -99,6 +100,15 @@ def _matmul_out_split(a: DNDarray, b: DNDarray, out_ndim: int) -> Optional[int]:
 
 def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False) -> DNDarray:
     """Matrix product of two DNDarrays (reference ``basics.py:424``)."""
+    # offer the call for lazy capture before touching any buffer (the
+    # same slot protocol as the generic dispatchers in _operations):
+    # inside an open ht.lazy() scope this records a "matmul" node and a
+    # captured predict pipeline fuses standardize -> matmul -> argmax
+    # into one program; NotImplemented means proceed eagerly
+    if _operations._capture is not None and _operations._capture.active():
+        res = _operations._capture.matmul(a, b, allow_resplit)
+        if res is not NotImplemented:
+            return res
     if not isinstance(a, DNDarray) or not isinstance(b, DNDarray):
         raise TypeError("both operands must be DNDarrays")
     promoted = types.promote_types(a.dtype, b.dtype)

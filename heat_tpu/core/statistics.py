@@ -70,6 +70,14 @@ def argmin(x: DNDarray, axis=None, out=None, **kwargs) -> DNDarray:
 
 
 def _arg_reduce(op, x, axis, out):
+    # offer the call for lazy capture before the buffer read below can
+    # force a pending operand (same slot protocol as the generic
+    # dispatchers) — this is the tail of the standardize -> matmul ->
+    # argmax predict pipeline, which must replay as ONE fused program
+    if _operations._capture is not None and _operations._capture.active():
+        res = _operations._capture.argreduce(op, x, axis, out)
+        if res is not NotImplemented:
+            return res
     if not isinstance(x, DNDarray):
         raise TypeError(f"expected x to be a DNDarray, but was {type(x)}")
     axis = sanitize_axis(x.shape, axis)

@@ -137,6 +137,15 @@ def save_checkpoint(
     policy = retry or DEFAULT_CHECKPOINT_POLICY
     _digest(b"", checksum)  # validate the algorithm name up front
 
+    if jax.process_count() > 1:  # pragma: no cover - exercised via tools/mpirun.py
+        from jax.experimental import multihost_utils
+
+        # entry barrier: a re-save mutates the directory in place, so no
+        # rank may start writing while a peer could still be reading the
+        # PREVIOUS save (observed as a ws-2 race where one rank's listing
+        # caught another rank's next save mid-write)
+        multihost_utils.sync_global_devices("heat_tpu_checkpoint_begin")
+
     entries: List[Dict] = []
     err: Optional[BaseException] = None
     try:
@@ -260,6 +269,13 @@ def save_checkpoint(
     _replicated_raise("checkpoint manifest commit", err)
     if jax.process_index() == 0:
         _gc_stale_shards(directory, entries)
+    if jax.process_count() > 1:  # pragma: no cover - exercised via tools/mpirun.py
+        from jax.experimental import multihost_utils
+
+        # without this, save_checkpoint returns on the other ranks while
+        # process 0 is still unlinking stale shards — a caller listing the
+        # directory right after the save races the GC
+        multihost_utils.sync_global_devices("heat_tpu_checkpoint_gc")
     return manifest_path
 
 

@@ -1,0 +1,34 @@
+"""heat_tpu.serve — resident multi-tenant serving over the SPMD mesh.
+
+The rest of this tree is script-shaped: a program owns the mesh, runs,
+and exits, paying trace/compile cost every launch. This package keeps
+the mesh (and every compiled program) RESIDENT: one
+:class:`~heat_tpu.serve.service.ServeService` holds named fitted
+estimators on-device (:class:`~heat_tpu.serve.session.ModelRegistry`),
+routes concurrent client requests through an async queue, and batches
+them by shape bucket (:mod:`~heat_tpu.serve.batching`) so unrelated
+clients share one sharded dispatch — warm requests replay cached
+programs only: 1 dispatch / 0 traces / 0 compiles.
+
+Counters live in :data:`SERVE_STATS` (re-exported as
+``heat_tpu.SERVE_STATS``), fed through the same
+:mod:`heat_tpu.core._hooks` observer slot as LAYOUT/MOVE/COMPILE/FUSE/
+STREAM/KERNEL_STATS. See docs/SERVING.md for the architecture, the
+bucket-policy latency/throughput model, and the multi-controller
+lockstep contract.
+"""
+from ._stats import SERVE_STATS, refresh_latency_stats, reset_serve_stats
+from .batching import BucketPolicy, PendingBatch
+from .service import Request, ServeService
+from .session import ModelRegistry
+
+__all__ = [
+    "SERVE_STATS",
+    "refresh_latency_stats",
+    "reset_serve_stats",
+    "BucketPolicy",
+    "PendingBatch",
+    "Request",
+    "ServeService",
+    "ModelRegistry",
+]

@@ -1,0 +1,97 @@
+"""Serving-layer counters riding the :mod:`heat_tpu.core._hooks`
+observer slot, beside LAYOUT/MOVE/COMPILE/FUSE/STREAM/KERNEL_STATS.
+
+The service emits passive ``serve.*`` events (see
+:func:`heat_tpu.core._hooks.observe`):
+
+- ``serve.request`` (``depth``) — a request was enqueued; ``depth`` is
+  the queue depth right after the append (gauge + high-water mark);
+- ``serve.batch`` (``requests``, ``rows``, ``bucket``, ``hit``) — one
+  shape-bucketed batch was dispatched: ``rows`` real rows padded up to
+  ``bucket``; ``hit`` says this (endpoint, bucket) was dispatched
+  before, i.e. every program it runs is warm;
+- ``serve.latency`` (``ms``) — one request completed, measured from
+  enqueue to result-ready (the client-visible number);
+- ``serve.error`` — a dispatch raised; the batch's requests carry the
+  error and the service lives on.
+
+One module-level observer folds them into :data:`SERVE_STATS`; the
+percentile gauges are recomputed from a bounded latency ring on
+:func:`refresh_latency_stats` (called by ``ServeService.stats()``), not
+per event. All writers take the module lock — events arrive from client
+threads and the dispatcher thread concurrently.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+from ..core import _hooks
+
+__all__ = ["SERVE_STATS", "reset_serve_stats", "refresh_latency_stats"]
+
+SERVE_STATS = {
+    "requests": 0,
+    "batches": 0,
+    "batched_rows": 0,      # real rows dispatched inside batches
+    "padded_rows": 0,       # bucket padding overhead (dead rows)
+    "bucket_hits": 0,       # batches whose (endpoint, bucket) was warm
+    "bucket_misses": 0,
+    "errors": 0,
+    "queue_depth": 0,       # gauge: depth at the last enqueue
+    "max_queue_depth": 0,
+    "p50_latency_ms": 0.0,  # gauges: refreshed from the latency ring
+    "p99_latency_ms": 0.0,
+}
+
+_LOCK = threading.Lock()
+_LATENCIES: "deque" = deque(maxlen=4096)
+
+
+def reset_serve_stats() -> None:
+    """Zero :data:`SERVE_STATS` and the latency ring (test/bench
+    isolation)."""
+    with _LOCK:
+        for k in SERVE_STATS:
+            SERVE_STATS[k] = 0.0 if k.endswith("_ms") else 0
+        _LATENCIES.clear()
+
+
+def refresh_latency_stats() -> None:
+    """Recompute the p50/p99 gauges from the latency ring."""
+    with _LOCK:
+        if not _LATENCIES:
+            return
+        xs = sorted(_LATENCIES)
+        n = len(xs)
+        SERVE_STATS["p50_latency_ms"] = xs[min(n - 1, int(0.50 * n))]
+        SERVE_STATS["p99_latency_ms"] = xs[min(n - 1, int(0.99 * n))]
+
+
+def _observer(event: str, ctx: dict) -> None:
+    if not event.startswith("serve."):
+        return
+    with _LOCK:
+        if event == "serve.request":
+            SERVE_STATS["requests"] += 1
+            depth = int(ctx.get("depth", 0))
+            SERVE_STATS["queue_depth"] = depth
+            if depth > SERVE_STATS["max_queue_depth"]:
+                SERVE_STATS["max_queue_depth"] = depth
+        elif event == "serve.batch":
+            SERVE_STATS["batches"] += 1
+            rows = int(ctx.get("rows", 0))
+            bucket = int(ctx.get("bucket", rows))
+            SERVE_STATS["batched_rows"] += rows
+            SERVE_STATS["padded_rows"] += max(0, bucket - rows)
+            if ctx.get("hit"):
+                SERVE_STATS["bucket_hits"] += 1
+            else:
+                SERVE_STATS["bucket_misses"] += 1
+        elif event == "serve.latency":
+            _LATENCIES.append(float(ctx.get("ms", 0.0)))
+        elif event == "serve.error":
+            SERVE_STATS["errors"] += 1
+
+
+_hooks.add_observer(_observer)

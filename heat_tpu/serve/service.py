@@ -1,0 +1,475 @@
+"""The resident SPMD service: event loop + single dispatch thread.
+
+One :class:`ServeService` owns the mesh for its lifetime. Client threads
+``submit()`` requests (numpy rows + an endpoint name) and block on
+:meth:`Request.result`; ONE dispatcher thread drains the queue, forms
+shape-bucketed batches (:mod:`heat_tpu.serve.batching`), runs each batch
+through its endpoint on-device, and scatters result rows back to the
+waiting requests. All device work happens on the dispatcher thread —
+the PR 9 lesson: concurrent dispatch from multiple threads interleaves
+cross-process collectives differently per process and deadlocks the
+rendezvous — and every batch is pinned under ``collective_lockstep``
+before the next one launches, so multi-controller execution keeps one
+total order of collective-bearing programs.
+
+Flush triggers, and the multi-controller contract
+-------------------------------------------------
+A pending batch dispatches when (a) it reaches ``policy.max_batch``
+rows, (b) its oldest request has waited ``policy.max_latency_ms``, or
+(c) a barrier forces it: ``flush()``, ``drain()``, ``close()``, or any
+``submit_call`` (control calls act as barriers so model mutations are
+ordered against traffic). ``flush()`` enqueues a no-op control call, so
+the barrier has a deterministic POSITION in the queue: exactly the
+requests submitted before it are forced, never a racing later submit
+the dispatcher happened to observe.
+
+Triggers (a) and (b) are armed with a single controller only. Both are
+rank-divergent under multiple controllers: wall clocks drift, and the
+count trigger fires at whatever queue prefix each rank's dispatcher
+happens to observe — with two pending endpoint groups, rank A can see
+only the younger group full (dispatching it first) while rank B sees
+both (dispatching the older first), and the collective-bearing batch
+programs then interleave in different orders across ranks, which is
+exactly the deadlock ``collective_lockstep`` exists to prevent. So at
+``jax.process_count() > 1`` the service is barrier-driven SPMD like
+everything else in this tree: every process submits the same requests
+in the same order and calls the same barriers; batches between barriers
+form from identical queue segments by identical rules, and lockstep
+pinning keeps one total order of programs. See docs/SERVING.md.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..core import _hooks
+from ..core import factories
+from ..resilience.errors import ResilienceError
+from ..core.communication import collective_lockstep
+from ..core.dndarray import DNDarray
+from .batching import BucketPolicy, PendingBatch
+from .session import ModelRegistry
+from ._stats import SERVE_STATS, refresh_latency_stats
+
+__all__ = ["Request", "ServeService"]
+
+
+class Request:
+    """One client request: ``payload`` rows bound for ``endpoint``.
+
+    ``payload`` is host data shaped ``(rows, *row_shape)``; the result
+    (set by the dispatcher) is the matching slice of the batch output.
+    """
+
+    __slots__ = ("endpoint", "payload", "rows", "enqueue_t",
+                 "_done", "_result", "_error")
+
+    def __init__(self, endpoint: str, payload: np.ndarray):
+        self.endpoint = endpoint
+        self.payload = payload
+        self.rows = int(payload.shape[0])
+        self.enqueue_t = time.monotonic()
+        self._done = threading.Event()
+        self._result = None
+        self._error: Optional[BaseException] = None
+
+    def _finish(self, result=None, error: Optional[BaseException] = None) -> None:
+        self._result = result
+        self._error = error
+        _hooks.observe(
+            "serve.latency", ms=(time.monotonic() - self.enqueue_t) * 1e3
+        )
+        self._done.set()
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        """Block until the dispatcher answered; returns the result rows
+        or re-raises the dispatch error."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"request to {self.endpoint!r} still pending")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class _Call:
+    """A control item: a closure executed on the dispatcher thread (the
+    only thread allowed to do device work). Acts as a flush barrier."""
+
+    __slots__ = ("fn", "_done", "_result", "_error")
+
+    def __init__(self, fn: Callable):
+        self.fn = fn
+        self._done = threading.Event()
+        self._result = None
+        self._error: Optional[BaseException] = None
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._done.wait(timeout):
+            raise TimeoutError("control call still pending")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class ServeService:
+    """Persistent multi-tenant serving loop over the resident mesh.
+
+    Parameters
+    ----------
+    policy : BucketPolicy
+        Batching policy (bucket menu, max-batch, max-latency).
+    registry : ModelRegistry
+        Resident model registry; a fresh one when omitted.
+    snapshot_dir : str, optional
+        When set, the registry is snapshotted here every
+        ``snapshot_every`` successful batches (on the dispatcher thread,
+        so snapshots are ordered against traffic), and a dispatch error
+        triggers a best-effort restore from the last snapshot before the
+        service carries on — the supervised-service loop.
+    snapshot_every : int
+        Snapshot cadence in batches (0 disables periodic snapshots).
+    """
+
+    def __init__(
+        self,
+        policy: Optional[BucketPolicy] = None,
+        registry: Optional[ModelRegistry] = None,
+        snapshot_dir: Optional[str] = None,
+        snapshot_every: int = 0,
+    ):
+        self.policy = policy or BucketPolicy()
+        self.registry = registry or ModelRegistry()
+        self.snapshot_dir = snapshot_dir
+        self.snapshot_every = int(snapshot_every)
+        self._endpoints: Dict[str, Callable] = {}
+        self._cond = threading.Condition()
+        self._queue: List = []
+        self._closed = False
+        self._seen_buckets = set()
+        self._have_snapshot = False
+        self._batches_since_snapshot = 0
+        # the latency timer and the max-batch count trigger both fire at
+        # rank-divergent moments (see the module docstring); arm them
+        # only when there is no other rank to diverge from
+        self._async_triggers = jax.process_count() == 1
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="serve-dispatch"
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------ endpoints
+    def register_endpoint(self, name: str, fn: Callable) -> None:
+        """Install a row-wise endpoint: ``fn(x: DNDarray) -> DNDarray``
+        where output row ``i`` depends only on input row ``i`` (plus
+        resident state) — the contract that makes bucket padding and
+        result scattering safe."""
+        if self._closed:
+            raise RuntimeError("service is closed")
+        self._endpoints[name] = fn
+
+    def register_model(self, name: str, model, methods: Sequence[str] = ("predict",)):
+        """Register ``model`` in the resident registry and expose one
+        endpoint per method as ``"<name>.<method>"``. Endpoints resolve
+        the model through the registry AT DISPATCH TIME, so a later
+        ``registry.register(name, refreshed)`` swaps the model without
+        touching endpoints or compiled programs."""
+        self.registry.register(name, model)
+        for method in methods:
+            if not callable(getattr(model, method, None)):
+                raise TypeError(f"{name!r} model has no callable {method!r}")
+            self._endpoints[f"{name}.{method}"] = _model_endpoint(
+                self.registry, name, method
+            )
+
+    def endpoints(self) -> List[str]:
+        return sorted(self._endpoints)
+
+    # ------------------------------------------------------------- clients
+    def submit(self, endpoint: str, payload) -> Request:
+        """Enqueue ``payload`` rows for ``endpoint``; returns a
+        :class:`Request` future. ``payload`` is host data shaped
+        ``(rows, *row_shape)`` (one sample: shape ``(1, ...)``)."""
+        if endpoint not in self._endpoints:
+            raise KeyError(
+                f"unknown endpoint {endpoint!r}; known: {self.endpoints()}"
+            )
+        payload = np.asarray(payload)
+        if payload.ndim < 1 or payload.shape[0] < 1:
+            raise ValueError("payload must be (rows, ...) with rows >= 1")
+        request = Request(endpoint, payload)
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("service is closed")
+            self._queue.append(request)
+            depth = len(self._queue)
+            self._cond.notify()
+        _hooks.observe("serve.request", depth=depth)
+        return request
+
+    def predict(self, name: str, payload, timeout: Optional[float] = None):
+        """Synchronous convenience: submit to ``"<name>.predict"`` and
+        wait for the rows."""
+        return self.submit(f"{name}.predict", payload).result(timeout)
+
+    def submit_call(self, fn: Callable) -> _Call:
+        """Run ``fn()`` on the dispatcher thread, ordered after every
+        currently pending request (a barrier). This is the door for
+        anything that is NOT a row-wise map: ``fit``, ``partial_fit``,
+        registry snapshots, model refreshes."""
+        call = _Call(fn)
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("service is closed")
+            self._queue.append(call)
+            self._cond.notify()
+        return call
+
+    def feed(
+        self,
+        name: str,
+        chunks,
+        method: str = "partial_fit",
+        depth: int = 2,
+        timeout: Optional[float] = None,
+    ) -> int:
+        """Stream chunks into a resident model's incremental update
+        (``partial_fit`` / ``update``), overlapping chunk production with
+        device compute: the PR 10 Prefetcher runs the chunk source
+        ``depth`` ahead on its producer thread while each update executes
+        on the DISPATCHER thread (via :meth:`submit_call`, so updates are
+        ordered against concurrent predict traffic). Tuple chunks splat
+        into positional args — ``(x, y)`` feeds ``partial_fit(x, y)``.
+        Returns the number of chunks applied."""
+        from ..stream import Prefetcher
+
+        registry = self.registry
+        applied = 0
+        pending: List[_Call] = []
+        for chunk in Prefetcher(chunks, depth=depth):
+            pending.append(self.submit_call(_feed_step(registry, name, method, chunk)))
+            applied += 1
+            # stay at most ``depth`` updates ahead of the dispatcher so
+            # the chunk source is throttled by compute, not read whole
+            while len(pending) > max(1, depth):
+                pending.pop(0).result(timeout)
+        for call in pending:
+            call.result(timeout)
+        return applied
+
+    def flush(self) -> None:
+        """Force-dispatch everything submitted before this call
+        (non-blocking). Implemented as a no-op control call so the
+        barrier sits at a deterministic queue position — requests
+        submitted AFTER the flush stay pending, on every rank."""
+        call = _Call(lambda: None)
+        with self._cond:
+            if self._closed:
+                return
+            self._queue.append(call)
+            self._cond.notify()
+
+    def drain(self, timeout: Optional[float] = None) -> None:
+        """Block until every request submitted before this call has been
+        dispatched and answered."""
+        self.submit_call(lambda: None).result(timeout)
+
+    def stats(self) -> dict:
+        """Snapshot of SERVE_STATS with the latency percentiles
+        refreshed."""
+        refresh_latency_stats()
+        snap = dict(SERVE_STATS)
+        with self._cond:
+            snap["queue_depth"] = len(self._queue)
+        return snap
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        """Flush outstanding work and stop the dispatcher thread."""
+        with self._cond:
+            if self._closed and not self._thread.is_alive():
+                return
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join(timeout)
+
+    def __enter__(self) -> "ServeService":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.close()
+        return False
+
+    # ----------------------------------------------------------- dispatcher
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                work = self._pick_work()
+                if work is None:
+                    if self._closed and not self._queue:
+                        return
+                    self._cond.wait(self._wait_timeout())
+                    continue
+            kind, item = work
+            if kind == "batch":
+                self._dispatch_batch(item)
+            else:
+                self._run_call(item)
+
+    def _pick_work(self):
+        """Choose the next unit of work, FIFO by oldest member. Caller
+        holds the lock; device work happens outside it."""
+        if not self._queue:
+            return None
+        # the segment before the first control call; the call is a
+        # barrier, so requests behind it stay out of this round's groups
+        call_at = len(self._queue)
+        for i, item in enumerate(self._queue):
+            if isinstance(item, _Call):
+                call_at = i
+                break
+        groups: Dict[tuple, PendingBatch] = {}
+        for item in self._queue[:call_at]:
+            key = (item.endpoint, item.payload.shape[1:], item.payload.dtype.str)
+            if key not in groups:
+                groups[key] = PendingBatch(key)
+            groups[key].add(item)
+        force = self._closed or call_at < len(self._queue)
+        now = time.monotonic()
+        for group in groups.values():  # insertion order = oldest first
+            if (
+                force
+                or (
+                    self._async_triggers
+                    and (
+                        group.rows >= self.policy.max_batch
+                        or group.age_ms(now) >= self.policy.max_latency_ms
+                    )
+                )
+            ):
+                # cap each dispatch at max_batch rows: a burst then
+                # becomes several batches in the SAME warm bucket rather
+                # than one batch in a novel (cold) oversized bucket; a
+                # single over-large request still dispatches alone
+                chosen = PendingBatch(group.key)
+                for request in group.requests:
+                    if chosen.rows and chosen.rows + request.rows > self.policy.max_batch:
+                        break
+                    chosen.add(request)
+                members = set(map(id, chosen.requests))
+                self._queue = [x for x in self._queue if id(x) not in members]
+                return ("batch", chosen)
+        if call_at == 0:
+            return ("call", self._queue.pop(0))
+        return None
+
+    def _wait_timeout(self) -> Optional[float]:
+        """Seconds until the oldest pending group hits the latency
+        trigger (None: sleep until notified)."""
+        if not self._async_triggers or not self._queue:
+            return None
+        oldest = None
+        for item in self._queue:
+            if isinstance(item, _Call):
+                break
+            if oldest is None or item.enqueue_t < oldest:
+                oldest = item.enqueue_t
+        if oldest is None:
+            return None
+        remaining = self.policy.max_latency_ms / 1e3 - (time.monotonic() - oldest)
+        return max(1e-4, remaining)
+
+    def _dispatch_batch(self, group: PendingBatch) -> None:
+        endpoint, row_shape, dtype_str = group.key
+        try:
+            stacked = group.stack(self.policy)
+            bucket = int(stacked.shape[0])
+            bucket_key = (endpoint, bucket, row_shape, dtype_str)
+            hit = bucket_key in self._seen_buckets
+            x = factories.array(stacked, split=0)
+            out = self._endpoints[endpoint](x)
+            # pin this program to completion before the next independent
+            # one launches: multi-controller collective order stays total
+            collective_lockstep(out._raw if isinstance(out, DNDarray) else out)
+            host = out.numpy() if isinstance(out, DNDarray) else np.asarray(out)
+            self._seen_buckets.add(bucket_key)
+        except Exception as exc:  # noqa: BLE001 - delivered to the clients
+            _hooks.observe("serve.error", endpoint=endpoint)
+            for request in group.requests:
+                request._finish(error=exc)
+            self._maybe_restore(exc)
+            return
+        _hooks.observe(
+            "serve.batch",
+            requests=len(group.requests),
+            rows=group.rows,
+            bucket=bucket,
+            hit=hit,
+        )
+        offset = 0
+        for request in group.requests:
+            request._finish(result=host[offset:offset + request.rows])
+            offset += request.rows
+        self._maybe_snapshot()
+
+    def _run_call(self, call: _Call) -> None:
+        try:
+            call._result = call.fn()
+        except Exception as exc:  # noqa: BLE001 - delivered to the caller
+            call._error = exc
+            _hooks.observe("serve.error", endpoint="<call>")
+        call._done.set()
+
+    # ------------------------------------------------- supervised snapshots
+    def _maybe_snapshot(self) -> None:
+        if not self.snapshot_dir or self.snapshot_every <= 0:
+            return
+        self._batches_since_snapshot += 1
+        if self._batches_since_snapshot < self.snapshot_every:
+            return
+        self._batches_since_snapshot = 0
+        try:
+            self.registry.snapshot(self.snapshot_dir)
+            self._have_snapshot = True
+        except ResilienceError:
+            # a deserted collective / divergence is never "best-effort" —
+            # swallowing it here would wedge the other ranks
+            raise
+        except Exception:  # noqa: BLE001 - snapshots are best-effort
+            _hooks.observe("serve.error", endpoint="<snapshot>")
+
+    def _maybe_restore(self, exc: BaseException) -> None:
+        """After a dispatch error, roll the resident models back to the
+        last good snapshot (best-effort — the supervised-service loop).
+        """
+        if not self.snapshot_dir or not self._have_snapshot:
+            return
+        try:
+            self.registry.restore(self.snapshot_dir)
+            _hooks.observe("serve.restore", cause=type(exc).__name__)
+        except ResilienceError:
+            raise
+        except Exception:  # noqa: BLE001 - the original error already went out
+            _hooks.observe("serve.error", endpoint="<restore>")
+
+
+def _model_endpoint(registry: ModelRegistry, name: str, method: str) -> Callable:
+    def endpoint(x: DNDarray):
+        return getattr(registry.get(name), method)(x)
+
+    endpoint._cache_stable = True  # module-level factory, one per registration
+    return endpoint
+
+
+def _feed_step(registry: ModelRegistry, name: str, method: str, chunk) -> Callable:
+    def step():
+        bound = getattr(registry.get(name), method)
+        return bound(*chunk) if isinstance(chunk, tuple) else bound(chunk)
+
+    return step

@@ -95,6 +95,34 @@ class TemporaryDirectory:
         return False
 
 
+def submesh(k: int):
+    """``k`` devices for a smaller-than-world mesh, spanning every process.
+
+    Single-process this is simply ``jax.devices()[:k]``. Multi-process,
+    a prefix of the global device list would put every device on process
+    0 — a mesh the other ranks cannot address, so any computation on it
+    deadlocks or errors the group. Instead each process contributes an
+    equal share of its local devices (``k`` must divide evenly), keeping
+    the mesh usable from every rank.
+    """
+    devs = jax.devices()
+    nproc = jax.process_count()
+    if nproc == 1:
+        return devs[:k]
+    if k % nproc:
+        raise ValueError(f"submesh size {k} does not divide over {nproc} processes")
+    per = k // nproc
+    picked = []
+    for p in range(nproc):
+        local = [d for d in devs if d.process_index == p][:per]
+        if len(local) < per:
+            raise ValueError(
+                f"process {p} has fewer than {per} devices for a submesh of {k}"
+            )
+        picked.extend(local)
+    return picked
+
+
 def on_pid0(fn) -> None:
     """Run a filesystem mutation exactly once per process group.
 

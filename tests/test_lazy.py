@@ -42,7 +42,7 @@ def _fresh_counters():
     reset_fuse_stats()
     yield
     # a test must never leak an open scope into the rest of the suite
-    assert not _capture._SCOPES, "test leaked an open ht.lazy() scope"
+    assert not _capture._scopes(), "test leaked an open ht.lazy() scope"
 
 
 def _delta(before):
@@ -299,7 +299,7 @@ class TestEscapeHatches:
             with ht.lazy():
                 escaped["w"] = x * 5.0
                 raise RuntimeError("boom")
-        assert not _capture._SCOPES
+        assert not _capture._scopes()
         # eager is restored: new ops return plain DNDarrays
         y = x + 1.0
         assert not isinstance(y, LazyDNDarray)

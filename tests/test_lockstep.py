@@ -30,9 +30,14 @@ def _fresh_stats():
 
 
 def _dispatch_collectives(n=3):
-    """Fire n real collective fault points (single-process allgathers)."""
+    """Fire n real collective fault points (single-process allgathers).
+
+    The trailing (non-gathered) dim varies per dispatch so each one gets
+    a distinct fingerprint; the gathered-axis extent is deliberately NOT
+    part of the fingerprint (ragged gathers differ there per rank by
+    protocol contract)."""
     for i in range(n):
-        communication.ragged_process_allgather(np.arange(i + 1))
+        communication.ragged_process_allgather(np.zeros((2, i + 1)))
 
 
 class TestRecording:
@@ -47,7 +52,7 @@ class TestRecording:
         entries = ls.entries()
         assert [seq for seq, _, _ in entries] == [0, 1, 2]
         assert all(site == "collective.allgather" for _, site, _ in entries)
-        # shapes differ per dispatch, so the fingerprints must too
+        # trailing dims differ per dispatch, so the fingerprints must too
         assert len({fp for _, _, fp in entries}) == 3
         assert LOCKSTEP_STATS["events"] == 3
 
@@ -57,6 +62,19 @@ class TestRecording:
             communication.ragged_process_allgather(np.arange(4))
         (_, _, fp1), (_, _, fp2) = ls.entries()
         assert fp1 == fp2
+
+    def test_ragged_axis_extent_excluded_from_fingerprint(self):
+        # per-rank extents along the gathered axis legally differ — that
+        # is the ragged protocol's contract — so two gathers that differ
+        # ONLY there must fingerprint identically, else every legal
+        # ragged gather at ws>1 self-reports as a divergence
+        with lockstep(check_at_exit=False) as ls:
+            communication.ragged_process_allgather(np.zeros((1, 4)))
+            communication.ragged_process_allgather(np.zeros((3, 4)))
+            communication.ragged_process_allgather(np.zeros((3, 5)))
+        (_, _, fp1), (_, _, fp2), (_, _, fp3) = ls.entries()
+        assert fp1 == fp2  # rows (the gathered axis) don't matter
+        assert fp2 != fp3  # trailing dims do
 
     def test_shard_site_and_non_collectives_excluded(self):
         with lockstep(check_at_exit=False) as ls:

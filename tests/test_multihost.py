@@ -717,6 +717,130 @@ def test_two_process_lockstep_divergence(tmp_path):
     assert finals[0] == finals[1] == ["seq=1", "counts=(3,", "2)"], finals
 
 
+_SERVE_WORKER = r"""
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+pid = int(sys.argv[1]); nproc = int(sys.argv[2]); port = sys.argv[3]
+
+import heat_tpu as ht
+from heat_tpu import analysis
+from heat_tpu.analysis.sanitizer import Region
+from heat_tpu.serve import BucketPolicy, ServeService, reset_serve_stats
+
+ht.init_distributed(
+    coordinator_address=f"localhost:{port}", num_processes=nproc, process_id=pid
+)
+assert jax.device_count() == 8 and jax.local_device_count() == 4
+
+cols, classes = 8, 4
+rng = np.random.default_rng(21)
+w_np = rng.normal(size=(cols, classes)).astype(np.float32)
+mu_np = rng.normal(size=(classes,)).astype(np.float32)
+# model weights SPLIT across the process boundary: every batch dispatch
+# contracts x @ w over the sharded axis, a cross-process collective
+w = ht.array(w_np, split=0)
+mu = ht.array(mu_np)
+
+def linear(x):
+    return x @ w + mu
+
+def score(x):
+    return ht.argmax(x @ w + mu, axis=1)
+
+with analysis.lockstep():
+    svc = ServeService(policy=BucketPolicy(edges=(1, 2, 4, 8), max_batch=8))
+    svc.register_endpoint("linear", linear)
+    svc.register_endpoint("score", score)
+    # async (timer/count) triggers fire at rank-divergent moments and
+    # must be disarmed under multiple controllers: barrier-driven only
+    assert svc._async_triggers is False
+
+    # cold pass: one dispatch per (endpoint, bucket), each draining alone
+    for name in ("linear", "score"):
+        for b in (1, 2, 4, 8):
+            r = svc.submit(name, rng.normal(size=(b, cols)).astype(np.float32))
+            svc.flush()
+            r.result(300)
+
+    # the SPMD serving contract: both ranks submit the SAME interleaved
+    # multi-tenant trace in the same order, then one flush barrier; many
+    # collective-bearing requests are outstanding concurrently and the
+    # dispatcher must form identical batches in identical order on both
+    # ranks (or the x @ w collectives cross-rendezvous and deadlock)
+    trace = [
+        (("linear", "score")[i % 2],
+         rng.normal(size=(1 + i % 4, cols)).astype(np.float32))
+        for i in range(24)
+    ]
+    reset_serve_stats()
+    region = Region("ws2 warm serve")
+    requests = [svc.submit(name, p) for name, p in trace]
+    svc.flush()
+    results = [r.result(300) for r in requests]
+    warm = region.compiles + region.traces
+    stats = svc.stats()
+    svc.close(300)
+div = int(analysis.LOCKSTEP_STATS["divergences"])
+assert warm == 0, warm
+assert div == 0, div
+assert stats["errors"] == 0, stats
+assert stats["bucket_misses"] == 0, stats
+
+acc = 0.0
+for (name, p), out in zip(trace, results):
+    ref = p @ w_np + mu_np
+    if name == "score":
+        assert np.array_equal(np.asarray(out), np.argmax(ref, axis=1)), name
+    else:
+        np.testing.assert_allclose(np.asarray(out), ref, atol=1e-4)
+    acc += float(np.asarray(out, dtype=np.float64).sum())
+
+print(f"WORKER{pid} SERVE OK {acc:.4f} {warm} {div} {stats['batches']}")
+"""
+
+
+@pytest.mark.skipif(
+    os.environ.get("HEAT_TPU_TEST_DEVICES", "8") != "8",
+    reason="one fixed 2x4 topology is enough for the matrix",
+)
+def test_two_process_serving(tmp_path):
+    """Resident serving under real multi-process execution (PR 13
+    tentpole): two endpoints over process-spanning sharded weights serve
+    24 concurrent outstanding requests; batches form identically on both
+    ranks (no lockstep divergence, no deadlock), the warm phase neither
+    traces nor compiles, and both ranks scatter identical results."""
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+
+    worker = tmp_path / "serve_worker.py"
+    worker.write_text(_SERVE_WORKER)
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env.pop("HEAT_TPU_TEST_DEVICES", None)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(i), "2", str(port)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = [p.communicate(timeout=600)[0] for p in procs]
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out}"
+        assert f"WORKER{i} SERVE OK" in out, out
+    # identical result checksum, batch count, and zero counters per rank
+    finals = [out.strip().splitlines()[-1].split()[3:] for out in outs]
+    assert finals[0] == finals[1], finals
+
+
 _PYTEST_DRIVER = r"""
 import os, sys
 import jax

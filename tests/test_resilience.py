@@ -66,7 +66,7 @@ class TestCheckpointRoundTrip(TestCase):
 
     def test_restore_onto_fewer_devices(self):
         x = ht.arange(23, dtype=ht.float32, split=0)
-        comm4 = ht.MeshCommunication(devices=jax.devices()[:4])
+        comm4 = ht.MeshCommunication(devices=mh.submesh(4))
         with mh.TemporaryDirectory() as d:
             rz.save_checkpoint(x, d)
             y = rz.load_checkpoint(d, comm=comm4)
@@ -74,7 +74,7 @@ class TestCheckpointRoundTrip(TestCase):
         np.testing.assert_array_equal(y.numpy(), x.numpy())
 
     def test_restore_onto_more_devices(self):
-        comm2 = ht.MeshCommunication(devices=jax.devices()[:2])
+        comm2 = ht.MeshCommunication(devices=mh.submesh(2))
         x = ht.arange(11, dtype=ht.float32, split=0, comm=comm2)
         with mh.TemporaryDirectory() as d:
             rz.save_checkpoint(x, d)

@@ -1,0 +1,519 @@
+"""heat_tpu.serve: resident serving, shape-bucketed batching, warm replay.
+
+Covers the PR 13 tentpole end to end on the single-process CPU mesh:
+bucket policy arithmetic, batch formation, the Region-asserted warm path
+(0 traces / 0 compiles after one cold pass per bucket), multi-tenant
+correctness, error delivery, resident-model registry + snapshots riding
+the checkpoint layer, the supervised restore loop, streaming ``feed``,
+and the concurrency contracts the serving layer leans on (thread-local
+lazy scopes, locked FUSE_STATS, locked ExecutableCaches). The real
+2-process serving run lives in tests/test_multihost.py (slow tier).
+
+Region-delta discipline (learned the hard way): ``Region`` properties
+read COMPILE_STATS LIVE, so every warm-path test asserts its deltas
+BEFORE making any further eager calls — a post-measurement oracle call
+with a novel shape would add traces to the region being asserted.
+"""
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu import serve
+from heat_tpu.analysis.sanitizer import Region
+from heat_tpu.cluster import KMeans
+from heat_tpu.core.kernels import KERNEL_STATS, reset_kernel_stats
+from heat_tpu.core.lazy import FUSE_STATS, reset_fuse_stats
+from heat_tpu.core.lazy import capture as _capture
+from heat_tpu.core.lazy import evaluate as _evaluate
+from heat_tpu.core import statistics as _statistics
+from heat_tpu.regression import Lasso
+from heat_tpu.serve import (
+    SERVE_STATS,
+    BucketPolicy,
+    ModelRegistry,
+    PendingBatch,
+    Request,
+    ServeService,
+    reset_serve_stats,
+)
+
+
+pytestmark = pytest.mark.skipif(
+    jax.process_count() > 1,
+    reason="single-controller unit suite by design: async triggers are "
+    "disarmed at ws>1 (dispatch is flush/barrier-driven only), so the "
+    "timer- and count-trigger tests here cannot fire; the real 2-process "
+    "serving path is covered in tests/test_multihost.py",
+)
+
+
+def _fitted_kmeans(seed=0, k=3, f=6):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(k, f)).astype(np.float32) * 4.0
+    data = np.concatenate(
+        [c + rng.normal(scale=0.1, size=(16, f)).astype(np.float32) for c in centers]
+    )
+    km = KMeans(n_clusters=k, max_iter=10, random_state=seed)
+    km.fit(ht.array(data, split=0))
+    return km
+
+
+class TestBucketPolicy:
+    def test_bucket_rounding(self):
+        p = BucketPolicy(edges=(1, 2, 4, 8), max_batch=8)
+        assert [p.bucket_rows(n) for n in (1, 2, 3, 5, 8)] == [1, 2, 4, 8, 8]
+        # beyond the menu: next power of two of the last edge
+        assert p.bucket_rows(9) == 16
+        assert p.bucket_rows(17) == 32
+
+    def test_pad_zero_extends_axis0_only(self):
+        p = BucketPolicy(edges=(4,))
+        x = np.arange(6, dtype=np.float32).reshape(3, 2)
+        padded = p.pad(x)
+        assert padded.shape == (4, 2)
+        np.testing.assert_array_equal(padded[:3], x)
+        np.testing.assert_array_equal(padded[3:], 0.0)
+        y = np.ones((4, 2), np.float32)
+        assert p.pad(y) is y  # already on an edge: no copy
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            BucketPolicy(edges=())
+        with pytest.raises(ValueError):
+            BucketPolicy(max_batch=0)
+        with pytest.raises(ValueError):
+            BucketPolicy().bucket_rows(0)
+
+
+class TestPendingBatch:
+    def test_stack_orders_and_pads(self):
+        p = BucketPolicy(edges=(1, 2, 4, 8))
+        a = Request("e", np.full((2, 3), 1.0, np.float32))
+        b = Request("e", np.full((1, 3), 2.0, np.float32))
+        batch = PendingBatch(("e", (3,), "<f4"))
+        batch.add(a)
+        batch.add(b)
+        assert batch.rows == 3
+        assert batch.born == a.enqueue_t  # oldest member drives the timer
+        stacked = batch.stack(p)
+        assert stacked.shape == (4, 3)
+        np.testing.assert_array_equal(stacked[:2], 1.0)
+        np.testing.assert_array_equal(stacked[2], 2.0)
+        np.testing.assert_array_equal(stacked[3], 0.0)
+
+
+class TestServeService:
+    def _warm(self, service, endpoint, cols, buckets, seed=7):
+        """One cold dispatch per bucket; each drains ALONE (flush() sets
+        the barrier without blocking, so back-to-back submits would
+        coalesce into one grouped batch and leave small buckets cold)."""
+        rng = np.random.default_rng(seed)
+        for b in buckets:
+            r = service.submit(endpoint, rng.normal(size=(b, cols)).astype(np.float32))
+            service.flush()
+            r.result(60)
+
+    def test_warm_requests_replay_cached_programs(self):
+        """The tentpole claim: after one cold pass per bucket, served
+        requests run 0 traces / 0 compiles and match the numpy oracle."""
+        cols = 8
+        rng = np.random.default_rng(1)
+        w_np = rng.normal(size=(cols, 4)).astype(np.float32)
+        w = ht.array(w_np)
+
+        @ht.fuse
+        def pipe(x):
+            return ht.argmax(x @ w, axis=1)
+
+        payloads = [rng.normal(size=(r, cols)).astype(np.float32) for r in (1, 3, 2, 4, 1)]
+        oracles = [np.argmax(p @ w_np, axis=1) for p in payloads]
+        with ServeService(policy=BucketPolicy(edges=(1, 2, 4, 8), max_batch=8)) as s:
+            s.register_endpoint("pipe", pipe)
+            self._warm(s, "pipe", cols, (1, 2, 4, 8))
+            reset_serve_stats()
+            region = Region("warm serve")
+            requests = [s.submit("pipe", p) for p in payloads]
+            s.flush()
+            results = [r.result(60) for r in requests]
+            assert region.traces == 0, region.stats()
+            assert region.compiles == 0, region.stats()
+            stats = s.stats()
+        assert stats["bucket_misses"] == 0, stats
+        assert stats["errors"] == 0, stats
+        assert stats["requests"] == len(payloads)
+        assert stats["padded_rows"] > 0  # 11 rows cannot tile the menu exactly
+        assert stats["p99_latency_ms"] >= stats["p50_latency_ms"] > 0.0
+        for got, want in zip(results, oracles):
+            np.testing.assert_array_equal(got, want)
+
+    def test_multi_tenant_batches_never_mix_endpoints(self):
+        cols = 6
+        a = ht.array(np.full((cols,), 2.0, np.float32))
+        b = ht.array(np.full((cols,), -1.0, np.float32))
+        with ServeService(policy=BucketPolicy(edges=(1, 2, 4), max_batch=4)) as s:
+            s.register_endpoint("double", lambda x: x * a)
+            s.register_endpoint("negate", lambda x: x * b)
+            rng = np.random.default_rng(2)
+            pairs = []
+            for i in range(10):
+                p = rng.normal(size=(1 + i % 3, cols)).astype(np.float32)
+                pairs.append((p, s.submit("double" if i % 2 else "negate", p), i % 2))
+            s.flush()
+            for p, r, doubled in pairs:
+                np.testing.assert_allclose(
+                    r.result(60), p * (2.0 if doubled else -1.0), rtol=1e-6
+                )
+
+    def test_timer_flush_dispatches_partial_batches(self):
+        # single controller: the latency trigger must flush a lone
+        # request with no explicit barrier
+        with ServeService(policy=BucketPolicy(max_batch=64, max_latency_ms=5.0)) as s:
+            s.register_endpoint("id", lambda x: x)
+            p = np.ones((2, 3), np.float32)
+            np.testing.assert_array_equal(s.submit("id", p).result(30), p)
+
+    def test_error_delivery_and_survival(self):
+        reset_serve_stats()
+        with ServeService(policy=BucketPolicy(edges=(1, 2), max_batch=2)) as s:
+            def boom(x):
+                raise RuntimeError("model exploded")
+
+            s.register_endpoint("boom", boom)
+            s.register_endpoint("id", lambda x: x)
+            bad = s.submit("boom", np.ones((1, 2), np.float32))
+            s.flush()
+            with pytest.raises(RuntimeError, match="model exploded"):
+                bad.result(60)
+            # the dispatcher survives: the next request is served normally
+            p = np.full((2, 2), 3.0, np.float32)
+            np.testing.assert_array_equal(s.submit("id", p).result(60), p)
+            assert s.stats()["errors"] == 1
+        with pytest.raises(KeyError):
+            s_closed = ServeService()
+            try:
+                s_closed.submit("nope", np.ones((1, 1)))
+            finally:
+                s_closed.close()
+
+    def test_submit_validation(self):
+        with ServeService() as s:
+            s.register_endpoint("id", lambda x: x)
+            with pytest.raises(ValueError):
+                s.submit("id", np.float32(3.0))  # 0-d: no rows axis
+        with pytest.raises(RuntimeError):
+            s.submit("id", np.ones((1, 1), np.float32))  # closed
+
+    def test_register_model_resolves_at_dispatch_time(self):
+        km = _fitted_kmeans(seed=3)
+        x = np.random.default_rng(4).normal(size=(5, 6)).astype(np.float32)
+        want = km.predict(ht.array(x, split=0)).numpy()
+        with ServeService(policy=BucketPolicy(edges=(8,), max_batch=8)) as s:
+            s.register_model("km", km)
+            assert s.endpoints() == ["km.predict"]
+            np.testing.assert_array_equal(
+                np.asarray(s.predict("km", x, timeout=60)).ravel(), want.ravel()
+            )
+            # refresh: re-register swaps the model behind the SAME endpoint
+            km2 = _fitted_kmeans(seed=5)
+            want2 = km2.predict(ht.array(x, split=0)).numpy()
+            s.submit_call(lambda: s.registry.register("km", km2)).result(60)
+            np.testing.assert_array_equal(
+                np.asarray(s.predict("km", x, timeout=60)).ravel(), want2.ravel()
+            )
+
+    def test_feed_streams_partial_fit_on_dispatcher_thread(self):
+        rng = np.random.default_rng(6)
+        theta = np.array([[1.5], [-2.0], [0.5]], np.float32)
+        model = Lasso(lam=0.0, max_iter=5)
+
+        def chunks():
+            for _ in range(6):
+                x = rng.normal(size=(16, 2)).astype(np.float32)
+                y = np.hstack([np.ones((16, 1), np.float32), x]) @ theta
+                yield (ht.array(x, split=0), ht.array(y, split=0))
+
+        with ServeService() as s:
+            s.registry.register("lasso", model)
+            applied = s.feed("lasso", chunks(), depth=2, timeout=60)
+        assert applied == 6
+        assert model.coef_ is not None  # the updates actually landed
+        assert model.state_dict()["theta"].shape[-1] == 1
+
+    def test_supervised_snapshot_restore_loop(self, tmp_path):
+        """snapshot_every=1 + a dispatch error rolls the resident model
+        back to the last good snapshot (the supervised-service loop)."""
+        km = _fitted_kmeans(seed=8)
+        good_centers = km.state_dict()["cluster_centers"].copy()
+        x = np.random.default_rng(9).normal(size=(4, 6)).astype(np.float32)
+        with ServeService(
+            policy=BucketPolicy(edges=(4,), max_batch=4),
+            snapshot_dir=str(tmp_path),
+            snapshot_every=1,
+        ) as s:
+            s.register_model("km", km)
+            s.predict("km", x, timeout=60)  # 1 good batch -> snapshot taken
+            # corrupt the resident state, ordered on the dispatcher thread
+            s.submit_call(
+                lambda: km.load_state_dict(
+                    dict(km.state_dict(), cluster_centers=np.zeros_like(good_centers))
+                )
+            ).result(60)
+            assert not np.array_equal(
+                km.state_dict()["cluster_centers"], good_centers
+            )
+            def boom(x):
+                raise RuntimeError("poison")
+
+            s.register_endpoint("boom", boom)
+            bad = s.submit("boom", x)
+            s.flush()
+            with pytest.raises(RuntimeError):
+                bad.result(60)
+            s.drain(60)  # restore runs on the dispatcher, after the error
+            np.testing.assert_allclose(
+                km.state_dict()["cluster_centers"], good_centers, rtol=1e-6
+            )
+
+
+class TestModelRegistry:
+    def test_registry_basics(self):
+        reg = ModelRegistry()
+        with pytest.raises(ValueError):
+            reg.register("a/b", object())
+        reg.register("m", 1)
+        assert "m" in reg and reg.names() == ["m"]
+        assert reg.get("m") == 1
+        reg.remove("m")
+        with pytest.raises(KeyError, match="no model registered"):
+            reg.get("m")
+
+    def test_snapshot_restore_round_trip(self, tmp_path):
+        km = _fitted_kmeans(seed=10)
+        lasso = Lasso(lam=0.01, max_iter=3)
+        rng = np.random.default_rng(11)
+        lasso.fit(
+            ht.array(rng.normal(size=(32, 2)).astype(np.float32), split=0),
+            ht.array(rng.normal(size=(32, 1)).astype(np.float32), split=0),
+        )
+        reg = ModelRegistry()
+        reg.register("km", km)
+        reg.register("lasso", lasso)
+        reg.register("opaque", object())  # no state_dict: listed, skipped
+        reg.snapshot(str(tmp_path))
+
+        km_centers = km.state_dict()["cluster_centers"].copy()
+        theta = lasso.state_dict()["theta"].copy()
+        km.load_state_dict(
+            dict(km.state_dict(), cluster_centers=np.zeros_like(km_centers))
+        )
+        lasso.load_state_dict(dict(lasso.state_dict(), theta=np.zeros_like(theta)))
+
+        restored = reg.restore(str(tmp_path))
+        assert sorted(restored) == ["km", "lasso"]
+        np.testing.assert_allclose(
+            km.state_dict()["cluster_centers"], km_centers, rtol=1e-6
+        )
+        np.testing.assert_allclose(lasso.state_dict()["theta"], theta, rtol=1e-6)
+
+    def test_restore_subset(self, tmp_path):
+        km = _fitted_kmeans(seed=12)
+        reg = ModelRegistry()
+        reg.register("km", km)
+        reg.snapshot(str(tmp_path))
+        assert reg.restore(str(tmp_path), names=["other"]) == []
+
+
+class TestServeStats:
+    def test_latency_percentiles_and_depth_gauges(self):
+        reset_serve_stats()
+        from heat_tpu.core import _hooks
+
+        for ms in (1.0, 2.0, 3.0, 4.0, 100.0):
+            _hooks.observe("serve.latency", ms=ms)
+        _hooks.observe("serve.request", depth=3)
+        _hooks.observe("serve.request", depth=7)
+        _hooks.observe("serve.request", depth=2)
+        serve.refresh_latency_stats()
+        assert SERVE_STATS["p50_latency_ms"] == 3.0
+        assert SERVE_STATS["p99_latency_ms"] == 100.0
+        assert SERVE_STATS["queue_depth"] == 2
+        assert SERVE_STATS["max_queue_depth"] == 7
+        reset_serve_stats()
+        assert SERVE_STATS["max_queue_depth"] == 0
+
+
+class TestServeConcurrency:
+    """Satellite 3: the thread-safety contracts the serving layer needs."""
+
+    def test_lazy_scopes_are_thread_local(self):
+        seen = {}
+
+        def other_thread():
+            # a scope opened on the main thread must be invisible here
+            seen["scopes"] = list(_capture._scopes())
+            seen["active"] = _capture.active()
+
+        with ht.lazy():
+            t = threading.Thread(target=other_thread)
+            t.start()
+            t.join()
+            assert _capture.active()  # still open on the opening thread
+        assert seen["scopes"] == []
+        assert seen["active"] is False
+
+    def test_concurrent_warm_fused_trips_keep_exact_counters(self):
+        """8 threads replaying one warm fused program: FUSE_STATS counts
+        exactly (no lost updates), no eager fallbacks, and PROGRAM_CACHE
+        does not thrash."""
+        cols = 8
+        w = ht.array(np.random.default_rng(13).normal(size=(cols,)).astype(np.float32))
+        x_np = np.random.default_rng(14).normal(size=(16, cols)).astype(np.float32)
+        want = (x_np * 2.0 + 1.0) * np.asarray(w._raw)
+
+        x = ht.array(x_np, split=0)
+
+        def trip():
+            # materialize AFTER scope exit: .numpy() inside an open scope
+            # is a forced mid-capture evaluation and counts as a fallback
+            with ht.lazy():
+                y = (x * 2.0 + 1.0) * w
+            return y.numpy()
+
+        np.testing.assert_allclose(trip(), want, rtol=1e-6)  # cold
+        threads, errors = [], []
+        n_threads, n_trips = 8, 25
+        reset_fuse_stats()
+        cache_before = len(_evaluate.PROGRAM_CACHE)
+
+        def worker():
+            try:
+                for _ in range(n_trips):
+                    np.testing.assert_allclose(trip(), want, rtol=1e-6)
+            except Exception as exc:  # noqa: BLE001 - reported to the test
+                errors.append(exc)
+
+        barrier = threading.Barrier(n_threads)
+
+        def synced():
+            barrier.wait()
+            worker()
+
+        for _ in range(n_threads):
+            t = threading.Thread(target=synced)
+            threads.append(t)
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        total = n_threads * n_trips
+        assert FUSE_STATS["fused_dispatches"] == total, FUSE_STATS
+        assert FUSE_STATS["cache_hits"] == total, FUSE_STATS
+        assert FUSE_STATS["eager_fallbacks"] == 0, FUSE_STATS
+        assert len(_evaluate.PROGRAM_CACHE) == cache_before
+
+    def test_concurrent_submitters_one_dispatcher(self):
+        """Many client threads hammering one warm service: every result
+        correct, zero errors, zero warm compiles."""
+        cols = 4
+        w_np = np.random.default_rng(15).normal(size=(cols,)).astype(np.float32)
+        w = ht.array(w_np)
+        with ServeService(policy=BucketPolicy(edges=(1, 2, 4, 8), max_batch=8)) as s:
+            s.register_endpoint("scale", lambda x: x * w)
+            rng = np.random.default_rng(16)
+            for b in (1, 2, 4, 8):
+                r = s.submit("scale", rng.normal(size=(b, cols)).astype(np.float32))
+                s.flush()
+                r.result(60)
+            reset_serve_stats()
+            region = Region("concurrent warm serve")
+            failures = []
+
+            def client(seed):
+                crng = np.random.default_rng(seed)
+                try:
+                    for _ in range(15):
+                        p = crng.normal(
+                            size=(int(crng.integers(1, 5)), cols)
+                        ).astype(np.float32)
+                        got = s.submit("scale", p).result(60)
+                        np.testing.assert_allclose(got, p * w_np, rtol=1e-5)
+                except Exception as exc:  # noqa: BLE001 - reported to the test
+                    failures.append(exc)
+
+            threads = [threading.Thread(target=client, args=(100 + i,)) for i in range(6)]
+            for t in threads:
+                t.start()
+            # the timer trigger flushes ragged tails while clients overlap
+            for t in threads:
+                t.join()
+            s.drain(60)
+            assert not failures, failures
+            assert region.traces == 0, region.stats()
+            assert region.compiles == 0, region.stats()
+            stats = s.stats()
+        assert stats["errors"] == 0
+        assert stats["requests"] == 6 * 15
+        assert stats["bucket_misses"] == 0
+
+
+class TestKernelStatsBucketMemo:
+    """Satellite 4: repeated mixed-shape / bucket-shaped moment calls must
+    not defeat the PR 11 moments panel memo or recompile anything."""
+
+    def test_mixed_shapes_keep_memo_and_stay_warm(self):
+        arrays = [
+            ht.array(
+                np.random.default_rng(20 + n).normal(size=(n, 16)).astype(np.float32),
+                split=0,
+            )
+            for n in (8, 16, 32)  # serve-bucket shapes
+        ]
+        for a in arrays:  # cold pass: panel program + finalizers per shape
+            ht.mean(a), ht.std(a), ht.var(a)
+        live = {id(a.larray) for a in arrays}
+        assert live <= set(_statistics._PANELS), "panel memo missing live buffers"
+        reset_kernel_stats()
+        region = Region("warm mixed moments")
+        rounds = 4
+        for _ in range(rounds):
+            for a in arrays:  # alternating shapes: the memo keys by buffer
+                ht.mean(a), ht.std(a), ht.var(a)
+        assert region.traces == 0, region.stats()
+        assert region.compiles == 0, region.stats()
+        # every warm call still records its dispatch (memo hits included)
+        calls = rounds * len(arrays) * 3
+        assert KERNEL_STATS["dispatches"] == calls, KERNEL_STATS
+        assert KERNEL_STATS.get("moments_onepass.xla", 0) == calls, KERNEL_STATS
+        # no thrash: all live buffers still memoized after the sweep
+        assert live <= set(_statistics._PANELS)
+
+    def test_fresh_bucket_shaped_buffers_do_not_evict_live_memos(self):
+        """Serve batches materialize NEW buffers at a fixed bucket shape;
+        those must reuse the per-shape panel program (0 compiles) and
+        must not push live buffers out of the FIFO-capped memo."""
+        base = ht.array(
+            np.random.default_rng(30).normal(size=(8, 16)).astype(np.float32),
+            split=0,
+        )
+        ht.mean(base), ht.std(base)
+        fresh = ht.array(
+            np.random.default_rng(31).normal(size=(8, 16)).astype(np.float32),
+            split=0,
+        )
+        ht.mean(fresh)  # warms nothing new: same shape, same program
+        region = Region("fresh bucket buffers")
+        for seed in range(5):
+            x = ht.array(
+                np.random.default_rng(40 + seed)
+                .normal(size=(8, 16))
+                .astype(np.float32),
+                split=0,
+            )
+            ht.mean(x), ht.std(x)
+        ht.std(base)  # live buffer: still a memo hit, no recompute cost
+        assert region.traces == 0, region.stats()
+        assert region.compiles == 0, region.stats()
+        assert id(base.larray) in _statistics._PANELS

@@ -571,7 +571,7 @@ class TestShardGCAcrossWorldSizes(TestCase):
         must name every on-disk shard (no stale ws-8 files that a later
         save at another geometry could alias)."""
         x8 = ht.arange(24, dtype=ht.float32, split=0)
-        comm2 = ht.MeshCommunication(devices=jax.devices()[:2])
+        comm2 = ht.MeshCommunication(devices=mh.submesh(2))
         y2 = ht.arange(10, dtype=ht.float32, split=0, comm=comm2) + 100.0
         with mh.TemporaryDirectory() as d:
             rz.save_checkpoint(x8, d)
@@ -586,7 +586,7 @@ class TestShardGCAcrossWorldSizes(TestCase):
             np.testing.assert_array_equal(z.numpy(), y2.numpy())
 
     def test_resave_larger_world_roundtrips(self):
-        comm2 = ht.MeshCommunication(devices=jax.devices()[:2])
+        comm2 = ht.MeshCommunication(devices=mh.submesh(2))
         x2 = ht.arange(10, dtype=ht.float32, split=0, comm=comm2)
         y8 = ht.arange(24, dtype=ht.float32, split=0) * 3.0
         with mh.TemporaryDirectory() as d:

@@ -140,6 +140,39 @@ def validate(line: str, obj: dict) -> None:
                 f"{obj.get('moments_onepass_warm_compiles')!r}: the warm "
                 "one-pass moments sweep recompiled"
             )
+    # serving-layer gates (r13). Absent when the serve subprocess failed
+    # (the driver folds a serve_error note instead) — absence is not a
+    # violation, a present-but-failing value is.
+    if "serve_requests_per_sec" in obj:
+        rps = obj["serve_requests_per_sec"]
+        if not isinstance(rps, (int, float)) or isinstance(rps, bool) or rps <= 0:
+            raise ValueError(
+                f"'serve_requests_per_sec' must be a positive number, got "
+                f"{rps!r}: the serving load generator completed no requests"
+            )
+        speedup = obj.get("serve_batched_speedup")
+        if not isinstance(speedup, (int, float)) or isinstance(speedup, bool):
+            raise ValueError(
+                f"'serve_batched_speedup' must be numeric, got {speedup!r}"
+            )
+        if speedup < 1.5:
+            raise ValueError(
+                f"serve_batched_speedup {speedup} < 1.5: shape-bucketed "
+                "batching is not beating per-request dispatch at the same "
+                "offered load — the serving layer's one reason to exist"
+            )
+        if obj.get("serve_warm_compiles") != 0:
+            raise ValueError(
+                f"serve_warm_compiles must be 0, got {obj.get('serve_warm_compiles')!r}: "
+                "a warm serving request traced or compiled — the resident "
+                "service is not replaying cached programs"
+            )
+        if obj.get("serve_lockstep_divergences") != 0:
+            raise ValueError(
+                "serve_lockstep_divergences must be 0, got "
+                f"{obj.get('serve_lockstep_divergences')!r}: concurrent "
+                "serving batches issued collectives out of lockstep"
+            )
     if "stream_speedup" in obj:
         # reported only on hosts with a core to run the producer on (the
         # worker emits a stream_overlap note instead on single-core hosts)
