@@ -74,6 +74,17 @@ async dispatch). Warm passes are counter-asserted to 0 compiles/0 traces
 and the streaming results are oracle-checked in-worker against the
 in-memory ``ht.mean``/``ht.var``/``ht.cov``/``ht.histogram``.
 
+A ninth, ``frame_groupby`` (``bench.py --frame-worker``, same
+subprocess pattern), drives the sort-based shuffle engine: a
+``Frame.groupby(key).sum()`` over 2^16 rows at key cardinalities 16 /
+4096 / 2^16, counter-asserted to exactly ONE bucketed exchange per
+operand (``MOVE_STATS["bucket_moves"]``) and 0 warm compiles/traces,
+oracle-checked against numpy in-worker. Two comparator rows: a raw-jnp
+``jax.ops.segment_sum`` program (the single-device speed-of-light) and
+the sort-then-loop decomposition a user would write from the existing
+public API (``ht.sort`` + one masked reduction per key) — the engine
+must beat the latter >= 2x at low cardinality (gated by bench_check).
+
 Protocol r7 additionally bounds the two DMA-overlap-banded kernel
 diagnostics (``OVERLAP_BAND``): their best/best_median can never ratchet
 beyond 1.2x the trailing clean median, retiring the stale single-run
@@ -611,6 +622,7 @@ def main():
     out.update(fused_bench())
     out.update(stream_bench())
     out.update(serve_bench())
+    out.update(frame_bench())
     detail_path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json"
     )
@@ -1026,6 +1038,160 @@ def stream_worker():
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+FRAME_ROWS = 1 << 16
+FRAME_CARDS = (16, 4096, 1 << 16)
+FRAME_GATE_CARD = 16  # the sort-then-loop comparator runs here
+
+
+def frame_worker():
+    """Subprocess body for the ``frame_groupby`` workload: distributed
+    groupby-sum through the shuffle engine at three key cardinalities.
+
+    The engine's contract is asserted, not assumed, on the warm repeat:
+    exactly ONE bucketed exchange per operand (key + value = 2 bucket
+    moves per groupby, read from ``MOVE_STATS["bucket_moves"]``) and 0
+    compiles / 0 traces (``Region``) — a warm groupby replays cached
+    executables end to end. Results are oracle-checked against
+    ``np.bincount`` per cardinality (divergences counted).
+
+    Comparators: ``frame_jnp_rows_per_s`` is a jitted global
+    ``jax.ops.segment_sum`` — the no-distribution speed-of-light for the
+    same reduction; ``frame_loop_rows_per_s`` is the sort-then-loop
+    decomposition available from the public API before this layer
+    (``ht.sort`` once, then one masked ``(x * (k == u)).sum()`` reduction
+    per key): its dispatch count scales with cardinality, which is
+    exactly the per-key traffic the shuffle engine exists to avoid.
+    ``frame_groupby_speedup`` (engine over sort-then-loop at cardinality
+    16) is gated >= 2.0 by tools/bench_check.py.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_platforms", "cpu")
+    import heat_tpu as ht
+    from heat_tpu.analysis.sanitizer import Region
+    from heat_tpu.parallel.flatmove import MOVE_STATS
+
+    n = FRAME_ROWS
+    rng = np.random.default_rng(11)
+    x = rng.standard_normal(n).astype(np.float32)
+    divergences = 0
+    warm_compiles = 0
+    exchanges_per_operand = set()
+    by_card = {}
+    result = {}
+    for card in FRAME_CARDS:
+        keys = rng.integers(0, card, n).astype(np.int32)
+        f = ht.Frame({"k": keys, "x": x})
+        f.groupby("k").sum()  # cold pass compiles the engine programs
+
+        before = MOVE_STATS["bucket_moves"]
+        region = Region(f"warm frame groupby card={card}")
+        g = f.groupby("k").sum()
+        warm_compiles += region.compiles + region.traces
+        moves = MOVE_STATS["bucket_moves"] - before
+        # 2 operands (key column + one value column) -> 2 bucket moves
+        assert moves == 2, (card, moves)
+        exchanges_per_operand.add(moves // 2)
+
+        d = {k: np.asarray(c._logical()) for k, c in g._cols.items()}
+        oracle = np.bincount(keys, weights=x.astype(np.float64), minlength=card)
+        present = np.unique(keys)
+        if not (
+            np.array_equal(d["k"], present)
+            and np.allclose(d["x"], oracle[present], rtol=1e-3, atol=1e-3)
+        ):
+            divergences += 1
+
+        def trip():
+            out = f.groupby("k").sum()
+            np.asarray(out["x"]._raw)  # host fence
+
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            trip()
+            best = min(best, time.perf_counter() - t0)
+        by_card[str(card)] = round(n / best, 1)
+
+        if card == FRAME_GATE_CARD:
+            # sort-then-loop decomposition from the pre-frame public API
+            kh = ht.array(keys, split=0)
+            xh = ht.array(x, split=0)
+
+            def loop_trip():
+                ht.sort(kh)  # co-locate equal keys, as the engine does
+                sums = [
+                    (xh * (kh == u).astype(ht.float32)).sum() for u in range(card)
+                ]
+                np.asarray(sums[-1].larray)  # host fence
+
+            loop_trip()  # warm
+            lbest = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                loop_trip()
+                lbest = min(lbest, time.perf_counter() - t0)
+            result["frame_loop_rows_per_s"] = round(n / lbest, 1)
+            result["frame_groupby_speedup"] = round(lbest / best, 2)
+
+            # raw-jnp comparator: one global segment_sum, no distribution
+            seg = jax.jit(  # graftlint: G001 - one-shot comparator, warmed then timed
+                lambda k, v: jax.ops.segment_sum(v, k, num_segments=card)
+            )
+            kj, xj = jnp.asarray(keys), jnp.asarray(x)
+            np.asarray(seg(kj, xj))  # warm
+            jbest = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                np.asarray(seg(kj, xj))
+                jbest = min(jbest, time.perf_counter() - t0)
+            result["frame_jnp_rows_per_s"] = round(n / jbest, 1)
+
+    result.update(
+        {
+            "frame_groupby_rows_per_s": by_card[str(FRAME_GATE_CARD)],
+            "frame_groupby_rows_per_s_by_card": by_card,
+            "frame_warm_compiles": int(warm_compiles),
+            "frame_divergences": int(divergences),
+            "frame_exchanges_per_operand": max(exchanges_per_operand),
+            "frame_unit": (
+                f"rows/s through Frame.groupby(k).sum() (n={n}, key "
+                f"cardinalities {list(FRAME_CARDS)}, 8 virtual CPU devices; "
+                "speedup vs ht.sort + per-key masked reductions at "
+                f"cardinality {FRAME_GATE_CARD})"
+            ),
+        }
+    )
+    print(json.dumps(result))
+
+
+def frame_bench():
+    """Run the frame_groupby workload ONCE in a fresh 8-virtual-CPU-
+    device subprocess and fold its JSON line into the output; a failure
+    degrades to a ``frame_error`` field, never kills the bench."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--frame-worker"],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=900,
+        )
+        lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+        if proc.returncode != 0 or not lines:
+            return {"frame_error": (proc.stderr or proc.stdout or "no output")[-400:]}
+        return json.loads(lines[-1])
+    except Exception as e:  # noqa: BLE001 - diagnostics ride in the output
+        return {"frame_error": repr(e)[:400]}
+
+
 SERVE_COLS = 16
 SERVE_CLASSES = 8
 SERVE_REQUESTS = 192
@@ -1334,6 +1500,14 @@ def _compact_summary(out, detail_path):
         "serve_warm_compiles",
         "serve_lockstep_divergences",
         "serve_error",
+        "frame_groupby_rows_per_s",
+        "frame_groupby_speedup",
+        "frame_loop_rows_per_s",
+        "frame_jnp_rows_per_s",
+        "frame_warm_compiles",
+        "frame_divergences",
+        "frame_exchanges_per_operand",
+        "frame_error",
         "lockstep_events",
         "lockstep_divergences",
         "kmeans_fused_ratio",
@@ -2082,5 +2256,7 @@ if __name__ == "__main__":
         stream_worker()
     elif "--serve-worker" in sys.argv:
         serve_worker()
+    elif "--frame-worker" in sys.argv:
+        frame_worker()
     else:
         main()

@@ -22,6 +22,7 @@ from . import resilience
 from . import serve
 from . import spatial
 from . import stream
+from . import frame
 from . import utils
 from .core import random
 from .core import version
@@ -40,6 +41,7 @@ from .core.lazy import FUSE_STATS
 from .stream import STREAM_STATS
 from .core.kernels import KERNEL_STATS
 from .serve import SERVE_STATS
+from .frame import Frame, SHUFFLE_STATS
 
 
 def __getattr__(name: str):

@@ -704,16 +704,26 @@ class DNDarray:
         from ..parallel.flatmove import ragged_move
 
         split = self.__split
+        p = self.__comm.size
         cur = tuple(int(c) for c in self.lshape_map[:, split])
-        if counts == cur:
-            return self
-        _hooks.trace_barrier("redistribute_")
         canonical = self.__comm.counts_displs_shape(self.__gshape, split)[0]
         b_out = max(1, max(counts))
         if counts == tuple(canonical):
             # target IS the canonical map: land exactly on the canonical
             # padded buffer and drop the ragged state
-            b_out = self.__comm.padded_dim(self.__gshape[split]) // self.__comm.size
+            b_out = self.__comm.padded_dim(self.__gshape[split]) // p
+        if counts == cur and self.__array.shape[split] // p == b_out:
+            # already in the target layout PHYSICALLY (counts alone are
+            # not enough: a ragged buffer whose counts happen to equal a
+            # map can still carry a wider block — e.g. a shuffle result
+            # whose group counts coincide with the ceil-div map)
+            if counts == tuple(canonical) and self.__lcounts is not None:
+                self.__lcounts = None
+                self.__array = _place(
+                    self.__array, self.__comm, split, self.__gshape, force=True
+                )
+            return self
+        _hooks.trace_barrier("redistribute_")
         buf = ragged_move(self.__array, split, cur, counts, b_out, self.__comm)
         if counts == tuple(canonical):
             self.__lcounts = None

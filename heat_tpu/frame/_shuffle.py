@@ -1,0 +1,672 @@
+"""Sort-based distributed shuffle engine — the frame layer's substrate.
+
+A shuffle moves every row to the device that owns its key, so that any
+per-key computation (groupby aggregation, hash join, value counts)
+becomes device-local afterwards. MPI frameworks express this as one
+``Alltoallv`` with data-dependent bucket sizes; XLA programs need static
+shapes, so the TPU-native formulation splits the same work into three
+cached jitted programs plus ONE bounded bucketed exchange per operand:
+
+1. **plan** (one program): locally sort rows by key (pads last), fold
+   duplicate keys with a segment-reduce into per-shard *partials* (at
+   most one row per distinct local key — the combiner that makes low
+   cardinality cheap), elect range splitters from per-shard key samples
+   via one ``all_gather`` (replicated by construction — every device
+   computes identical splitters, the sample-sort election), tag each
+   partial with its destination partition, sort by destination, and
+   ``all_gather`` the per-destination counts into the replicated P×P
+   bucket matrix.
+2. **exchange**: the host materializes the (tiny) bucket matrix — the
+   same bounded host sync ``redistribute_`` performs for its target
+   map — and dispatches :func:`heat_tpu.parallel.flatmove.bucket_move`
+   once per operand column: colored ``ppermute`` matchings, counted in
+   ``MOVE_STATS``, watchdog-bounded. No per-key traffic, ever.
+3. **merge** (one program): locally sort the received partials by key
+   and segment-reduce again with each statistic's combiner (sums add,
+   counts add, mins min, maxs max) — legal because every statistic
+   carried here is associative and commutative, the same contract as
+   :class:`heat_tpu.stream.StreamingMoments.merge`.
+
+Partition decisions are REPLICATED at every step: splitters come out of
+an ``all_gather`` inside the program, bucket matrices are identical on
+every process (same program, same inputs), and the host-side schedule is
+derived from those replicated values only — lockstep-clean at ws>1 by
+construction, no rank ever branches on local state.
+
+Program caching: plan/merge/join programs are keyed by (shape, dtypes,
+statistics, partition mode, mesh) — all data-independent — so a warm
+repeat is 0 traces / 0 compiles (Region-asserted in tests and bench).
+The exchange program is keyed by the bucket matrix (data-dependent, like
+the ragged redistribute it generalizes): repeated shuffles of the same
+data replay cached executables end to end.
+
+Key semantics: keys order by ``lax.sort``'s total order (NaN sorts
+last; each NaN is its own group since NaN != NaN — pass integer keys
+for pandas-like grouping). ``-0.0`` and ``0.0`` hash identically.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax, shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..core._cache import ExecutableCache
+from ..core.communication import SPLIT_AXIS, MeshCommunication, collective_lockstep
+from ..core.dndarray import DNDarray
+from ..parallel.flatmove import bucket_move
+
+__all__ = [
+    "SHUFFLE_STATS",
+    "shard_counts",
+    "groupby_reduce",
+    "shuffle_rows",
+    "hash_join",
+    "compact_rows",
+    "STAT_COMBINE",
+]
+
+# one entry per (geometry, dtypes, stats, mode) — warm shuffles replay
+_PROGRAMS = ExecutableCache(maxsize=128)
+
+# running counters: tests and bench read these alongside MOVE_STATS to
+# assert the engine's exchange budget and cache behavior
+SHUFFLE_STATS = {"groupbys": 0, "joins": 0, "compactions": 0}
+
+# how each statistic kind folds in the merge stage (all associative)
+STAT_COMBINE = {"sum": "sum", "sumsq": "sum", "count": "sum", "min": "min", "max": "max"}
+
+# splitter-election oversampling per shard (sample-sort: s samples per
+# shard bound the heaviest partition by ~n/P * (1 + 1/s))
+_OVERSAMPLE = 32
+
+
+def shard_counts(col: DNDarray) -> Tuple[int, ...]:
+    """Per-shard valid-row counts of a split-0 column — ``lcounts`` for a
+    ragged layout, the canonical ceil-div map otherwise. Pure metadata."""
+    if col.lcounts is not None:
+        return tuple(int(c) for c in col.lcounts)
+    counts, _, _ = col.comm.counts_displs_shape(col.gshape, 0)
+    return tuple(int(c) for c in counts)
+
+
+# --------------------------------------------------------------- kernel pieces
+def _max_key(dtype) -> np.ndarray:
+    dt = np.dtype(dtype)
+    if dt.kind == "f":
+        return np.asarray(np.inf, dt)
+    if dt.kind == "b":
+        return np.asarray(True)
+    return np.asarray(np.iinfo(dt).max, dt)
+
+
+def _neutral(kind: str, dtype) -> np.ndarray:
+    dt = np.dtype(dtype)
+    if kind in ("sum", "sumsq", "count"):
+        return np.asarray(0, dt)
+    if kind == "min":
+        return _max_key(dt)
+    if dt.kind == "f":
+        return np.asarray(-np.inf, dt)
+    if dt.kind == "b":
+        return np.asarray(False)
+    return np.asarray(np.iinfo(dt).min, dt)
+
+
+def _sort_by_key(keys, pad, payloads):
+    """Stable local sort: pads last, then ascending key (lax.sort's total
+    order — NaN last), ties by position. Returns (sorted_keys,
+    sorted_pad, sorted_payloads)."""
+    b = keys.shape[0]
+    iota = lax.iota(jnp.int32, b)
+    k = keys.astype(jnp.int8) if keys.dtype == jnp.bool_ else keys
+    ops = lax.sort((pad.astype(jnp.int32), k, iota), num_keys=3, is_stable=True)
+    perm = ops[2]
+    return keys[perm], ops[0].astype(jnp.bool_), [v[perm] for v in payloads]
+
+
+def _hash_pid(keys, p: int):
+    """Destination partition of each key under multiplicative hashing.
+    Equal keys (incl. -0.0 vs 0.0) always land on the same partition."""
+    if jnp.issubdtype(keys.dtype, jnp.floating):
+        z = jnp.where(keys == 0, jnp.zeros_like(keys), keys)
+        if keys.dtype == jnp.float64:
+            bits = lax.bitcast_convert_type(z, jnp.uint64).astype(jnp.uint32)
+        else:
+            bits = lax.bitcast_convert_type(z.astype(jnp.float32), jnp.uint32)
+    elif keys.dtype == jnp.bool_:
+        bits = keys.astype(jnp.uint32)
+    else:
+        bits = keys.astype(jnp.uint32)
+    h = (bits * jnp.uint32(2654435761)) ^ (bits >> jnp.uint32(13))
+    return (h % jnp.uint32(p)).astype(jnp.int32)
+
+
+def _range_pid(keys, splitters):
+    """Destination partition under elected range splitters (sorted,
+    length P-1): equal keys compare identically so they co-locate, and
+    partitions cover contiguous key ranges in rank order."""
+    k = keys.astype(jnp.int8) if keys.dtype == jnp.bool_ else keys
+    s = splitters.astype(k.dtype) if splitters.dtype != k.dtype else splitters
+    return jnp.searchsorted(s, k, side="right").astype(jnp.int32)
+
+
+def _elect(sorted_keys, sorted_pad, n, p: int):
+    """Range splitters from one locally sorted key block: s evenly spaced
+    samples per shard (pads replaced by the max key so empty shards do
+    not skew downward), one all_gather, sort, take the P-1 quantiles.
+    Replicated by construction — every device computes the same values."""
+    b = sorted_keys.shape[0]
+    mk = jnp.asarray(_max_key(sorted_keys.dtype))
+    sk = jnp.where(sorted_pad, mk, sorted_keys)
+    idx = jnp.clip((lax.iota(jnp.int32, _OVERSAMPLE) * n) // jnp.maximum(n, 1), 0, b - 1)
+    smp = jnp.where(n > 0, sk[idx], jnp.full((_OVERSAMPLE,), mk))
+    g = lax.all_gather(smp, SPLIT_AXIS, tiled=True)
+    gs = jnp.sort(g)
+    m = gs.shape[0]
+    pos = (jnp.arange(1, p) * m) // p
+    return gs[pos]
+
+
+def _segments(sorted_keys, valid):
+    """(is_start, segment_ids, n_segments) of equal-key runs in a sorted
+    block; invalid rows get the out-of-range segment (dropped by the
+    segment reducers)."""
+    b = sorted_keys.shape[0]
+    prev = jnp.concatenate([sorted_keys[:1], sorted_keys[:-1]])
+    first = lax.iota(jnp.int32, b) == 0
+    is_start = valid & (first | (sorted_keys != prev))
+    seg = jnp.cumsum(is_start.astype(jnp.int32)) - 1
+    segv = jnp.where(valid, seg, b)
+    return is_start, segv, jnp.sum(is_start.astype(jnp.int32))
+
+
+def _segment_reduce(kind: str, data, valid, segv, b: int):
+    neutral = jnp.asarray(_neutral(kind, data.dtype))
+    masked = jnp.where(valid, data, neutral)
+    if STAT_COMBINE[kind] == "sum":
+        return jax.ops.segment_sum(masked, segv, num_segments=b)
+    if STAT_COMBINE[kind] == "min":
+        return jax.ops.segment_min(masked, segv, num_segments=b)
+    return jax.ops.segment_max(masked, segv, num_segments=b)
+
+
+def _scatter_starts(values, segv, fill, b: int):
+    """Per-segment representative (all rows of a segment carry the same
+    key, so duplicate scatter writes agree)."""
+    return jnp.full((b,), jnp.asarray(fill), values.dtype).at[segv].set(
+        values, mode="drop"
+    )
+
+
+def _dest_matrix(pid, p: int):
+    """This shard's per-destination counts, all_gathered into the
+    replicated P×P bucket matrix (row = source, column = destination)."""
+    row = jnp.sum(
+        pid[None, :] == lax.iota(jnp.int32, p)[:, None], axis=1
+    ).astype(jnp.int32)
+    return lax.all_gather(row, SPLIT_AXIS)
+
+
+# ------------------------------------------------------------------- programs
+def _plan_executable(
+    pshape: Tuple[int, ...],
+    key_dtype,
+    val_dtypes: Tuple[str, ...],
+    stats: Tuple[Tuple[str, int, str], ...],
+    p: int,
+    mode: str,
+    comm: MeshCommunication,
+):
+    """The groupby plan program: local sort → segment-reduce partials →
+    splitter election → destination tagging → destination-major sort →
+    replicated bucket matrix. One dispatch, data-independent cache key."""
+    mesh = comm.mesh
+    key = ("plan", pshape, str(key_dtype), val_dtypes, stats, p, mode, mesh)
+    fn = _PROGRAMS.get(key)
+    if fn is not None:
+        return fn
+    b = pshape[0] // p
+
+    def kernel(kb, counts, *vals):
+        r = lax.axis_index(SPLIT_AXIS)
+        n = counts[r]
+        pad = lax.iota(jnp.int32, b) >= n
+        sk, sp, svals = _sort_by_key(kb, pad, list(vals))
+        valid = ~sp
+        _, segv, u = _segments(sk, valid)
+        ukeys = _scatter_starts(sk, segv, _max_key(sk.dtype), b)
+        parts = []
+        for kind, ci, odt in stats:
+            dt = jnp.dtype(odt)
+            data = (
+                valid.astype(dt)
+                if kind == "count"
+                else svals[ci].astype(dt) ** 2
+                if kind == "sumsq"
+                else svals[ci].astype(dt)
+            )
+            parts.append(_segment_reduce(kind, data, valid, segv, b))
+        upad = lax.iota(jnp.int32, b) >= u
+        if mode == "range":
+            splitters = _elect(ukeys, upad, u, p)
+            pid = _range_pid(ukeys, splitters)
+        else:
+            pid = _hash_pid(ukeys, p)
+        pid = jnp.where(upad, p, pid)
+        iota = lax.iota(jnp.int32, b)
+        perm = lax.sort((pid, iota), num_keys=2, is_stable=True)[1]
+        mat = _dest_matrix(pid, p)
+        uvec = lax.all_gather(u, SPLIT_AXIS)
+        return (ukeys[perm], *[s[perm] for s in parts], mat, uvec)
+
+    spec = P(SPLIT_AXIS)
+    in_specs = (spec, P(), *([spec] * len(val_dtypes)))
+    out_specs = (spec, *([spec] * len(stats)), P(), P())
+    prog = shard_map(kernel, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False)
+    fn = _PROGRAMS[key] = jax.jit(prog)
+    return fn
+
+
+def _merge_executable(
+    pshape: Tuple[int, ...],
+    key_dtype,
+    stats: Tuple[Tuple[str, str], ...],
+    p: int,
+    comm: MeshCommunication,
+):
+    """The post-exchange merge program: sort received partials by key,
+    segment-reduce with each statistic's associative combiner, report
+    per-shard group counts (replicated)."""
+    mesh = comm.mesh
+    key = ("gmerge", pshape, str(key_dtype), stats, p, mesh)
+    fn = _PROGRAMS.get(key)
+    if fn is not None:
+        return fn
+    b = pshape[0] // p
+
+    def kernel(kb, counts, *parts):
+        r = lax.axis_index(SPLIT_AXIS)
+        n = counts[r]
+        pad = lax.iota(jnp.int32, b) >= n
+        sk, sp, sparts = _sort_by_key(kb, pad, list(parts))
+        valid = ~sp
+        _, segv, g = _segments(sk, valid)
+        ukeys = _scatter_starts(sk, segv, _max_key(sk.dtype), b)
+        outs = [
+            _segment_reduce(kind, s, valid, segv, b)
+            for (kind, _), s in zip(stats, sparts)
+        ]
+        gvec = lax.all_gather(g, SPLIT_AXIS)
+        return (ukeys, *outs, gvec)
+
+    spec = P(SPLIT_AXIS)
+    in_specs = (spec, P(), *([spec] * len(stats)))
+    out_specs = (spec, *([spec] * len(stats)), P())
+    prog = shard_map(kernel, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False)
+    fn = _PROGRAMS[key] = jax.jit(prog)
+    return fn
+
+
+def _elect_executable(
+    pshapes: Tuple[Tuple[int, ...], ...],
+    key_dtype,
+    p: int,
+    comm: MeshCommunication,
+):
+    """Splitter election over one or more key columns (a join elects from
+    BOTH sides so the two shuffles agree on partition boundaries)."""
+    mesh = comm.mesh
+    key = ("elect", pshapes, str(key_dtype), p, mesh)
+    fn = _PROGRAMS.get(key)
+    if fn is not None:
+        return fn
+    nbufs = len(pshapes)
+
+    def kernel(*args):
+        blocks, counts = args[:nbufs], args[nbufs:]
+        r = lax.axis_index(SPLIT_AXIS)
+        mk = jnp.asarray(_max_key(blocks[0].dtype))
+        samples = []
+        for blk, cnt in zip(blocks, counts):
+            b = blk.shape[0]
+            n = cnt[r]
+            pad = lax.iota(jnp.int32, b) >= n
+            sk, sp, _ = _sort_by_key(blk, pad, [])
+            sk = jnp.where(sp, mk, sk)
+            idx = jnp.clip(
+                (lax.iota(jnp.int32, _OVERSAMPLE) * n) // jnp.maximum(n, 1), 0, b - 1
+            )
+            samples.append(jnp.where(n > 0, sk[idx], jnp.full((_OVERSAMPLE,), mk)))
+        local = jnp.concatenate(samples)
+        g = lax.all_gather(local, SPLIT_AXIS, tiled=True)
+        gs = jnp.sort(g)
+        pos = (jnp.arange(1, p) * gs.shape[0]) // p
+        return gs[pos]
+
+    spec = P(SPLIT_AXIS)
+    in_specs = tuple([spec] * nbufs + [P()] * nbufs)
+    prog = shard_map(kernel, mesh=mesh, in_specs=in_specs, out_specs=P(), check_vma=False)
+    fn = _PROGRAMS[key] = jax.jit(prog)
+    return fn
+
+
+def _partition_executable(
+    pshape: Tuple[int, ...],
+    key_dtype,
+    payload_dtypes: Tuple[str, ...],
+    p: int,
+    mode: str,
+    comm: MeshCommunication,
+):
+    """Row partition program (no pre-aggregation — the join path): sort
+    rows by key, tag destinations, destination-major sort, replicated
+    bucket matrix."""
+    mesh = comm.mesh
+    key = ("part", pshape, str(key_dtype), payload_dtypes, p, mode, mesh)
+    fn = _PROGRAMS.get(key)
+    if fn is not None:
+        return fn
+    b = pshape[0] // p
+
+    def kernel(kb, counts, splitters, *vals):
+        r = lax.axis_index(SPLIT_AXIS)
+        n = counts[r]
+        pad = lax.iota(jnp.int32, b) >= n
+        sk, sp, svals = _sort_by_key(kb, pad, list(vals))
+        if mode == "range":
+            pid = _range_pid(sk, splitters)
+        else:
+            pid = _hash_pid(sk, p)
+        pid = jnp.where(sp, p, pid)
+        iota = lax.iota(jnp.int32, b)
+        perm = lax.sort((pid, iota), num_keys=2, is_stable=True)[1]
+        mat = _dest_matrix(pid, p)
+        return (sk[perm], *[v[perm] for v in svals], mat)
+
+    spec = P(SPLIT_AXIS)
+    in_specs = (spec, P(), P(), *([spec] * len(payload_dtypes)))
+    out_specs = (spec, *([spec] * len(payload_dtypes)), P())
+    prog = shard_map(kernel, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False)
+    fn = _PROGRAMS[key] = jax.jit(prog)
+    return fn
+
+
+def _join_executable(
+    l_pshape: Tuple[int, ...],
+    r_pshape: Tuple[int, ...],
+    key_dtype,
+    l_dtypes: Tuple[str, ...],
+    r_dtypes: Tuple[str, ...],
+    how: str,
+    p: int,
+    comm: MeshCommunication,
+):
+    """Device-local merge join of two co-partitioned, exchanged sides:
+    sort both by key, match left rows into the (unique-keyed) right side
+    with one searchsorted, compact (inner) or null-fill (left)."""
+    mesh = comm.mesh
+    key = ("join", l_pshape, r_pshape, str(key_dtype), l_dtypes, r_dtypes, how, p, mesh)
+    fn = _PROGRAMS.get(key)
+    if fn is not None:
+        return fn
+    bl = l_pshape[0] // p
+    br = r_pshape[0] // p
+
+    def kernel(lk, lcnt, *rest):
+        rk, rcnt = rest[len(l_dtypes)], rest[len(l_dtypes) + 1]
+        lvals = list(rest[: len(l_dtypes)])
+        rvals = list(rest[len(l_dtypes) + 2 :])
+        r = lax.axis_index(SPLIT_AXIS)
+        nl, nr = lcnt[r], rcnt[r]
+        lpad = lax.iota(jnp.int32, bl) >= nl
+        rpad = lax.iota(jnp.int32, br) >= nr
+        slk, slp, slv = _sort_by_key(lk, lpad, lvals)
+        srk, srp, srv = _sort_by_key(rk, rpad, rvals)
+        mk = jnp.asarray(_max_key(srk.dtype))
+        srk2 = jnp.where(srp, mk, srk)
+        # duplicate right keys would silently multiply rows in a merge
+        # join — detect and report (replicated via max over shards)
+        dup_local = jnp.any((srk2[1:] == srk2[:-1]) & ~srp[1:] & ~srp[:-1])
+        dup = lax.pmax(dup_local.astype(jnp.int32), SPLIT_AXIS)
+        idx = jnp.searchsorted(srk2, jnp.where(slp, mk, slk), side="left")
+        idxc = jnp.clip(idx, 0, br - 1)
+        hit = (idx < nr) & (srk2[idxc] == slk) & ~slp
+        gathered = [v[idxc] for v in srv]
+        if how == "inner":
+            keep = hit
+            iota = lax.iota(jnp.int32, bl)
+            perm = lax.sort(((~keep).astype(jnp.int32), iota), num_keys=2, is_stable=True)[1]
+            g = jnp.sum(keep.astype(jnp.int32))
+            outs = (
+                slk[perm],
+                *[v[perm] for v in slv],
+                *[jnp.where(keep, v, jnp.zeros_like(v))[perm] for v in gathered],
+            )
+        else:  # left: all valid left rows, unmatched right values -> NaN
+            g = nl
+            filled = []
+            for v in gathered:
+                fv = v.astype(jnp.promote_types(v.dtype, jnp.float32))
+                filled.append(jnp.where(hit, fv, jnp.full_like(fv, jnp.nan)))
+            outs = (slk, *slv, *filled)
+        gvec = lax.all_gather(g, SPLIT_AXIS)
+        return (*outs, gvec, dup)
+
+    spec = P(SPLIT_AXIS)
+    in_specs = (
+        spec, P(), *([spec] * len(l_dtypes)), spec, P(), *([spec] * len(r_dtypes)),
+    )
+    out_specs = (
+        spec, *([spec] * (len(l_dtypes) + len(r_dtypes))), P(), P(),
+    )
+    prog = shard_map(kernel, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False)
+    fn = _PROGRAMS[key] = jax.jit(prog)
+    return fn
+
+
+def _compact_executable(
+    pshape: Tuple[int, ...],
+    dtypes: Tuple[str, ...],
+    p: int,
+    comm: MeshCommunication,
+):
+    """Local filter compaction: stable-partition kept rows to each
+    shard's prefix (ragged result, ZERO exchanges), report kept counts."""
+    mesh = comm.mesh
+    key = ("compact", pshape, dtypes, p, mesh)
+    fn = _PROGRAMS.get(key)
+    if fn is not None:
+        return fn
+    b = pshape[0] // p
+
+    def kernel(mask, counts, *cols):
+        r = lax.axis_index(SPLIT_AXIS)
+        n = counts[r]
+        valid = lax.iota(jnp.int32, b) < n
+        keep = mask & valid
+        iota = lax.iota(jnp.int32, b)
+        perm = lax.sort(((~keep).astype(jnp.int32), iota), num_keys=2, is_stable=True)[1]
+        g = jnp.sum(keep.astype(jnp.int32))
+        gvec = lax.all_gather(g, SPLIT_AXIS)
+        return (*[c[perm] for c in cols], gvec)
+
+    spec = P(SPLIT_AXIS)
+    in_specs = (spec, P(), *([spec] * len(dtypes)))
+    out_specs = (*([spec] * len(dtypes)), P())
+    prog = shard_map(kernel, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False)
+    fn = _PROGRAMS[key] = jax.jit(prog)
+    return fn
+
+
+# ------------------------------------------------------------- orchestration
+def _counts_vec(counts: Sequence[int]) -> jnp.ndarray:
+    return jnp.asarray(tuple(int(c) for c in counts), jnp.int32)
+
+
+def _exchange_operands(
+    bufs: List[jax.Array], mat: np.ndarray, comm: MeshCommunication
+) -> Tuple[List[jax.Array], np.ndarray, int]:
+    """ONE bucket exchange per operand column over a shared schedule."""
+    out_counts = mat.sum(axis=0)
+    b_out = max(1, int(out_counts.max()))
+    moved = [
+        collective_lockstep(bucket_move(b, 0, mat.tolist(), b_out, comm)) for b in bufs
+    ]
+    return moved, out_counts, b_out
+
+
+def groupby_reduce(
+    key_col: DNDarray,
+    value_bufs: List[jax.Array],
+    val_dtypes: Tuple[str, ...],
+    stats: Tuple[Tuple[str, int, str], ...],
+    mode: str = "range",
+) -> Tuple[DNDarray, List[DNDarray], int]:
+    """Distributed groupby: per-shard combine → one exchange per operand
+    → per-shard merge. Returns (unique keys, one reduced column per
+    requested statistic, n_groups) in a co-aligned ragged split-0 layout
+    (with ``mode="range"`` the keys are additionally in global sorted
+    order).
+
+    ``stats`` is a tuple of ``(kind, value_index, out_dtype)`` with
+    ``kind`` in {sum, sumsq, count, min, max} (count ignores the index).
+    """
+    if mode not in ("range", "hash"):
+        raise ValueError(f"mode must be 'range' or 'hash', got {mode!r}")
+    comm = key_col.comm
+    p = comm.size
+    kb = key_col._raw
+    counts = _counts_vec(shard_counts(key_col))
+    plan = _plan_executable(
+        tuple(kb.shape), kb.dtype, val_dtypes, stats, p, mode, comm
+    )
+    out = collective_lockstep(plan(kb, counts, *value_bufs))
+    pk, parts, mat = out[0], list(out[1 : 1 + len(stats)]), out[-2]
+    # the replicated bucket matrix comes to host to build the static
+    # exchange schedule — same bounded sync as redistribute_'s target map
+    mat_np = np.asarray(mat)
+    moved, out_counts, b_out = _exchange_operands([pk, *parts], mat_np, comm)
+    merge = _merge_executable(
+        (p * b_out,),
+        kb.dtype,
+        tuple((kind, odt) for kind, _, odt in stats),
+        p,
+        comm,
+    )
+    mout = collective_lockstep(merge(moved[0], _counts_vec(out_counts), *moved[1:]))
+    gvec = np.asarray(mout[-1])
+    n_groups = int(gvec.sum())
+    mkeys = DNDarray._from_ragged(
+        mout[0], (n_groups,), mout[0].dtype, 0, tuple(int(c) for c in gvec),
+        device=key_col.device, comm=comm,
+    )
+    reduced = [
+        DNDarray._from_ragged(
+            buf, (n_groups,), buf.dtype, 0, tuple(int(c) for c in gvec),
+            device=key_col.device, comm=comm,
+        )
+        for buf in mout[1 : 1 + len(stats)]
+    ]
+    SHUFFLE_STATS["groupbys"] += 1
+    return mkeys, reduced, n_groups
+
+
+def shuffle_rows(
+    key_col: DNDarray,
+    payload_bufs: List[jax.Array],
+    mode: str = "range",
+    splitters: Optional[jax.Array] = None,
+) -> Tuple[List[jax.Array], np.ndarray, int]:
+    """Full-row shuffle (no combining): co-locate equal keys. Returns
+    (moved [key, *payload] buffers, per-shard out_counts, b_out). Rows
+    arrive locally sorted by destination then key; pass ``splitters`` to
+    reuse a prior election (both sides of a join must agree)."""
+    comm = key_col.comm
+    p = comm.size
+    kb = key_col._raw
+    counts = _counts_vec(shard_counts(key_col))
+    if mode == "range" and splitters is None:
+        elect = _elect_executable((tuple(kb.shape),), kb.dtype, p, comm)
+        splitters = collective_lockstep(elect(kb, counts))
+    if splitters is None:
+        splitters = jnp.zeros((max(p - 1, 1),), kb.dtype)
+    part = _partition_executable(
+        tuple(kb.shape), kb.dtype,
+        tuple(str(b.dtype) for b in payload_bufs), p, mode, comm,
+    )
+    out = collective_lockstep(part(kb, counts, splitters, *payload_bufs))
+    mat_np = np.asarray(out[-1])
+    moved, out_counts, b_out = _exchange_operands(list(out[:-1]), mat_np, comm)
+    return moved, out_counts, b_out
+
+
+def hash_join(
+    l_key: DNDarray,
+    l_bufs: List[jax.Array],
+    r_key: DNDarray,
+    r_bufs: List[jax.Array],
+    how: str = "inner",
+    mode: str = "range",
+) -> Tuple[List[jax.Array], np.ndarray, int]:
+    """Distributed join: co-partition both sides with ONE shared splitter
+    election, one exchange per operand on each side, then a device-local
+    merge join. Right keys must be unique (m:1 join — the hash-join
+    contract pandas calls ``validate="m:1"``). Returns (result buffers
+    ``[key, *left_cols, *right_cols]``, per-shard counts, dup_flag).
+    Left-join right columns are promoted to float and NaN-filled."""
+    if how not in ("inner", "left"):
+        raise ValueError(f"how must be 'inner' or 'left', got {how!r}")
+    comm = l_key.comm
+    p = comm.size
+    splitters = None
+    if mode == "range":
+        elect = _elect_executable(
+            (tuple(l_key._raw.shape), tuple(r_key._raw.shape)),
+            l_key._raw.dtype, p, comm,
+        )
+        splitters = collective_lockstep(
+            elect(
+                l_key._raw, r_key._raw,
+                _counts_vec(shard_counts(l_key)), _counts_vec(shard_counts(r_key)),
+            )
+        )
+    l_moved, l_counts, _ = shuffle_rows(l_key, l_bufs, mode, splitters)
+    r_moved, r_counts, _ = shuffle_rows(r_key, r_bufs, mode, splitters)
+    join = _join_executable(
+        tuple(l_moved[0].shape), tuple(r_moved[0].shape), l_moved[0].dtype,
+        tuple(str(b.dtype) for b in l_moved[1:]),
+        tuple(str(b.dtype) for b in r_moved[1:]),
+        how, p, comm,
+    )
+    out = collective_lockstep(
+        join(
+            l_moved[0], _counts_vec(l_counts), *l_moved[1:],
+            r_moved[0], _counts_vec(r_counts), *r_moved[1:],
+        )
+    )
+    dup = int(np.asarray(out[-1]))
+    gvec = np.asarray(out[-2])
+    SHUFFLE_STATS["joins"] += 1
+    return list(out[:-2]), gvec, dup
+
+
+def compact_rows(
+    mask_buf: jax.Array,
+    col_bufs: List[jax.Array],
+    counts: Sequence[int],
+    comm: MeshCommunication,
+) -> Tuple[List[jax.Array], np.ndarray]:
+    """Local filter compaction (zero exchanges): each shard moves its
+    kept rows to the block prefix; returns (buffers, kept counts)."""
+    fn = _compact_executable(
+        tuple(mask_buf.shape), tuple(str(b.dtype) for b in col_bufs), comm.size, comm
+    )
+    out = collective_lockstep(fn(mask_buf, _counts_vec(counts), *col_bufs))
+    gvec = np.asarray(out[-1])
+    SHUFFLE_STATS["compactions"] += 1
+    return list(out[:-1]), gvec

@@ -1,0 +1,174 @@
+"""``Frame.groupby(...)`` — aggregation planning over the shuffle engine.
+
+The planner turns user aggs (sum/mean/min/max/count/std) into the
+minimal set of RAW associative statistics the engine must carry (a mean
+needs a float sum and the group count; a std additionally a sum of
+squares; duplicates are computed once). The engine moves exactly one
+bounded exchange per raw statistic plus one for the keys; everything a
+non-associative agg needs is *derived* afterwards from associative
+pieces with plain DNDarray arithmetic — which keeps the finalize step
+capturable by ``ht.lazy()``, so ``groupby → agg → filter`` chains fuse
+into one replayed program.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Mapping, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..core.dndarray import DNDarray
+from ._shuffle import groupby_reduce
+
+__all__ = ["FrameGroupBy", "AGGS"]
+
+AGGS = ("sum", "mean", "min", "max", "count", "std")
+
+AggSpec = Union[str, Sequence[str], Mapping[str, Union[str, Sequence[str]]]]
+
+
+def _sum_dtype(vdt: np.dtype) -> str:
+    return "int32" if vdt == np.bool_ else str(vdt)
+
+
+def _float_dtype(vdt: np.dtype) -> str:
+    return str(np.promote_types(vdt, np.float32))
+
+
+class FrameGroupBy:
+    """Deferred groupby: holds (frame, key, partition mode) until an
+    aggregation names the statistics to carry through the shuffle."""
+
+    def __init__(self, frame, key: str, mode: str = "range"):
+        self._frame = frame
+        self._key = key
+        self._mode = mode
+
+    # ------------------------------------------------------------- plan+run
+    def agg(self, spec: AggSpec, ddof: int = 1):
+        """Aggregate value columns per distinct key.
+
+        ``spec`` is a single agg name (applied to every non-key column),
+        a list of agg names, or a ``{column: agg | [aggs]}`` mapping.
+        Returns a :class:`Frame` whose first column is the key (globally
+        sorted in range mode); value columns keep their name for a
+        single agg and gain a ``_<agg>`` suffix otherwise. ``count``
+        needs no value column and lands in a column named ``"count"``
+        when requested by name.
+        """
+        frame, key = self._frame, self._key
+        value_cols = [n for n in frame.columns if n != key]
+        # ---- normalize to ordered (column, agg, out_name) requests
+        requests: List[Tuple[str, str]] = []
+        if isinstance(spec, str):
+            spec = [spec]
+        if isinstance(spec, Mapping):
+            for col, aggs in spec.items():
+                if col not in frame.columns or col == key:
+                    raise KeyError(f"cannot aggregate column {col!r}")
+                for a in [aggs] if isinstance(aggs, str) else list(aggs):
+                    requests.append((col, a))
+        else:
+            for a in list(spec):
+                if a == "count":
+                    requests.append((key, "count"))
+                else:
+                    requests.extend((c, a) for c in value_cols)
+        if not requests:
+            raise ValueError("empty aggregation spec")
+        for col, a in requests:
+            if a not in AGGS:
+                raise ValueError(f"unknown agg {a!r}; choose from {AGGS}")
+        multi = {c: n > 1 for c, n in _multiplicity(requests).items()}
+
+        # ---- plan raw associative statistics (deduplicated)
+        used_cols = sorted(
+            {c for c, a in requests if a != "count"}, key=frame.columns.index
+        )
+        ci = {c: i for i, c in enumerate(used_cols)}
+        vdts = {c: np.dtype(frame[c]._raw.dtype) for c in used_cols}
+        raw: Dict[Tuple[str, int, str], int] = {}
+
+        def need(kind: str, col: str) -> Tuple[str, int, str]:
+            if kind == "count":
+                k = ("count", 0, "int32")
+            elif kind in ("min", "max"):
+                k = (kind, ci[col], str(vdts[col]))
+            elif kind == "sum":
+                k = ("sum", ci[col], _sum_dtype(vdts[col]))
+            elif kind == "fsum":
+                k = ("sum", ci[col], _float_dtype(vdts[col]))
+            else:  # fsumsq
+                k = ("sumsq", ci[col], _float_dtype(vdts[col]))
+            raw.setdefault(k, len(raw))
+            return k
+
+        plan: List[Tuple[str, str, str, List[Tuple[str, int, str]]]] = []
+        for col, a in requests:
+            if a == "count":
+                slots = [need("count", col)]
+            elif a in ("sum", "min", "max"):
+                slots = [need(a if a != "sum" else "sum", col)]
+            elif a == "mean":
+                slots = [need("fsum", col), need("count", col)]
+            else:  # std
+                slots = [need("fsum", col), need("fsumsq", col), need("count", col)]
+            name = "count" if a == "count" and col == key else (
+                f"{col}_{a}" if multi[col] else col
+            )
+            plan.append((name, col, a, slots))
+
+        # ---- one shuffle carries every raw statistic
+        stats = tuple(sorted(raw, key=raw.get))
+        mkeys, reduced, _ = groupby_reduce(
+            frame[key],
+            [frame[c]._raw for c in used_cols],
+            tuple(str(vdts[c]) for c in used_cols),
+            stats,
+            mode=self._mode,
+        )
+        slot = {k: reduced[i] for i, k in enumerate(stats)}
+
+        # ---- derive requested aggs (plain DNDarray ops: lazy-capturable)
+        out: Dict[str, DNDarray] = {key: mkeys}
+        for name, col, a, slots in plan:
+            if name in out:
+                raise ValueError(f"duplicate output column {name!r}")
+            if a in ("sum", "min", "max", "count"):
+                out[name] = slot[slots[0]]
+            elif a == "mean":
+                fsum, cnt = slot[slots[0]], slot[slots[1]]
+                out[name] = fsum / cnt
+            else:  # std
+                fsum, fsumsq, cnt = (slot[s] for s in slots)
+                mean = fsum / cnt
+                var = (fsumsq / cnt - mean * mean) * (cnt / (cnt - ddof))
+                out[name] = var.clip(0.0, None).sqrt()
+        from .frame import Frame
+
+        return Frame._wrap(out)
+
+    # -------------------------------------------------------- conveniences
+    def sum(self):
+        return self.agg("sum")
+
+    def mean(self):
+        return self.agg("mean")
+
+    def min(self):
+        return self.agg("min")
+
+    def max(self):
+        return self.agg("max")
+
+    def std(self, ddof: int = 1):
+        return self.agg("std", ddof=ddof)
+
+    def count(self):
+        return self.agg("count")
+
+
+def _multiplicity(requests: List[Tuple[str, str]]) -> Dict[str, int]:
+    m: Dict[str, int] = {}
+    for col, _ in requests:
+        m[col] = m.get(col, 0) + 1
+    return m

@@ -64,10 +64,13 @@ def _bounded_exchange(label: str, fn, buf: jax.Array):
 
 __all__ = [
     "flat_schedule",
+    "bucket_schedule",
     "reshape_flatmove_executable",
     "reshape_via_flatmove",
     "ragged_move_executable",
     "ragged_move",
+    "bucket_move_executable",
+    "bucket_move",
     "strided_take_executable",
     "strided_take",
     "MOVE_STATS",
@@ -76,7 +79,9 @@ __all__ = [
 # Running count of dispatched interval exchanges. Tests and the ragged
 # bench read (and reset) this to assert a pipeline's exchange budget —
 # e.g. redistribute→elementwise→redistribute must cost exactly ONE move.
-MOVE_STATS = {"ragged_moves": 0}
+# ``bucket_moves`` sub-counts the shuffle engine's bucketed exchanges
+# (every bucket move is also a ragged move for budget purposes).
+MOVE_STATS = {"ragged_moves": 0, "bucket_moves": 0}
 
 
 class Edge(NamedTuple):
@@ -110,9 +115,16 @@ def flat_schedule(
             if hi > lo:
                 edges.append(Edge(r, dd, lo - int(a[r]), lo - int(b[dd]), hi - lo))
             dd += 1
+    return _color(edges)
+
+
+def _color(edges: List[Edge]) -> Tuple[List[Edge], List[List[Edge]]]:
+    """Split self-edges off and greedy-color the rest into ppermute
+    matchings (each device at most once per round as src and as dst —
+    the property :func:`_tables` requires). Interval overlap graphs stay
+    near Delta; general bipartite edge sets stay under 2*Delta - 1."""
     self_edges = [e for e in edges if e.src == e.dst]
     rest = [e for e in edges if e.src != e.dst]
-    # greedy bipartite edge coloring; interval structure keeps it near Delta
     src_used: dict = {}
     dst_used: dict = {}
     colored: dict = {}
@@ -125,6 +137,33 @@ def flat_schedule(
         colored.setdefault(c, []).append(e)
     rounds = [colored[c] for c in sorted(colored)]
     return self_edges, rounds
+
+
+def bucket_schedule(matrix: Sequence[Sequence[int]]) -> Tuple[List[Edge], List[List[Edge]]]:
+    """(self_edges, rounds) for a *bucketed* exchange — the shuffle
+    engine's Alltoallv. ``matrix[r][d]`` rows travel from device ``r`` to
+    device ``d``; on ``r`` the outgoing buckets sit destination-major at
+    offset 0 (rows locally sorted by partition id), on ``d`` the incoming
+    buckets land source-major at offset 0. Unlike :func:`flat_schedule`
+    this is NOT an order-preserving interval redistribution — any
+    bipartite edge set is legal; the same greedy coloring turns it into
+    ppermute matchings."""
+    # graftlint: host-sync - P×P schedule input, already host-side metadata
+    m = np.asarray(matrix, dtype=np.int64)
+    if m.ndim != 2 or m.shape[0] != m.shape[1]:
+        raise ValueError(f"bucket matrix must be square, got shape {m.shape}")
+    if (m < 0).any():
+        raise ValueError("bucket matrix has negative counts")
+    p = m.shape[0]
+    src_off = np.concatenate([np.zeros((p, 1), np.int64), np.cumsum(m, axis=1)], axis=1)
+    dst_off = np.concatenate([np.zeros((1, p), np.int64), np.cumsum(m, axis=0)], axis=0)
+    edges = [
+        Edge(r, d, int(src_off[r, d]), int(dst_off[r, d]), int(m[r, d]))
+        for r in range(p)
+        for d in range(p)
+        if m[r, d] > 0
+    ]
+    return _color(edges)
 
 
 def _tables(edges: List[Edge], p: int):
@@ -369,6 +408,86 @@ def ragged_move(
     )
     MOVE_STATS["ragged_moves"] += 1
     return _bounded_exchange("ragged", fn, buf)
+
+
+def bucket_move_executable(
+    buf_shape: Tuple[int, ...],
+    dtype,
+    split: int,
+    matrix: Sequence[Sequence[int]],
+    b_out: int,
+    comm: MeshCommunication,
+):
+    """Cached jitted program for one bucketed exchange (shuffle engine).
+
+    Device ``r`` holds its outgoing rows destination-major at offset 0 of
+    its block: ``matrix[r][d]`` split-axis rows for destination ``d``, in
+    destination-rank order (the shuffle's local sort by partition id
+    produces exactly this layout). The output block of device ``d`` holds
+    the incoming rows source-major at offset 0 —
+    ``sum(matrix[r][d] for r)`` valid rows. Reuses the ragged interval
+    kernel: only the edge schedule differs (:func:`bucket_schedule`
+    instead of :func:`flat_schedule`). ``.lower()``-able for the
+    distribution-proof tests."""
+    mesh = comm.mesh
+    p = mesh.shape[SPLIT_AXIS]
+    m = tuple(tuple(int(c) for c in row) for row in matrix)
+    if len(m) != p or any(len(row) != p for row in m):
+        raise ValueError(f"bucket matrix must be {p}x{p}")
+    b_in = buf_shape[split] // p
+    if max((sum(row) for row in m), default=0) > b_in:
+        raise ValueError("a source's outgoing rows exceed its block size")
+    if max((sum(row[d] for row in m) for d in range(p)), default=0) > int(b_out):
+        raise ValueError("a destination's incoming rows exceed b_out")
+    key = ("bucket", tuple(buf_shape), str(dtype), split, m, int(b_out), mesh)
+    fn = _JIT_CACHE.get(key)
+    if fn is not None:
+        return fn
+    ndim = len(buf_shape)
+    outer = int(np.prod(buf_shape[:split], dtype=np.int64)) if split else 1
+    inner = (
+        int(np.prod(buf_shape[split + 1 :], dtype=np.int64))
+        if split + 1 < ndim
+        else 1
+    )
+    unit = outer * inner
+    self_edges, rounds = bucket_schedule(
+        [[c * unit for c in row] for row in m]
+    )
+    spec = P(*[SPLIT_AXIS if i == split else None for i in range(ndim)])
+    kernel = partial(
+        _ragged_kernel,
+        axis_name=SPLIT_AXIS,
+        p=p,
+        split=split,
+        b_out=int(b_out),
+        self_edges=self_edges,
+        rounds=rounds,
+    )
+    prog = shard_map(kernel, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False)
+    fn = _JIT_CACHE[key] = jax.jit(prog)
+    return fn
+
+
+def bucket_move(
+    buf: jax.Array,
+    split: int,
+    matrix: Sequence[Sequence[int]],
+    b_out: int,
+    comm: MeshCommunication,
+) -> jax.Array:
+    """Run one bucketed exchange (see :func:`bucket_move_executable`).
+    Counted in ``MOVE_STATS`` as both a ragged move (exchange budget) and
+    a bucket move (the shuffle engine's per-operand assert); watchdog-
+    bounded (label ``flatmove.bucket``) when ``resilience.deadlines`` is
+    active."""
+    _hooks.trace_barrier("bucket_move")
+    fn = bucket_move_executable(
+        tuple(buf.shape), buf.dtype, split, matrix, b_out, comm
+    )
+    MOVE_STATS["ragged_moves"] += 1
+    MOVE_STATS["bucket_moves"] += 1
+    return _bounded_exchange("bucket", fn, buf)
 
 
 def _t_interval(lo: int, hi: int, start: int, step: int, m: int) -> Tuple[int, int]:

@@ -14,6 +14,10 @@ Public surface (see ``docs/STREAMING.md`` for the walkthrough):
   :class:`~heat_tpu.stream.estimators.StreamingHistogram` — single-pass
   estimators via pairwise merge formulas, oracle-equal to the in-memory
   ``ht.mean/var/cov/histogram``;
+- :class:`~heat_tpu.stream.groupby.StreamingGroupBy` — bounded-memory
+  per-key aggregation: chunks fold into a fixed-capacity replicated
+  (key, statistics) table with the same associative contract as the
+  :mod:`heat_tpu.frame` groupby, so chunked and in-memory results agree;
 - ``STREAM_STATS`` / :func:`reset_stream_stats` — chunk/prefetch/overlap
   counters riding the :mod:`heat_tpu.core._hooks` observer slot.
 
@@ -25,10 +29,11 @@ chunks ahead of the consumer (plus the chunk being consumed) no matter
 how large the dataset is; the warm chunk loop re-dispatches cached
 executables — 0 traces / 0 compiles per chunk.
 """
-from . import chunked, estimators, prefetch
+from . import chunked, estimators, groupby, prefetch
 from ._stats import STREAM_STATS, reset_stream_stats
 from .chunked import ChunkIterator
 from .estimators import StreamingCov, StreamingHistogram, StreamingMoments
+from .groupby import StreamingGroupBy
 from .prefetch import Prefetcher
 
 __all__ = [
@@ -37,6 +42,7 @@ __all__ = [
     "StreamingMoments",
     "StreamingCov",
     "StreamingHistogram",
+    "StreamingGroupBy",
     "STREAM_STATS",
     "reset_stream_stats",
 ]

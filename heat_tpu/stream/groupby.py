@@ -1,0 +1,314 @@
+"""Streaming groupby: bounded-memory per-key aggregation over chunks.
+
+The distributed frame groupby (:mod:`heat_tpu.frame`) shuffles rows so
+each device owns its keys; the STREAMING formulation never sees all rows
+at once, so it instead folds every chunk into a fixed-capacity
+REPLICATED table of (key, raw associative statistics) — exactly the
+``StreamingMoments`` contract: ``update()`` is one cached jitted
+program per (capacity, statistics) pair, ``merge()`` combines two
+estimators pairwise, and both are legal because every carried statistic
+(sum, sum of squares, count, min, max) is associative and commutative.
+Derived aggregations (mean, std) are computed at ``result()`` time from
+the associative pieces — the same raw-statistics planning the frame
+groupby uses, so a chunked fold and an in-memory
+``Frame.groupby(...).agg(...)`` agree on the same data.
+
+The fold itself is sort-based like the shuffle engine's local stages:
+concatenate the state table with the chunk's rows, sort by key (pads
+last), segment-reduce equal-key runs back into the capacity. Exceeding
+the capacity flips a replicated overflow flag (checked only at
+``result()``/``merge`` — no per-chunk host sync); raise ``capacity`` and
+re-run, or use the frame groupby when the key cardinality is unbounded.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..core._cache import ExecutableCache
+from ..core.communication import collective_lockstep
+from ..core.dndarray import DNDarray
+
+__all__ = ["StreamingGroupBy"]
+
+# one entry per (capacity, statistics, flavor) — the chunk loop
+# re-dispatches the same executable every chunk
+_PROGRAMS = ExecutableCache(maxsize=64)
+
+_AGGS = ("sum", "mean", "min", "max", "count", "std")
+
+
+def _max_key(dtype):
+    dt = np.dtype(dtype)
+    if dt.kind == "f":
+        return np.asarray(np.inf, dt)
+    if dt.kind == "b":
+        return np.asarray(True)
+    return np.asarray(np.iinfo(dt).max, dt)
+
+
+def _neutral(kind: str, dtype):
+    dt = np.dtype(dtype)
+    if kind in ("sum", "sumsq", "count"):
+        return np.asarray(0, dt)
+    if kind == "min":
+        return _max_key(dt)
+    if dt.kind == "f":
+        return np.asarray(-np.inf, dt)
+    if dt.kind == "b":
+        return np.asarray(False)
+    return np.asarray(np.iinfo(dt).min, dt)
+
+
+def _fold_program(cap: int, kinds: Tuple[str, ...], flavor: str):
+    """One fold step: (state table) ⊕ (rows) → state table.
+
+    ``flavor="chunk"`` derives each row's raw statistic contribution from
+    the chunk's value column (count→1, sum→v, sumsq→v², min/max→v);
+    ``flavor="state"`` takes raw statistic rows as-is (merging another
+    estimator's table). Shapes are static per (cap, kinds, geometry), so
+    a warm chunk loop re-dispatches one executable."""
+    key = ("gb-fold", cap, kinds, flavor)
+    prog = _PROGRAMS.get(key)
+    if prog is None:
+
+        def step(sk, g, ov, kb, nv, state_stats, row_stats_or_v):
+            b = kb.shape[0]
+            state_valid = lax.iota(jnp.int32, cap) < g
+            chunk_valid = lax.iota(jnp.int32, b) < nv
+            keys = jnp.concatenate([sk, kb])
+            valid = jnp.concatenate([state_valid, chunk_valid])
+            rows = []
+            for i, kind in enumerate(kinds):
+                st = state_stats[i]
+                if flavor == "state":
+                    contrib = row_stats_or_v[i]
+                elif kind == "count":
+                    contrib = chunk_valid.astype(st.dtype)
+                elif kind == "sumsq":
+                    v = row_stats_or_v.astype(st.dtype)
+                    contrib = v * v
+                else:
+                    contrib = row_stats_or_v.astype(st.dtype)
+                rows.append(jnp.concatenate([st, contrib]))
+            m = cap + b
+            iota = lax.iota(jnp.int32, m)
+            skey = keys.astype(jnp.int8) if keys.dtype == jnp.bool_ else keys
+            perm = lax.sort(
+                ((~valid).astype(jnp.int32), skey, iota), num_keys=3, is_stable=True
+            )[2]
+            ks, vs = keys[perm], valid[perm]
+            prev = jnp.concatenate([ks[:1], ks[:-1]])
+            is_start = vs & ((iota == 0) | (ks != prev))
+            seg = jnp.cumsum(is_start.astype(jnp.int32)) - 1
+            # out-of-capacity segments scatter out of range and drop
+            segv = jnp.where(vs, seg, cap)
+            new_g = jnp.sum(is_start.astype(jnp.int32))
+            new_keys = jnp.full((cap,), jnp.asarray(_max_key(keys.dtype)), keys.dtype)
+            new_keys = new_keys.at[segv].set(ks, mode="drop")
+            outs = []
+            for kind, r in zip(kinds, rows):
+                rs = r[perm]
+                neutral = jnp.asarray(_neutral(kind, rs.dtype))
+                masked = jnp.where(vs, rs, neutral)
+                if kind == "min":
+                    outs.append(jax.ops.segment_min(masked, segv, num_segments=cap))
+                elif kind == "max":
+                    outs.append(jax.ops.segment_max(masked, segv, num_segments=cap))
+                else:
+                    outs.append(jax.ops.segment_sum(masked, segv, num_segments=cap))
+            return (
+                new_keys,
+                # pin int32: x64 promotion would widen g and force the
+                # next fold to respecialize on an int64 state scalar
+                jnp.minimum(new_g, cap).astype(jnp.int32),
+                ov | (new_g > cap),
+                tuple(outs),
+            )
+
+        _PROGRAMS[key] = jax.jit(step)
+        prog = _PROGRAMS[key]
+    return prog
+
+
+class StreamingGroupBy:
+    """Single-pass per-key aggregation with a fixed group capacity.
+
+    ``aggs`` names the wanted aggregations (subset of sum/mean/min/max/
+    count/std); ``capacity`` bounds the number of distinct keys the
+    replicated state table can hold. ``update(keys, values)`` folds one
+    chunk (1-D key and value DNDarrays of equal length; ``values`` may
+    be omitted when only ``count`` is requested); ``merge(other)``
+    combines two estimators; ``result()`` returns ``{"key": ..., agg:
+    ...}`` as replicated DNDarrays sorted by key.
+    """
+
+    def __init__(self, aggs: Sequence[str] = ("sum",), capacity: int = 4096):
+        aggs = (aggs,) if isinstance(aggs, str) else tuple(aggs)
+        if not aggs:
+            raise ValueError("need at least one aggregation")
+        for a in aggs:
+            if a not in _AGGS:
+                raise ValueError(f"unknown agg {a!r}; choose from {_AGGS}")
+        if capacity < 1:
+            raise ValueError(f"capacity must be positive, got {capacity}")
+        self.aggs = aggs
+        self.capacity = int(capacity)
+        kinds = []
+
+        def need(kind):
+            if kind not in kinds:
+                kinds.append(kind)
+
+        need("count")  # group sizes are always carried (and are cheap)
+        for a in aggs:
+            if a == "sum":
+                need("sum")
+            elif a in ("min", "max"):
+                need(a)
+            elif a == "mean":
+                need("fsum")
+            elif a == "std":
+                need("fsum")
+                need("fsumsq")
+        self._kinds = tuple(kinds)
+        self._n = 0
+        self._keys = None
+        self._g = None
+        self._ov = None
+        self._stats = None
+        self._vdtype = None
+        self._device = None
+        self._comm = None
+
+    @property
+    def n(self) -> int:
+        """Rows folded in so far."""
+        return self._n
+
+    # ---------------------------------------------------------------- folds
+    def _program_kinds(self) -> Tuple[str, ...]:
+        # the program's raw statistic names: fsum/fsumsq are sums in
+        # float dtype — the kernel only needs the combiner family
+        return tuple(
+            "sum" if k == "fsum" else "sumsq" if k == "fsumsq" else k
+            for k in self._kinds
+        )
+
+    def _stat_dtype(self, kind: str):
+        if kind == "count":
+            return jnp.int32
+        if kind in ("fsum", "fsumsq"):
+            return jnp.promote_types(self._vdtype, jnp.float32)
+        return self._vdtype
+
+    def update(self, keys: DNDarray, values: Optional[DNDarray] = None):
+        """Fold one chunk. ``keys`` is a 1-D DNDarray; ``values`` a 1-D
+        DNDarray of the same length (required unless only counting)."""
+        if not isinstance(keys, DNDarray):
+            raise TypeError(f"keys must be a DNDarray, got {type(keys)}")
+        needs_values = any(k != "count" for k in self._kinds)
+        if needs_values and values is None:
+            raise ValueError(f"aggs {self.aggs} need a values column")
+        if values is not None and (
+            not isinstance(values, DNDarray) or values.gshape != keys.gshape
+        ):
+            raise ValueError("values must be a DNDarray with the keys' shape")
+        if keys.ndim != 1:
+            raise ValueError(f"keys must be 1-D, got {keys.ndim}-D")
+        kb = keys.larray
+        vb = values.larray if values is not None else jnp.zeros_like(kb, jnp.float32)
+        if self._keys is None:
+            self._device = keys.device
+            self._comm = keys.comm
+            self._vdtype = vb.dtype
+            cap = self.capacity
+            # commit the state REPLICATED over the chunks' mesh up front:
+            # that is the sharding the fold emits, so the first warm
+            # repeat replays the cold executable instead of respecializing
+            rep = NamedSharding(self._comm.mesh, PartitionSpec())
+
+            def _put(a):
+                return jax.device_put(a, rep)
+
+            self._keys = _put(
+                jnp.full((cap,), jnp.asarray(_max_key(kb.dtype)), kb.dtype)
+            )
+            self._g = _put(jnp.int32(0))
+            self._ov = _put(jnp.asarray(False))
+            self._stats = tuple(
+                _put(jnp.zeros((cap,), self._stat_dtype(k))) for k in self._kinds
+            )
+        prog = _fold_program(self.capacity, self._program_kinds(), "chunk")
+        out = collective_lockstep(
+            prog(
+                self._keys, self._g, self._ov, kb, jnp.int32(keys.gshape[0]),
+                self._stats, vb,
+            )
+        )
+        self._keys, self._g, self._ov, self._stats = out
+        self._n += int(keys.gshape[0])
+        return self
+
+    def merge(self, other: "StreamingGroupBy") -> "StreamingGroupBy":
+        """Fold ``other``'s table into this one (pairwise combine)."""
+        if (self.aggs, self.capacity) != (other.aggs, other.capacity):
+            raise ValueError("cannot merge groupbys with different aggs/capacity")
+        self._require_data()
+        other._require_data()
+        prog = _fold_program(self.capacity, self._program_kinds(), "state")
+        out = collective_lockstep(
+            prog(
+                self._keys, self._g, self._ov, other._keys, other._g,
+                self._stats, other._stats,
+            )
+        )
+        self._keys, self._g, self._ov, self._stats = out
+        self._n += other._n
+        return self
+
+    # -------------------------------------------------------------- results
+    def _require_data(self):
+        if self._n == 0:
+            raise RuntimeError("no chunks folded in yet (call update first)")
+
+    def result(self) -> Dict[str, DNDarray]:
+        """Finalize: ``{"key", *aggs}`` as replicated DNDarrays sorted by
+        key. Raises if the capacity overflowed (replicated verdict — every
+        process raises together)."""
+        self._require_data()
+        if bool(np.asarray(self._ov)):
+            raise RuntimeError(
+                f"StreamingGroupBy exceeded capacity={self.capacity} distinct "
+                "keys; raise capacity or use heat_tpu.frame for unbounded keys"
+            )
+        g = int(np.asarray(self._g))
+        slot = dict(zip(self._kinds, self._stats))
+        cnt = slot["count"]
+        fin = {"key": self._keys}
+        for a in self.aggs:
+            if a == "sum":
+                fin[a] = slot["sum"]
+            elif a == "count":
+                fin[a] = cnt
+            elif a in ("min", "max"):
+                fin[a] = slot[a]
+            elif a == "mean":
+                fin[a] = slot["fsum"] / jnp.maximum(cnt, 1)
+            else:  # std, ddof=1 like Frame.groupby().std() (1-row group -> nan)
+                mean = slot["fsum"] / jnp.maximum(cnt, 1)
+                var = (slot["fsumsq"] / jnp.maximum(cnt, 1) - mean * mean) * (
+                    cnt / (cnt - 1)
+                )
+                fin[a] = jnp.sqrt(jnp.clip(var, 0.0, None))
+        return {
+            name: DNDarray(
+                arr[:g], split=None, device=self._device, comm=self._comm
+            )
+            for name, arr in fin.items()
+        }

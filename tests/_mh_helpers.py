@@ -126,10 +126,15 @@ def submesh(k: int):
 def on_pid0(fn) -> None:
     """Run a filesystem mutation exactly once per process group.
 
-    Process 0 executes ``fn``; everyone then rendezvouses, and a mutation
+    Everyone rendezvouses BEFORE process 0 executes ``fn`` — a rank still
+    reading the pre-mutation state (e.g. ``os.listdir`` to record which
+    file a fault injection will delete) must not observe a half-applied
+    mutation, else the group's recorded expectations diverge. Process 0
+    then executes ``fn``; everyone rendezvouses again, and a mutation
     error is re-raised on EVERY process (replicated verdict) so the group
     never splits into mutated-vs-raised halves.
     """
+    barrier()  # pre-mutation reads complete on every rank first
     err = None
     if pid0():
         try:

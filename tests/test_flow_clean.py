@@ -83,6 +83,20 @@ def test_kernels_package_is_flow_clean():
     )
 
 
+def test_frame_package_is_flow_clean():
+    """Explicit gate over the shuffle/frame layer: partition decisions
+    (splitter election, destination matrices, received-row counts) must
+    be REPLICATED values — exactly the rank-divergence surface graftflow
+    taints. A per-process branch on any of them deadlocks the exchange."""
+    findings, files_checked = gf.analyze_paths(
+        [os.path.join(REPO, "heat_tpu", "frame")]
+    )
+    assert files_checked >= 4  # __init__, _shuffle, frame, groupby
+    assert not findings, "\n".join(
+        f"  {f.path}:{f.line}:{f.col}: {f.rule} {f.message}" for f in findings
+    )
+
+
 def test_testing_package_is_flow_clean():
     """Explicit gate over the fault-tolerant suite runner: the worker
     drives real collectives from a persistent process, so a laundered

@@ -84,6 +84,20 @@ def test_kernels_package_is_lint_clean():
     )
 
 
+def test_frame_package_is_lint_clean():
+    """Explicit gate over the shuffle/frame layer: the engine caches
+    plan/merge/join executables and syncs exactly two bounded metadata
+    vectors per shuffle — a laundered host sync or per-call jit closure
+    here would turn every groupby into a retrace."""
+    findings, files_checked = gl.lint_paths(
+        [os.path.join(REPO, "heat_tpu", "frame")]
+    )
+    assert files_checked >= 4  # __init__, _shuffle, frame, groupby
+    assert not findings, "\n".join(
+        f"  {f.path}:{f.line}:{f.col}: {f.rule} {f.message}" for f in findings
+    )
+
+
 def test_testing_package_is_lint_clean():
     """Explicit gate over the fault-tolerant suite runner: the
     coordinator (``runner.py``) is deliberately jax-free stdlib code and

@@ -841,6 +841,98 @@ def test_two_process_serving(tmp_path):
     assert finals[0] == finals[1], finals
 
 
+_FRAME_WORKER = r"""
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+pid = int(sys.argv[1]); nproc = int(sys.argv[2]); port = sys.argv[3]
+
+import heat_tpu as ht
+from heat_tpu.analysis.sanitizer import Region
+from heat_tpu.parallel.flatmove import MOVE_STATS
+
+ht.init_distributed(
+    coordinator_address=f"localhost:{port}", num_processes=nproc, process_id=pid
+)
+assert jax.device_count() == 8 and jax.local_device_count() == 4
+
+# identical rows on every process (the host-boundary contract)
+rng = np.random.default_rng(17)
+keys = rng.integers(0, 23, size=301).astype(np.int32)
+vals = rng.normal(size=301).astype(np.float32)
+f = ht.Frame({"k": keys, "x": vals})
+
+f.groupby("k").mean()  # cold: compile plan+merge, elect splitters
+before = MOVE_STATS["bucket_moves"]
+region = Region("warm 2-process groupby")
+out = f.groupby("k").mean()
+moves = MOVE_STATS["bucket_moves"] - before
+warm = region.compiles + region.traces
+assert moves == 3, moves   # keys + fsum + count, ONE exchange each
+assert warm == 0, region.stats()
+
+d = {n: np.asarray(c._logical()) for n, c in zip(out.columns, (out["k"], out["x"]))}
+order = np.argsort(d["k"], kind="stable")
+uk = np.unique(keys)
+want = np.array([vals[keys == u].mean() for u in uk], np.float64)
+np.testing.assert_array_equal(d["k"][order], uk)
+np.testing.assert_allclose(d["x"][order], want, rtol=1e-4, atol=1e-5)
+
+# join across the process split: small unique-keyed right side
+small = ht.Frame({"k": np.arange(23, dtype=np.int32),
+                  "y": np.arange(23, dtype=np.float32)})
+j = f.join(small, on="k")
+dj = j.to_dict()
+assert len(dj["k"]) == len(keys)
+np.testing.assert_allclose(np.sort(dj["y"]), np.sort(keys.astype(np.float32)))
+
+payload = " ".join(f"{v:.5f}" for v in d["x"][order][:8])
+print(f"WORKER{pid} FRAME OK {moves} {warm} {payload}")
+"""
+
+
+@pytest.mark.skipif(
+    os.environ.get("HEAT_TPU_TEST_DEVICES", "8") != "8",
+    reason="one fixed 2x4 topology is enough for the matrix",
+)
+def test_two_process_frame_groupby_join(tmp_path):
+    """The shuffle engine under real multi-process execution (PR 14
+    tentpole): splitter election, destination matrices, and received-row
+    counts are replicated, so both ranks run the same bounded exchange
+    schedule — warm groupby is 0-trace/0-compile with exactly one
+    bucket exchange per operand, and groupby+join match numpy on both
+    ranks with identical payloads."""
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+
+    worker = tmp_path / "frame_worker.py"
+    worker.write_text(_FRAME_WORKER)
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env.pop("HEAT_TPU_TEST_DEVICES", None)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(i), "2", str(port)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = [p.communicate(timeout=600)[0] for p in procs]
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out}"
+        assert f"WORKER{i} FRAME OK" in out, out
+    # identical move/compile counters and identical group means per rank
+    finals = [out.strip().splitlines()[-1].split()[3:] for out in outs]
+    assert finals[0] == finals[1], finals
+
+
 _PYTEST_DRIVER = r"""
 import os, sys
 import jax

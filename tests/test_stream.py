@@ -17,11 +17,13 @@ import subprocess
 import sys
 import time
 
+import jax
 import numpy as np
 import pytest
 
 import heat_tpu as ht
 from heat_tpu.analysis.sanitizer import sanitizer
+from . import _mh_helpers as mh
 from heat_tpu.stream import (
     STREAM_STATS,
     ChunkIterator,
@@ -68,68 +70,93 @@ class TestChunkIterator:
         assert STREAM_STATS["chunks"] == len(chunks)
         assert STREAM_STATS["bytes_read"] == data.nbytes
 
-    def test_hdf5_source(self, tmp_path, data):
+    def test_hdf5_source(self, data):
         h5py = pytest.importorskip("h5py")
-        path = str(tmp_path / "s.h5")
-        with h5py.File(path, "w") as fh:
-            fh.create_dataset("data", data=data)
-        it = ChunkIterator(path, 40, dataset="data")
-        assert len(it) == 3
-        np.testing.assert_allclose(
-            np.concatenate([c.numpy() for c in it]), data, rtol=1e-6
-        )
+        with mh.TemporaryDirectory() as d:
+            path = os.path.join(d, "s.h5")
 
-    def test_csv_source(self, tmp_path, data):
-        path = str(tmp_path / "s.csv")
-        np.savetxt(path, data, delimiter=",", header="a,b,c,d,e,f")
-        it = ChunkIterator(path, 30, header_lines=1)
-        np.testing.assert_allclose(
-            np.concatenate([c.numpy() for c in it]), data, rtol=1e-5
-        )
+            def write():
+                with h5py.File(path, "w") as fh:
+                    fh.create_dataset("data", data=data)
 
-    def test_dataset_required_for_hdf5(self, tmp_path, data):
+            mh.on_pid0(write)  # one writer; every rank reads the shared path
+            it = ChunkIterator(path, 40, dataset="data")
+            assert len(it) == 3
+            np.testing.assert_allclose(
+                np.concatenate([c.numpy() for c in it]), data, rtol=1e-6
+            )
+
+    def test_csv_source(self, data):
+        with mh.TemporaryDirectory() as d:
+            path = os.path.join(d, "s.csv")
+            mh.on_pid0(
+                lambda: np.savetxt(path, data, delimiter=",", header="a,b,c,d,e,f")
+            )
+            it = ChunkIterator(path, 30, header_lines=1)
+            np.testing.assert_allclose(
+                np.concatenate([c.numpy() for c in it]), data, rtol=1e-5
+            )
+
+    def test_dataset_required_for_hdf5(self, data):
         h5py = pytest.importorskip("h5py")
-        path = str(tmp_path / "x.h5")
-        with h5py.File(path, "w") as fh:
-            fh.create_dataset("data", data=data)
-        with pytest.raises(ValueError, match="dataset"):
-            ChunkIterator(path, 10)
-        with pytest.raises(FileNotFoundError):
-            ChunkIterator(str(tmp_path / "missing.h5"), 10, dataset="data")
+        with mh.TemporaryDirectory() as d:
+            path = os.path.join(d, "x.h5")
+
+            def write():
+                with h5py.File(path, "w") as fh:
+                    fh.create_dataset("data", data=data)
+
+            mh.on_pid0(write)
+            with pytest.raises(ValueError, match="dataset"):
+                ChunkIterator(path, 10)
+            with pytest.raises(FileNotFoundError):
+                ChunkIterator(os.path.join(d, "missing.h5"), 10, dataset="data")
 
 
 class TestIOWindows:
     """Satellite: the uniform start/stop row-window contract across the
     chunked readers (what ChunkIterator is built on)."""
 
-    def test_hdf5_window(self, tmp_path, data):
+    def test_hdf5_window(self, data):
         h5py = pytest.importorskip("h5py")
-        path = str(tmp_path / "w.h5")
-        with h5py.File(path, "w") as fh:
-            fh.create_dataset("d", data=data)
-        x = ht.load_hdf5(path, "d", split=0, start=10, stop=35)
-        np.testing.assert_allclose(x.numpy(), data[10:35], rtol=1e-6)
-        # stop past the end clips like a python slice
-        x = ht.load_hdf5(path, "d", split=0, start=95, stop=10_000)
-        np.testing.assert_allclose(x.numpy(), data[95:], rtol=1e-6)
+        with mh.TemporaryDirectory() as d:
+            path = os.path.join(d, "w.h5")
 
-    def test_csv_window(self, tmp_path, data):
-        path = str(tmp_path / "w.csv")
-        np.savetxt(path, data, delimiter=",", header="h", comments="# ")
-        x = ht.load_csv(path, sep=",", header_lines=1, split=0, start=7, stop=50)
-        np.testing.assert_allclose(x.numpy(), data[7:50], rtol=1e-5)
+            def write():
+                with h5py.File(path, "w") as fh:
+                    fh.create_dataset("d", data=data)
 
-    def test_csv_negative_window_raises(self, tmp_path, data):
-        path = str(tmp_path / "w2.csv")
-        np.savetxt(path, data, delimiter=",")
-        with pytest.raises(ValueError, match="row count"):
-            ht.load_csv(path, sep=",", start=-5)
+            mh.on_pid0(write)
+            x = ht.load_hdf5(path, "d", split=0, start=10, stop=35)
+            np.testing.assert_allclose(x.numpy(), data[10:35], rtol=1e-6)
+            # stop past the end clips like a python slice
+            x = ht.load_hdf5(path, "d", split=0, start=95, stop=10_000)
+            np.testing.assert_allclose(x.numpy(), data[95:], rtol=1e-6)
 
-    def test_netcdf_window(self, tmp_path, data):
-        path = str(tmp_path / "w.nc")
-        ht.save_netcdf(ht.array(data, split=0), path, "d")
-        x = ht.load_netcdf(path, "d", split=0, start=3, stop=41)
-        np.testing.assert_allclose(x.numpy(), data[3:41], rtol=1e-6)
+    def test_csv_window(self, data):
+        with mh.TemporaryDirectory() as d:
+            path = os.path.join(d, "w.csv")
+            mh.on_pid0(
+                lambda: np.savetxt(path, data, delimiter=",", header="h", comments="# ")
+            )
+            x = ht.load_csv(path, sep=",", header_lines=1, split=0, start=7, stop=50)
+            np.testing.assert_allclose(x.numpy(), data[7:50], rtol=1e-5)
+
+    def test_csv_negative_window_raises(self, data):
+        with mh.TemporaryDirectory() as d:
+            path = os.path.join(d, "w2.csv")
+            mh.on_pid0(lambda: np.savetxt(path, data, delimiter=","))
+            with pytest.raises(ValueError, match="row count"):
+                ht.load_csv(path, sep=",", start=-5)
+
+    def test_netcdf_window(self, data):
+        with mh.TemporaryDirectory() as d:
+            path = os.path.join(d, "w.nc")
+            # save_netcdf is itself collective — every rank participates,
+            # writing through the replicated shared path
+            ht.save_netcdf(ht.array(data, split=0), path, "d")
+            x = ht.load_netcdf(path, "d", split=0, start=3, stop=41)
+            np.testing.assert_allclose(x.numpy(), data[3:41], rtol=1e-6)
 
 
 def _slow_chunks(data, chunk_rows, delay):
@@ -151,7 +178,17 @@ class TestPrefetcher:
         assert STREAM_STATS["prefetch_hits"] > 0
 
     def test_read_bound_consumer_stalls(self, data):
-        list(Prefetcher(_slow_chunks(data, 30, 0.03), depth=2))
+        p = Prefetcher(_slow_chunks(data, 30, 0.03), depth=2)
+        if jax.process_count() > 1:
+            # already-staged iterables degrade to synchronous inline under
+            # multiple controllers (a producer thread would issue device
+            # work concurrently with the consumer's collective dispatch) —
+            # assert the documented degrade, not the overlap
+            assert p._thread is None
+            list(p)
+            assert STREAM_STATS["stalls"] == 0
+            return
+        list(p)
         assert STREAM_STATS["stalls"] > 0
         assert STREAM_STATS["overlap_seconds"] >= 0.0
 
@@ -180,7 +217,10 @@ class TestPrefetcher:
         it = Prefetcher(_slow_chunks(data, 10, 0.02), depth=2)
         next(it)
         it.close()
-        assert not it._thread.is_alive()
+        if jax.process_count() > 1:
+            assert it._thread is None  # sync-inline degrade: nothing to join
+        else:
+            assert not it._thread.is_alive()
         it.close()  # idempotent
         with pytest.raises(StopIteration):
             next(it)

@@ -173,6 +173,45 @@ def validate(line: str, obj: dict) -> None:
                 f"{obj.get('serve_lockstep_divergences')!r}: concurrent "
                 "serving batches issued collectives out of lockstep"
             )
+    # frame/shuffle gates (r14). Absent when the frame subprocess failed
+    # (the driver folds a frame_error note instead) — absence is not a
+    # violation, a present-but-failing value is.
+    if "frame_groupby_rows_per_s" in obj:
+        rps = obj["frame_groupby_rows_per_s"]
+        if not isinstance(rps, (int, float)) or isinstance(rps, bool) or rps <= 0:
+            raise ValueError(
+                f"'frame_groupby_rows_per_s' must be a positive number, got "
+                f"{rps!r}: the shuffle groupby aggregated no rows"
+            )
+        speedup = obj.get("frame_groupby_speedup")
+        if not isinstance(speedup, (int, float)) or isinstance(speedup, bool):
+            raise ValueError(
+                f"'frame_groupby_speedup' must be numeric, got {speedup!r}"
+            )
+        if speedup < 2.0:
+            raise ValueError(
+                f"frame_groupby_speedup {speedup} < 2.0: the one-shuffle "
+                "segment-reduce groupby is not beating the sort-then-loop "
+                "decomposition at low cardinality — the engine's reason to exist"
+            )
+        if obj.get("frame_warm_compiles") != 0:
+            raise ValueError(
+                f"frame_warm_compiles must be 0, got {obj.get('frame_warm_compiles')!r}: "
+                "a warm groupby retraced/recompiled instead of replaying its "
+                "cached plan/merge programs"
+            )
+        if obj.get("frame_divergences") != 0:
+            raise ValueError(
+                f"frame_divergences must be 0, got {obj.get('frame_divergences')!r}: "
+                "the shuffle groupby disagreed with its numpy bincount oracle — "
+                "the throughput numbers describe a wrong answer"
+            )
+        if obj.get("frame_exchanges_per_operand") != 1:
+            raise ValueError(
+                "frame_exchanges_per_operand must be 1, got "
+                f"{obj.get('frame_exchanges_per_operand')!r}: the engine's "
+                "contract is exactly ONE bounded ragged exchange per operand"
+            )
     if "stream_speedup" in obj:
         # reported only on hosts with a core to run the producer on (the
         # worker emits a stream_overlap note instead on single-core hosts)
