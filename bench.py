@@ -1305,7 +1305,7 @@ def serve_worker():
 
         region = Region("warm serve phase")
         batched_rps, p50_ms, p99_ms, batched_stats = run_leg(batched)
-        unbatched_rps, _, _, _ = run_leg(unbatched)
+        unbatched_rps, _, _, unbatched_stats = run_leg(unbatched)
         warm_compiles = region.compiles + region.traces
         assert warm_compiles == 0, region.stats()
         assert batched_stats["bucket_misses"] == 0, batched_stats
@@ -1341,6 +1341,14 @@ def serve_worker():
                 "serve_bucket_hit_rate": round(hits / max(1, total_b), 3),
                 "serve_warm_compiles": int(warm_compiles),
                 "serve_lockstep_divergences": divergences,
+                # r16 fault-ladder counters: the warm measured path must
+                # never climb a recovery rung or shed a deadline
+                "serve_shed": int(
+                    batched_stats["shed"] + unbatched_stats["shed"]
+                ),
+                "serve_restores": int(
+                    batched_stats["restores"] + unbatched_stats["restores"]
+                ),
                 "serve_unit": (
                     f"open-loop predict pipeline requests/s at "
                     f"{1.0 / SERVE_INTERARRIVAL_S:.0f} req/s offered load "
@@ -1499,6 +1507,8 @@ def _compact_summary(out, detail_path):
         "serve_p99_ms",
         "serve_warm_compiles",
         "serve_lockstep_divergences",
+        "serve_shed",
+        "serve_restores",
         "serve_error",
         "frame_groupby_rows_per_s",
         "frame_groupby_speedup",

@@ -47,6 +47,8 @@ __all__ = [
     "MPICommunication",
     "CUDA_AWARE_MPI",
     "collective_lockstep",
+    "replicated_decision",
+    "replicated_ids",
 ]
 
 # canonical mesh-axis name carrying the DNDarray ``split`` dimension
@@ -516,6 +518,40 @@ def _replicated_decision_impl(flag: bool) -> bool:
     )
     votes = multihost_utils.process_allgather(np.asarray([flag], dtype=np.bool_))
     return bool(np.asarray(votes).any())
+
+
+def replicated_ids(ids, *, cap: int = 64, active: bool = True) -> frozenset:
+    """Union a small process-local set of integer ids across every
+    process — the set-valued sibling of :func:`replicated_decision`, for
+    decisions that need consensus on WHICH members, not just whether any.
+
+    The motivating caller is elastic shrink under multiple controllers:
+    ``probe`` only sees this process's addressable devices, so each rank
+    holds a partial unhealthy set; building survivor meshes from partial
+    sets would give every rank a DIFFERENT mesh. One fixed-width
+    allgather (``cap`` slots, -1 padded — rank-invariant shape, so the
+    collective itself is lockstep-safe) returns the identical union
+    everywhere. ``active=False`` — or a single-process world — returns
+    the local set without dispatching anything."""
+    local = frozenset(int(i) for i in ids)
+    if not active or jax.process_count() == 1:
+        return local
+    if len(local) > cap:
+        raise ValueError(
+            f"replicated_ids: {len(local)} ids exceed the {cap}-slot frame"
+        )
+    from . import _hooks
+
+    def impl() -> frozenset:
+        from jax.experimental import multihost_utils
+
+        _hooks.fault_point("collective.replicated_ids", shape=(cap,), dtype="int32")
+        frame = np.full((cap,), -1, dtype=np.int32)
+        frame[: len(local)] = sorted(local)
+        gathered = np.asarray(multihost_utils.process_allgather(frame)).ravel()
+        return frozenset(int(i) for i in gathered if i >= 0)
+
+    return _hooks.guarded_call("collective.replicated_ids", impl)
 
 
 def collective_lockstep(tree):

@@ -147,6 +147,27 @@ def _single_writer_commit(label: str, write) -> None:
         raise err
 
 
+def _h5_read_open(path: str):
+    """Open an HDF5 file read-only WITHOUT taking the HDF5 file lock.
+
+    At ws>1 every process opens the same file, and two processes can
+    legitimately hold read handles concurrently (streaming chunk reads,
+    overlapped prefetch). libhdf5's default file locking makes that a
+    race: a reader fails with ``BlockingIOError: unable to lock file``
+    while a sibling's handle is open on storage where POSIX locks are
+    per-file, not per-handle. Lock-free reads are safe here because no
+    reader ever races a writer's bytes — every write in this module
+    stages into a temp file and commits by atomic rename, so an open
+    path always names a fully-written file. ``locking=False`` needs
+    h5py >= 3.5 (HDF5 >= 1.12.1); older stacks fall back to the default
+    locked open.
+    """
+    try:
+        return h5py.File(path, "r", locking=False)
+    except TypeError:  # pragma: no cover - old h5py without the kwarg
+        return h5py.File(path, "r")
+
+
 def supports_hdf5() -> bool:
     """Whether h5py is available (reference ``io.py``)."""
     return __HAS_HDF5
@@ -223,7 +244,7 @@ def load_hdf5(
         raise TypeError(f"dataset must be str, not {type(dataset)}")
     comm = sanitize_comm(comm)
     dtype = types.canonical_heat_type(dtype)
-    with h5py.File(path, "r") as handle:
+    with _h5_read_open(path) as handle:
         data = handle[dataset]
         fshape = tuple(data.shape)
         r0, r1 = _row_window(fshape[0] if fshape else 0, start, stop)
@@ -420,7 +441,7 @@ def load_netcdf(
         return _load_netcdf3(path, variable, dtype, split, device, comm, start, stop)
     if not __HAS_HDF5:
         raise ImportError("netCDF support needs netCDF4 or h5py installed")
-    with h5py.File(path, "r") as probe:
+    with _h5_read_open(path) as probe:
         if variable not in probe:
             raise KeyError(f"variable {variable!r} not found in {path}")
         # netCDF convention: a PURE dimension (no data) is a dimension

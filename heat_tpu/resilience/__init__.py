@@ -78,7 +78,11 @@ from .errors import (
     DivergenceError,
     LockstepError,
     NoHealthyDevicesError,
+    PoisonRequestError,
     ResilienceError,
+    ServeDeadlineError,
+    ServeError,
+    ServeOverloadError,
 )
 from .guard import Fingerprint, Guard, fingerprint, guarded
 from .guard import check as check_divergence
@@ -123,6 +127,10 @@ __all__ = [
     "LockstepError",
     "DegradeError",
     "NoHealthyDevicesError",
+    "ServeError",
+    "ServeOverloadError",
+    "ServeDeadlineError",
+    "PoisonRequestError",
     # guard
     "fingerprint",
     "Fingerprint",

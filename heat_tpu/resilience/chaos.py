@@ -29,7 +29,8 @@ single uniform draw):
   perturbed silently, so the same logical shard digests differently
   across its replica group — the injected silently-diverged replica that
   :func:`~heat_tpu.resilience.guard.guarded` must catch;
-- ``device_loss`` — supervisor sites only (``supervisor.step``): one
+- ``device_loss`` — supervisor/serve sites only (``supervisor.step``,
+  ``serve.dispatch``): one
   healthy device of the default mesh is marked unhealthy
   (:func:`~heat_tpu.resilience.degrade.mark_unhealthy`) and a
   ``RuntimeError`` is raised mid-step — the simulated died-accelerator
@@ -67,7 +68,9 @@ from ..core import _hooks
 __all__ = ["chaos", "Injection", "FaultSchedule"]
 
 # site categories a chaos context can target (site id prefix before ".")
-_KNOWN_TARGETS = ("io", "collective", "checkpoint", "guard", "degrade", "supervisor")
+_KNOWN_TARGETS = (
+    "io", "collective", "checkpoint", "guard", "degrade", "supervisor", "serve",
+)
 
 
 @dataclass
@@ -106,7 +109,8 @@ class chaos:
         Per-site probabilities in [0, 1] for each fault kind.
     straggler_delay : float
         Seconds a ``straggler`` fault sleeps before the site proceeds.
-    targets : sequence of {"io", "collective", "checkpoint", "guard", "degrade"}
+    targets : sequence of {"io", "collective", "checkpoint", "guard",
+        "degrade", "supervisor", "serve"}
         Which site categories participate; others always pass.
     max_faults : int, optional
         Stop injecting after this many faults (transient-fault recipe).
@@ -222,7 +226,7 @@ class chaos:
                         Injection(site, "lockstep_divergence", "dropped recorded event")
                     )
                 return  # silent either way: detection is the sanitizer's job
-        if site.startswith("supervisor."):
+        if site.startswith(("supervisor.", "serve.")):
             threshold += self.device_loss
             if u < threshold:
                 dev = _lose_device(u)

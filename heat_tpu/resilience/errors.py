@@ -33,6 +33,10 @@ __all__ = [
     "LockstepError",
     "DegradeError",
     "NoHealthyDevicesError",
+    "ServeError",
+    "ServeOverloadError",
+    "ServeDeadlineError",
+    "PoisonRequestError",
 ]
 
 
@@ -152,3 +156,77 @@ class NoHealthyDevicesError(DegradeError):
         super().__init__(
             f"all {total} mesh device(s) are marked unhealthy; nothing to shrink onto"
         )
+
+
+class ServeError(ResilienceError):
+    """Base class for the serving layer's request-survival contract
+    errors (:mod:`heat_tpu.serve`): an accepted request is always
+    answered — with rows or with one of these."""
+
+
+class ServeOverloadError(ServeError):
+    """Admission control fast-reject: the service queue is past its
+    high-water depth. Raised in the SUBMITTING thread before the request
+    is enqueued — a rejected request was never accepted, so the survival
+    contract does not cover it (back off and resubmit).
+
+    Attributes
+    ----------
+    depth : int
+        Queue depth observed at rejection.
+    high_water : int
+        The configured admission limit.
+    """
+
+    def __init__(self, depth: int, high_water: int):
+        self.depth = int(depth)
+        self.high_water = int(high_water)
+        super().__init__(
+            f"serve queue overloaded: depth {depth} >= high water {high_water} "
+            "— request rejected before enqueue (back off and resubmit)"
+        )
+
+
+class ServeDeadlineError(ServeError, TimeoutError):
+    """A request's deadline expired while it waited in the queue; it was
+    shed before padding a batch (dead rows never reach the device).
+
+    Attributes
+    ----------
+    endpoint : str
+        The endpoint the request was bound for.
+    waited_ms : float
+        How long the request sat in the queue before shedding.
+    deadline_ms : float
+        Its configured deadline.
+    """
+
+    def __init__(self, endpoint: str, waited_ms: float, deadline_ms: float):
+        self.endpoint = endpoint
+        self.waited_ms = float(waited_ms)
+        self.deadline_ms = float(deadline_ms)
+        super().__init__(
+            f"request to {endpoint!r} shed: waited {waited_ms:.1f}ms past its "
+            f"{deadline_ms:.1f}ms deadline"
+        )
+
+
+class PoisonRequestError(ServeError):
+    """Batch bisection isolated THIS request as the one whose payload
+    makes its endpoint fail; its batch neighbors were answered normally.
+    The underlying endpoint failure is chained as ``__cause__`` and
+    quoted in the message.
+
+    Attributes
+    ----------
+    endpoint : str
+        The endpoint that rejected the payload.
+    """
+
+    def __init__(self, endpoint: str, cause: BaseException):
+        self.endpoint = endpoint
+        super().__init__(
+            f"poison request isolated by batch bisection on {endpoint!r}: "
+            f"{type(cause).__name__}: {cause}"
+        )
+        self.__cause__ = cause

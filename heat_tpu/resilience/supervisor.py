@@ -570,12 +570,28 @@ class Supervisor:
     def _restore_latest(self) -> Optional[Tuple[dict, int]]:
         """Load the newest committed checkpoint, falling back to older ones
         when a load fails verification; None when nothing is loadable."""
+        multi = jax.process_count() > 1
         for ckpt_step, path in self._valid_dirs():
             if ckpt_step not in self._run_steps:
                 continue  # a stale dir from another run is not ours to restore
+            # the STATE_NAME read is rank-LOCAL: if it failed on one rank
+            # only and that rank silently fell back to an OLDER candidate
+            # while its peers proceeded into the load_checkpoint
+            # collectives below, the ranks would issue mismatched
+            # collective sequences and hang. One replicated verdict per
+            # candidate keeps every rank on the same directory.
+            meta, err = None, None
             try:
+                _hooks.fault_point(
+                    "supervisor.restore_manifest", step=ckpt_step, path=path
+                )
                 with open(os.path.join(path, STATE_NAME), "rb") as f:
                     meta = json.loads(f.read().decode())
+            except (OSError, ValueError) as exc:
+                err = exc
+            if replicated_decision(err is not None, active=multi):
+                continue  # unreadable somewhere: all ranks skip together
+            try:
                 state: dict = dict(meta.get("scalars", {}))
                 # ``meta`` is read from this host's view of the checkpoint
                 # directory, but the directory is shared storage by the
@@ -593,8 +609,9 @@ class Supervisor:
                     state[name] = arr.numpy() if kind == "ndarray" else arr
                 return state, int(meta.get("step", ckpt_step))
             except ResilienceError:
-                continue  # corrupt/unreadable: try the next older checkpoint
-            except (OSError, ValueError):
+                # load_checkpoint failures re-raise on EVERY rank together
+                # (the checkpoint layer's _replicated_raise), so this
+                # fallback to an older candidate stays in lockstep too
                 continue
         return None
 

@@ -17,9 +17,15 @@ STREAM/KERNEL_STATS. See docs/SERVING.md for the architecture, the
 bucket-policy latency/throughput model, and the multi-controller
 lockstep contract.
 """
+from ..resilience.errors import (
+    PoisonRequestError,
+    ServeDeadlineError,
+    ServeError,
+    ServeOverloadError,
+)
 from ._stats import SERVE_STATS, refresh_latency_stats, reset_serve_stats
 from .batching import BucketPolicy, PendingBatch
-from .service import Request, ServeService
+from .service import DEFAULT_DISPATCH_POLICY, Request, ServeService
 from .session import ModelRegistry
 
 __all__ = [
@@ -31,4 +37,9 @@ __all__ = [
     "Request",
     "ServeService",
     "ModelRegistry",
+    "DEFAULT_DISPATCH_POLICY",
+    "ServeError",
+    "ServeOverloadError",
+    "ServeDeadlineError",
+    "PoisonRequestError",
 ]

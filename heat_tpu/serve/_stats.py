@@ -12,8 +12,23 @@ The service emits passive ``serve.*`` events (see
   before, i.e. every program it runs is warm;
 - ``serve.latency`` (``ms``) — one request completed, measured from
   enqueue to result-ready (the client-visible number);
-- ``serve.error`` — a dispatch raised; the batch's requests carry the
-  error and the service lives on.
+- ``serve.error`` — a dispatch raised; the fault ladder takes over and
+  the service lives on;
+- ``serve.retry`` (``attempt``) — a transiently-failed batch is being
+  re-run under the RetryPolicy backoff schedule;
+- ``serve.bisect`` (``requests``) — retry exhausted (or a poison-class
+  failure): the batch is being bisected to isolate the poison request(s);
+- ``serve.restore`` (``cause``) — resident models were rolled back to
+  the last registry snapshot;
+- ``serve.shrink`` (``old``, ``new``) — the mesh was shrunk to its
+  healthy devices and the registry elastically restored onto it;
+- ``serve.redispatch`` (``requests``) — in-flight requests were
+  re-dispatched after a restore/shrink recovery;
+- ``serve.shed`` (``endpoint``, ``waited_ms``) — a request's deadline
+  expired in the queue; it was answered with ``ServeDeadlineError``
+  before padding a batch;
+- ``serve.rejected`` (``depth``) — admission control fast-rejected a
+  submit past the high-water queue depth (``ServeOverloadError``).
 
 One module-level observer folds them into :data:`SERVE_STATS`; the
 percentile gauges are recomputed from a bounded latency ring on
@@ -38,6 +53,13 @@ SERVE_STATS = {
     "bucket_hits": 0,       # batches whose (endpoint, bucket) was warm
     "bucket_misses": 0,
     "errors": 0,
+    "retries": 0,           # fault ladder: transient batch re-runs
+    "bisections": 0,        # fault ladder: poison-isolation episodes
+    "restores": 0,          # fault ladder: registry snapshot rollbacks
+    "shrinks": 0,           # fault ladder: elastic mesh shrinks
+    "redispatched": 0,      # requests re-dispatched after a recovery
+    "shed": 0,              # requests shed on an expired deadline
+    "rejected": 0,          # submits fast-rejected by admission control
     "queue_depth": 0,       # gauge: depth at the last enqueue
     "max_queue_depth": 0,
     "p50_latency_ms": 0.0,  # gauges: refreshed from the latency ring
@@ -92,6 +114,20 @@ def _observer(event: str, ctx: dict) -> None:
             _LATENCIES.append(float(ctx.get("ms", 0.0)))
         elif event == "serve.error":
             SERVE_STATS["errors"] += 1
+        elif event == "serve.retry":
+            SERVE_STATS["retries"] += 1
+        elif event == "serve.bisect":
+            SERVE_STATS["bisections"] += 1
+        elif event == "serve.restore":
+            SERVE_STATS["restores"] += 1
+        elif event == "serve.shrink":
+            SERVE_STATS["shrinks"] += 1
+        elif event == "serve.redispatch":
+            SERVE_STATS["redispatched"] += int(ctx.get("requests", 1))
+        elif event == "serve.shed":
+            SERVE_STATS["shed"] += 1
+        elif event == "serve.rejected":
+            SERVE_STATS["rejected"] += 1
 
 
 _hooks.add_observer(_observer)

@@ -36,6 +36,46 @@ everything else in this tree: every process submits the same requests
 in the same order and calls the same barriers; batches between barriers
 form from identical queue segments by identical rules, and lockstep
 pinning keeps one total order of programs. See docs/SERVING.md.
+
+The request-survival contract
+-----------------------------
+Every ACCEPTED request is eventually answered — result rows or a typed
+error — never lost and never answered twice, under device loss, poison
+payloads, snapshot corruption, and overload. A failed batch dispatch
+rides a fault ladder borrowed from the Supervisor's classification
+policy (:mod:`heat_tpu.resilience.supervisor`):
+
+- transient ``OSError``/``TimeoutError`` — re-run the batch under the
+  :class:`~heat_tpu.resilience.RetryPolicy` backoff schedule; exhausted
+  retries escalate to bisection;
+- payload-class failures (``ValueError``/``TypeError``/... , or
+  exhausted retries) — BISECT the batch: halves re-run until the poison
+  request(s) are isolated and answered with
+  :class:`~heat_tpu.resilience.PoisonRequestError` while their former
+  neighbors get their rows;
+- ``CollectiveTimeout``/``DivergenceError`` (resident state suspect) —
+  restore the registry from its last snapshot and replay the in-flight
+  batch once;
+- ``RuntimeError`` (a died device surfaces as an XLA runtime error) —
+  ``probe`` + cross-rank consensus on the unhealthy set
+  (:func:`~heat_tpu.core.communication.replicated_ids`, so every rank
+  builds the SAME survivor mesh), ``shrink_to_healthy``, elastic-restore
+  the registry onto the survivors, and re-dispatch the in-flight batch;
+- ``NoHealthyDevicesError`` — nothing to run on: the batch is answered
+  with the error and the dispatcher lives to reject further work.
+
+Admission control bounds the other end: ``max_queue_depth`` fast-rejects
+submits past the high-water mark
+(:class:`~heat_tpu.resilience.ServeOverloadError`, raised in the client
+thread before enqueue), and per-request deadlines shed expired requests
+with :class:`~heat_tpu.resilience.ServeDeadlineError` before they pad a
+batch. Deadline shedding is wall-clock driven and therefore
+single-controller only (armed with the async triggers); overload
+rejection at ws>1 counts requests accepted since the last barrier — a
+rank-invariant number — instead of the racing instantaneous depth.
+Recovery activity is counted in ``SERVE_STATS``
+(``retries/bisections/restores/shrinks/shed/rejected/redispatched``);
+the recovery-free warm path is byte-identical to PR 13's.
 """
 from __future__ import annotations
 
@@ -48,14 +88,51 @@ import numpy as np
 
 from ..core import _hooks
 from ..core import factories
-from ..resilience.errors import ResilienceError
-from ..core.communication import collective_lockstep
+from ..resilience.errors import (
+    NoHealthyDevicesError,
+    PoisonRequestError,
+    ResilienceError,
+    ServeDeadlineError,
+    ServeOverloadError,
+)
+from ..resilience.retry import RetryPolicy
+from ..core.communication import (
+    collective_lockstep,
+    replicated_decision,
+    replicated_ids,
+    sanitize_comm,
+)
 from ..core.dndarray import DNDarray
 from .batching import BucketPolicy, PendingBatch
 from .session import ModelRegistry
 from ._stats import SERVE_STATS, refresh_latency_stats
 
-__all__ = ["Request", "ServeService"]
+__all__ = ["Request", "ServeService", "DEFAULT_DISPATCH_POLICY"]
+
+# backoff for transient dispatch errors: fast, deterministic (seeded,
+# zero jitter — every rank must sleep the same schedule), bounded
+DEFAULT_DISPATCH_POLICY = RetryPolicy(
+    max_attempts=3, base_delay=0.02, max_delay=0.5, multiplier=2.0,
+    jitter=0.0, seed=0, max_elapsed=10.0,
+)
+
+
+def _classify_dispatch(exc: BaseException) -> str:
+    """Map a dispatch exception to a ladder rung. The Supervisor's
+    policy table with one serving-specific refinement: an exception that
+    is none of the known infrastructure classes (``ValueError``,
+    ``TypeError``, ...) is a PAYLOAD problem — bisect, don't die."""
+    if isinstance(exc, NoHealthyDevicesError):
+        return "fatal"
+    if isinstance(exc, ResilienceError):
+        # checked BEFORE OSError/TimeoutError: CollectiveTimeout
+        # subclasses TimeoutError and must not be retried in place
+        return "restore"
+    if isinstance(exc, (OSError, TimeoutError)):
+        return "retry"
+    if isinstance(exc, RuntimeError):
+        return "probe"
+    return "bisect"
 
 
 class Request:
@@ -63,21 +140,38 @@ class Request:
 
     ``payload`` is host data shaped ``(rows, *row_shape)``; the result
     (set by the dispatcher) is the matching slice of the batch output.
+    ``deadline_ms`` bounds the time the request may wait in the queue
+    before it is shed with :class:`ServeDeadlineError` (None: no bound).
+    ``answers`` counts ``_finish`` calls — the survival contract says it
+    ends at exactly 1, and the chaos soak asserts it.
     """
 
     __slots__ = ("endpoint", "payload", "rows", "enqueue_t",
+                 "deadline_ms", "deadline_t", "answers",
                  "_done", "_result", "_error")
 
-    def __init__(self, endpoint: str, payload: np.ndarray):
+    def __init__(self, endpoint: str, payload: np.ndarray,
+                 deadline_ms: Optional[float] = None):
         self.endpoint = endpoint
         self.payload = payload
         self.rows = int(payload.shape[0])
         self.enqueue_t = time.monotonic()
+        self.deadline_ms = None if deadline_ms is None else float(deadline_ms)
+        self.deadline_t = (
+            None if deadline_ms is None
+            else self.enqueue_t + float(deadline_ms) / 1e3
+        )
+        self.answers = 0
         self._done = threading.Event()
         self._result = None
         self._error: Optional[BaseException] = None
 
     def _finish(self, result=None, error: Optional[BaseException] = None) -> None:
+        self.answers += 1
+        if self._done.is_set():
+            # first answer wins; extra calls are only COUNTED so the
+            # never-answered-twice contract stays provable
+            return
         self._result = result
         self._error = error
         _hooks.observe(
@@ -136,6 +230,14 @@ class ServeService:
         service carries on — the supervised-service loop.
     snapshot_every : int
         Snapshot cadence in batches (0 disables periodic snapshots).
+    max_queue_depth : int, optional
+        Admission high-water mark: a ``submit`` that would push the
+        queue past this depth is fast-rejected with
+        :class:`ServeOverloadError` (None: unbounded, the PR 13
+        behavior).
+    retry : RetryPolicy, optional
+        Backoff schedule for transiently-failed batch dispatches
+        (default :data:`DEFAULT_DISPATCH_POLICY`).
     """
 
     def __init__(
@@ -144,11 +246,19 @@ class ServeService:
         registry: Optional[ModelRegistry] = None,
         snapshot_dir: Optional[str] = None,
         snapshot_every: int = 0,
+        max_queue_depth: Optional[int] = None,
+        retry: Optional[RetryPolicy] = None,
     ):
+        if max_queue_depth is not None and max_queue_depth < 1:
+            raise ValueError(
+                f"max_queue_depth must be >= 1, got {max_queue_depth}"
+            )
         self.policy = policy or BucketPolicy()
         self.registry = registry or ModelRegistry()
         self.snapshot_dir = snapshot_dir
         self.snapshot_every = int(snapshot_every)
+        self.max_queue_depth = max_queue_depth
+        self.retry = retry or DEFAULT_DISPATCH_POLICY
         self._endpoints: Dict[str, Callable] = {}
         self._cond = threading.Condition()
         self._queue: List = []
@@ -156,6 +266,11 @@ class ServeService:
         self._seen_buckets = set()
         self._have_snapshot = False
         self._batches_since_snapshot = 0
+        # requests accepted since the last barrier: the rank-invariant
+        # depth admission control uses under multiple controllers (the
+        # instantaneous queue length races the dispatcher's pops at
+        # rank-divergent moments)
+        self._since_barrier = 0
         # the latency timer and the max-batch count trigger both fire at
         # rank-divergent moments (see the module docstring); arm them
         # only when there is no other rank to diverge from
@@ -193,10 +308,17 @@ class ServeService:
         return sorted(self._endpoints)
 
     # ------------------------------------------------------------- clients
-    def submit(self, endpoint: str, payload) -> Request:
+    def submit(self, endpoint: str, payload,
+               deadline_ms: Optional[float] = None) -> Request:
         """Enqueue ``payload`` rows for ``endpoint``; returns a
         :class:`Request` future. ``payload`` is host data shaped
-        ``(rows, *row_shape)`` (one sample: shape ``(1, ...)``)."""
+        ``(rows, *row_shape)`` (one sample: shape ``(1, ...)``).
+        ``deadline_ms`` bounds queue wait: a request still undispatched
+        past it is answered with :class:`ServeDeadlineError` instead of
+        padding a batch (single-controller only — wall clocks are
+        rank-divergent; see the module docstring). A submit past
+        ``max_queue_depth`` raises :class:`ServeOverloadError` without
+        enqueueing — a rejected request was never accepted."""
         if endpoint not in self._endpoints:
             raise KeyError(
                 f"unknown endpoint {endpoint!r}; known: {self.endpoints()}"
@@ -204,13 +326,32 @@ class ServeService:
         payload = np.asarray(payload)
         if payload.ndim < 1 or payload.shape[0] < 1:
             raise ValueError("payload must be (rows, ...) with rows >= 1")
-        request = Request(endpoint, payload)
+        request = Request(endpoint, payload, deadline_ms=deadline_ms)
+        reject = None
         with self._cond:
             if self._closed:
                 raise RuntimeError("service is closed")
-            self._queue.append(request)
-            depth = len(self._queue)
-            self._cond.notify()
+            if self.max_queue_depth is not None:
+                # ws>1 counts accepts since the last barrier (every rank
+                # submits the same trace, so the count is identical
+                # everywhere); ws==1 uses the live queue depth. Control
+                # calls (flush/drain sentinels, submit_call work) never
+                # consume admission budget — only requests do.
+                depth_now = (
+                    sum(1 for x in self._queue if not isinstance(x, _Call))
+                    if self._async_triggers
+                    else self._since_barrier
+                )
+                if depth_now >= self.max_queue_depth:
+                    reject = depth_now
+            if reject is None:
+                self._queue.append(request)
+                self._since_barrier += 1
+                depth = len(self._queue)
+                self._cond.notify()
+        if reject is not None:
+            _hooks.observe("serve.rejected", depth=reject)
+            raise ServeOverloadError(reject, self.max_queue_depth)
         _hooks.observe("serve.request", depth=depth)
         return request
 
@@ -229,6 +370,7 @@ class ServeService:
             if self._closed:
                 raise RuntimeError("service is closed")
             self._queue.append(call)
+            self._since_barrier = 0
             self._cond.notify()
         return call
 
@@ -274,11 +416,15 @@ class ServeService:
             if self._closed:
                 return
             self._queue.append(call)
+            self._since_barrier = 0
             self._cond.notify()
 
     def drain(self, timeout: Optional[float] = None) -> None:
         """Block until every request submitted before this call has been
-        dispatched and answered."""
+        dispatched and answered. Safe to call mid-recovery: the fault
+        ladder always terminates with every in-flight request answered,
+        so the barrier behind it is reached regardless of which rung the
+        dispatcher is currently climbing."""
         self.submit_call(lambda: None).result(timeout)
 
     def stats(self) -> dict:
@@ -319,6 +465,8 @@ class ServeService:
             kind, item = work
             if kind == "batch":
                 self._dispatch_batch(item)
+            elif kind == "shed":
+                self._shed(item)
             else:
                 self._run_call(item)
 
@@ -334,6 +482,19 @@ class ServeService:
             if isinstance(item, _Call):
                 call_at = i
                 break
+        if self._async_triggers:
+            # deadline shedding: expired requests are answered with the
+            # typed error BEFORE they can pad a batch. Wall-clock driven,
+            # hence single-controller only (same arming as the triggers)
+            now = time.monotonic()
+            expired = [
+                item for item in self._queue[:call_at]
+                if item.deadline_t is not None and now >= item.deadline_t
+            ]
+            if expired:
+                doomed = set(map(id, expired))
+                self._queue = [x for x in self._queue if id(x) not in doomed]
+                return ("shed", expired)
         groups: Dict[tuple, PendingBatch] = {}
         for item in self._queue[:call_at]:
             key = (item.endpoint, item.payload.shape[1:], item.payload.dtype.str)
@@ -371,40 +532,123 @@ class ServeService:
 
     def _wait_timeout(self) -> Optional[float]:
         """Seconds until the oldest pending group hits the latency
-        trigger (None: sleep until notified)."""
+        trigger or the nearest request deadline expires (None: sleep
+        until notified)."""
         if not self._async_triggers or not self._queue:
             return None
         oldest = None
+        deadline = None
         for item in self._queue:
             if isinstance(item, _Call):
                 break
             if oldest is None or item.enqueue_t < oldest:
                 oldest = item.enqueue_t
+            if item.deadline_t is not None and (
+                deadline is None or item.deadline_t < deadline
+            ):
+                deadline = item.deadline_t
         if oldest is None:
             return None
-        remaining = self.policy.max_latency_ms / 1e3 - (time.monotonic() - oldest)
+        now = time.monotonic()
+        remaining = self.policy.max_latency_ms / 1e3 - (now - oldest)
+        if deadline is not None:
+            remaining = min(remaining, deadline - now)
         return max(1e-4, remaining)
 
+    def _shed(self, expired: List[Request]) -> None:
+        """Answer deadline-expired requests with the typed error (off
+        the lock — finishing wakes client threads and fires observers)."""
+        now = time.monotonic()
+        for request in expired:
+            waited = (now - request.enqueue_t) * 1e3
+            _hooks.observe(
+                "serve.shed", endpoint=request.endpoint, waited_ms=waited
+            )
+            request._finish(error=ServeDeadlineError(
+                request.endpoint, waited, request.deadline_ms
+            ))
+
     def _dispatch_batch(self, group: PendingBatch) -> None:
+        """Run one batch through the fault ladder (module docstring):
+        retry -> bisect for payload faults, snapshot-restore + replay for
+        suspect state, probe + lockstep shrink + redispatch for device
+        loss. Terminates with EVERY request in ``group`` answered —
+        result rows or a typed error — no matter which rungs fire."""
+        endpoint = group.key[0]
+        attempt = 0
+        delays = None
+        restored = False
+        shrunk = False
+        while True:
+            try:
+                self._execute(group)
+                self._maybe_snapshot()
+                return
+            except Exception as exc:  # noqa: BLE001 - classified, never ignored
+                _hooks.observe("serve.error", endpoint=endpoint)
+                action = _classify_dispatch(exc)
+                if action == "retry":
+                    if delays is None:
+                        delays = self.retry.delays()
+                    if attempt < len(delays):
+                        _hooks.observe(
+                            "serve.retry", attempt=attempt + 1, endpoint=endpoint
+                        )
+                        self.retry.sleep(delays[attempt])
+                        attempt += 1
+                        continue
+                    action = "bisect"  # retries exhausted: suspect a payload
+                if action == "restore":
+                    # resident state is suspect (divergence / deserted
+                    # collective): roll back to the snapshot, replay once
+                    if not restored and self._restore_registry(exc):
+                        restored = True
+                        _hooks.observe(
+                            "serve.redispatch", requests=len(group.requests)
+                        )
+                        continue
+                    self._fail_group(group, exc)
+                    return
+                if action == "probe":
+                    # a died device surfaces as an XLA RuntimeError
+                    try:
+                        handled = not shrunk and self._shrink_and_restore(exc)
+                    except Exception as shrink_exc:  # noqa: BLE001 - e.g. nothing survives
+                        self._fail_group(group, shrink_exc)
+                        return
+                    if handled:
+                        shrunk = True
+                        _hooks.observe(
+                            "serve.redispatch", requests=len(group.requests)
+                        )
+                        continue
+                    # probe found a healthy mesh: not a device problem
+                    action = "bisect"
+                if action == "bisect":
+                    self._bisect(group, exc)
+                    return
+                # fatal (NoHealthyDevicesError, ...): answer and live on
+                self._fail_group(group, exc)
+                return
+
+    def _execute(self, group: PendingBatch) -> None:
+        """One batch attempt: stack, dispatch, scatter. Raises on any
+        failure WITHOUT finishing requests — that is the ladder's call."""
         endpoint, row_shape, dtype_str = group.key
-        try:
-            stacked = group.stack(self.policy)
-            bucket = int(stacked.shape[0])
-            bucket_key = (endpoint, bucket, row_shape, dtype_str)
-            hit = bucket_key in self._seen_buckets
-            x = factories.array(stacked, split=0)
-            out = self._endpoints[endpoint](x)
-            # pin this program to completion before the next independent
-            # one launches: multi-controller collective order stays total
-            collective_lockstep(out._raw if isinstance(out, DNDarray) else out)
-            host = out.numpy() if isinstance(out, DNDarray) else np.asarray(out)
-            self._seen_buckets.add(bucket_key)
-        except Exception as exc:  # noqa: BLE001 - delivered to the clients
-            _hooks.observe("serve.error", endpoint=endpoint)
-            for request in group.requests:
-                request._finish(error=exc)
-            self._maybe_restore(exc)
-            return
+        stacked = group.stack(self.policy)
+        bucket = int(stacked.shape[0])
+        bucket_key = (endpoint, bucket, row_shape, dtype_str)
+        hit = bucket_key in self._seen_buckets
+        _hooks.fault_point(
+            "serve.dispatch", endpoint=endpoint, bucket=bucket, rows=group.rows
+        )
+        x = factories.array(stacked, split=0)
+        out = self._endpoints[endpoint](x)
+        # pin this program to completion before the next independent
+        # one launches: multi-controller collective order stays total
+        collective_lockstep(out._raw if isinstance(out, DNDarray) else out)
+        host = out.numpy() if isinstance(out, DNDarray) else np.asarray(out)
+        self._seen_buckets.add(bucket_key)
         _hooks.observe(
             "serve.batch",
             requests=len(group.requests),
@@ -416,7 +660,109 @@ class ServeService:
         for request in group.requests:
             request._finish(result=host[offset:offset + request.rows])
             offset += request.rows
-        self._maybe_snapshot()
+
+    def _fail_group(self, group: PendingBatch, exc: BaseException) -> None:
+        for request in group.requests:
+            request._finish(error=exc)
+
+    def _bisect(self, group: PendingBatch, cause: BaseException) -> None:
+        """Isolate the poison request(s): re-run halves of the failed
+        batch until every still-failing singleton is answered with
+        :class:`PoisonRequestError` — its former batch neighbors get
+        their rows from the succeeding halves."""
+        endpoint = group.key[0]
+        requests = list(group.requests)
+        found: List[Request] = []
+
+        def fail_one(request: Request, exc: BaseException) -> None:
+            found.append(request)
+            request._finish(error=PoisonRequestError(endpoint, exc))
+
+        def run(part: List[Request], exc: BaseException) -> None:
+            if len(part) == 1:
+                fail_one(part[0], exc)
+                return
+            mid = len(part) // 2
+            for half in (part[:mid], part[mid:]):
+                sub = PendingBatch(group.key)
+                for request in half:
+                    sub.add(request)
+                try:
+                    self._execute(sub)
+                except Exception as sub_exc:  # noqa: BLE001 - recurse to isolate
+                    _hooks.observe("serve.error", endpoint=endpoint)
+                    run(half, sub_exc)
+
+        if len(requests) == 1:
+            fail_one(requests[0], cause)
+        else:
+            _hooks.observe("serve.bisect", requests=len(requests))
+            run(requests, cause)
+        if found:
+            # a poison payload may have corrupted resident state before
+            # raising: the old supervised-service rollback still applies
+            self._maybe_restore(cause)
+
+    def _shrink_and_restore(self, exc: BaseException) -> bool:
+        """Device-loss recovery: probe, reach cross-rank consensus on
+        the unhealthy set, shrink the mesh onto the survivors, and land
+        the resident registry on the new mesh. Returns False when the
+        probe (on every rank) found a healthy mesh — the failure was not
+        a device problem. Raises :class:`NoHealthyDevicesError` through
+        when nothing survives."""
+        from ..resilience import degrade
+
+        comm = sanitize_comm(None)
+        multi = jax.process_count() > 1
+        try:
+            degrade.probe(comm)
+        except ResilienceError:
+            raise
+        except Exception:  # noqa: BLE001 - a dead probe proves nothing new
+            pass
+        # every rank must build the SAME survivor mesh: probe only sees
+        # this process's addressable devices, so union the per-rank sets
+        # and take one replicated go/no-go — ranks shrink in lockstep
+        bad = replicated_ids(degrade.unhealthy_devices(), active=multi)
+        for dev in bad:
+            degrade.mark_unhealthy(dev)
+        if not replicated_decision(bool(bad), active=multi):
+            return False
+        old = comm.size
+        new_comm, _ = degrade.shrink_to_healthy(comm, set_default=True)
+        self._relocate_registry()
+        # programs compiled for the old mesh are dead; buckets re-warm
+        self._seen_buckets.clear()
+        _hooks.observe(
+            "serve.shrink", old=old, new=new_comm.size, cause=type(exc).__name__
+        )
+        return True
+
+    def _relocate_registry(self) -> None:
+        """Land every resident model's state on the (new) default mesh:
+        elastic-restore from the last snapshot when there is one
+        (``load_checkpoint`` reassembles shards onto the current mesh),
+        or round-trip live state through host memory otherwise."""
+        if self.snapshot_dir and self._have_snapshot:
+            try:
+                self.registry.restore(self.snapshot_dir)
+                _hooks.observe("serve.restore", cause="shrink")
+                return
+            # graftlint: G006 - best-effort: a failed elastic restore falls
+            # through to the live state_dict move below, never silent loss
+            except Exception:  # noqa: BLE001
+                _hooks.observe("serve.error", endpoint="<restore>")
+        for name in self.registry.names():
+            model = self.registry.get(name)
+            state_fn = getattr(model, "state_dict", None)
+            load_fn = getattr(model, "load_state_dict", None)
+            if state_fn is None or load_fn is None:
+                continue
+            state = {
+                k: (v.numpy() if isinstance(v, DNDarray) else v)
+                for k, v in state_fn().items()
+            }
+            load_fn(state)
 
     def _run_call(self, call: _Call) -> None:
         try:
@@ -435,27 +781,45 @@ class ServeService:
             return
         self._batches_since_snapshot = 0
         try:
+            _hooks.fault_point("serve.snapshot", directory=self.snapshot_dir)
             self.registry.snapshot(self.snapshot_dir)
             self._have_snapshot = True
-        except ResilienceError:
-            # a deserted collective / divergence is never "best-effort" —
-            # swallowing it here would wedge the other ranks
-            raise
-        except Exception:  # noqa: BLE001 - snapshots are best-effort
+        # graftlint: G006 - snapshots are best-effort; the checkpoint
+        # layer's _replicated_raise discipline makes any multi-process
+        # failure (ResilienceError included) symmetric, so every rank
+        # absorbs it together and the NEXT cadence hit retries (the
+        # previous good snapshot, if any, still stands)
+        except Exception:  # noqa: BLE001
             _hooks.observe("serve.error", endpoint="<snapshot>")
 
+    def _restore_registry(self, exc: BaseException) -> bool:
+        """Roll resident models back to the last snapshot ahead of a
+        batch replay; False when there is nothing to restore from (or
+        the restore itself failed, symmetrically on every rank)."""
+        if not self.snapshot_dir or not self._have_snapshot:
+            return False
+        try:
+            self.registry.restore(self.snapshot_dir)
+        # graftlint: G006 - symmetric absorb (see _maybe_snapshot); the
+        # False return escalates the ladder, nothing is lost silently
+        except Exception:  # noqa: BLE001
+            _hooks.observe("serve.error", endpoint="<restore>")
+            return False
+        _hooks.observe("serve.restore", cause=type(exc).__name__)
+        return True
+
     def _maybe_restore(self, exc: BaseException) -> None:
-        """After a dispatch error, roll the resident models back to the
-        last good snapshot (best-effort — the supervised-service loop).
-        """
+        """After a batch ultimately failed, roll the resident models back
+        to the last good snapshot (best-effort — the supervised-service
+        loop; the failing requests already carry their error)."""
         if not self.snapshot_dir or not self._have_snapshot:
             return
         try:
             self.registry.restore(self.snapshot_dir)
             _hooks.observe("serve.restore", cause=type(exc).__name__)
-        except ResilienceError:
-            raise
-        except Exception:  # noqa: BLE001 - the original error already went out
+        # graftlint: G006 - symmetric absorb (see _maybe_snapshot); the
+        # failing requests already carry their typed error
+        except Exception:  # noqa: BLE001
             _hooks.observe("serve.error", endpoint="<restore>")
 
 

@@ -29,6 +29,7 @@ import numpy as np
 from ..core import io as core_io
 from ..core.dndarray import DNDarray
 from ..resilience import load_checkpoint, save_checkpoint
+from ..resilience.checkpoint import _replicated_raise
 
 __all__ = ["ModelRegistry"]
 
@@ -118,9 +119,19 @@ class ModelRegistry:
         models (each must already be registered — the snapshot stores
         state, not code). Returns the list of restored names."""
         path = os.path.join(directory, _MANIFEST)
-        core_io._check_path_visible(path)
-        with open(path) as f:
-            manifest = json.load(f)
+        # the manifest read is rank-LOCAL (plain open on a shared path):
+        # if it fails on one process only, that process must not desert
+        # the load_checkpoint collectives below — gather the per-rank
+        # status first and raise on EVERY rank together (the failing
+        # rank its real error, peers a CheckpointError naming it)
+        manifest, err = None, None
+        try:
+            core_io._check_path_visible(path)
+            with open(path) as f:
+                manifest = json.load(f)
+        except Exception as exc:  # noqa: BLE001 - re-raised symmetrically
+            err = exc
+        _replicated_raise("registry restore", err)
         wanted = set(names) if names is not None else None
         restored: List[str] = []
         # graftflow: F003 - manifest is the single-writer-committed shared

@@ -123,6 +123,39 @@ def submesh(k: int):
     return picked
 
 
+def gather_axis0(buf):
+    """Host-read a global axis-0-sharded jax array on EVERY process.
+
+    ``np.asarray`` on a multi-process global array raises (the buffer is
+    not fully addressable), so tests asserting on raw ``shard_map``
+    outputs — ring_map, halo_exchange — must assemble instead: the
+    process-local shards concatenate in split order, then one ragged
+    host allgather stitches the per-process blocks in pid order (mesh
+    device order IS pid order, so the concat is the global array).
+    Collective at ws>1 — every process must call. Single-process this is
+    plain ``np.asarray``.
+    """
+    import numpy as np
+
+    if getattr(buf, "is_fully_addressable", True):
+        return np.asarray(jax.device_get(buf))
+    shards = sorted(
+        buf.addressable_shards, key=lambda s: (s.index[0].start or 0)
+    )
+    seen = set()
+    blocks = []
+    for s in shards:
+        start = s.index[0].start or 0
+        if start in seen:  # replicated coordinate (multi-axis meshes)
+            continue
+        seen.add(start)
+        blocks.append(np.asarray(jax.device_get(s.data)))
+    local = np.concatenate(blocks, axis=0)
+    return np.concatenate(
+        communication.ragged_process_allgather(local, axis=0), axis=0
+    )
+
+
 def on_pid0(fn) -> None:
     """Run a filesystem mutation exactly once per process group.
 

@@ -171,6 +171,34 @@ class TestBenchCheck:
         assert "fused_error" in obj
         assert len(line) < bench_check.LINE_BUDGET
 
+    def test_rejects_serve_fault_ladder_activity_on_warm_path(self):
+        # a fault-free warm serving run must never shed a deadline or
+        # roll the registry back — nonzero means the r16 ladder fires on
+        # the healthy path; absence (pre-r16 records) is tolerated
+        def serve_out(**over):
+            out = _synthetic_out()
+            out.update(
+                serve_requests_per_sec=800.0,
+                serve_batched_speedup=3.5,
+                serve_warm_compiles=0,
+                serve_lockstep_divergences=0,
+                serve_shed=0,
+                serve_restores=0,
+            )
+            out.update(over)
+            return out
+
+        line = json.dumps(bench._compact_summary(serve_out(), "d.json"))
+        assert bench_check.check(line)["serve_shed"] == 0
+        with pytest.raises(ValueError, match="shed deadline requests"):
+            bench_check.check(json.dumps(
+                bench._compact_summary(serve_out(serve_shed=2), "d.json")
+            ))
+        with pytest.raises(ValueError, match="rolled the registry back"):
+            bench_check.check(json.dumps(
+                bench._compact_summary(serve_out(serve_restores=1), "d.json")
+            ))
+
     def test_rejects_stream_no_overlap(self):
         # prefetch-on barely different from synchronous means the double
         # buffer bought nothing — the pipeline feature is regressing

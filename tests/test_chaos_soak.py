@@ -14,6 +14,14 @@ class TestChaosSoak(unittest.TestCase):
 
         self.assertEqual(chaos_soak.main(["--quick"]), 0)
 
+    def test_quick_serve_soak_passes(self):
+        """The r16 serving soak: seeded faults on every dispatch rung
+        (retry, bisect, restore, shrink, shed, reject) with the zero
+        lost / zero duplicated / oracle-equal survival proof."""
+        import chaos_soak
+
+        self.assertEqual(chaos_soak.main(["--serve", "--quick"]), 0)
+
 
 if __name__ == "__main__":
     unittest.main()

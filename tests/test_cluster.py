@@ -286,10 +286,14 @@ class TestParallelPrimitives(TestCase):
         y = rng.random((comm.size * 2, 4)).astype(np.float32)
         from heat_tpu.parallel import ring_map
 
+        from . import _mh_helpers as mh
+
         xj = ht.array(x, split=0).larray
         yj = ht.array(y, split=0).larray
         out = ring_map(lambda a, b: a @ b.T, xj, yj, comm)
-        np.testing.assert_allclose(np.asarray(out), x @ y.T, rtol=1e-5, atol=1e-5)
+        # raw shard_map output: ws>1 it is not fully addressable, so
+        # assemble via the collective helper instead of np.asarray
+        np.testing.assert_allclose(mh.gather_axis0(out), x @ y.T, rtol=1e-5, atol=1e-5)
 
     def test_halo_exchange(self):
         comm = ht.get_comm()
@@ -297,10 +301,12 @@ class TestParallelPrimitives(TestCase):
             pytest.skip("needs multi-device mesh")
         from heat_tpu.parallel import halo_exchange
 
+        from . import _mh_helpers as mh
+
         p = comm.size
         n = p * 6  # divisible for any world size (halo requires even shards)
         x = ht.arange(n, dtype=ht.float32, split=0).reshape((n, 1))
-        h = np.asarray(halo_exchange(x.larray, 1, comm))
+        h = mh.gather_axis0(halo_exchange(x.larray, 1, comm))
         block = n // p
         assert h.shape == (p, block + 2, 1)
         # interior shard i: first element is last element of shard i-1
@@ -319,12 +325,14 @@ class TestParallelPrimitives(TestCase):
 
         import jax.numpy as jnp
 
+        from . import _mh_helpers as mh
+
         p = comm.size
         n = p * 6 + 3  # non-divisible
         # raw (unpadded) array: the pad branch itself must run — a DNDarray
         # buffer would arrive pre-padded and leave it dead
         x = jnp.arange(n, dtype=jnp.float32).reshape(n, 1)
-        h = np.asarray(halo_exchange(x, 1, comm))
+        h = mh.gather_axis0(halo_exchange(x, 1, comm))
         block = -(-n // p)
         assert h.shape == (p, block + 2, 1)
         for i in range(1, p - 1):

@@ -841,6 +841,132 @@ def test_two_process_serving(tmp_path):
     assert finals[0] == finals[1], finals
 
 
+_SERVE_SHRINK_WORKER = r"""
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+pid = int(sys.argv[1]); nproc = int(sys.argv[2]); port = sys.argv[3]; shared = sys.argv[4]
+
+import heat_tpu as ht
+from heat_tpu import resilience as rz
+from heat_tpu.core import communication as comm_mod
+from heat_tpu.serve import BucketPolicy, ServeService, reset_serve_stats
+
+ht.init_distributed(
+    coordinator_address=f"localhost:{port}", num_processes=nproc, process_id=pid
+)
+assert jax.device_count() == 8 and jax.local_device_count() == 4
+
+cols, classes = 8, 4
+rng = np.random.default_rng(31)
+w_np = rng.normal(size=(cols, classes)).astype(np.float32)
+
+
+class Linear:
+    # snapshot-protocol model whose split-0 weight SPANS the process
+    # boundary; load_state_dict re-places it on the CURRENT default
+    # mesh, which is what makes the elastic relocate land on survivors
+    def __init__(self, w_host):
+        self.load_state_dict({"w": w_host})
+
+    def state_dict(self):
+        return {"w": self.w}
+
+    def load_state_dict(self, state):
+        self.w = ht.array(np.asarray(state["w"], dtype=np.float32), split=0)
+
+    def predict(self, x):
+        return x @ self.w
+
+
+reset_serve_stats()
+svc = ServeService(
+    policy=BucketPolicy(edges=(2, 4), max_batch=8),
+    snapshot_dir=shared,
+    snapshot_every=1,
+)
+svc.register_model("lin", Linear(w_np))
+assert svc._async_triggers is False
+
+xs = [rng.normal(size=(2, cols)).astype(np.float32) for _ in range(3)]
+# warm pass: the (2-row) bucket compiles and the first snapshot commits
+r = svc.submit("lin.predict", xs[0])
+svc.flush()
+np.testing.assert_allclose(np.asarray(r.result(300)), xs[0] @ w_np, atol=1e-4)
+
+# one chaos device loss at the next dispatch: same seed on both ranks,
+# so both mark the SAME global device and classify/probe/shrink in
+# lockstep (the replicated_ids union + one replicated go/no-go)
+sched = rz.FaultSchedule(events=[("serve.dispatch", 1, "device_loss")], seed=7)
+with sched:
+    reqs = [svc.submit("lin.predict", x) for x in xs[1:]]
+    svc.flush()
+    outs = [np.asarray(q.result(300)) for q in reqs]
+assert sched.pending() == [], sched.pending()
+
+stats = svc.stats()
+svc.close(300)
+new_comm = comm_mod.sanitize_comm(None)
+assert new_comm.size == 7, new_comm.size
+# the survivor mesh still spans BOTH processes
+procs = {int(d.process_index) for d in new_comm.mesh.devices.ravel()}
+assert procs == {0, 1}, procs
+for x, out in zip(xs[1:], outs):
+    np.testing.assert_allclose(out, x @ w_np, atol=1e-4)
+assert stats["shrinks"] == 1, stats
+assert stats["redispatched"] == 2, stats
+assert stats["restores"] == 1, stats  # the shrink-relocate restore
+acc = float(sum(abs(o).sum() for o in outs))
+rz.clear_unhealthy()
+print(f"WORKER{pid} SHRINK OK {new_comm.size} {stats['shrinks']} "
+      f"{stats['redispatched']} {acc:.4f}")
+"""
+
+
+@pytest.mark.skipif(
+    os.environ.get("HEAT_TPU_TEST_DEVICES", "8") != "8",
+    reason="one fixed 2x4 topology is enough for the matrix",
+)
+def test_two_process_serve_shrink_redispatch(tmp_path):
+    """PR 16 tentpole, end to end at real world size 2: a chaos device
+    loss mid-dispatch makes both ranks probe, agree on the casualty via
+    the replicated-ids union, shrink to the 7 survivors (still spanning
+    both processes), elastically restore the registry's process-spanning
+    sharded weights from the snapshot, and redispatch the in-flight
+    batch — every request answered exactly once with oracle-equal rows."""
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+
+    worker = tmp_path / "serve_shrink_worker.py"
+    worker.write_text(_SERVE_SHRINK_WORKER)
+    shared = tmp_path / "snap"
+    shared.mkdir()
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env.pop("HEAT_TPU_TEST_DEVICES", None)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(i), "2", str(port), str(shared)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = [p.communicate(timeout=600)[0] for p in procs]
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out}"
+        assert f"WORKER{i} SHRINK OK" in out, out
+    # identical survivor mesh, counters, and result checksum on each rank
+    finals = [out.strip().splitlines()[-1].split()[3:] for out in outs]
+    assert finals[0] == finals[1], finals
+
+
 _FRAME_WORKER = r"""
 import sys
 import jax

@@ -133,6 +133,26 @@ class TestCheckpointFailureModes(TestCase):
             self.assertIn(shard, str(cm.exception))
             self.assertIn("crc32", str(cm.exception))
 
+    def test_replicated_raise_is_symmetric_across_ranks(self):
+        """The ``_replicated_raise`` discipline, rank-divergently: an
+        error held by process 0 ONLY must raise on EVERY process — the
+        failing rank its real error, the peers a CheckpointError naming
+        the culprit — instead of rank 0 deserting the next collective
+        while its peers hang inside it."""
+        from heat_tpu.resilience.checkpoint import _replicated_raise
+
+        # symmetric no-error: a pure barrier, returns everywhere
+        _replicated_raise("probe", None)
+
+        err = ValueError("pid0-local failure") if mh.pid0() else None
+        with self.assertRaises((ValueError, rz.CheckpointError)) as cm:
+            _replicated_raise("registry restore", err)
+        if mh.pid0():
+            self.assertIs(cm.exception, err)  # the real error, unwrapped
+        else:
+            self.assertIn("process(es) [0]", str(cm.exception))
+            self.assertIn("registry restore", str(cm.exception))
+
     def test_verify_false_skips_checksum(self):
         x = ht.arange(23, dtype=ht.float32, split=0)
         with mh.TemporaryDirectory() as d:

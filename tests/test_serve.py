@@ -278,6 +278,263 @@ class TestServeService:
             )
 
 
+class TestFaultLadder:
+    """PR 16 tentpole: the request-survival contract, rung by rung.
+    Every accepted request is answered exactly once — result rows or a
+    typed error — whatever the dispatch path hits."""
+
+    def test_transient_dispatch_failure_retries_in_place(self):
+        reset_serve_stats()
+        calls = {"n": 0}
+        w = ht.array(np.full((3,), 2.0, np.float32))
+
+        def flaky(x):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise OSError("transient fabric hiccup")
+            return x * w
+
+        nosleep = ht.resilience.RetryPolicy(
+            max_attempts=3, base_delay=0.001, jitter=0.0, seed=0,
+            sleep=lambda s: None,
+        )
+        with ServeService(
+            policy=BucketPolicy(edges=(2,), max_batch=2), retry=nosleep
+        ) as s:
+            s.register_endpoint("flaky", flaky)
+            p = np.ones((2, 3), np.float32)
+            r = s.submit("flaky", p)
+            s.flush()
+            np.testing.assert_allclose(r.result(60), p * 2.0, rtol=1e-6)
+            stats = s.stats()
+        assert r.answers == 1
+        assert stats["retries"] == 1, stats
+        assert stats["bisections"] == 0, stats
+
+    def test_exhausted_retries_escalate_to_bisection(self):
+        reset_serve_stats()
+        nosleep = ht.resilience.RetryPolicy(
+            max_attempts=2, base_delay=0.001, jitter=0.0, seed=0,
+            sleep=lambda s: None,
+        )
+
+        def dead(x):
+            raise OSError("hard down")
+
+        with ServeService(
+            policy=BucketPolicy(edges=(1, 2), max_batch=2), retry=nosleep
+        ) as s:
+            s.register_endpoint("dead", dead)
+            r = s.submit("dead", np.ones((1, 2), np.float32))
+            s.flush()
+            with pytest.raises(serve.PoisonRequestError, match="hard down"):
+                r.result(60)
+            stats = s.stats()
+        assert r.answers == 1
+        assert stats["retries"] == 1, stats  # max_attempts=2 -> one retry
+
+    def test_poison_bisection_isolates_request_neighbors_succeed(self):
+        """One NaN payload inside a 4-request batch: bisection answers it
+        with PoisonRequestError while its 3 former neighbors get their
+        real rows."""
+        reset_serve_stats()
+        w = ht.array(np.full((3,), -1.0, np.float32))
+
+        def guard_nan(x):
+            if np.isnan(x.numpy()).any():
+                raise ValueError("NaN rows in payload")
+            return x * w
+
+        with ServeService(policy=BucketPolicy(edges=(1, 2, 4), max_batch=8)) as s:
+            s.register_endpoint("neg", guard_nan)
+            payloads = [np.full((1, 3), float(i), np.float32) for i in range(4)]
+            payloads[2] = payloads[2].copy()
+            payloads[2][0, 0] = np.nan
+            requests = [s.submit("neg", p) for p in payloads]
+            s.flush()
+            for i, (r, p) in enumerate(zip(requests, payloads)):
+                if i == 2:
+                    with pytest.raises(serve.PoisonRequestError, match="NaN rows"):
+                        r.result(60)
+                else:
+                    np.testing.assert_allclose(r.result(60), p * -1.0, rtol=1e-6)
+            stats = s.stats()
+        assert all(r.answers == 1 for r in requests)
+        assert stats["bisections"] == 1, stats
+
+    def test_resilience_error_restores_snapshot_and_replays(self, tmp_path):
+        """CollectiveTimeout mid-dispatch: the registry rolls back to the
+        last snapshot and the SAME in-flight batch replays to success."""
+        reset_serve_stats()
+        km = _fitted_kmeans(seed=21)
+        x = np.random.default_rng(22).normal(size=(4, 6)).astype(np.float32)
+        want = km.predict(ht.array(x, split=0)).numpy()
+        state = {"armed": False}
+
+        with ServeService(
+            policy=BucketPolicy(edges=(4,), max_batch=4),
+            snapshot_dir=str(tmp_path),
+            snapshot_every=1,
+        ) as s:
+            s.register_model("km", km)
+
+            def fragile(q):
+                if state["armed"]:
+                    state["armed"] = False
+                    raise ht.resilience.CollectiveTimeout("serve batch", 1.0, 1.0)
+                return s.registry.get("km").predict(q)
+
+            s.register_endpoint("fragile", fragile)
+            s.predict("km", x, timeout=60)  # snapshot taken
+            s.submit_call(lambda: state.update(armed=True)).result(60)
+            r = s.submit("fragile", x)
+            s.flush()
+            np.testing.assert_array_equal(
+                np.asarray(r.result(60)).ravel(), want.ravel()
+            )
+            stats = s.stats()
+        assert r.answers == 1
+        assert stats["restores"] == 1, stats
+        assert stats["redispatched"] == 1, stats
+
+    def test_device_loss_shrinks_mesh_and_redispatches(self, tmp_path):
+        """A chaos device loss at serve.dispatch: probe + shrink to the
+        survivors, registry elastically restored, in-flight requests
+        redispatched — answered with oracle-equal rows."""
+        from heat_tpu.core import communication as comm_mod
+
+        reset_serve_stats()
+        orig = comm_mod.sanitize_comm(None)
+        km = _fitted_kmeans(seed=23)
+        x = np.random.default_rng(24).normal(size=(4, 6)).astype(np.float32)
+        want = km.predict(ht.array(x, split=0)).numpy()
+        try:
+            with ServeService(
+                policy=BucketPolicy(edges=(4,), max_batch=4),
+                snapshot_dir=str(tmp_path),
+                snapshot_every=1,
+            ) as s:
+                s.register_model("km", km)
+                s.predict("km", x, timeout=60)  # warm + snapshot
+                sched = ht.resilience.FaultSchedule(
+                    events=[("serve.dispatch", 1, "device_loss")], seed=0
+                )
+                with sched:
+                    r = s.submit("km.predict", x)
+                    s.flush()
+                    got = r.result(120)
+                assert sched.pending() == []
+                np.testing.assert_array_equal(
+                    np.asarray(got).ravel(), want.ravel()
+                )
+                assert comm_mod.sanitize_comm(None).size == orig.size - 1
+                stats = s.stats()
+            assert r.answers == 1
+            assert stats["shrinks"] == 1, stats
+            assert stats["redispatched"] == 1, stats
+            assert stats["restores"] == 1, stats  # shrink-relocate restore
+        finally:
+            comm_mod.use_comm(orig)
+            ht.resilience.clear_unhealthy()
+
+    def test_overload_fast_reject_at_high_water(self):
+        reset_serve_stats()
+        gate = threading.Event()
+        running = threading.Event()
+
+        def block():
+            running.set()
+            gate.wait()
+
+        with ServeService(
+            policy=BucketPolicy(edges=(1, 2), max_batch=2), max_queue_depth=2
+        ) as s:
+            s.register_endpoint("id", lambda x: x)
+            try:
+                blocker = s.submit_call(block)
+                # the dispatcher must be INSIDE the call (not merely have
+                # it queued) so the queue holds exactly the requests below
+                assert running.wait(30)
+                accepted = [
+                    s.submit("id", np.ones((1, 2), np.float32)) for _ in range(2)
+                ]
+                with pytest.raises(serve.ServeOverloadError, match="back off"):
+                    s.submit("id", np.ones((1, 2), np.float32))
+            finally:
+                gate.set()
+            blocker.result(60)
+            for r in accepted:
+                np.testing.assert_array_equal(
+                    r.result(60), np.ones((1, 2), np.float32)
+                )
+            stats = s.stats()
+        assert all(r.answers == 1 for r in accepted)
+        assert stats["rejected"] == 1, stats
+        # a rejected submit was never accepted: nothing to answer
+        assert stats["requests"] == 2, stats
+
+    def test_deadline_shed_before_padding_a_batch(self):
+        reset_serve_stats()
+        gate = threading.Event()
+        running = threading.Event()
+
+        def block():
+            running.set()
+            gate.wait()
+
+        with ServeService(policy=BucketPolicy(edges=(1, 2), max_batch=2)) as s:
+            s.register_endpoint("id", lambda x: x)
+            try:
+                blocker = s.submit_call(block)
+                assert running.wait(30)
+                doomed = s.submit(
+                    "id", np.ones((1, 2), np.float32), deadline_ms=0.0
+                )
+            finally:
+                gate.set()
+            blocker.result(60)
+            with pytest.raises(serve.ServeDeadlineError, match="shed"):
+                doomed.result(60)
+            # the dispatcher lives on: a fresh request is served normally
+            p = np.full((2, 2), 5.0, np.float32)
+            np.testing.assert_array_equal(s.submit("id", p).result(60), p)
+            stats = s.stats()
+        assert doomed.answers == 1
+        assert stats["shed"] == 1, stats
+
+    def test_drain_quiesces_cleanly_mid_recovery(self):
+        """drain() called while the ladder is mid-climb: the barrier is
+        reached because recovery always terminates with the in-flight
+        batch answered."""
+        reset_serve_stats()
+        calls = {"n": 0}
+        started = threading.Event()
+
+        def slow_flaky(x):
+            calls["n"] += 1
+            started.set()
+            if calls["n"] <= 2:
+                raise OSError("transient")
+            return x
+
+        slow = ht.resilience.RetryPolicy(
+            max_attempts=3, base_delay=0.05, jitter=0.0, seed=0
+        )
+        with ServeService(
+            policy=BucketPolicy(edges=(1, 2), max_batch=2), retry=slow
+        ) as s:
+            s.register_endpoint("sf", slow_flaky)
+            p = np.ones((1, 2), np.float32)
+            r = s.submit("sf", p)
+            s.flush()
+            started.wait(30)  # the ladder is now retrying with real sleeps
+            s.drain(60)  # must ride out both backoff sleeps and return
+            np.testing.assert_array_equal(r.result(0), p)
+            stats = s.stats()
+        assert r.answers == 1
+        assert stats["retries"] == 2, stats
+
+
 class TestModelRegistry:
     def test_registry_basics(self):
         reg = ModelRegistry()
@@ -289,6 +546,18 @@ class TestModelRegistry:
         reg.remove("m")
         with pytest.raises(KeyError, match="no model registered"):
             reg.get("m")
+
+    def test_restore_unreadable_manifest_raises_typed(self, tmp_path):
+        """registry.restore failures are symmetric: the manifest read
+        rides ``_replicated_raise``, so a missing or corrupt manifest is
+        a typed error on EVERY rank instead of a rank-divergent desertion
+        (ws-2 coverage: test_resilience's ``_replicated_raise`` test)."""
+        reg = ModelRegistry()
+        with pytest.raises(FileNotFoundError):
+            reg.restore(str(tmp_path))  # no manifest was ever committed
+        (tmp_path / "registry.json").write_text("{not json")
+        with pytest.raises(ValueError):
+            reg.restore(str(tmp_path))
 
     def test_snapshot_restore_round_trip(self, tmp_path):
         km = _fitted_kmeans(seed=10)

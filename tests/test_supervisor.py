@@ -467,6 +467,36 @@ class TestRestoreFallback(TestCase):
         # silently fell back to step-2 and re-ran from there)
         self.assertEqual(delta(before)["restores"], 1)
 
+    def test_unreadable_manifest_skips_candidate_on_every_rank(self):
+        """An io_error reading the NEWEST candidate's state manifest: the
+        per-candidate replicated verdict makes every rank skip it together
+        (a rank that silently fell back alone would desert the
+        load_checkpoint collectives and hang the group) and the restore
+        falls back to the older commit."""
+        with mh.TemporaryDirectory() as d:
+            armed = []
+
+            def step(state, data, i):
+                if i == 3 and not armed:
+                    armed.append(i)
+                    raise rz.DivergenceError("suspect state")
+                return bump(state, data, i)
+
+            before = snap()
+            sched = rz.FaultSchedule(
+                events=[("supervisor.restore_manifest", 1, "io_error")], seed=0
+            )
+            with sched:
+                res = rz.Supervisor(
+                    d, rz.CheckpointSchedule(every_steps=1, keep_last=5),
+                    retry=nosleep(), checkpoint_retry=nosleep(),
+                ).run(step, make_state(), n_steps=5)
+            self.assertEqual(sched.pending(), [])
+        assert_bumped(self, res.state, 5)
+        # the newest (step-3) manifest was unreadable; the restore landed
+        # on step-2 and re-ran from there — one recovery, not a hang
+        self.assertEqual(delta(before)["restores"], 1)
+
 
 class TestRetryPolicyMaxElapsed(TestCase):
     def test_budget_cuts_schedule_short(self):

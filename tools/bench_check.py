@@ -173,6 +173,22 @@ def validate(line: str, obj: dict) -> None:
                 f"{obj.get('serve_lockstep_divergences')!r}: concurrent "
                 "serving batches issued collectives out of lockstep"
             )
+        # r16 fault-ladder counters: a fault-free warm run must never
+        # climb a recovery rung (restore) or shed a deadline — either
+        # means the ladder is misfiring on the healthy path. Absent on
+        # pre-r16 records; present-but-nonzero is the violation.
+        if "serve_shed" in obj and obj["serve_shed"] != 0:
+            raise ValueError(
+                f"serve_shed must be 0, got {obj['serve_shed']!r}: the "
+                "warm serving legs shed deadline requests under a "
+                "fault-free load"
+            )
+        if "serve_restores" in obj and obj["serve_restores"] != 0:
+            raise ValueError(
+                f"serve_restores must be 0, got {obj['serve_restores']!r}: "
+                "the warm serving legs rolled the registry back with no "
+                "fault injected"
+            )
     # frame/shuffle gates (r14). Absent when the frame subprocess failed
     # (the driver folds a frame_error note instead) — absence is not a
     # violation, a present-but-failing value is.

@@ -29,12 +29,26 @@ places the divergence in checkpoint-1's guard pass (on a non-primary
 replica) and the torn write in checkpoint-1's write stream — never in the
 baseline block, where a rewind would have no committed target.
 
+``--serve`` switches to the SERVING soak: one resident
+:class:`~heat_tpu.serve.ServeService` (fitted KMeans behind a guarded
+endpoint, snapshot-every-batch) driven through every rung of the
+request-survival fault ladder with phase-scoped fault schedules —
+a transient dispatch I/O error (retry), a device loss (probe + shrink +
+elastic registry restore + redispatch), a silent replica divergence
+caught by the endpoint's guard pass (snapshot restore + replay), a
+poison NaN payload (batch bisection), a failed snapshot write
+(absorbed), plus deadline shedding and admission-control overload.
+The proof asserted per trial: every ACCEPTED request was answered
+EXACTLY once — results oracle-equal to the pre-fault fitted model,
+failures carrying the typed error — no response lost, none duplicated,
+and the SERVE_STATS recovery counters match the schedule.
+
 Run directly (full soak), or the bounded quick tier (single seed per
 workload, small problems, <= 60 s — the tier-1 entry point via
 ``tests/test_chaos_soak.py``):
 
     XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
-        python tools/chaos_soak.py [--quick] [--seeds N]
+        python tools/chaos_soak.py [--quick] [--seeds N] [--serve]
 """
 from __future__ import annotations
 
@@ -234,6 +248,222 @@ def trial_lasso(seed: int, quick: bool) -> dict:
 
 WORKLOADS = (("kmeans", trial_kmeans), ("lasso", trial_lasso))
 
+SERVE_COUNTER_KEYS = (
+    "retries", "bisections", "restores", "shrinks",
+    "redispatched", "shed", "rejected",
+)
+
+
+def run_serve_trial(seed: int, quick: bool) -> dict:
+    """One serving-soak trial: drive a resident service through every
+    fault-ladder rung and prove the request-survival contract (module
+    docs). Raises on any failed proof; returns the JSON record."""
+    import threading
+
+    from heat_tpu import serve as serve_mod
+    from heat_tpu.resilience.errors import (
+        PoisonRequestError,
+        ServeDeadlineError,
+        ServeOverloadError,
+    )
+    from heat_tpu.serve import SERVE_STATS
+
+    orig_comm = comm_mod.sanitize_comm(None)
+    t0 = time.monotonic()
+    rng = np.random.default_rng(3000 + seed)
+    k, f = 3, 4
+    blob = rng.normal(size=(k, f)) * 5.0
+    pts = blob[rng.integers(0, k, size=64)] + rng.normal(size=(64, f)) * 0.3
+    km = KMeans(n_clusters=k, init="random", max_iter=10, tol=0.0,
+                random_state=seed)
+    km.fit(ht.array(pts.astype(np.float32), split=0))
+
+    def payloads(n, rows):
+        return [
+            (blob[rng.integers(0, k, size=rows)]
+             + rng.normal(size=(rows, f)) * 0.3).astype(np.float32)
+            for _ in range(n)
+        ]
+
+    def oracle(p):
+        # per-row argmin against the fitted centers: exact under any
+        # mesh size, so post-shrink results must compare EQUAL
+        return km.predict(ht.array(p, split=0)).numpy()
+
+    nosleep = rz.RetryPolicy(max_attempts=3, base_delay=0.001, jitter=0.0,
+                             seed=seed, sleep=lambda s: None)
+    accepted = []  # (request, expected ndarray | exception class)
+    schedules = []
+    before = dict(SERVE_STATS)
+
+    try:
+        with tempfile.TemporaryDirectory() as d:
+            svc = serve_mod.ServeService(
+                serve_mod.BucketPolicy(max_latency_ms=60_000.0, max_batch=64),
+                snapshot_dir=d, snapshot_every=1, max_queue_depth=32,
+                retry=nosleep,
+            )
+            registry = svc.registry
+            registry.register("km", km)
+
+            def classify(x):
+                if np.isnan(x.numpy()).any():
+                    raise ValueError("poison payload: NaN rows")
+                out = registry.get("km").predict(x)
+                # guard pass over the replicated resident state: the
+                # injectable surface for silent replica divergence
+                rz.check_divergence(
+                    registry.get("km").cluster_centers_, label="serve soak"
+                )
+                return out
+
+            svc.register_endpoint("classify", classify)
+
+            def run_phase(ps, wants):
+                rs = [svc.submit("classify", p) for p in ps]
+                accepted.extend(zip(rs, wants))
+                svc.drain(timeout=300)
+
+            def clean_phase(n, rows):
+                ps = payloads(n, rows)
+                run_phase(ps, [oracle(p) for p in ps])
+
+            # warmup (fault-free): first batch + first snapshot
+            clean_phase(2, 2)
+
+            # rung 1 — transient dispatch failure: retry in place
+            ps = payloads(3, 2)
+            wants = [oracle(p) for p in ps]
+            sched = rz.FaultSchedule(
+                events=[("serve.dispatch", 1, "io_error")], seed=seed)
+            schedules.append(sched)
+            with sched:
+                run_phase(ps, wants)
+
+            # rung 2 — device loss: probe + shrink + elastic registry
+            # restore onto the survivor mesh + redispatch
+            ps = payloads(3, 2)
+            wants = [oracle(p) for p in ps]
+            sched = rz.FaultSchedule(
+                events=[("serve.dispatch", 1, "device_loss")], seed=seed)
+            schedules.append(sched)
+            with sched:
+                run_phase(ps, wants)
+            shrunk = comm_mod.sanitize_comm(None).size
+            assert shrunk == orig_comm.size - 1, (
+                f"mesh is {shrunk} devices after device loss, "
+                f"expected {orig_comm.size - 1}"
+            )
+
+            # rung 3 — silent replica divergence in resident state:
+            # snapshot restore + replay. The endpoint's guard pass digests
+            # the centers once per surviving device (split=None => one
+            # replica per device, hit r+1 is replica r); perturbing any
+            # NON-primary replica makes the group digests disagree.
+            ps = payloads(3, 2)
+            wants = [oracle(p) for p in ps]
+            replica = int(rng.integers(1, shrunk))
+            sched = rz.FaultSchedule(
+                events=[("guard.shard", replica + 1, "divergence")], seed=seed)
+            schedules.append(sched)
+            with sched:
+                run_phase(ps, wants)
+
+            # rung 4 — poison payload: bisect the batch, typed error for
+            # the poison request, real rows for its former neighbors
+            ps = payloads(4, 1)
+            ps[2] = ps[2].copy()
+            ps[2][0, 0] = np.nan
+            wants = [
+                PoisonRequestError if i == 2 else oracle(p)
+                for i, p in enumerate(ps)
+            ]
+            run_phase(ps, wants)
+
+            # rung 5 — failed snapshot write: absorbed (the previous good
+            # snapshot stands), requests still answered
+            ps = payloads(2, 2)
+            wants = [oracle(p) for p in ps]
+            sched = rz.FaultSchedule(
+                events=[("serve.snapshot", 1, "io_error")], seed=seed)
+            schedules.append(sched)
+            with sched:
+                run_phase(ps, wants)
+            clean_phase(2, 2)  # next cadence hit snapshots cleanly
+
+            # admission control: block the dispatcher behind a control
+            # call, let one zero-deadline request expire (shed) and fill
+            # the queue to the high-water mark (overload fast-reject)
+            gate = threading.Event()
+            blocker = svc.submit_call(gate.wait)
+            shed_req = svc.submit("classify", payloads(1, 2)[0],
+                                  deadline_ms=0.0)
+            accepted.append((shed_req, ServeDeadlineError))
+            fp = payloads(1, 1)[0]
+            fw = oracle(fp)
+            rejections = 0
+            for _ in range(svc.max_queue_depth + 8):
+                try:
+                    accepted.append((svc.submit("classify", fp), fw))
+                except ServeOverloadError:
+                    rejections += 1
+                    break
+            assert rejections == 1, "queue never reached the high-water mark"
+            gate.set()
+            blocker.result(60)
+            svc.drain(timeout=300)
+            svc.close(timeout=60)
+
+        # ---- the proof: nothing lost, nothing duplicated, oracle-equal
+        for request, want in accepted:
+            assert request.done, "LOST request: accepted but never answered"
+            assert request.answers == 1, (
+                f"request answered {request.answers} times (contract: exactly 1)"
+            )
+            if isinstance(want, np.ndarray):
+                np.testing.assert_array_equal(
+                    np.asarray(request.result(0)).ravel(), want.ravel(),
+                    err_msg=f"seed={seed}: answered rows drifted from oracle",
+                )
+            else:
+                try:
+                    request.result(0)
+                    raise AssertionError(f"expected {want.__name__}")
+                except want:
+                    pass
+        for sched in schedules:
+            assert sched.pending() == [], f"schedule incomplete:\n{sched.report()}"
+        delta = {c: SERVE_STATS[c] - before[c] for c in SERVE_COUNTER_KEYS}
+        assert delta["retries"] >= 1, f"no retry counted: {delta}"
+        assert delta["shrinks"] == 1, f"expected exactly one shrink: {delta}"
+        assert delta["restores"] >= 3, (
+            f"expected shrink-relocate + divergence-replay + post-bisect "
+            f"rollback restores: {delta}"
+        )
+        assert delta["bisections"] == 1, f"expected one bisection: {delta}"
+        assert delta["redispatched"] == 6, (
+            f"expected the 3 device-loss + 3 divergence in-flight requests "
+            f"redispatched: {delta}"
+        )
+        assert delta["shed"] == 1 and delta["rejected"] == 1, delta
+        kinds = sorted(i.kind for s in schedules for i in s.injected)
+        assert kinds == ["device_loss", "divergence", "io_error", "io_error"], kinds
+        return {
+            "workload": "serve",
+            "seed": seed,
+            "ok": True,
+            "faults": {f"{i.kind}@{i.site}": i.detail or True
+                       for s in schedules for i in s.injected},
+            "recoveries": delta,
+            "requests": len(accepted),
+            "answered_once": True,
+            "mesh": f"{orig_comm.size}->{shrunk}",
+            "wall_s": round(time.monotonic() - t0, 2),
+        }
+    finally:
+        comm_mod.use_comm(orig_comm)
+        rz.clear_unhealthy()
+
 
 # ------------------------------------------------------------------ driver
 def run_trial(name: str, fn, seed: int, quick: bool) -> dict:
@@ -278,14 +508,21 @@ def main(argv=None) -> int:
                         help="bounded tier-1 soak: 1 seed/workload, small problems")
     parser.add_argument("--seeds", type=int, default=None,
                         help="seeds per workload (default 3; quick forces 1)")
+    parser.add_argument("--serve", action="store_true",
+                        help="serving soak: the ServeService request-survival "
+                             "contract instead of the supervisor workloads")
     args = parser.parse_args(argv)
     seeds = range(1 if args.quick else (args.seeds or 3))
 
     records, failures = [], 0
-    for name, fn in WORKLOADS:
+    workloads = (
+        (("serve", None),) if args.serve else WORKLOADS
+    )
+    for name, fn in workloads:
         for seed in seeds:
             try:
-                rec = run_trial(name, fn, seed, args.quick)
+                rec = (run_serve_trial(seed, args.quick) if name == "serve"
+                       else run_trial(name, fn, seed, args.quick))
             except Exception as e:  # noqa: BLE001 - report-all tool
                 failures += 1
                 rec = {"workload": name, "seed": seed, "ok": False,
@@ -293,13 +530,16 @@ def main(argv=None) -> int:
             records.append(rec)
             print(json.dumps(rec))
     oks = [r for r in records if r["ok"]]
+    timed = [r for r in oks if "mttr_s" in r]
     summary = {
         "summary": True,
         "trials": len(records),
         "failures": failures,
         "shrinks": sum(r["recoveries"]["shrinks"] for r in oks),
         "restores": sum(r["recoveries"]["restores"] for r in oks),
-        "mean_mttr_s": round(sum(r["mttr_s"] for r in oks) / max(1, len(oks)), 4),
+        "mean_mttr_s": round(
+            sum(r["mttr_s"] for r in timed) / max(1, len(timed)), 4
+        ),
     }
     print(json.dumps(summary))
     return 1 if failures else 0
