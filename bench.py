@@ -1201,6 +1201,7 @@ SERVE_REQUESTS = 192
 # the ratio measures the load generator, not batching)
 SERVE_INTERARRIVAL_S = 0.0004
 SERVE_MAX_BATCH = 32
+HEALTH_TICKS = 50  # probe ticks timed for the health_probe_ms metric
 
 
 def serve_worker():
@@ -1229,8 +1230,10 @@ def serve_worker():
     import heat_tpu as ht
     from heat_tpu import analysis
     from heat_tpu.analysis.sanitizer import Region
+    from heat_tpu.resilience.monitor import HEALTH_STATS, HealthMonitor
     from heat_tpu.serve import (
         SERVE_STATS,
+        Autoscaler,
         BucketPolicy,
         ServeService,
         refresh_latency_stats,
@@ -1283,8 +1286,15 @@ def serve_worker():
         )
 
     with analysis.lockstep():
+        # the batched leg carries a live autoscaler (r17): the dispatcher
+        # consults it after every work unit, so the measured warm phase
+        # proves the consult hook is free — a healthy idle mesh must
+        # produce ZERO scale events and no extra compiles. The long
+        # interval keeps probe ticks out of the measured legs; the first
+        # (always-due) tick lands in warm-up.
         batched = ServeService(
-            policy=BucketPolicy(max_batch=SERVE_MAX_BATCH, max_latency_ms=2.0)
+            policy=BucketPolicy(max_batch=SERVE_MAX_BATCH, max_latency_ms=2.0),
+            autoscaler=Autoscaler(HealthMonitor(interval_s=3600.0)),
         )
         batched.register_endpoint("pipe", predict_pipeline)
         unbatched = ServeService(policy=BucketPolicy(max_batch=1))
@@ -1326,6 +1336,19 @@ def serve_worker():
         unbatched.close()
     divergences = int(analysis.LOCKSTEP_STATS["divergences"])
 
+    # r17 health-monitor overhead: steady-state probe ticks must be
+    # trace-free (one device_put/get round-trip per device, no jit, no
+    # host sync), so monitoring is cheap enough to leave always-on.
+    mon = HealthMonitor(interval_s=0.0)
+    mon.tick()  # warm (first device_put touches lazy per-device state)
+    probe_region = Region("health probe ticks")
+    ms_before = float(HEALTH_STATS["probe_ms_total"])
+    for _ in range(HEALTH_TICKS):
+        mon.tick()
+    probe_ms = (float(HEALTH_STATS["probe_ms_total"]) - ms_before) / HEALTH_TICKS
+    probe_compiles = probe_region.compiles + probe_region.traces
+    assert probe_compiles == 0, probe_region.stats()
+
     occupancy = batched_stats["batched_rows"] / max(1, batched_stats["batches"])
     hits = batched_stats["bucket_hits"]
     total_b = hits + batched_stats["bucket_misses"]
@@ -1349,6 +1372,15 @@ def serve_worker():
                 "serve_restores": int(
                     batched_stats["restores"] + unbatched_stats["restores"]
                 ),
+                # r17 autoscaler + health monitor: a healthy idle mesh
+                # must never scale, and steady-state probe ticks must
+                # replay trace-free
+                "serve_scale_events": int(
+                    batched_stats["scale_events"]
+                    + unbatched_stats["scale_events"]
+                ),
+                "health_probe_ms": round(probe_ms, 4),
+                "health_probe_warm_compiles": int(probe_compiles),
                 "serve_unit": (
                     f"open-loop predict pipeline requests/s at "
                     f"{1.0 / SERVE_INTERARRIVAL_S:.0f} req/s offered load "
@@ -1509,6 +1541,9 @@ def _compact_summary(out, detail_path):
         "serve_lockstep_divergences",
         "serve_shed",
         "serve_restores",
+        "serve_scale_events",
+        "health_probe_ms",
+        "health_probe_warm_compiles",
         "serve_error",
         "frame_groupby_rows_per_s",
         "frame_groupby_speedup",

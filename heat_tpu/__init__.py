@@ -37,6 +37,7 @@ from .parallel.flatmove import MOVE_STATS
 from .analysis.sanitizer import COMPILE_STATS
 from .analysis.lockstep import LOCKSTEP_STATS
 from .resilience.supervisor import RECOVERY_STATS
+from .resilience.monitor import HEALTH_STATS
 from .core.lazy import FUSE_STATS
 from .stream import STREAM_STATS
 from .core.kernels import KERNEL_STATS

@@ -43,6 +43,15 @@ Supervised execution (PR 6):
   surviving mesh. Recovery activity is counted in
   :data:`RECOVERY_STATS`.
 
+Proactive health + elastic capacity (PR 17):
+
+- :mod:`~heat_tpu.resilience.monitor` — :class:`HealthMonitor` probe
+  ticks on a replicated cadence keep a per-device health ledger with
+  EWMA straggler detection and flap damping; a damped-then-healed
+  device is re-admitted by :func:`~heat_tpu.resilience.grow_to_healthy`
+  (the inverse of shrink), so capacity comes BACK. Counters in
+  :data:`HEALTH_STATS`.
+
 Chaos (:mod:`~heat_tpu.resilience.chaos`) injects every failure class
 deterministically — I/O errors, torn writes, silent corruption,
 timeouts, stragglers, replica divergence, device loss — either
@@ -66,6 +75,7 @@ from .checkpoint import (
 )
 from .degrade import (
     clear_unhealthy,
+    grow_to_healthy,
     healthy_devices,
     mark_unhealthy,
     probe,
@@ -86,6 +96,13 @@ from .errors import (
 )
 from .guard import Fingerprint, Guard, fingerprint, guarded
 from .guard import check as check_divergence
+from .monitor import (
+    HEALTH_STATS,
+    DeviceHealth,
+    HealthMonitor,
+    TickReport,
+    reset_health_stats,
+)
 from .retry import DEFAULT_CHECKPOINT_POLICY, NO_RETRY, RetryError, RetryPolicy
 from .supervisor import (
     RECOVERY_STATS,
@@ -147,6 +164,13 @@ __all__ = [
     "healthy_devices",
     "probe",
     "shrink_to_healthy",
+    "grow_to_healthy",
+    # health monitor
+    "HealthMonitor",
+    "DeviceHealth",
+    "TickReport",
+    "HEALTH_STATS",
+    "reset_health_stats",
     # supervisor
     "Supervisor",
     "SupervisorError",

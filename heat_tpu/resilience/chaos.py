@@ -35,6 +35,17 @@ single uniform draw):
   (:func:`~heat_tpu.resilience.degrade.mark_unhealthy`) and a
   ``RuntimeError`` is raised mid-step — the simulated died-accelerator
   that only probe + :func:`shrink_to_healthy` can recover from;
+- ``device_flap``  — device-probe sites only (``monitor.probe``,
+  ``degrade.probe``, which carry a ``device`` id): the probe of that one
+  device fails ONCE with a ``RuntimeError`` — the transient flap that
+  the :class:`~heat_tpu.resilience.monitor.HealthMonitor`'s flap
+  damping (``heal_after`` clean ticks before re-admission) exists to
+  absorb; unlike ``device_loss`` nothing is marked unhealthy directly,
+  the monitor's own replicated verdict does the degrading;
+- ``straggler_probe`` — device-probe sites only: the probe *sleeps*
+  ``straggler_delay`` seconds and proceeds (no exception) — the
+  injected slow device that only the monitor's EWMA-vs-median straggler
+  detection can catch;
 - ``lockstep_divergence`` — collective sites only, and only while a
   :class:`heat_tpu.analysis.lockstep.lockstep` sanitizer is recording:
   the event the sanitizer just recorded for this site is silently
@@ -69,7 +80,8 @@ __all__ = ["chaos", "Injection", "FaultSchedule"]
 
 # site categories a chaos context can target (site id prefix before ".")
 _KNOWN_TARGETS = (
-    "io", "collective", "checkpoint", "guard", "degrade", "supervisor", "serve",
+    "io", "collective", "checkpoint", "guard", "degrade", "supervisor",
+    "serve", "monitor",
 )
 
 
@@ -108,9 +120,10 @@ class chaos:
     io_error, timeout, torn_write, corrupt, straggler, divergence : float
         Per-site probabilities in [0, 1] for each fault kind.
     straggler_delay : float
-        Seconds a ``straggler`` fault sleeps before the site proceeds.
+        Seconds a ``straggler`` (or ``straggler_probe``) fault sleeps
+        before the site proceeds.
     targets : sequence of {"io", "collective", "checkpoint", "guard",
-        "degrade", "supervisor", "serve"}
+        "degrade", "supervisor", "serve", "monitor"}
         Which site categories participate; others always pass.
     max_faults : int, optional
         Stop injecting after this many faults (transient-fault recipe).
@@ -125,6 +138,8 @@ class chaos:
     divergence: float = 0.0
     device_loss: float = 0.0
     lockstep_divergence: float = 0.0
+    device_flap: float = 0.0
+    straggler_probe: float = 0.0
     straggler_delay: float = 0.05
     targets: Sequence[str] = _KNOWN_TARGETS
     max_faults: Optional[int] = None
@@ -136,7 +151,8 @@ class chaos:
         if unknown:
             raise ValueError(f"unknown chaos targets {sorted(unknown)}; known: {_KNOWN_TARGETS}")
         for knob in ("io_error", "timeout", "torn_write", "corrupt", "straggler",
-                     "divergence", "device_loss", "lockstep_divergence"):
+                     "divergence", "device_loss", "lockstep_divergence",
+                     "device_flap", "straggler_probe"):
             p = getattr(self, knob)
             if not 0.0 <= p <= 1.0:
                 raise ValueError(f"{knob} must be a probability in [0, 1], got {p}")
@@ -203,6 +219,24 @@ class chaos:
                     Injection(site, "divergence", f"replica {replica} byte {pos}")
                 )
                 return  # silent: detection is the guard layer's job
+        device = ctx.get("device")  # device id at per-device probe sites
+        if device is not None:
+            threshold += self.device_flap
+            if u < threshold:
+                self.injected.append(
+                    Injection(site, "device_flap", f"device {device}")
+                )
+                raise RuntimeError(
+                    f"chaos[{site}]: device {device} flapped "
+                    "(transient probe failure)"
+                )
+            threshold += self.straggler_probe
+            if u < threshold:
+                self.injected.append(
+                    Injection(site, "straggler_probe", f"slept {self.straggler_delay}s")
+                )
+                time.sleep(self.straggler_delay)  # slow probe, not a dead one
+                return
         threshold += self.io_error
         if u < threshold:
             self.injected.append(Injection(site, "io_error", ""))
@@ -255,6 +289,7 @@ def _drop_lockstep_event() -> bool:
 _SCHEDULED_KINDS = (
     "io_error", "timeout", "torn_write", "corrupt", "straggler",
     "divergence", "device_loss", "lockstep_divergence",
+    "device_flap", "straggler_probe",
 )
 
 
@@ -316,6 +351,22 @@ def _apply_fault(kind: str, site: str, ctx: dict, u: float, straggler_delay: flo
         )
         err.chaos_detail = f"device {dev}"
         raise err
+    if kind == "device_flap":
+        # only per-device probe sites (monitor.probe / degrade.probe)
+        # carry a device id; elsewhere the event stays pending
+        device = ctx.get("device")
+        if device is None:
+            return None
+        err = RuntimeError(
+            f"chaos[{site}]: device {device} flapped (transient probe failure)"
+        )
+        err.chaos_detail = f"device {device}"
+        raise err
+    if kind == "straggler_probe":
+        if ctx.get("device") is None:
+            return None
+        time.sleep(straggler_delay)  # slow probe, not a dead one
+        return f"slept {straggler_delay}s"
     raise ValueError(f"unknown scheduled fault kind {kind!r}; known: {_SCHEDULED_KINDS}")
 
 

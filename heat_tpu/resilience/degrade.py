@@ -18,6 +18,13 @@ means a *smaller mesh*, not a dead job:
   are independent there, and the pre- and post-shrink device counts are
   independent here for the same reason).
 
+- :func:`grow_to_healthy` is the inverse: once a degraded device's mark
+  is cleared (normally by the
+  :class:`~heat_tpu.resilience.monitor.HealthMonitor` after its
+  flap-damping streak), the mesh is rebuilt over the recovered device
+  set and live arrays are redistributed back onto it — capacity returns
+  instead of being lost forever.
+
 Values are preserved exactly: for every array,
 ``shrunk.numpy() == original.numpy()``; only the layout (device count,
 per-shard extents, padding) changes.
@@ -45,6 +52,7 @@ __all__ = [
     "healthy_devices",
     "probe",
     "shrink_to_healthy",
+    "grow_to_healthy",
 ]
 
 # process-wide registry of device ids excluded from future meshes
@@ -154,6 +162,67 @@ def shrink_to_healthy(
         if not isinstance(x, DNDarray):
             raise DegradeError(
                 f"shrink_to_healthy can only move DNDarrays, got {type(x)}"
+            )
+        new_arrays.append(_move_to_comm(x, new_comm))
+    if set_default:
+        from ..core.communication import use_comm
+
+        use_comm(new_comm)
+    return new_comm, new_arrays
+
+
+def grow_to_healthy(
+    comm: Optional[MeshCommunication] = None,
+    arrays: Sequence[DNDarray] = (),
+    *,
+    base: Optional[MeshCommunication] = None,
+    set_default: bool = False,
+) -> Tuple[MeshCommunication, List[DNDarray]]:
+    """The inverse of :func:`shrink_to_healthy`: rebuild the mesh over
+    every currently-healthy device of ``base`` and move live arrays
+    onto it — a recovered (or flap-damped and finally healed) device
+    means a *bigger* mesh again, not permanently lost capacity.
+
+    ``base`` names the capacity set (default: the full ``WORLD`` device
+    set); ``comm`` is the current — possibly shrunken — communicator the
+    arrays live on. Returns ``(new_comm, new_arrays)`` exactly like
+    shrink: same ``gshape``/``dtype``/``split``, values bit-preserved,
+    resharded onto the bigger mesh with the elastic-restore assembly.
+    When the healthy base set already equals the current mesh the inputs
+    are returned unchanged. ``set_default=True`` installs the grown
+    communicator as the process default (``use_comm``).
+
+    Safety invariants (see docs/RESILIENCE.md): this function admits
+    exactly the devices with no unhealthy mark — clearing a mark is the
+    *caller's* decision (normally the
+    :class:`~heat_tpu.resilience.monitor.HealthMonitor` after its
+    ``heal_after`` flap-damping streak), so a flapping device never
+    re-enters the mesh just because a grow ran; and under multiple
+    controllers the grow/no-grow decision must be replicated before the
+    call (the monitor's verdicts and the serve/supervisor hooks already
+    are), because a rank growing alone deserts every later collective.
+
+    Raises :class:`NoHealthyDevicesError` when nothing in ``base`` is
+    healthy.
+    """
+    comm = sanitize_comm(comm)
+    if base is None:
+        from ..core.communication import WORLD
+
+        base = WORLD
+    target = healthy_devices(base)
+    if not target:
+        raise NoHealthyDevicesError(len(base.mesh.devices.ravel().tolist()))
+    current_ids = [int(d.id) for d in comm.mesh.devices.ravel().tolist()]
+    if [int(d.id) for d in target] == current_ids and len(comm.mesh.axis_names) == 1:
+        return comm, list(arrays)
+
+    new_comm = MeshCommunication(devices=target)
+    new_arrays: List[DNDarray] = []
+    for x in arrays:
+        if not isinstance(x, DNDarray):
+            raise DegradeError(
+                f"grow_to_healthy can only move DNDarrays, got {type(x)}"
             )
         new_arrays.append(_move_to_comm(x, new_comm))
     if set_default:

@@ -64,7 +64,7 @@ from ..core._atomic import atomic_write_bytes
 from ..core.communication import replicated_decision, sanitize_comm
 from ..core.dndarray import DNDarray
 from .checkpoint import load_checkpoint, save_checkpoint
-from .degrade import probe, shrink_to_healthy, unhealthy_devices
+from .degrade import grow_to_healthy, probe, shrink_to_healthy, unhealthy_devices
 from .errors import NoHealthyDevicesError, ResilienceError
 from .guard import check as check_divergence
 from .retry import DEFAULT_CHECKPOINT_POLICY, RetryPolicy
@@ -101,6 +101,7 @@ RECOVERY_STATS: Dict[str, float] = {
     "retries": 0,                # transient step re-runs
     "restores": 0,               # checkpoint restores (state rewinds)
     "shrinks": 0,                # probe + shrink mesh recoveries
+    "grows": 0,                  # elastic re-grows onto healed devices
     "checkpoints": 0,            # committed checkpoints
     "checkpoint_failures": 0,    # saves absorbed (previous good kept)
     "gc_removed": 0,             # stale checkpoint dirs GC'd
@@ -128,6 +129,8 @@ def _on_observe(event: str, ctx: dict) -> None:
         RECOVERY_STATS["restores"] += 1
     elif kind == "shrink":
         RECOVERY_STATS["shrinks"] += 1
+    elif kind == "grow":
+        RECOVERY_STATS["grows"] += 1
     elif kind == "checkpoint":
         RECOVERY_STATS["checkpoints"] += 1
     elif kind == "checkpoint_failure":
@@ -251,6 +254,15 @@ class Supervisor:
         zero-overhead.
     set_default_on_shrink : bool
         Install the shrunken communicator as the process default.
+    monitor : HealthMonitor, optional
+        A :class:`~heat_tpu.resilience.monitor.HealthMonitor` consulted
+        BETWEEN steps (``maybe_tick``, so the cadence decision is
+        replicated at ws>1): a tick that degrades devices shrinks the
+        mesh proactively — before a dispatch has to fail — and a tick
+        that heals them grows it back
+        (:func:`~heat_tpu.resilience.degrade.grow_to_healthy`), moving
+        the live data and state arrays both ways. Long fits reclaim
+        capacity mid-run instead of finishing on the crippled mesh.
     """
 
     def __init__(
@@ -264,9 +276,11 @@ class Supervisor:
         max_restores_per_step: int = 2,
         divergence_check: bool = True,
         set_default_on_shrink: bool = True,
+        monitor=None,
     ):
         if max_recoveries < 0:
             raise ValueError(f"max_recoveries must be >= 0, got {max_recoveries}")
+        self.monitor = monitor
         self.directory = directory
         self.schedule = schedule or (
             CheckpointSchedule(every_steps=1) if directory else None
@@ -345,6 +359,8 @@ class Supervisor:
                 self._retry_first_failure.pop(step - 1, None)
                 if self._checkpointing_on:
                     self._maybe_checkpoint(state, step, force=bool(done))
+                if self.monitor is not None:
+                    state, data = self._monitor_step(state, data, step)
             except Exception as exc:  # noqa: BLE001 - classified, never ignored
                 state, data, step, detached = self._recover(
                     exc, state, data, step, label
@@ -367,6 +383,52 @@ class Supervisor:
             comm=self._comm,
             data=data,
         )
+
+    # ------------------------------------------------------ health monitor
+    def _monitor_step(self, state, data, step):
+        """Between-steps health hook (``monitor=``): a tick that degrades
+        devices shrinks the mesh BEFORE a dispatch has to fail; a tick
+        that heals them grows it back. Both moves carry the data tuple
+        AND the live state DNDarrays — unlike the reactive shrink rung
+        there is no checkpoint rewind: the run continues at the current
+        step on the resized mesh. The tick cadence and every verdict are
+        replicated (HealthMonitor's contract), so all ranks resize
+        together or not at all."""
+        report = self.monitor.maybe_tick()
+        if report is None or not (report.degraded or report.healed):
+            return state, data
+        arrays = list(data)
+        dnd_keys = [k for k, v in state.items() if isinstance(v, DNDarray)]
+        arrays += [state[k] for k in dnd_keys]
+        old = self._comm.size
+        if report.degraded:
+            survivors = [
+                d for d in self._comm.mesh.devices.ravel().tolist()
+                if int(d.id) not in unhealthy_devices()
+            ]
+            procs = {int(d.process_index) for d in survivors}
+            if len(procs) < jax.process_count():  # pragma: no cover - multihost only
+                # a proactive shrink must not strand whole processes
+                # mid-run; leave this loss to the reactive rung, whose
+                # detach logic owns that case
+                return state, data
+            new_comm, moved = shrink_to_healthy(
+                self._comm, arrays, set_default=self.set_default_on_shrink
+            )
+            event = "recovery.shrink"
+        else:
+            new_comm, moved = grow_to_healthy(
+                self._comm, arrays, base=self.monitor.base,
+                set_default=self.set_default_on_shrink,
+            )
+            event = "recovery.grow"
+        if new_comm is self._comm:
+            return state, data
+        _hooks.observe(event, step=step, old=old, new=new_comm.size)
+        self._comm = new_comm
+        for k, v in zip(dnd_keys, moved[len(data):]):
+            state[k] = v
+        return state, tuple(moved[: len(data)])
 
     # ------------------------------------------------------------- recovery
     def _recover(self, exc, state, data, step, label):

@@ -24,6 +24,7 @@ from ..resilience.errors import (
     ServeOverloadError,
 )
 from ._stats import SERVE_STATS, refresh_latency_stats, reset_serve_stats
+from .autoscale import Autoscaler
 from .batching import BucketPolicy, PendingBatch
 from .service import DEFAULT_DISPATCH_POLICY, Request, ServeService
 from .session import ModelRegistry
@@ -32,6 +33,7 @@ __all__ = [
     "SERVE_STATS",
     "refresh_latency_stats",
     "reset_serve_stats",
+    "Autoscaler",
     "BucketPolicy",
     "PendingBatch",
     "Request",

@@ -22,6 +22,15 @@ The service emits passive ``serve.*`` events (see
   the last registry snapshot;
 - ``serve.shrink`` (``old``, ``new``) — the mesh was shrunk to its
   healthy devices and the registry elastically restored onto it;
+- ``serve.grow`` (``old``, ``new``) — the mesh was grown back over
+  healed devices and the registry elastically restored onto it;
+- ``serve.scale`` (``direction``, ``old``, ``new``) — one
+  autoscaler-initiated scale event (proactive shrink or grow), as
+  opposed to the reactive fault-ladder shrink;
+- ``serve.depth`` (``depth``) — the dispatcher finished a unit of work;
+  ``depth`` is the request queue depth it left behind (keeps the
+  ``queue_depth`` gauge fresh across drains — enqueue-only updates left
+  it stale at the pre-drain value);
 - ``serve.redispatch`` (``requests``) — in-flight requests were
   re-dispatched after a restore/shrink recovery;
 - ``serve.shed`` (``endpoint``, ``waited_ms``) — a request's deadline
@@ -56,11 +65,13 @@ SERVE_STATS = {
     "retries": 0,           # fault ladder: transient batch re-runs
     "bisections": 0,        # fault ladder: poison-isolation episodes
     "restores": 0,          # fault ladder: registry snapshot rollbacks
-    "shrinks": 0,           # fault ladder: elastic mesh shrinks
+    "shrinks": 0,           # fault ladder / autoscaler: elastic mesh shrinks
+    "grows": 0,             # autoscaler: elastic re-grows onto healed devices
+    "scale_events": 0,      # autoscaler-initiated scale actions (both ways)
     "redispatched": 0,      # requests re-dispatched after a recovery
     "shed": 0,              # requests shed on an expired deadline
     "rejected": 0,          # submits fast-rejected by admission control
-    "queue_depth": 0,       # gauge: depth at the last enqueue
+    "queue_depth": 0,       # gauge: depth at the last enqueue OR dispatch
     "max_queue_depth": 0,
     "p50_latency_ms": 0.0,  # gauges: refreshed from the latency ring
     "p99_latency_ms": 0.0,
@@ -122,6 +133,14 @@ def _observer(event: str, ctx: dict) -> None:
             SERVE_STATS["restores"] += 1
         elif event == "serve.shrink":
             SERVE_STATS["shrinks"] += 1
+        elif event == "serve.grow":
+            SERVE_STATS["grows"] += 1
+        elif event == "serve.scale":
+            SERVE_STATS["scale_events"] += 1
+        elif event == "serve.depth":
+            # dispatch/drain-side gauge refresh: without it the gauge
+            # stays at the depth of the LAST ENQUEUE after a drain
+            SERVE_STATS["queue_depth"] = int(ctx.get("depth", 0))
         elif event == "serve.redispatch":
             SERVE_STATS["redispatched"] += int(ctx.get("requests", 1))
         elif event == "serve.shed":

@@ -1,0 +1,161 @@
+"""Queue-driven elastic autoscaling policy for the resident service.
+
+ROADMAP item 1's missing serving piece: grow/shrink the mesh as
+``queue_depth`` moves, without dropping in-flight requests. The policy
+lives here; the *mechanism* is the PR 16/17 degrade machinery
+(:func:`~heat_tpu.resilience.degrade.shrink_to_healthy` /
+:func:`~heat_tpu.resilience.degrade.grow_to_healthy`) applied by the
+``ServeService`` dispatcher — which consults :meth:`Autoscaler.consult`
+strictly BETWEEN batches, never mid-batch, so a scale event can
+invalidate compiled-program caches but never a request.
+
+Decision ladder, evaluated once per monitor tick (the
+:class:`~heat_tpu.resilience.monitor.HealthMonitor` owns the cadence,
+replicated at ws>1, so every rank decides together):
+
+1. the tick **degraded** a device → ``"shrink"``, immediately — a
+   proactive shrink beats waiting for the device to poison a dispatch;
+   safety ignores hysteresis and cooldown;
+2. the tick **healed** a device (it survived flap damping) → ``"grow"``
+   when capacity is actually below the base set; cooldown applies, and
+   a grow deferred by cooldown fires at a later tick;
+3. **queue pressure** — ``queue_depth`` above ``high_depth`` for
+   ``hysteresis`` consecutive ticks (the streak resets only when depth
+   falls back to ``low_depth``: the classic band, so depth oscillating
+   inside the band neither arms nor disarms) → ``"grow"`` when healed
+   capacity is available and cooldown has elapsed.
+
+Under multiple controllers the instantaneous queue depth is
+rank-divergent (each rank's clients race its dispatcher differently),
+so the final grow verdict is laundered through ONE
+:func:`~heat_tpu.core.communication.replicated_decision` per tick —
+every rank grows together or not at all; shrink needs no extra
+collective because the monitor's degrade verdicts are already
+replicated.
+
+The cache-invalidation contract (docs/SERVING.md): any scale event
+rebuilds the default mesh, so every program compiled for the old mesh
+is dead — the dispatcher clears its warm-bucket set and elastically
+relocates the resident registry, exactly like the PR 16 shrink rung.
+Scale activity is counted in ``SERVE_STATS``
+(``grows``/``shrinks``/``scale_events``); the steady-state warm path
+performs zero scale events and zero compiles (``bench.py`` gates both).
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+
+from ..core.communication import replicated_decision, sanitize_comm
+from ..resilience import degrade
+from ..resilience.monitor import HealthMonitor
+
+__all__ = ["Autoscaler"]
+
+
+class Autoscaler:
+    """Target queue-depth band + hysteresis + cooldown scaling policy.
+
+    Parameters
+    ----------
+    monitor : HealthMonitor
+        Owns the probe cadence and the health verdicts; its ``base``
+        communicator defines full capacity.
+    high_depth : int
+        Upper edge of the target queue-depth band: depth above this
+        arms the pressure streak.
+    low_depth : int
+        Lower edge: depth at or below this resets the streak.
+    hysteresis : int
+        Consecutive over-pressure ticks required before a pressure grow
+        (damping, so one burst never scales).
+    cooldown_s : float
+        Minimum seconds between grows (scale-up storms); shrinks are
+        safety-driven and never wait.
+    clock : callable
+        Monotonic time source (injectable for tests).
+    """
+
+    def __init__(
+        self,
+        monitor: HealthMonitor,
+        *,
+        high_depth: int = 8,
+        low_depth: int = 2,
+        hysteresis: int = 2,
+        cooldown_s: float = 0.0,
+        clock=time.monotonic,
+    ):
+        if high_depth < 1:
+            raise ValueError(f"high_depth must be >= 1, got {high_depth}")
+        if not 0 <= low_depth <= high_depth:
+            raise ValueError(
+                f"need 0 <= low_depth <= high_depth, got "
+                f"low={low_depth} high={high_depth}"
+            )
+        if hysteresis < 1:
+            raise ValueError(f"hysteresis must be >= 1, got {hysteresis}")
+        if cooldown_s < 0:
+            raise ValueError(f"cooldown_s must be >= 0, got {cooldown_s}")
+        self.monitor = monitor
+        self.high_depth = int(high_depth)
+        self.low_depth = int(low_depth)
+        self.hysteresis = int(hysteresis)
+        self.cooldown_s = float(cooldown_s)
+        self._clock = clock
+        self._pressure = 0            # consecutive over-high-watermark ticks
+        self._deferred_heal = False   # a heal grow blocked by cooldown
+        self._last_grow: float = -1.0
+
+    # ------------------------------------------------------------- policy
+    def consult(self, queue_depth: int) -> Optional[str]:
+        """One dispatcher consultation (between batches): runs the
+        monitor's ``maybe_tick`` and returns ``"shrink"``, ``"grow"`` or
+        ``None``. Off tick boundaries this is a single replicated bool
+        at ws>1 and pure arithmetic at ws==1."""
+        report = self.monitor.maybe_tick()
+        if report is None:
+            return None
+        if report.degraded:
+            # safety first: reset pressure so the post-shrink queue
+            # build-up must re-arm the band from scratch
+            self._pressure = 0
+            return "shrink"
+
+        if queue_depth > self.high_depth:
+            self._pressure += 1
+        elif queue_depth <= self.low_depth:
+            self._pressure = 0
+
+        want_capacity = self._capacity_below_base()
+        cooled = (
+            self._last_grow < 0
+            or (self._clock() - self._last_grow) >= self.cooldown_s
+        )
+        want_grow = want_capacity and cooled and (
+            bool(report.healed)
+            or self._deferred_heal
+            or self._pressure >= self.hysteresis
+        )
+        # ONE symmetric rendezvous per tick: pressure streaks and
+        # cooldown clocks are rank-local, the executed action must not be
+        want_grow = replicated_decision(
+            want_grow, active=jax.process_count() > 1
+        )
+        if want_grow:
+            self._pressure = 0
+            self._deferred_heal = False
+            self._last_grow = self._clock()
+            return "grow"
+        if report.healed and want_capacity:
+            self._deferred_heal = True  # cooldown blocked it; retry later
+        return None
+
+    def _capacity_below_base(self) -> bool:
+        """Is the current default mesh smaller than the healthy subset
+        of the monitored base set (i.e. is there anything to grow onto)?
+        Derived from replicated state, hence rank-identical."""
+        comm = sanitize_comm(None)
+        return comm.size < len(degrade.healthy_devices(self.monitor.base))

@@ -238,6 +238,16 @@ class ServeService:
     retry : RetryPolicy, optional
         Backoff schedule for transiently-failed batch dispatches
         (default :data:`DEFAULT_DISPATCH_POLICY`).
+    autoscaler : Autoscaler, optional
+        A :class:`~heat_tpu.serve.autoscale.Autoscaler` the dispatcher
+        consults BETWEEN work units — never mid-batch, so in-flight
+        requests are never dropped. A ``"shrink"`` verdict (the
+        autoscaler's HealthMonitor degraded a device) or ``"grow"``
+        verdict (a device healed, or sustained queue pressure with
+        healed capacity available) rebuilds the default mesh,
+        elastically relocates the resident registry, and invalidates
+        the warm-bucket program cache — exactly the fault ladder's
+        shrink rung, but proactive.
     """
 
     def __init__(
@@ -248,6 +258,7 @@ class ServeService:
         snapshot_every: int = 0,
         max_queue_depth: Optional[int] = None,
         retry: Optional[RetryPolicy] = None,
+        autoscaler=None,
     ):
         if max_queue_depth is not None and max_queue_depth < 1:
             raise ValueError(
@@ -259,6 +270,7 @@ class ServeService:
         self.snapshot_every = int(snapshot_every)
         self.max_queue_depth = max_queue_depth
         self.retry = retry or DEFAULT_DISPATCH_POLICY
+        self.autoscaler = autoscaler
         self._endpoints: Dict[str, Callable] = {}
         self._cond = threading.Condition()
         self._queue: List = []
@@ -469,6 +481,16 @@ class ServeService:
                 self._shed(item)
             else:
                 self._run_call(item)
+            # between work units — never mid-batch: refresh the depth
+            # gauge (enqueue-only updates go stale across drains) and
+            # give the autoscaler its consultation point
+            with self._cond:
+                depth = sum(
+                    1 for x in self._queue if not isinstance(x, _Call)
+                )
+            _hooks.observe("serve.depth", depth=depth)
+            if self.autoscaler is not None:
+                self._autoscale(depth)
 
     def _pick_work(self):
         """Choose the next unit of work, FIFO by oldest member. Caller
@@ -763,6 +785,49 @@ class ServeService:
                 for k, v in state_fn().items()
             }
             load_fn(state)
+
+    # ---------------------------------------------------------- autoscaling
+    def _autoscale(self, depth: int) -> None:
+        """Consult the autoscaler between work units and apply its
+        verdict. Advisory by contract: a scaling failure is absorbed
+        (counted as a serve error) and the service lives on — hard
+        device failures still ride the reactive fault ladder."""
+        try:
+            action = self.autoscaler.consult(depth)
+            if action is not None:
+                self._scale(action)
+        # graftlint: G006 - advisory path: a failed scale must never
+        # take down the dispatcher; the reactive ladder owns hard faults
+        except Exception:  # noqa: BLE001
+            _hooks.observe("serve.error", endpoint="<autoscale>")
+
+    def _scale(self, direction: str) -> None:
+        """Apply one scale verdict on the dispatcher thread (the only
+        thread allowed to do device work): rebuild the default mesh,
+        land the resident registry on it, and invalidate the warm-bucket
+        program cache — the PR 16 shrink-rung contract, both ways."""
+        from ..resilience import degrade
+
+        comm = sanitize_comm(None)
+        old = comm.size
+        if direction == "shrink":
+            new_comm, _ = degrade.shrink_to_healthy(comm, set_default=True)
+        else:
+            new_comm, _ = degrade.grow_to_healthy(
+                comm, base=self.autoscaler.monitor.base, set_default=True
+            )
+        if new_comm.size == old:
+            return  # nothing to do (verdict already satisfied)
+        self._relocate_registry()
+        # programs compiled for the old mesh are dead; buckets re-warm
+        self._seen_buckets.clear()
+        _hooks.observe(
+            "serve.scale", direction=direction, old=old, new=new_comm.size
+        )
+        _hooks.observe(
+            "serve.shrink" if direction == "shrink" else "serve.grow",
+            old=old, new=new_comm.size, cause="autoscale",
+        )
 
     def _run_call(self, call: _Call) -> None:
         try:

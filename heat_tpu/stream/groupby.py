@@ -234,7 +234,19 @@ class StreamingGroupBy:
             rep = NamedSharding(self._comm.mesh, PartitionSpec())
 
             def _put(a):
-                return jax.device_put(a, rep)
+                # NOT device_put: at ws>1 device_put onto a non-fully-
+                # addressable sharding runs a hidden assert_equal host
+                # broadcast whenever jax considers the operand
+                # uncommitted — and committed-ness is jit-cache state, so
+                # ranks can disagree and desert the broadcast (observed
+                # as a 120s abort under mpirun). The callback form builds
+                # the global array from process-local bytes, collective-
+                # free; the init values are deterministic constants, so
+                # every rank lands identical state.
+                a = np.asarray(a)
+                return jax.make_array_from_callback(
+                    a.shape, rep, lambda idx: a[idx]
+                )
 
             self._keys = _put(
                 jnp.full((cap,), jnp.asarray(_max_key(kb.dtype)), kb.dtype)

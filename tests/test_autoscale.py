@@ -1,0 +1,268 @@
+"""Autoscaler policy tests (PR 17 tentpole, part 3): the queue-depth
+band, hysteresis, cooldown, heal-driven grows, and the ServeService
+integration (proactive shrink on a flap, elastic re-grow on heal, warm
+caches invalidated, every response correct).
+
+The full seeded storm — two degrade -> shrink -> heal -> re-grow cycles
+under continuous traffic with the zero-lost/zero-duplicated proof — is
+``tools/chaos_soak.py --autoscale`` (tier-1 via test_chaos_soak.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu import resilience as rz
+from heat_tpu import serve as serve_mod
+from heat_tpu.core import communication as comm_mod
+from heat_tpu.resilience.monitor import HealthMonitor, reset_health_stats
+from heat_tpu.serve import SERVE_STATS, Autoscaler, reset_serve_stats
+from tests.base import TestCase
+
+
+def _monitor(**kw):
+    kw.setdefault("interval_s", 0.0)
+    return HealthMonitor(**kw)
+
+
+class AutoscaleBase(TestCase):
+    def setUp(self):
+        reset_health_stats()
+
+    def tearDown(self):
+        comm_mod.use_comm(None)
+        rz.clear_unhealthy()
+
+
+class TestPolicy(AutoscaleBase):
+    def test_param_validation(self):
+        mon = _monitor()
+        with pytest.raises(ValueError):
+            Autoscaler(mon, high_depth=0)
+        with pytest.raises(ValueError):
+            Autoscaler(mon, high_depth=4, low_depth=5)
+        with pytest.raises(ValueError):
+            Autoscaler(mon, low_depth=-1)
+        with pytest.raises(ValueError):
+            Autoscaler(mon, hysteresis=0)
+        with pytest.raises(ValueError):
+            Autoscaler(mon, cooldown_s=-1.0)
+
+    def test_idle_consult_is_none(self):
+        scaler = Autoscaler(_monitor())
+        self.assertIsNone(scaler.consult(queue_depth=0))
+
+    def test_off_tick_consults_do_nothing(self):
+        clock = [0.0]
+        scaler = Autoscaler(_monitor(interval_s=100.0, clock=lambda: clock[0]))
+        self.assertIsNone(scaler.consult(0))   # first tick always due
+        # off the cadence even maximal pressure cannot arm the streak
+        for _ in range(5):
+            self.assertIsNone(scaler.consult(10_000))
+        self.assertEqual(scaler._pressure, 0)
+
+    def test_degrade_verdict_shrinks_immediately(self):
+        p = self.comm.size
+        scaler = Autoscaler(_monitor())
+        sched = rz.FaultSchedule(
+            events=[("monitor.probe", 1, "device_flap")],
+        )
+        with sched:
+            self.assertEqual(scaler.consult(0), "shrink")
+        self.assertEqual(len(rz.unhealthy_devices()), 1)
+
+    def test_heal_triggers_grow_when_capacity_below_base(self):
+        p = self.comm.size
+        if p < 2:
+            pytest.skip("needs a shrinkable mesh")
+        scaler = Autoscaler(_monitor(heal_after=1))
+        sched = rz.FaultSchedule(events=[("monitor.probe", 1, "device_flap")])
+        with sched:
+            self.assertEqual(scaler.consult(0), "shrink")
+        # apply the shrink so capacity actually drops below base
+        small, _ = rz.shrink_to_healthy(None, set_default=True)
+        self.assertEqual(small.size, p - 1)
+        # next tick: clean probe heals (heal_after=1) -> grow verdict
+        self.assertEqual(scaler.consult(0), "grow")
+
+    def test_heal_without_missing_capacity_is_none(self):
+        """A device healing while the mesh is already full (e.g. an
+        external mark cleared before any shrink) must not grow."""
+        scaler = Autoscaler(_monitor(heal_after=1))
+        sched = rz.FaultSchedule(events=[("monitor.probe", 1, "device_flap")])
+        with sched:
+            self.assertEqual(scaler.consult(0), "shrink")
+        # mesh was never shrunk: capacity == base even after the heal
+        self.assertIsNone(scaler.consult(0))
+        self.assertEqual(rz.unhealthy_devices(), frozenset())
+
+    def test_pressure_band_hysteresis(self):
+        p = self.comm.size
+        if p < 2:
+            pytest.skip("needs a shrinkable mesh")
+        # free capacity without any heal events: shrink the default mesh
+        # while the base stays fully healthy.  The monitor must capture the
+        # FULL world as its base, so build it before swapping the default.
+        world = comm_mod.sanitize_comm(None)
+        scaler = Autoscaler(
+            _monitor(heal_after=100), high_depth=8, low_depth=2, hysteresis=3,
+        )
+        sub = comm_mod.MeshCommunication(
+            devices=world.mesh.devices.ravel().tolist()[:-1]
+        )
+        comm_mod.use_comm(sub)
+        # two over-pressure ticks: streak at 2 < hysteresis -> no grow
+        self.assertIsNone(scaler.consult(20))
+        self.assertIsNone(scaler.consult(20))
+        # depth back inside the band (> low, <= high): streak holds
+        self.assertIsNone(scaler.consult(5))
+        self.assertEqual(scaler._pressure, 2)
+        # depth at the low edge: streak resets
+        self.assertIsNone(scaler.consult(2))
+        self.assertEqual(scaler._pressure, 0)
+        # three consecutive over-pressure ticks arm the grow
+        self.assertIsNone(scaler.consult(20))
+        self.assertIsNone(scaler.consult(20))
+        self.assertEqual(scaler.consult(20), "grow")
+        self.assertEqual(scaler._pressure, 0)  # verdict consumed the streak
+
+    def test_pressure_never_grows_at_full_capacity(self):
+        scaler = Autoscaler(_monitor(heal_after=100), hysteresis=1)
+        for _ in range(4):
+            self.assertIsNone(scaler.consult(10_000))
+
+    def test_cooldown_defers_heal_grow(self):
+        p = self.comm.size
+        if p < 2:
+            pytest.skip("needs a shrinkable mesh")
+        clock = [0.0]
+        scaler = Autoscaler(
+            _monitor(heal_after=1), cooldown_s=100.0, clock=lambda: clock[0],
+        )
+        sched = rz.FaultSchedule(events=[("monitor.probe", 1, "device_flap")])
+        with sched:
+            self.assertEqual(scaler.consult(0), "shrink")
+        rz.shrink_to_healthy(None, set_default=True)
+        # first grow is never cooldown-blocked (no prior grow)
+        self.assertEqual(scaler.consult(0), "grow")
+        comm_mod.use_comm(None)  # "apply" it: back to the full mesh
+
+        # second cycle: degrade + shrink again, then heal INSIDE the
+        # cooldown window -> deferred, fires once the window elapses
+        sched = rz.FaultSchedule(events=[("monitor.probe", 1, "device_flap")])
+        with sched:
+            self.assertEqual(scaler.consult(0), "shrink")
+        rz.shrink_to_healthy(None, set_default=True)
+        clock[0] = 50.0                      # heal tick, still cooling
+        self.assertIsNone(scaler.consult(0))
+        self.assertTrue(scaler._deferred_heal)
+        clock[0] = 90.0                      # later tick, still cooling
+        self.assertIsNone(scaler.consult(0))
+        clock[0] = 101.0                     # window elapsed
+        self.assertEqual(scaler.consult(0), "grow")
+        self.assertFalse(scaler._deferred_heal)
+
+
+class TestServeIntegration(AutoscaleBase):
+    def test_flap_shrink_heal_grow_under_traffic(self):
+        """End to end on a live service: a flapping device proactively
+        shrinks the mesh between batches, the heal re-grows it, the
+        warm-bucket cache is invalidated on both scale events, and every
+        response stays oracle-equal throughout."""
+        p = self.comm.size
+        if p < 2:
+            pytest.skip("needs a shrinkable mesh")
+        cols = 4
+        w_np = np.arange(cols, dtype=np.float32) + 1.0
+
+        class _Lin:
+            """Minimal resident model: relocatable via state_dict, so the
+            service can land its weight on each re-scaled mesh."""
+
+            def __init__(self):
+                self.w = ht.array(w_np)
+
+            def predict(self, x):
+                return x @ self.w
+
+            def state_dict(self):
+                return {"w": self.w}
+
+            def load_state_dict(self, state):
+                # relocation hands back host arrays; land on the current mesh
+                self.w = ht.array(np.asarray(state["w"]))
+
+        reset_serve_stats()
+        before = dict(SERVE_STATS)
+        # heal_after=2: the dispatcher consults twice per submit+drain
+        # round (after the batch and after the drain sentinel), so with a
+        # 1-tick heal the mesh would re-grow inside the shrink round and
+        # no batch would ever dispatch on the shrunken mesh.
+        monitor = _monitor(heal_after=2)
+        svc = serve_mod.ServeService(
+            serve_mod.BucketPolicy(max_latency_ms=60_000.0, max_batch=16),
+            autoscaler=Autoscaler(monitor),
+        )
+        orig = comm_mod.sanitize_comm(None)
+        try:
+            svc.register_model("lin", _Lin(), methods=("predict",))
+            rng = np.random.default_rng(17)
+
+            def one_round():
+                x = rng.normal(size=(2, cols)).astype(np.float32)
+                r = svc.submit("lin.predict", x)
+                svc.drain(timeout=300)
+                np.testing.assert_allclose(
+                    np.asarray(r.result(0)), x @ w_np, atol=1e-4
+                )
+
+            one_round()  # warm on the full mesh
+            sched = rz.FaultSchedule(events=[("monitor.probe", 1, "device_flap")])
+            with sched:
+                # the flap tick happens at the dispatcher's next consult
+                for _ in range(4):
+                    one_round()
+                    if comm_mod.sanitize_comm(None).size == p - 1:
+                        break
+            self.assertEqual(sched.pending(), [])
+            self.assertEqual(comm_mod.sanitize_comm(None).size, p - 1)
+            # clean ticks heal (heal_after=1) and grow back
+            for _ in range(6):
+                one_round()
+                if comm_mod.sanitize_comm(None).size == p:
+                    break
+            self.assertEqual(comm_mod.sanitize_comm(None).size, p)
+            one_round()  # traffic still flows on the re-grown mesh
+            svc.close(timeout=60)
+        finally:
+            comm_mod.use_comm(orig)
+            rz.clear_unhealthy()
+        delta = {k: SERVE_STATS[k] - before[k]
+                 for k in ("shrinks", "grows", "scale_events", "errors")}
+        self.assertEqual(delta["shrinks"], 1, delta)
+        self.assertEqual(delta["grows"], 1, delta)
+        self.assertEqual(delta["scale_events"], 2, delta)
+        self.assertEqual(delta["errors"], 0, delta)
+        # cache-invalidation contract: cold start + one re-warm per scale
+        self.assertGreaterEqual(SERVE_STATS["bucket_misses"] - before["bucket_misses"], 3)
+
+    def test_queue_depth_gauge_fresh_after_drain(self):
+        """The PR 17 gauge fix: queue_depth must read 0 after a drain,
+        not the high-water depth of the last enqueue."""
+        cols = 3
+        w = ht.array(np.ones(cols, np.float32))
+        reset_serve_stats()
+        with serve_mod.ServeService(
+            serve_mod.BucketPolicy(max_latency_ms=60_000.0, max_batch=16)
+        ) as svc:
+            svc.register_endpoint("dot", lambda x: x @ w)
+            reqs = [
+                svc.submit("dot", np.ones((1, cols), np.float32))
+                for _ in range(4)
+            ]
+            svc.drain(timeout=300)
+            for r in reqs:
+                r.result(0)
+        self.assertEqual(SERVE_STATS["queue_depth"], 0, SERVE_STATS)
+        self.assertGreaterEqual(SERVE_STATS["max_queue_depth"], 1)

@@ -199,6 +199,43 @@ class TestBenchCheck:
                 bench._compact_summary(serve_out(serve_restores=1), "d.json")
             ))
 
+    def test_rejects_autoscale_activity_on_warm_path(self):
+        # r17: a healthy idle mesh must never scale, and steady-state
+        # health probe ticks must be trace-free; absence (pre-r17
+        # records) is tolerated
+        def serve_out(**over):
+            out = _synthetic_out()
+            out.update(
+                serve_requests_per_sec=800.0,
+                serve_batched_speedup=3.5,
+                serve_warm_compiles=0,
+                serve_lockstep_divergences=0,
+                serve_shed=0,
+                serve_restores=0,
+                serve_scale_events=0,
+                health_probe_ms=0.9,
+                health_probe_warm_compiles=0,
+            )
+            out.update(over)
+            return out
+
+        line = json.dumps(bench._compact_summary(serve_out(), "d.json"))
+        obj = bench_check.check(line)
+        assert obj["serve_scale_events"] == 0
+        assert obj["health_probe_warm_compiles"] == 0
+        with pytest.raises(ValueError, match="scaled a healthy"):
+            bench_check.check(json.dumps(
+                bench._compact_summary(serve_out(serve_scale_events=2), "d.json")
+            ))
+        with pytest.raises(ValueError, match="no longer free"):
+            bench_check.check(json.dumps(bench._compact_summary(
+                serve_out(health_probe_warm_compiles=1), "d.json"
+            )))
+        with pytest.raises(ValueError, match="non-negative number"):
+            bench_check.check(json.dumps(bench._compact_summary(
+                serve_out(health_probe_ms=-1.0), "d.json"
+            )))
+
     def test_rejects_stream_no_overlap(self):
         # prefetch-on barely different from synchronous means the double
         # buffer bought nothing — the pipeline feature is regressing

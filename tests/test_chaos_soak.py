@@ -22,6 +22,17 @@ class TestChaosSoak(unittest.TestCase):
 
         self.assertEqual(chaos_soak.main(["--serve", "--quick"]), 0)
 
+    def test_quick_autoscale_soak_passes(self):
+        """The r17 autoscale soak: HealthMonitor + Autoscaler drive a
+        live service through two full degrade -> proactive shrink ->
+        heal -> elastic re-grow cycles (a flapping device with a damped
+        mid-heal flap, then an EWMA-detected straggler) under request
+        traffic, with the zero lost / zero duplicated / oracle-equal
+        proof and the final mesh back at the full device count."""
+        import chaos_soak
+
+        self.assertEqual(chaos_soak.main(["--autoscale", "--quick"]), 0)
+
 
 if __name__ == "__main__":
     unittest.main()

@@ -8,6 +8,14 @@ uneven-extent battery, sharding construction, sub-mesh and multi-axis
 meshes, the chunked assembly protocol, communicator plumbing
 (WORLD/SELF/use_comm/comm_context/sanitize), and the multi-host
 init/alignment logic that is testable in one process.
+
+ws-2 clean (PR 17 burn-down): sub-mesh constructions draw
+process-spanning device sets from ``tests._mh_helpers.submesh`` instead
+of ``jax.devices()[:k]`` prefixes (which land entirely on process 0 and
+deadlock the group), host reads of padded global buffers go through a
+shard-assembling ``_host_read`` instead of ``np.asarray`` (not fully
+addressable at ws>1), and the sharding-partition / is_split assertions
+check the union across processes, not just the local shards.
 """
 from __future__ import annotations
 
@@ -27,7 +35,37 @@ from heat_tpu.core.communication import (
     ragged_process_allgather,
     sanitize_comm,
 )
+from tests._mh_helpers import submesh
 from tests.base import TestCase
+
+
+def _host_read(buf, split):
+    """Read a (possibly multi-process) padded global buffer on every host.
+
+    Single-process: plain ``np.asarray``. Multi-process the buffer is not
+    fully addressable, so the process-local shards concatenate in split
+    order and one ragged allgather stitches the per-process blocks in pid
+    order (the mesh is process-major, so that IS the global buffer).
+    Collective at ws>1 — every process must call."""
+    import jax
+
+    if getattr(buf, "is_fully_addressable", True):
+        return np.asarray(jax.device_get(buf))
+    shards = sorted(
+        buf.addressable_shards, key=lambda s: (s.index[split].start or 0)
+    )
+    seen = set()
+    blocks = []
+    for s in shards:
+        start = s.index[split].start or 0
+        if start in seen:  # replicated coordinate (multi-axis meshes)
+            continue
+        seen.add(start)
+        blocks.append(np.asarray(jax.device_get(s.data)))
+    local = np.concatenate(blocks, axis=split)
+    return np.concatenate(
+        ragged_process_allgather(local, axis=split), axis=split
+    )
 
 
 def _extent_battery(p):
@@ -166,14 +204,32 @@ class TestShardingConstruction(TestCase):
         import jax.numpy as jnp
 
         p = self.comm.size
-        buf = jax.device_put(
-            jnp.arange(4 * p * 3, dtype=jnp.float32).reshape(4 * p, 3),
+        nproc = jax.process_count()
+        data = np.arange(4 * p * 3, dtype=np.float32).reshape(4 * p, 3)
+        # make_array_from_callback builds the same global array at any
+        # world size (device_put of the full value cannot: the buffer is
+        # not fully addressable at ws>1)
+        buf = jax.make_array_from_callback(
+            (4 * p, 3),
             self.comm.array_sharding((4 * p, 3), 0),
+            lambda idx: jnp.asarray(data[idx]),
         )
+        # each process addresses exactly its share of the split shards...
         starts = sorted((s.index[0].start or 0) for s in buf.addressable_shards)
-        self.assertEqual(starts, [4 * r for r in range(p)])
+        self.assertEqual(len(starts), p // nproc)
         for s in buf.addressable_shards:
             self.assertEqual(s.data.shape, (4, 3))
+        # ...and the union across processes partitions the global extent:
+        # the process-spanning assertion (ws-2 burn-down), a plain
+        # ragged allgather of the local start offsets
+        all_starts = sorted(
+            int(v)
+            for block in ragged_process_allgather(
+                np.asarray(starts, dtype=np.int64), axis=0
+            )
+            for v in block
+        )
+        self.assertEqual(all_starts, [4 * r for r in range(p)])
 
 
 class TestSplitRanks(TestCase):
@@ -231,9 +287,18 @@ class TestSubMeshComms(TestCase):
     def test_sub_mesh_sizes_and_values(self):
         import jax
 
+        # sub-mesh sizes that span every process: a prefix of
+        # jax.devices() would land entirely on process 0 at ws>1 and
+        # deadlock the group, so sizes are multiples of the process
+        # count drawn through the process-spanning submesh() helper
         devs = jax.devices()
-        for k in sorted({1, len(devs)} | ({2, 3} if len(devs) >= 3 else set()) & set(range(1, len(devs) + 1))):
-            comm = MeshCommunication(devices=list(devs[:k]))
+        nproc = jax.process_count()
+        ks = sorted(
+            k for k in {nproc, 2 * nproc, 3 * nproc, len(devs)}
+            if k <= len(devs) and k // nproc <= jax.local_device_count()
+        )
+        for k in ks:
+            comm = MeshCommunication(devices=submesh(k))
             self.assertEqual(comm.size, k)
             n = 2 * k + 1
             x = np.arange(n, dtype=np.float32)
@@ -246,9 +311,10 @@ class TestSubMeshComms(TestCase):
         import jax
 
         devs = jax.devices()
-        if len(devs) < 2:
-            pytest.skip("needs two devices")
-        c1 = MeshCommunication(devices=list(devs[:1]))
+        nproc = jax.process_count()
+        if len(devs) < nproc + 1:
+            pytest.skip("needs a sub-mesh smaller than the world")
+        c1 = MeshCommunication(devices=submesh(nproc))
         a = ht.array(np.zeros(4, np.float32), split=0)
         b = ht.array(np.zeros(4, np.float32), split=0, comm=c1)
         with pytest.raises((ValueError, TypeError)):
@@ -257,19 +323,19 @@ class TestSubMeshComms(TestCase):
     def test_comm_context_scopes_factories(self):
         import jax
 
-        devs = jax.devices()
-        sub = MeshCommunication(devices=list(devs[:1]))
+        nproc = jax.process_count()
+        sub = MeshCommunication(devices=submesh(nproc))
         before = ht.get_comm()
         with comm_mod.comm_context(sub):
             x = ht.zeros((6,), split=0)
-            self.assertEqual(x.comm.size, 1)
+            self.assertEqual(x.comm.size, nproc)
             self.assertIs(ht.get_comm(), sub)
         self.assertIs(ht.get_comm(), before)
 
     def test_comm_context_restores_on_error(self):
         import jax
 
-        sub = MeshCommunication(devices=list(jax.devices()[:1]))
+        sub = MeshCommunication(devices=submesh(jax.process_count()))
         before = ht.get_comm()
         with pytest.raises(RuntimeError):
             with comm_mod.comm_context(sub):
@@ -289,7 +355,7 @@ class TestCommunicatorPlumbing(TestCase):
     def test_use_comm_roundtrip(self):
         import jax
 
-        sub = MeshCommunication(devices=list(jax.devices()[:1]))
+        sub = MeshCommunication(devices=submesh(jax.process_count()))
         try:
             comm_mod.use_comm(sub)
             self.assertIs(ht.get_comm(), sub)
@@ -317,8 +383,9 @@ class TestCommunicatorPlumbing(TestCase):
         a.mesh, b.mesh  # resolve both
         self.assertEqual(a, b)
         self.assertEqual(hash(a), hash(b))
-        if len(devs) > 1:
-            c = MeshCommunication(devices=devs[:1])
+        nproc = jax.process_count()
+        if len(devs) > nproc:
+            c = MeshCommunication(devices=submesh(nproc))
             c.mesh
             self.assertNotEqual(a, c)
         self.assertNotEqual(a, "something else")
@@ -367,14 +434,18 @@ class TestChunkedAssembly(TestCase):
 
             buf = _assemble_from_chunks(read_chunk, (n, 3), 0, self.comm, np.float32)
             self.assertEqual(tuple(buf.shape), self.comm.padded_shape((n, 3), 0))
-            got = np.asarray(buf)[:n]
+            got = _host_read(buf, 0)[:n]
             np.testing.assert_array_equal(got, full)
-            # every request was a canonical per-rank chunk with valid rows
+            # every request was a canonical per-rank chunk with valid
+            # rows (each process requests only its addressable ranks'
+            # chunks — no host ever reads the full array)
             for sl in requested:
                 self.assertGreater(sl[0].stop - sl[0].start, 0)
                 self.assertLessEqual(sl[0].stop, n)
 
     def test_assemble_skips_empty_chunks(self):
+        import jax
+
         p = self.comm.size
         if p < 2:
             pytest.skip("needs empty tail shards")
@@ -386,8 +457,17 @@ class TestChunkedAssembly(TestCase):
             return np.ones((1, 2), np.float32)
 
         buf = _assemble_from_chunks(read_chunk, (n, 2), 0, self.comm, np.float32)
-        self.assertEqual(len(calls), 1)  # empty shards never call the reader
-        np.testing.assert_array_equal(np.asarray(buf)[:1], np.ones((1, 2)))
+        # empty shards never call the reader: only the process that
+        # addresses rank 0's device reads anything at all
+        pid = jax.process_index()
+        local_nonempty = sum(
+            1
+            for r, d in _split_ranks(self.comm)
+            if int(d.process_index) == pid
+            and self.comm.chunk((n, 2), 0, rank=r)[1][0] > 0
+        )
+        self.assertEqual(len(calls), local_nonempty)
+        np.testing.assert_array_equal(_host_read(buf, 0)[:1], np.ones((1, 2)))
 
     def test_assemble_split1(self):
         p = self.comm.size
@@ -396,32 +476,48 @@ class TestChunkedAssembly(TestCase):
         buf = _assemble_from_chunks(
             lambda sl: full[sl], (2, n), 1, self.comm, np.float64
         )
-        np.testing.assert_array_equal(np.asarray(buf)[:, :n], full)
+        np.testing.assert_array_equal(_host_read(buf, 1)[:, :n], full)
 
-    def test_ragged_allgather_single_process(self):
+    def test_ragged_allgather_blocks(self):
+        import jax
+
+        nproc = jax.process_count()
         x = np.arange(12, dtype=np.int64).reshape(3, 4)
         blocks = ragged_process_allgather(x, axis=0)
-        self.assertEqual(len(blocks), 1)
-        np.testing.assert_array_equal(blocks[0], x)
+        self.assertEqual(len(blocks), nproc)
+        for b in blocks:  # every process contributed the same payload
+            np.testing.assert_array_equal(b, x)
         # empty payload round-trips too
         empty = ragged_process_allgather(np.empty((0, 4)), axis=0)
-        self.assertEqual(empty[0].shape, (0, 4))
+        self.assertEqual(len(empty), nproc)
+        for b in empty:
+            self.assertEqual(b.shape, (0, 4))
 
-    def test_assemble_local_shards_single_process(self):
+    def test_assemble_local_shards(self):
+        import jax
+
+        nproc = jax.process_count()
         local = np.arange(10, dtype=np.float32).reshape(5, 2)
         buf, gshape = assemble_local_shards(local, 0, self.comm)
-        self.assertEqual(gshape, (5, 2))
-        np.testing.assert_array_equal(np.asarray(buf)[:5], local)
+        # is_split semantics: the global array is the pid-ordered
+        # concatenation of the per-process shards
+        want = np.concatenate([local] * nproc, axis=0)
+        self.assertEqual(gshape, (5 * nproc, 2))
+        np.testing.assert_array_equal(_host_read(buf, 0)[: 5 * nproc], want)
         # is_split through the public factory agrees
         a = ht.array(local, is_split=0)
-        self.assertEqual(a.shape, (5, 2))
-        np.testing.assert_array_equal(a.numpy(), local)
+        self.assertEqual(a.shape, (5 * nproc, 2))
+        np.testing.assert_array_equal(a.numpy(), want)
 
     def test_assemble_local_shards_split1(self):
+        import jax
+
+        nproc = jax.process_count()
         local = np.arange(12, dtype=np.float32).reshape(3, 4)
         buf, gshape = assemble_local_shards(local, 1, self.comm)
-        self.assertEqual(gshape, (3, 4))
-        np.testing.assert_array_equal(np.asarray(buf)[:, :4], local)
+        want = np.concatenate([local] * nproc, axis=1)
+        self.assertEqual(gshape, (3, 4 * nproc))
+        np.testing.assert_array_equal(_host_read(buf, 1)[:, : 4 * nproc], want)
 
 
 class TestUnevenExtentEndToEnd(TestCase):

@@ -123,6 +123,34 @@ def test_suite_runner_cli_is_flow_clean():
     )
 
 
+def test_health_monitor_is_flow_clean():
+    """Explicit gate over the health monitor: the EWMA frame and the
+    cadence decision are collectives, so flow-laundering a per-rank
+    value (a local clock, a local failure set) into either would
+    desynchronize the very verdicts the monitor exists to replicate."""
+    findings, files_checked = gf.analyze_paths(
+        [os.path.join(REPO, "heat_tpu", "resilience", "monitor.py")]
+    )
+    assert files_checked == 1
+    assert not findings, "\n".join(
+        f"  {f.path}:{f.line}:{f.col}: {f.rule} {f.message}" for f in findings
+    )
+
+
+def test_autoscaler_is_flow_clean():
+    """Explicit gate over the autoscale policy: queue depth is
+    rank-divergent by nature, so every path from it to a mesh rebuild
+    must pass through the replicated grow decision — a laundered branch
+    here grows a mesh on one rank only."""
+    findings, files_checked = gf.analyze_paths(
+        [os.path.join(REPO, "heat_tpu", "serve", "autoscale.py")]
+    )
+    assert files_checked == 1
+    assert not findings, "\n".join(
+        f"  {f.path}:{f.line}:{f.col}: {f.rule} {f.message}" for f in findings
+    )
+
+
 def test_collective_vocabulary_matches_graftlint():
     """graftflow keeps its own copy of the collective-name set (both
     halves must stay importable without the other); the copies must not

@@ -125,6 +125,33 @@ def test_suite_runner_cli_is_lint_clean():
     )
 
 
+def test_health_monitor_is_lint_clean():
+    """Explicit gate over the health monitor: its verdicts feed mesh
+    rebuilds on every rank, so a swallowed resilience error or a
+    rank-dependent branch around its collectives would turn the
+    proactive layer into a hang generator."""
+    findings, files_checked = gl.lint_paths(
+        [os.path.join(REPO, "heat_tpu", "resilience", "monitor.py")]
+    )
+    assert files_checked == 1
+    assert not findings, "\n".join(
+        f"  {f.path}:{f.line}:{f.col}: {f.rule} {f.message}" for f in findings
+    )
+
+
+def test_autoscaler_is_lint_clean():
+    """Explicit gate over the autoscale policy: its grow verdict is the
+    single replicated decision standing between rank-divergent queue
+    depths and a deserted collective."""
+    findings, files_checked = gl.lint_paths(
+        [os.path.join(REPO, "heat_tpu", "serve", "autoscale.py")]
+    )
+    assert files_checked == 1
+    assert not findings, "\n".join(
+        f"  {f.path}:{f.line}:{f.col}: {f.rule} {f.message}" for f in findings
+    )
+
+
 def _run_cli(*args):
     return subprocess.run(
         [sys.executable, os.path.join("tools", "graftlint.py"), *args],

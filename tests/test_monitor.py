@@ -1,0 +1,315 @@
+"""HealthMonitor unit tests (PR 17 tentpole, part 1): probe ticks, the
+per-device ledger, straggler detection, flap damping, cadence, and the
+steady-state zero-trace/zero-compile/zero-host-sync contract.
+
+The multi-controller halves of the contract — one-rank probe failures
+surfacing the same verdict on every rank, rank-identical streak
+counters, grow-after-shrink at world size 2 — live in
+``tests/test_multihost.py::test_two_process_grow_after_shrink``; the
+full degrade -> shrink -> heal -> re-grow cycle under live serve
+traffic is ``tools/chaos_soak.py --autoscale``.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu import resilience as rz
+from heat_tpu.analysis.sanitizer import Region
+from heat_tpu.core import communication as comm_mod
+from heat_tpu.resilience.monitor import (
+    HEALTH_STATS,
+    HealthMonitor,
+    reset_health_stats,
+)
+from tests.base import TestCase
+
+
+def _flap_hits(dev_idx, nprobes, *ticks):
+    """FaultSchedule hit numbers for device index ``dev_idx`` on the
+    given 0-based ticks (``nprobes`` probes per tick, mesh order)."""
+    return [dev_idx + 1 + t * nprobes for t in ticks]
+
+
+class MonitorBase(TestCase):
+    def setUp(self):
+        reset_health_stats()
+
+    def tearDown(self):
+        comm_mod.use_comm(None)
+        rz.clear_unhealthy()
+
+
+class TestTickBasics(MonitorBase):
+    def test_clean_tick_reports_nothing(self):
+        mon = HealthMonitor(interval_s=0.0)
+        rep = mon.tick()
+        self.assertEqual(rep.degraded, [])
+        self.assertEqual(rep.healed, [])
+        self.assertEqual(rep.failed, frozenset())
+        self.assertGreater(rep.probe_ms, 0.0)
+        self.assertEqual(HEALTH_STATS["ticks"], 1)
+        self.assertEqual(HEALTH_STATS["probes"], self.comm.size)
+        self.assertEqual(HEALTH_STATS["probe_failures"], 0)
+        self.assertGreater(HEALTH_STATS["probe_ms_total"], 0.0)
+        for entry in mon.ledger.values():
+            self.assertEqual(entry.state, "healthy")
+            self.assertGreater(entry.ewma_ms, 0.0)
+
+    def test_steady_state_ticks_are_free(self):
+        """The acceptance criterion: warm probe ticks run 0 traces, 0
+        compiles, 0 host syncs, and (at world size 1) 0 collectives —
+        monitoring must never perturb what it measures."""
+        mon = HealthMonitor(interval_s=0.0)
+        mon.tick()  # warm: first transfer may touch lazy backend state
+        region = Region("steady-state health ticks")
+        for _ in range(5):
+            mon.tick()
+        self.assertEqual(region.traces, 0, region.stats())
+        self.assertEqual(region.compiles, 0, region.stats())
+        self.assertEqual(region.host_syncs, 0, region.stats())
+        self.assertEqual(region.collectives, 0, region.stats())
+
+    def test_param_validation(self):
+        with pytest.raises(ValueError):
+            HealthMonitor(heal_after=0)
+        with pytest.raises(ValueError):
+            HealthMonitor(degrade_after=0)
+        with pytest.raises(ValueError):
+            HealthMonitor(ewma_alpha=0.0)
+        with pytest.raises(ValueError):
+            HealthMonitor(ewma_alpha=1.5)
+        with pytest.raises(ValueError):
+            HealthMonitor(straggler_factor=0.5)
+
+    def test_maybe_tick_cadence_with_injected_clock(self):
+        clock = [0.0]
+        mon = HealthMonitor(interval_s=10.0, clock=lambda: clock[0])
+        self.assertIsNotNone(mon.maybe_tick())  # first tick is always due
+        clock[0] = 5.0
+        self.assertIsNone(mon.maybe_tick())     # inside the interval
+        clock[0] = 10.0
+        self.assertIsNotNone(mon.maybe_tick())  # due again
+        self.assertEqual(HEALTH_STATS["ticks"], 2)
+
+    def test_reset_health_stats(self):
+        HealthMonitor(interval_s=0.0).tick()
+        self.assertGreater(HEALTH_STATS["ticks"], 0)
+        reset_health_stats()
+        self.assertEqual(HEALTH_STATS["ticks"], 0)
+        self.assertEqual(HEALTH_STATS["probe_ms_total"], 0.0)
+
+
+class TestDegradeAndHeal(MonitorBase):
+    def test_probe_failure_degrades_immediately(self):
+        mon = HealthMonitor(interval_s=0.0, heal_after=2)
+        p = self.comm.size
+        dev = int(self.comm.mesh.devices.ravel().tolist()[0].id)
+        sched = rz.FaultSchedule(
+            events=[("monitor.probe", h, "device_flap")
+                    for h in _flap_hits(0, p, 0)],
+        )
+        with sched:
+            rep = mon.tick()
+        self.assertEqual(rep.degraded, [dev])
+        self.assertEqual(rep.failed, frozenset({dev}))
+        self.assertEqual(mon.ledger[dev].state, "unhealthy")
+        self.assertIn(dev, rz.unhealthy_devices())
+        self.assertEqual(HEALTH_STATS["degraded"], 1)
+        self.assertEqual(HEALTH_STATS["probe_failures"], 1)
+        # heal: exactly heal_after clean ticks re-admit the device
+        rep = mon.tick()
+        self.assertEqual(mon.ledger[dev].state, "healing")
+        self.assertEqual(rep.healed, [])
+        rep = mon.tick()
+        self.assertEqual(rep.healed, [dev])
+        self.assertEqual(mon.ledger[dev].state, "healthy")
+        self.assertEqual(rz.unhealthy_devices(), frozenset())
+        self.assertEqual(HEALTH_STATS["healed"], 1)
+
+    def test_straggler_needs_consecutive_verdicts(self):
+        """One slow probe makes a device *suspect*, never unhealthy;
+        ``degrade_after`` consecutive straggler verdicts degrade it.
+        ``ewma_alpha=1.0`` pins the EWMA to the latest sample, so the
+        verdict sequence is exactly the injection sequence."""
+        p = self.comm.size
+        mon = HealthMonitor(
+            interval_s=0.0, ewma_alpha=1.0, floor_ms=50.0,
+            degrade_after=2, heal_after=1,
+        )
+        dev = int(self.comm.mesh.devices.ravel().tolist()[1].id)
+        sched = rz.FaultSchedule(
+            events=[("monitor.probe", h, "straggler_probe")
+                    for h in _flap_hits(1, p, 0, 1)],
+            straggler_delay=0.2,
+        )
+        with sched:
+            rep = mon.tick()
+            self.assertIn(dev, rep.stragglers)
+            self.assertEqual(rep.degraded, [])
+            self.assertEqual(mon.ledger[dev].state, "suspect")
+            self.assertEqual(mon.ledger[dev].bad_streak, 1)
+            rep = mon.tick()
+            self.assertEqual(rep.degraded, [dev])
+        self.assertEqual(sched.pending(), [])
+        self.assertEqual(HEALTH_STATS["stragglers"], 2)
+        self.assertEqual(HEALTH_STATS["degraded"], 1)
+        self.assertEqual(HEALTH_STATS["probe_failures"], 0)  # slow, not dead
+        # the clean probe resets the EWMA (alpha=1), so the device heals
+        rep = mon.tick()
+        self.assertEqual(rep.healed, [dev])
+
+    def test_one_clean_tick_resets_suspect(self):
+        p = self.comm.size
+        mon = HealthMonitor(
+            interval_s=0.0, ewma_alpha=1.0, floor_ms=50.0, degrade_after=2,
+        )
+        dev = int(self.comm.mesh.devices.ravel().tolist()[2].id)
+        sched = rz.FaultSchedule(
+            # slow on ticks 0 and 2 — the clean tick 1 in between must
+            # reset the bad streak, so the device never degrades
+            events=[("monitor.probe", h, "straggler_probe")
+                    for h in _flap_hits(2, p, 0, 2)],
+            straggler_delay=0.2,
+        )
+        with sched:
+            mon.tick()
+            self.assertEqual(mon.ledger[dev].state, "suspect")
+            mon.tick()
+            self.assertEqual(mon.ledger[dev].state, "healthy")
+            self.assertEqual(mon.ledger[dev].bad_streak, 0)
+            mon.tick()
+            self.assertEqual(mon.ledger[dev].state, "suspect")
+        self.assertEqual(HEALTH_STATS["degraded"], 0)
+
+    def test_flap_damping_restarts_the_streak(self):
+        p = self.comm.size
+        mon = HealthMonitor(interval_s=0.0, heal_after=3)
+        dev = int(self.comm.mesh.devices.ravel().tolist()[0].id)
+        sched = rz.FaultSchedule(
+            # degrade on tick 0; tick 1 probes clean (healing, streak 1);
+            # tick 2 flaps again INSIDE the heal_after=3 window
+            events=[("monitor.probe", h, "device_flap")
+                    for h in _flap_hits(0, p, 0, 2)],
+        )
+        with sched:
+            mon.tick()
+            self.assertEqual(mon.ledger[dev].state, "unhealthy")
+            mon.tick()
+            self.assertEqual(mon.ledger[dev].state, "healing")
+            self.assertEqual(mon.ledger[dev].streak, 1)
+            rep = mon.tick()
+        self.assertEqual(rep.flapped, [dev])
+        self.assertEqual(mon.ledger[dev].state, "unhealthy")
+        self.assertEqual(mon.ledger[dev].streak, 0)
+        self.assertEqual(mon.ledger[dev].flaps, 1)
+        self.assertEqual(HEALTH_STATS["flaps_damped"], 1)
+        self.assertIn(dev, rz.unhealthy_devices())  # still excluded
+        # the FULL streak is required from scratch after the flap
+        for expected_healed in ([], [], [dev]):
+            rep = mon.tick()
+            self.assertEqual(rep.healed, expected_healed)
+        self.assertEqual(HEALTH_STATS["healed"], 1)
+        self.assertEqual(HEALTH_STATS["degraded"], 1)  # the flap is NOT a new degrade
+
+    def test_adopts_external_unhealthy_marks(self):
+        """Devices degraded by the serve/supervisor ladders (their own
+        replicated consensus) enter the ledger so healing can start."""
+        mon = HealthMonitor(interval_s=0.0, heal_after=10)
+        dev = int(self.comm.mesh.devices.ravel().tolist()[3].id)
+        rz.mark_unhealthy(dev)
+        mon.tick()
+        # adopted as unhealthy, then the clean probe started a heal streak
+        self.assertEqual(mon.ledger[dev].state, "healing")
+        self.assertEqual(mon.ledger[dev].streak, 1)
+        self.assertIn(dev, rz.unhealthy_devices())  # not healed yet
+        self.assertEqual(HEALTH_STATS["degraded"], 0)  # not a monitor verdict
+
+
+class TestElasticRoundTrip(MonitorBase):
+    def test_shrink_heal_grow_preserves_values(self):
+        """The ws-1 grow-after-shrink round-trip: degrade -> shrink ->
+        heal -> grow_to_healthy back to the full mesh, with registered
+        arrays redistributed intact both ways."""
+        p = self.comm.size
+        if p < 2:
+            pytest.skip("needs a shrinkable mesh")
+        x_np = np.arange(2 * p + 3, dtype=np.float32)
+        x = ht.array(x_np, split=0)
+        mon = HealthMonitor(interval_s=0.0, heal_after=1)
+        sched = rz.FaultSchedule(
+            events=[("monitor.probe", h, "device_flap")
+                    for h in _flap_hits(1, p, 0)],
+        )
+        with sched:
+            degraded = mon.tick().degraded
+        self.assertEqual(len(degraded), 1)
+        small, (xs,) = rz.shrink_to_healthy(None, [x], set_default=True)
+        self.assertEqual(small.size, p - 1)
+        np.testing.assert_array_equal(xs.numpy(), x_np)
+        # one clean tick heals (heal_after=1) and clears the mark
+        rep = mon.tick()
+        self.assertEqual(rep.healed, degraded)
+        grown, (xg,) = rz.grow_to_healthy(small, [xs], set_default=True)
+        self.assertEqual(grown.size, p)
+        np.testing.assert_array_equal(xg.numpy(), x_np)
+        self.assertIs(ht.get_comm(), grown)
+
+    def test_grow_is_noop_on_full_mesh(self):
+        comm = comm_mod.sanitize_comm(None)
+        x = ht.array(np.arange(6, dtype=np.float32), split=0)
+        grown, (xg,) = rz.grow_to_healthy(comm, [x])
+        self.assertIs(grown, comm)
+        self.assertIs(xg, x)
+
+    def test_grow_rejects_fully_unhealthy_base(self):
+        from heat_tpu.resilience.errors import NoHealthyDevicesError
+
+        for d in comm_mod.sanitize_comm(None).mesh.devices.ravel().tolist():
+            rz.mark_unhealthy(int(d.id))
+        with pytest.raises(NoHealthyDevicesError):
+            rz.grow_to_healthy()
+
+    def test_grow_rejects_non_dndarrays(self):
+        from heat_tpu.resilience.errors import DegradeError
+
+        # a real rebuild must happen for arrays to move (the full-mesh
+        # no-op fast path hands arrays back untouched), so exclude one
+        # device first
+        devs = comm_mod.sanitize_comm(None).mesh.devices.ravel().tolist()
+        if len(devs) < 2:
+            pytest.skip("needs a shrinkable mesh")
+        rz.mark_unhealthy(int(devs[0].id))
+        with pytest.raises(DegradeError):
+            rz.grow_to_healthy(None, [np.arange(3)])
+
+
+class TestBackgroundThread(MonitorBase):
+    def test_background_ticks_at_ws1(self):
+        mon = HealthMonitor(interval_s=0.005)
+        with mon.start():
+            deadline = time.monotonic() + 5.0
+            while HEALTH_STATS["ticks"] < 2 and time.monotonic() < deadline:
+                time.sleep(0.01)
+        self.assertGreaterEqual(HEALTH_STATS["ticks"], 2)
+        self.assertIsNone(mon._thread)  # context exit joined the thread
+
+    def test_start_twice_is_idempotent(self):
+        mon = HealthMonitor(interval_s=60.0)
+        try:
+            mon.start()
+            t = mon._thread
+            mon.start()
+            self.assertIs(mon._thread, t)
+        finally:
+            mon.stop()
+        mon.stop()  # stop after stop is a no-op
+
+    def test_start_refuses_multi_controller(self):
+        mon = HealthMonitor(interval_s=0.01)
+        mon._multi = True  # what a ws>1 construction computes
+        with pytest.raises(RuntimeError, match="maybe_tick"):
+            mon.start()

@@ -967,6 +967,138 @@ def test_two_process_serve_shrink_redispatch(tmp_path):
     assert finals[0] == finals[1], finals
 
 
+_GROW_WORKER = r"""
+import contextlib
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+pid = int(sys.argv[1]); nproc = int(sys.argv[2]); port = sys.argv[3]
+
+import heat_tpu as ht
+from heat_tpu import resilience as rz
+from heat_tpu.core import communication as comm_mod
+from heat_tpu.resilience.monitor import HEALTH_STATS
+
+ht.init_distributed(
+    coordinator_address=f"localhost:{port}", num_processes=nproc, process_id=pid
+)
+assert jax.device_count() == 8 and jax.local_device_count() == 4
+
+world = comm_mod.sanitize_comm(None)
+x_np = np.arange(64, dtype=np.float32).reshape(16, 4)
+x = ht.array(x_np, split=0)
+
+mon = rz.HealthMonitor(world, heal_after=3, degrade_after=2)
+
+# --- 1) symmetric no-error barrier: a clean tick runs the same
+# collectives on every rank (probe-failure union + EWMA frame) and
+# degrades nobody
+rep = mon.tick()
+assert rep.degraded == [] and rep.failed == frozenset(), rep
+
+# --- 2) a probe failure injected on ONE rank only (rank 1's first
+# addressable device) must surface the SAME degraded verdict on every
+# rank through the replicated-ids union. Rank 1 probes its 4 local
+# devices per tick, so hits 1 and 9 are ticks 1 and 3 of the scope:
+# degrade, then a mid-heal flap inside the heal_after=3 window.
+ravel = list(world.mesh.devices.ravel())
+flap_dev = [int(d.id) for d in ravel if int(d.process_index) == 1][0]
+sched = (
+    rz.FaultSchedule(
+        events=[("monitor.probe", 1, "device_flap"),
+                ("monitor.probe", 9, "device_flap")],
+        seed=5,
+    )
+    if pid == 1 else contextlib.nullcontext()
+)
+with sched:
+    rep = mon.tick()
+    assert rep.degraded == [flap_dev], (pid, rep)
+    assert mon.ledger[flap_dev].state == "unhealthy"
+
+    # proactive shrink off the degraded device: survivors still span
+    # BOTH processes, and the split-0 array lands on them intact
+    small, (xs,) = rz.shrink_to_healthy(world, [x], set_default=True)
+    assert small.size == 7, small.size
+    assert {int(d.process_index) for d in small.mesh.devices.ravel()} == {0, 1}
+    np.testing.assert_array_equal(xs.numpy(), x_np)
+
+    rep = mon.tick()   # clean: healing streak 1 on every rank
+    assert mon.ledger[flap_dev].state == "healing", mon.ledger[flap_dev]
+    rep = mon.tick()   # scheduled mid-heal flap: damped on every rank
+    assert rep.flapped == [flap_dev], (pid, rep)
+    assert mon.ledger[flap_dev].state == "unhealthy"
+if pid == 1:
+    assert sched.pending() == [], sched.report()
+
+# --- 3) flap damping restarts the streak: exactly heal_after=3 clean
+# ticks re-admit the device, with identical counters on every rank
+for _ in range(3):
+    rep = mon.tick()
+assert rep.healed == [flap_dev], (pid, rep)
+assert mon.ledger[flap_dev].state == "healthy"
+assert rz.unhealthy_devices() == frozenset(), rz.unhealthy_devices()
+
+# --- 4) elastic re-grow onto the healed base: full mesh, both
+# processes, values preserved through shrink AND grow
+grown, (xg,) = rz.grow_to_healthy(small, [xs], base=world, set_default=True)
+assert grown.size == 8, grown.size
+assert {int(d.process_index) for d in grown.mesh.devices.ravel()} == {0, 1}
+np.testing.assert_array_equal(xg.numpy(), x_np)
+
+entry = mon.ledger[flap_dev]
+acc = float(abs(xg.numpy()).sum())
+print(f"WORKER{pid} GROW OK {small.size}->{grown.size} dev{flap_dev} "
+      f"{entry.state} streak{entry.streak} flaps{entry.flaps} "
+      f"H{HEALTH_STATS['degraded']}/{HEALTH_STATS['healed']}"
+      f"/{HEALTH_STATS['flaps_damped']} {acc:.4f}")
+"""
+
+
+@pytest.mark.skipif(
+    os.environ.get("HEAT_TPU_TEST_DEVICES", "8") != "8",
+    reason="one fixed 2x4 topology is enough for the matrix",
+)
+def test_two_process_grow_after_shrink(tmp_path):
+    """PR 17 tentpole at real world size 2: a probe failure injected on
+    ONE rank surfaces the same degraded verdict on every rank (the
+    replicated-ids union), the mesh shrinks to 7 survivors spanning
+    both processes, a mid-heal flap is damped with rank-identical
+    streak counters (the quantized EWMA frame keeps verdicts
+    bit-equal), and after heal_after clean ticks grow_to_healthy
+    rebuilds the full 8-device mesh with array values preserved."""
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+
+    worker = tmp_path / "grow_worker.py"
+    worker.write_text(_GROW_WORKER)
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env.pop("HEAT_TPU_TEST_DEVICES", None)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(i), "2", str(port)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = [p.communicate(timeout=600)[0] for p in procs]
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out}"
+        assert f"WORKER{i} GROW OK" in out, out
+    # identical mesh trajectory, ledger state, streaks, flap counters,
+    # health counters, and array checksum on each rank
+    finals = [out.strip().splitlines()[-1].split()[2:] for out in outs]
+    assert finals[0] == finals[1], finals
+
+
 _FRAME_WORKER = r"""
 import sys
 import jax

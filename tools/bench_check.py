@@ -189,6 +189,28 @@ def validate(line: str, obj: dict) -> None:
                 "the warm serving legs rolled the registry back with no "
                 "fault injected"
             )
+        # r17 autoscaler + health monitor: a healthy idle mesh must never
+        # scale, and steady-state probe ticks must be trace-free. Absent
+        # on pre-r17 records; present-but-nonzero is the violation.
+        if "serve_scale_events" in obj and obj["serve_scale_events"] != 0:
+            raise ValueError(
+                "serve_scale_events must be 0, got "
+                f"{obj['serve_scale_events']!r}: the autoscaler scaled a "
+                "healthy, unpressured mesh during the warm serving legs"
+            )
+        if "health_probe_warm_compiles" in obj and obj["health_probe_warm_compiles"] != 0:
+            raise ValueError(
+                "health_probe_warm_compiles must be 0, got "
+                f"{obj['health_probe_warm_compiles']!r}: a steady-state "
+                "health probe tick traced or compiled — monitoring is no "
+                "longer free to leave always-on"
+            )
+        if "health_probe_ms" in obj:
+            pms = obj["health_probe_ms"]
+            if not isinstance(pms, (int, float)) or isinstance(pms, bool) or pms < 0:
+                raise ValueError(
+                    f"'health_probe_ms' must be a non-negative number, got {pms!r}"
+                )
     # frame/shuffle gates (r14). Absent when the frame subprocess failed
     # (the driver folds a frame_error note instead) — absence is not a
     # violation, a present-but-failing value is.

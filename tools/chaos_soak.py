@@ -43,12 +43,24 @@ EXACTLY once — results oracle-equal to the pre-fault fitted model,
 failures carrying the typed error — no response lost, none duplicated,
 and the SERVE_STATS recovery counters match the schedule.
 
+``--autoscale`` switches to the AUTOSCALE soak (PR 17): a resident
+service with a :class:`~heat_tpu.resilience.HealthMonitor` +
+:class:`~heat_tpu.serve.Autoscaler` is driven through two full
+degrade -> proactive shrink -> heal -> elastic re-grow cycles under
+continuous request traffic — a flapping device (scheduled
+``device_flap`` probe failures, with a mid-heal flap that flap damping
+must absorb) and a straggling device (scheduled ``straggler_probe``
+latency caught by the EWMA-vs-median detector). The proof: every
+accepted request answered exactly once and oracle-equal THROUGH every
+scale event, bucket program caches invalidated on each scale, and the
+final mesh back at the full device count.
+
 Run directly (full soak), or the bounded quick tier (single seed per
 workload, small problems, <= 60 s — the tier-1 entry point via
 ``tests/test_chaos_soak.py``):
 
     XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
-        python tools/chaos_soak.py [--quick] [--seeds N] [--serve]
+        python tools/chaos_soak.py [--quick] [--seeds N] [--serve] [--autoscale]
 """
 from __future__ import annotations
 
@@ -465,6 +477,206 @@ def run_serve_trial(seed: int, quick: bool) -> dict:
         rz.clear_unhealthy()
 
 
+def run_autoscale_trial(seed: int, quick: bool) -> dict:
+    """One autoscale-soak trial: a live service with a HealthMonitor +
+    Autoscaler driven through a full degrade -> shrink -> heal -> re-grow
+    cycle, twice (a flapping device damped then healed; a straggling
+    device EWMA-detected then healed), while request traffic keeps
+    flowing. The proof: zero lost, zero duplicated, oracle-equal
+    responses THROUGH every scale event, flap damping visibly engaged,
+    and the final mesh back at the full device count."""
+    from heat_tpu import serve as serve_mod
+    from heat_tpu.resilience.monitor import HEALTH_STATS
+    from heat_tpu.serve import SERVE_STATS
+
+    orig_comm = comm_mod.sanitize_comm(None)
+    ndev = orig_comm.size
+    t0 = time.monotonic()
+    rng = np.random.default_rng(4000 + seed)
+    k, f = 3, 4
+    blob = rng.normal(size=(k, f)) * 5.0
+    pts = blob[rng.integers(0, k, size=64)] + rng.normal(size=(64, f)) * 0.3
+    km = KMeans(n_clusters=k, init="random", max_iter=10, tol=0.0,
+                random_state=seed)
+    km.fit(ht.array(pts.astype(np.float32), split=0))
+
+    # host-side center snapshot taken once, BEFORE the service starts:
+    # the oracle below must never touch the mesh, because it runs on the
+    # main thread while the dispatcher may be mid-scale (km.predict here
+    # would race the relocation and see half-moved arrays)
+    centers = np.asarray(km.cluster_centers_.numpy(), dtype=np.float64)
+
+    def payload(rows=2):
+        return (blob[rng.integers(0, k, size=rows)]
+                + rng.normal(size=(rows, f)) * 0.3).astype(np.float32)
+
+    def oracle(p):
+        # pure-numpy per-row argmin against the fitted centers: exact
+        # under any mesh size (blobs are well separated, so float
+        # rounding cannot flip a verdict), so results through shrink AND
+        # grow compare EQUAL
+        d = ((p[:, None, :].astype(np.float64) - centers[None]) ** 2).sum(-1)
+        return np.argmin(d, axis=1)
+
+    accepted = []  # (request, expected ndarray)
+    schedules = []
+    before = dict(SERVE_STATS)
+    health_before = dict(HEALTH_STATS)
+
+    def hdelta(key):
+        return HEALTH_STATS[key] - health_before[key]
+
+    try:
+        with tempfile.TemporaryDirectory() as d:
+            # tick on every dispatcher consultation (interval 0); a
+            # healed device needs 3 clean consecutive ticks (flap
+            # damping window the scheduled mid-heal flap lands inside)
+            monitor = rz.HealthMonitor(
+                orig_comm, interval_s=0.0, heal_after=3, degrade_after=2,
+            )
+            scaler = serve_mod.Autoscaler(monitor, high_depth=8, low_depth=2)
+            svc = serve_mod.ServeService(
+                serve_mod.BucketPolicy(max_latency_ms=60_000.0, max_batch=64),
+                snapshot_dir=d, snapshot_every=1, autoscaler=scaler,
+            )
+            svc.registry.register("km", km)
+            svc.register_endpoint(
+                "classify", lambda x: svc.registry.get("km").predict(x)
+            )
+
+            def pump_until(cond, label, max_rounds=60):
+                """Keep one-batch traffic flowing until ``cond`` holds;
+                every answered batch is part of the survival proof."""
+                for _ in range(max_rounds):
+                    p = payload()
+                    want = oracle(p)
+                    accepted.append((svc.submit("classify", p), want))
+                    svc.drain(timeout=300)
+                    if cond():
+                        return
+                raise AssertionError(f"seed={seed}: {label} (after {max_rounds} rounds)")
+
+            def mesh_size():
+                return comm_mod.sanitize_comm(None).size
+
+            # warmup: first batch + first snapshot on the full mesh
+            pump_until(lambda: True, "warmup")
+            assert mesh_size() == ndev
+
+            # ---- cycle 1: a flapping device. Probes run in base-mesh
+            # order, ndev hits per tick, so device IDX's probe is hit
+            # idx+1+t*ndev of tick t: flap it at tick 0 (degrade ->
+            # proactive shrink), let tick 1 probe clean (healing streak
+            # starts), flap it AGAIN at tick 2 — inside the heal_after=3
+            # window, so flap damping must reset the streak and hold the
+            # device OUT of the mesh until 3 consecutive clean ticks.
+            flap_dev = int(rng.integers(0, ndev))
+            sched = rz.FaultSchedule(
+                events=[
+                    ("monitor.probe", flap_dev + 1, "device_flap"),
+                    ("monitor.probe", flap_dev + 1 + 2 * ndev, "device_flap"),
+                ],
+                seed=seed,
+            )
+            schedules.append(sched)
+            with sched:
+                pump_until(lambda: mesh_size() == ndev - 1,
+                           "monitor flap never shrank the mesh")
+                pump_until(lambda: not sched.pending(),
+                           "mid-heal flap event never fired")
+            assert hdelta("flaps_damped") >= 1, (
+                f"flap damping never engaged: {HEALTH_STATS}"
+            )
+            pump_until(lambda: mesh_size() == ndev,
+                       "healed device never re-grew the mesh")
+            assert hdelta("healed") >= 1 and hdelta("degraded") >= 1
+
+            # ---- cycle 2: a straggling device. Two consecutive slow
+            # probes lift the device's EWMA two orders of magnitude over
+            # straggler_factor x the mesh median (and the absolute
+            # floor), so the verdict repeats degrade_after=2 times ->
+            # degrade -> shrink; the EWMA then decays under the cut ->
+            # heal -> re-grow. Nothing raises: detection is pure latency.
+            strag_dev = int((flap_dev + ndev // 2) % ndev)
+            sched = rz.FaultSchedule(
+                events=[
+                    ("monitor.probe", strag_dev + 1, "straggler_probe"),
+                    ("monitor.probe", strag_dev + 1 + ndev, "straggler_probe"),
+                ],
+                straggler_delay=0.2,
+                seed=seed,
+            )
+            schedules.append(sched)
+            with sched:
+                pump_until(lambda: not sched.pending(),
+                           "straggler probe never fired")
+            pump_until(lambda: mesh_size() == ndev - 1,
+                       "straggler EWMA never shrank the mesh")
+            pump_until(lambda: mesh_size() == ndev,
+                       "recovered straggler never re-grew the mesh")
+            assert hdelta("stragglers") >= 2, (
+                f"straggler verdicts missing: {HEALTH_STATS}"
+            )
+
+            # steady state after the storm: traffic flows, no residue
+            pump_until(lambda: True, "cooldown traffic")
+            svc.drain(timeout=300)
+            svc.close(timeout=60)
+
+        # ---- the proof: nothing lost, nothing duplicated, oracle-equal
+        for request, want in accepted:
+            assert request.done, "LOST request: accepted but never answered"
+            assert request.answers == 1, (
+                f"request answered {request.answers} times (contract: exactly 1)"
+            )
+            np.testing.assert_array_equal(
+                np.asarray(request.result(0)).ravel(), want.ravel(),
+                err_msg=f"seed={seed}: answered rows drifted from oracle",
+            )
+        for sched in schedules:
+            assert sched.pending() == [], f"schedule incomplete:\n{sched.report()}"
+        assert mesh_size() == ndev, (
+            f"final mesh has {mesh_size()} devices, expected the full {ndev}"
+        )
+        delta = {
+            c: SERVE_STATS[c] - before[c]
+            for c in ("shrinks", "grows", "scale_events", "restores",
+                      "bucket_misses", "errors")
+        }
+        assert delta["shrinks"] == 2, f"expected exactly two shrinks: {delta}"
+        assert delta["grows"] == 2, f"expected exactly two grows: {delta}"
+        assert delta["scale_events"] == 4, delta
+        # every scale kills the compiled-program buckets: the first batch
+        # after each of the 4 scale events re-warms (+ the cold start)
+        assert delta["bucket_misses"] >= 5, (
+            f"bucket caches were not invalidated across scales: {delta}"
+        )
+        assert delta["restores"] >= 4, (
+            f"registry was not relocated on every scale: {delta}"
+        )
+        health = {k: hdelta(k) for k in
+                  ("ticks", "probes", "probe_failures", "stragglers",
+                   "degraded", "healed", "flaps_damped")}
+        assert health["degraded"] == 2 and health["healed"] == 2, health
+        assert health["probe_failures"] == 2, health  # the two flap events
+        return {
+            "workload": "autoscale",
+            "seed": seed,
+            "ok": True,
+            "faults": {f"{i.kind}@{i.site}": i.detail or True
+                       for s in schedules for i in s.injected},
+            "recoveries": delta,
+            "health": health,
+            "requests": len(accepted),
+            "answered_once": True,
+            "mesh": f"{ndev}->{ndev - 1}->{ndev} (x2)",
+            "wall_s": round(time.monotonic() - t0, 2),
+        }
+    finally:
+        comm_mod.use_comm(orig_comm)
+        rz.clear_unhealthy()
+
+
 # ------------------------------------------------------------------ driver
 def run_trial(name: str, fn, seed: int, quick: bool) -> dict:
     """One trial: returns the JSON record; raises on any failed proof."""
@@ -511,18 +723,29 @@ def main(argv=None) -> int:
     parser.add_argument("--serve", action="store_true",
                         help="serving soak: the ServeService request-survival "
                              "contract instead of the supervisor workloads")
+    parser.add_argument("--autoscale", action="store_true",
+                        help="autoscale soak: HealthMonitor + Autoscaler drive "
+                             "a live service through degrade -> shrink -> heal "
+                             "-> re-grow cycles under request traffic")
     args = parser.parse_args(argv)
     seeds = range(1 if args.quick else (args.seeds or 3))
 
     records, failures = [], 0
-    workloads = (
-        (("serve", None),) if args.serve else WORKLOADS
-    )
+    if args.autoscale:
+        workloads = (("autoscale", None),)
+    elif args.serve:
+        workloads = (("serve", None),)
+    else:
+        workloads = WORKLOADS
     for name, fn in workloads:
         for seed in seeds:
             try:
-                rec = (run_serve_trial(seed, args.quick) if name == "serve"
-                       else run_trial(name, fn, seed, args.quick))
+                if name == "autoscale":
+                    rec = run_autoscale_trial(seed, args.quick)
+                elif name == "serve":
+                    rec = run_serve_trial(seed, args.quick)
+                else:
+                    rec = run_trial(name, fn, seed, args.quick)
             except Exception as e:  # noqa: BLE001 - report-all tool
                 failures += 1
                 rec = {"workload": name, "seed": seed, "ok": False,
