@@ -85,6 +85,17 @@ the sort-then-loop decomposition a user would write from the existing
 public API (``ht.sort`` + one masked reduction per key) — the engine
 must beat the latter >= 2x at low cardinality (gated by bench_check).
 
+A tenth, ``serve_ws2`` (``bench.py --serve-ws2-worker``, TWO
+coordinated ``jax.distributed`` subprocesses of 4 virtual devices
+each), proves the replicated dispatch tick earns its keep at real
+world size 2: the same burst of requests against process-spanning
+sharded weights is served once with the tick armed (the ws>1 default —
+no flush() anywhere, timer/count batching re-armed) and once in the
+tick-disabled barrier-per-request discipline the disarmed triggers
+force on an interactive client. Gated: tick-batched throughput >= 2x
+barrier-driven, 0 lockstep divergences, 0 warm compiles/traces, and at
+least one tick actually fired.
+
 Protocol r7 additionally bounds the two DMA-overlap-banded kernel
 diagnostics (``OVERLAP_BAND``): their best/best_median can never ratchet
 beyond 1.2x the trailing clean median, retiring the stale single-run
@@ -622,6 +633,7 @@ def main():
     out.update(fused_bench())
     out.update(stream_bench())
     out.update(serve_bench())
+    out.update(serve_ws2_bench())
     out.update(frame_bench())
     detail_path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json"
@@ -1200,6 +1212,7 @@ SERVE_REQUESTS = 192
 # ratio (at lower load the batched leg just keeps up with arrivals and
 # the ratio measures the load generator, not batching)
 SERVE_INTERARRIVAL_S = 0.0004
+SERVE_WS2_REQUESTS = 64  # burst size per measured ws2 leg
 SERVE_MAX_BATCH = 32
 HEALTH_TICKS = 50  # probe ticks timed for the health_probe_ms metric
 
@@ -1391,6 +1404,201 @@ def serve_worker():
     )
 
 
+def serve_ws2_worker(pid, nproc, port):
+    """One rank of the ``serve_ws2`` workload: replicated-tick batching
+    vs the barrier-per-request discipline at real world size 2.
+
+    Both ranks play the SAME seeded burst of requests against an
+    endpoint whose weights are split across the process boundary (every
+    dispatch is a cross-process collective). Two service lifetimes run
+    strictly one after the other — two live dispatchers would interleave
+    collectives from two threads per rank:
+
+    - TICK leg (``tick_ms=None``, the ws>1 default): the replicated
+      dispatch tick re-arms the timer/count triggers, so the burst is
+      submitted with NO flush() anywhere and batches form tick-decided,
+      identically on both ranks.
+    - BARRIER leg (``tick_ms=0``, the pre-tick mode): async triggers are
+      disarmed, so an interactive client that cannot know whether more
+      work is coming must flush after EVERY submit to bound its latency
+      — each request dispatches alone behind its own barrier.
+
+    The gated number is ``serve_ws2_speedup`` = tick / barrier completed
+    requests-per-second on the same trace. Both measured legs run under
+    one ``analysis.lockstep()`` with 0 divergences and 0 compiles/traces
+    (Region) asserted in-worker; results are oracle-checked against the
+    numpy pipeline."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import heat_tpu as ht
+    from heat_tpu import analysis
+    from heat_tpu.analysis.sanitizer import Region
+    from heat_tpu.serve import (
+        SERVE_STATS,
+        BucketPolicy,
+        ServeService,
+        refresh_latency_stats,
+        reset_serve_stats,
+    )
+
+    ht.init_distributed(
+        coordinator_address=f"localhost:{port}", num_processes=nproc, process_id=pid
+    )
+
+    cols = 8
+    rng = np.random.default_rng(47)
+    w_np = rng.normal(size=(cols, 4)).astype(np.float32)
+    mu_np = rng.normal(size=(4,)).astype(np.float32)
+    # weights split across the process boundary: x @ w contracts over
+    # the sharded axis, so every batch dispatch is a collective
+    w = ht.array(w_np, split=0)
+    mu = ht.array(mu_np)
+
+    def linear(x):
+        return x @ w + mu
+
+    # warm-up must cover every bucket a GROUPED batch can land in: the
+    # tick leg stacks requests up to max_batch=16 rows, so the batch
+    # buckets reach 16 even though no single request exceeds 8 rows
+    buckets_needed = (1, 2, 4, 8, 16)
+    trace = [
+        rng.normal(size=(1 + i % 8, cols)).astype(np.float32)
+        for i in range(SERVE_WS2_REQUESTS)
+    ]
+
+    def run_epoch(tick_ms, barrier_per_request):
+        """One full service lifetime: cold pass over every bucket, one
+        measured burst, close. Returns (rps, p50, p99, warm, stats)."""
+        svc = ServeService(
+            policy=BucketPolicy(
+                edges=buckets_needed, max_batch=16, max_latency_ms=2.0
+            ),
+            tick_ms=tick_ms,
+        )
+        svc.register_endpoint("linear", linear)
+        assert svc._tick_armed is (tick_ms is None)
+        for b in buckets_needed:
+            r = svc.submit("linear", rng.normal(size=(b, cols)).astype(np.float32))
+            if barrier_per_request:
+                svc.flush()
+            r.result(300)
+
+        reset_serve_stats()
+        region = Region("ws2 measured leg")
+        t0 = time.perf_counter()
+        if barrier_per_request:
+            results = []
+            for payload in trace:
+                r = svc.submit("linear", payload)
+                svc.flush()
+                results.append(r.result(300))
+        else:
+            requests = [svc.submit("linear", payload) for payload in trace]
+            results = [r.result(300) for r in requests]
+        elapsed = time.perf_counter() - t0
+        warm = region.compiles + region.traces
+        refresh_latency_stats()
+        p50 = float(SERVE_STATS["p50_latency_ms"])
+        p99 = float(SERVE_STATS["p99_latency_ms"])
+        # close() joins the dispatcher: counters quiescent before the read
+        svc.close(300)
+        stats = svc.stats()
+        for payload, out in zip(trace, results):
+            np.testing.assert_allclose(
+                np.asarray(out), payload @ w_np + mu_np, atol=1e-4
+            )
+        return len(trace) / elapsed, p50, p99, warm, stats
+
+    with analysis.lockstep():
+        tick_rps, tick_p50, tick_p99, tick_warm, tick_stats = run_epoch(None, False)
+        bar_rps, _, bar_p99, bar_warm, bar_stats = run_epoch(0.0, True)
+    divergences = int(analysis.LOCKSTEP_STATS["divergences"])
+    warm_compiles = tick_warm + bar_warm
+    assert warm_compiles == 0, (tick_warm, bar_warm)
+    assert tick_stats["ticks"] > 0, tick_stats
+    assert tick_stats["tick_batches"] == tick_stats["batches"] > 0, tick_stats
+    assert tick_stats["shed"] == 0 and tick_stats["rejected"] == 0, tick_stats
+    assert bar_stats["errors"] == 0 and tick_stats["errors"] == 0
+
+    print(
+        json.dumps(
+            {
+                "serve_ws2_speedup": round(tick_rps / bar_rps, 3),
+                "serve_ws2_requests_per_sec": round(tick_rps, 2),
+                "serve_ws2_barrier_requests_per_sec": round(bar_rps, 2),
+                "serve_ws2_p50_ms": round(tick_p50, 3),
+                "serve_ws2_p99_ms": round(tick_p99, 3),
+                "serve_ws2_barrier_p99_ms": round(bar_p99, 3),
+                "serve_ws2_warm_compiles": int(warm_compiles),
+                "serve_ws2_lockstep_divergences": divergences,
+                "serve_ws2_ticks": int(tick_stats["ticks"]),
+                "serve_ws2_batches": int(tick_stats["batches"]),
+                "serve_ws2_unit": (
+                    f"burst of {SERVE_WS2_REQUESTS} requests (rows 1..8, "
+                    f"f={cols}) over 2 processes x 4 virtual CPU devices; "
+                    "tick-batched vs flush-per-request"
+                ),
+            }
+        )
+    )
+
+
+def serve_ws2_bench():
+    """Run the serve_ws2 workload ONCE across two coordinated
+    ``jax.distributed`` subprocesses (4 virtual CPU devices each) and
+    fold rank 0's JSON line into the output; any failure degrades to a
+    ``serve_ws2_error`` field, never kills the bench. Both ranks must
+    report the IDENTICAL tick-batch count — the replicated plan is pure,
+    so a mismatch means rank-divergent batch formation."""
+    import socket
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env.pop("HEAT_TPU_TEST_DEVICES", None)
+    try:
+        with socket.socket() as s:
+            s.bind(("localhost", 0))
+            port = s.getsockname()[1]
+        procs = [
+            subprocess.Popen(
+                [
+                    sys.executable, os.path.abspath(__file__),
+                    "--serve-ws2-worker", str(i), "2", str(port),
+                ],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+            for i in range(2)
+        ]
+        outs = [p.communicate(timeout=900)[0] for p in procs]
+        if any(p.returncode != 0 for p in procs):
+            bad = next(
+                out for p, out in zip(procs, outs) if p.returncode != 0
+            )
+            return {"serve_ws2_error": (bad or "no output")[-400:]}
+        parsed = []
+        for out in outs:
+            lines = [ln for ln in out.strip().splitlines() if ln.strip()]
+            parsed.append(json.loads(lines[-1]))
+        if parsed[0]["serve_ws2_batches"] != parsed[1]["serve_ws2_batches"]:
+            return {
+                "serve_ws2_error": (
+                    "rank-divergent batch formation: "
+                    f"{parsed[0]['serve_ws2_batches']} vs "
+                    f"{parsed[1]['serve_ws2_batches']} batches"
+                )
+            }
+        return parsed[0]
+    except Exception as e:  # noqa: BLE001 - diagnostics ride in the output
+        return {"serve_ws2_error": repr(e)[:400]}
+
+
 def stream_bench():
     """Run the stream_pipeline workload ONCE in a fresh 8-virtual-CPU-
     device subprocess and fold its JSON line into the output; a failure
@@ -1545,6 +1753,13 @@ def _compact_summary(out, detail_path):
         "health_probe_ms",
         "health_probe_warm_compiles",
         "serve_error",
+        "serve_ws2_speedup",
+        "serve_ws2_requests_per_sec",
+        "serve_ws2_p99_ms",
+        "serve_ws2_warm_compiles",
+        "serve_ws2_lockstep_divergences",
+        "serve_ws2_ticks",
+        "serve_ws2_error",
         "frame_groupby_rows_per_s",
         "frame_groupby_speedup",
         "frame_loop_rows_per_s",
@@ -2293,7 +2508,12 @@ def cdist_bench():
 if __name__ == "__main__":
     import sys
 
-    if "--ragged-worker" in sys.argv:
+    if "--serve-ws2-worker" in sys.argv:
+        i = sys.argv.index("--serve-ws2-worker")
+        serve_ws2_worker(
+            int(sys.argv[i + 1]), int(sys.argv[i + 2]), sys.argv[i + 3]
+        )
+    elif "--ragged-worker" in sys.argv:
         ragged_worker()
     elif "--fused-worker" in sys.argv:
         fused_worker()

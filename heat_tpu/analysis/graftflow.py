@@ -178,6 +178,7 @@ LAUNDER_CALLS = {
     "process_allgather", "ragged_process_allgather", "all_gather",
     "psum", "pmax", "pmin", "pmean", "broadcast_one_to_all",
     "sync_global_devices", "assemble_local_shards", "replicated_decision",
+    "replicated_frame",
     "process_count", "device_count",
     "lshape_map", "counts_displs_shape",
 }
@@ -186,10 +187,12 @@ LAUNDER_CALLS = {
 # they count as schedule events for F001/F003/F004 even though the
 # rendezvous itself is a call or two deeper.  save/load_checkpoint run
 # sync_global_devices + a ragged allgather; check_divergence reduces
-# per-shard digests; replicated_decision is a one-bool host allgather.
+# per-shard digests; replicated_decision is a one-bool host allgather;
+# replicated_frame is the fixed-width metadata allgather under the
+# health monitor's EWMA frame and the serve dispatch tick.
 COLLECTIVE_WRAPPERS = {
     "save_checkpoint", "load_checkpoint", "check_divergence",
-    "replicated_decision",
+    "replicated_decision", "replicated_frame",
 }
 
 CACHE_NAME_RE = re.compile(r"(?i)(^|_)caches?$")

@@ -49,6 +49,7 @@ __all__ = [
     "collective_lockstep",
     "replicated_decision",
     "replicated_ids",
+    "replicated_frame",
 ]
 
 # canonical mesh-axis name carrying the DNDarray ``split`` dimension
@@ -552,6 +553,40 @@ def replicated_ids(ids, *, cap: int = 64, active: bool = True) -> frozenset:
         return frozenset(int(i) for i in gathered if i >= 0)
 
     return _hooks.guarded_call("collective.replicated_ids", impl)
+
+
+def replicated_frame(
+    frame, *, label: str = "collective.replicated_frame", active: bool = True
+) -> np.ndarray:
+    """Exchange a small fixed-width int64 metadata frame: every process
+    contributes one ``frame`` (identical shape/dtype everywhere by
+    contract — a rank-dependent shape would desync the allgather itself)
+    and receives the stacked ``(nproc, *frame.shape)`` array, identical
+    on every rank.  The array-valued sibling of
+    :func:`replicated_decision` / :func:`replicated_ids`: any pure
+    function of the gathered frames computes the SAME value on every
+    process, so its result may gate collectives — graftflow models this
+    call as laundering taint, which is exactly that contract.
+
+    ``label`` names the guarded-call site (and its fault point) so
+    distinct frame protocols — the health monitor's EWMA frame, the
+    serve dispatch tick — stay separately addressable under chaos
+    schedules.  ``active=False`` — or a single-process world — returns
+    ``frame[None]`` without dispatching anything, so single-controller
+    callers run the identical decode path over a one-row gather."""
+    frame = np.ascontiguousarray(frame, dtype=np.int64)
+    if not active or jax.process_count() == 1:
+        return frame[None]
+    from . import _hooks
+
+    def impl() -> np.ndarray:
+        from jax.experimental import multihost_utils
+
+        _hooks.fault_point(label, shape=frame.shape, dtype="int64")
+        gathered = np.asarray(multihost_utils.process_allgather(frame))
+        return gathered.reshape((jax.process_count(),) + frame.shape)
+
+    return _hooks.guarded_call(label, impl)
 
 
 def collective_lockstep(tree):

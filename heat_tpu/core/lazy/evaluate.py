@@ -25,9 +25,34 @@ re-enter capture (trace-safe mode turns ``capture.active()`` off) and
 never move data host-side (``_hooks.trace_barrier`` sites raise, which
 :mod:`heat_tpu.core.lazy.capture` converts into an eager fallback at
 capture time — such an op is simply never part of a graph).
+
+Cross-chain common-subexpression reuse
+--------------------------------------
+Serving workloads evaluate N distinct chains that share a long prefix
+(every endpoint standardizes its input the same way, then applies its
+own head). Compiling each chain monolithically re-traces the shared
+prefix N times. On a program-cache miss, :func:`_evaluate_group`
+therefore consults a bounded registry of previously compiled chain
+signatures: when the new chain's serialized prefix (ops, statics,
+operand wiring AND the leaf layouts it touches) matches a registered
+chain for at least :data:`_CSE_MIN_PREFIX` nodes, the shared prefix is
+compiled ONCE as its own cached program and the new chain becomes a
+composite — prefix program + remainder program — stored under the full
+signature like any other executable. ``FUSE_STATS["cse_hits"]`` counts
+the compilations that *reused* an already-compiled prefix; a warm
+replay of a composite is still exactly one cached lookup (one
+``fused_dispatch``, one ``cache_hit``, zero traces).
+
+The cut is collective-safe by construction: boundary outputs keep
+their recorded eager shardings (no resharding is introduced), and the
+registry is populated in evaluation order, which the SPMD lockstep
+discipline already requires to be rank-uniform — the replicated serve
+dispatch tick (:mod:`heat_tpu.serve.tick`) compiles endpoints in the
+same order on every rank.
 """
 from __future__ import annotations
 
+import threading
 from typing import List, Sequence, Tuple
 
 import jax
@@ -42,7 +67,19 @@ __all__ = ["infer_meta", "evaluate", "META_CACHE", "PROGRAM_CACHE"]
 # op-shape metadata probes: one eval_shape per distinct (op, layout)
 META_CACHE = ExecutableCache(maxsize=1024)
 # fused executables: one jit per distinct (graph, leaf layouts, comm)
+# (shared-prefix programs live here too, under "cse"-tagged keys, so
+# they ride the same LRU bound instead of pinning executables forever)
 PROGRAM_CACHE = ExecutableCache(maxsize=256)
+
+# shortest shared prefix worth a program cut: a 1-node prefix saves one
+# op trace but costs an extra dispatch boundary forever
+_CSE_MIN_PREFIX = 2
+# registry of recently compiled chain signatures, newest last:
+# (comm, sig_nodes, leaf_tokens) triples. Bounded like the executable
+# caches — an evicted chain only costs a missed reuse opportunity.
+_CSE_MAX_CHAINS = 32
+_CSE_CHAINS: List[Tuple] = []
+_CSE_LOCK = threading.Lock()
 
 
 def _reconstruct(meta: NodeMeta, buf) -> DNDarray:
@@ -178,6 +215,135 @@ def _build_program(spec, leaf_metas, out_ids, out_metas, comm):
     return jax.jit(run, out_shardings=shardings)
 
 
+def _cse_prefix_len(sig_nodes, leaf_tokens, entry_nodes, entry_leaves) -> int:
+    """Length of the longest common serialized prefix of two chains.
+
+    Node signatures must match exactly AND every leaf a prefix node
+    touches must have the same layout token in both chains (leaf slots
+    are assigned in first-use order, so identical wiring implies
+    identical slot numbering — only the layouts can differ)."""
+    k = 0
+    for a, b in zip(sig_nodes, entry_nodes):
+        if a != b:
+            break
+        ok = True
+        for ent in a[3]:  # ("n", i) | ("l", j) | ("s", *token)
+            if ent[0] != "l":
+                continue
+            v = ent[1]
+            if (
+                v >= len(leaf_tokens)
+                or v >= len(entry_leaves)
+                or leaf_tokens[v] != entry_leaves[v]
+            ):
+                ok = False
+                break
+        if not ok:
+            break
+        k += 1
+    return k
+
+
+def _cse_register(comm, sig_nodes, leaf_tokens) -> None:
+    """Record a compiled chain so later chains can reuse its prefix."""
+    if len(sig_nodes) < _CSE_MIN_PREFIX:
+        return
+    entry = (comm, sig_nodes, leaf_tokens)
+    with _CSE_LOCK:
+        if entry in _CSE_CHAINS:
+            return
+        _CSE_CHAINS.append(entry)
+        del _CSE_CHAINS[:-_CSE_MAX_CHAINS]
+
+
+def _cse_compile(comm, nodes, spec, sig_nodes, leaf_metas, out_ids, out_metas):
+    """Composite program for a chain sharing a prefix with a seen chain,
+    or None when no registered chain shares at least ``_CSE_MIN_PREFIX``
+    serialized nodes. The shared prefix compiles as its own cached
+    program (keyed by its serialized form + boundary, so every chain
+    with the same prefix and cut reuses ONE executable); the remainder
+    compiles per chain and consumes the boundary buffers as extra
+    leaves. The composite replays as prefix-then-remainder with outputs
+    routed back into full-graph order."""
+    leaf_tokens = tuple(m.token for m in leaf_metas)
+    with _CSE_LOCK:
+        chains = list(_CSE_CHAINS)
+    k = 0
+    for e_comm, e_nodes, e_leaves in chains:
+        if e_comm != comm:
+            continue
+        k = max(k, _cse_prefix_len(sig_nodes, leaf_tokens, e_nodes, e_leaves))
+    # the full chain always keeps at least its last node in the
+    # remainder: the final node is necessarily a target (nothing after
+    # it consumes it), so the remainder program is never empty
+    k = min(k, len(nodes) - 1)
+    if k < _CSE_MIN_PREFIX:
+        return None
+
+    # boundary: prefix nodes the remainder consumes, plus prefix nodes
+    # that are program outputs in their own right
+    need = {i for i in out_ids if i < k}
+    for _, _, _, wiring in spec[k:]:
+        for tag, v in wiring:
+            if tag == "n" and v < k:
+                need.add(v)
+    boundary = tuple(sorted(need))
+    if not boundary:
+        return None
+
+    # leaves are numbered in first-use order, so the prefix touches
+    # exactly slots [0, nlp)
+    used = [
+        v for _, _, _, wiring in spec[:k] for tag, v in wiring if tag == "l"
+    ]
+    nlp = 1 + max(used) if used else 0
+
+    boundary_metas = [nodes[i].meta for i in boundary]
+    psig = ("cse", comm, leaf_tokens[:nlp], tuple(sig_nodes[:k]), boundary)
+    pprog = PROGRAM_CACHE.get(psig)
+    if pprog is None:
+        pprog = _build_program(spec[:k], leaf_metas[:nlp], boundary,
+                               boundary_metas, comm)
+        PROGRAM_CACHE[psig] = pprog
+    else:
+        stats_inc("cse_hits")
+
+    # remainder: rewrite wiring so prefix nodes arrive as extra leaves
+    # appended after the graph's own leaf slots
+    slot = {i: len(leaf_metas) + j for j, i in enumerate(boundary)}
+    rspec = []
+    for kind, op, statics, wiring in spec[k:]:
+        rw = tuple(
+            (("n", v - k) if v >= k else ("l", slot[v]))
+            if tag == "n" else (tag, v)
+            for tag, v in wiring
+        )
+        rspec.append((kind, op, statics, rw))
+    r_out = tuple(i - k for i in out_ids if i >= k)
+    r_metas = [nodes[i].meta for i in out_ids if i >= k]
+    rprog = _build_program(rspec, list(leaf_metas) + boundary_metas,
+                           r_out, r_metas, comm)
+
+    # output routing: each full-graph output comes from one of the two
+    # programs, in full out_ids order
+    route, ri = [], 0
+    for i in out_ids:
+        if i < k:
+            route.append(("p", boundary.index(i)))
+        else:
+            route.append(("r", ri))
+            ri += 1
+
+    def run(*bufs):
+        pouts = pprog(*bufs[:nlp])
+        routs = rprog(*bufs, *pouts)
+        return tuple(
+            pouts[j] if tag == "p" else routs[j] for tag, j in route
+        )
+
+    return run
+
+
 def _evaluate_group(comm, targets: Sequence[Node]) -> None:
     nodes = _collect(targets)
     if not nodes:
@@ -233,9 +399,15 @@ def _evaluate_group(comm, targets: Sequence[Node]) -> None:
     sig = (comm, tuple(m.token for m in leaf_metas), tuple(sig_nodes), out_ids)
     prog = PROGRAM_CACHE.get(sig)
     if prog is None:
-        prog = _build_program(spec, leaf_metas, out_ids, out_metas, comm)
+        prog = _cse_compile(
+            comm, nodes, spec, tuple(sig_nodes), leaf_metas, out_ids, out_metas
+        )
+        if prog is None:
+            prog = _build_program(spec, leaf_metas, out_ids, out_metas, comm)
         PROGRAM_CACHE[sig] = prog
         stats_inc("graphs_captured")
+        _cse_register(comm, tuple(sig_nodes),
+                      tuple(m.token for m in leaf_metas))
     else:
         stats_inc("cache_hits")
     stats_inc("fused_dispatches")

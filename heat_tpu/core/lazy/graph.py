@@ -48,12 +48,21 @@ __all__ = ["FUSE_STATS", "reset_fuse_stats", "stats_inc",
 #                         needing a host-side exchange, ...) plus forced
 #                         mid-scope materializations (``.numpy()``,
 #                         ``print``, indexing, ``.item()``); either way
-#                         the op itself runs eagerly and stays correct.
+#                         the op itself runs eagerly and stays correct;
+# - ``cse_hits``          program-cache misses that reused an already-
+#                         compiled shared-prefix program instead of
+#                         re-tracing it (cross-chain common-subexpression
+#                         reuse in :mod:`heat_tpu.core.lazy.evaluate` —
+#                         N endpoints sharing a standardize-style prefix
+#                         compile it once; warm replay of the composite
+#                         still counts exactly one fused_dispatch and one
+#                         cache_hit).
 FUSE_STATS = {
     "graphs_captured": 0,
     "fused_dispatches": 0,
     "eager_fallbacks": 0,
     "cache_hits": 0,
+    "cse_hits": 0,
 }
 
 

@@ -68,6 +68,7 @@ from ..core import _hooks
 from ..core.communication import (
     MeshCommunication,
     replicated_decision,
+    replicated_frame,
     replicated_ids,
     sanitize_comm,
 )
@@ -225,13 +226,21 @@ class HealthMonitor:
         }
 
     # ------------------------------------------------------------- cadence
+    def local_due(self, now: Optional[float] = None) -> bool:
+        """Rank-local cadence check — NO collective. The piggyback half
+        of :meth:`maybe_tick`: a caller that already exchanges its own
+        replicated frame (the serve dispatch tick) carries this flag in
+        it and runs :meth:`probe_local` / :meth:`apply_gathered` when the
+        gathered flags agree, instead of paying a separate decision
+        allgather per heartbeat."""
+        now = self._clock() if now is None else now
+        return self._last_tick < 0 or (now - self._last_tick) >= self.interval_s
+
     def maybe_tick(self) -> Optional[TickReport]:
         """Tick when the cadence is due; the due decision is replicated
         at ws>1 (wall clocks drift), so every rank ticks together or not
         at all. THE entry point for dispatch-boundary piggybacking."""
-        now = self._clock()
-        due = self._last_tick < 0 or (now - self._last_tick) >= self.interval_s
-        if not replicated_decision(due, active=self._multi):
+        if not replicated_decision(self.local_due(), active=self._multi):
             return None
         return self.tick()
 
@@ -290,6 +299,27 @@ class HealthMonitor:
     def _tick_locked(self) -> TickReport:
         t0 = time.perf_counter()
         self._last_tick = self._clock()
+        local_fail, export, probes = self._probe_local_locked()
+
+        # replicated verdict inputs: failure union + µs-quantized EWMA
+        # frame — every rank transitions its ledger from identical data
+        failed = replicated_ids(local_fail, active=self._multi)
+        ewmas = self._replicated_ewmas(export)
+        return self._apply_locked(failed, ewmas, probes, len(local_fail), t0)
+
+    # --------------------------------------------- piggyback (probe/apply)
+    def probe_local(self):
+        """The rank-local half of a tick: probe every addressable base
+        device and fold the samples into the local ledger EWMAs — NO
+        collective dispatched. Returns ``(fail_ids, ewma_export, probes)``
+        where ``ewma_export`` is the ``{device_id: ewma_ms}`` dict this
+        rank would contribute to the health frame; a piggybacking caller
+        ships both on its own replicated frame and finishes the tick with
+        :meth:`apply_gathered`."""
+        with self._tick_lock:
+            return self._probe_local_locked()
+
+    def _probe_local_locked(self):
         pid = jax.process_index()
         local_fail: List[int] = []
         local_ms: Dict[int, float] = {}
@@ -310,19 +340,33 @@ class HealthMonitor:
                 raise
             except Exception:  # noqa: BLE001 - any probe failure means unhealthy
                 local_fail.append(int(dev.id))
-
-        # replicated verdict inputs: failure union + µs-quantized EWMA
-        # frame — every rank transitions its ledger from identical data
-        failed = replicated_ids(local_fail, active=self._multi)
         for dev_id, ms in local_ms.items():
             entry = self.ledger[dev_id]
             entry.ewma_ms = (
                 ms if entry.ewma_ms == 0.0
                 else self.ewma_alpha * ms + (1.0 - self.ewma_alpha) * entry.ewma_ms
             )
-        ewmas = self._replicated_ewmas(
-            {d: self.ledger[d].ewma_ms for d in local_ms}
-        )
+        export = {d: self.ledger[d].ewma_ms for d in local_ms}
+        return local_fail, export, probes
+
+    def apply_gathered(self, failed, ewmas, *, probes: int = 0,
+                       failures: int = 0) -> TickReport:
+        """The replicated half of a tick: adopt the gathered verdict
+        inputs (``failed`` — the cross-rank failure union; ``ewmas`` —
+        the unioned µs-quantized ``{device_id: ewma_ms}``) and run the
+        ledger transitions. Every argument must already be identical on
+        every rank — the caller's frame exchange is the rendezvous — so
+        the transitions (and :data:`HEALTH_STATS`) stay rank-identical.
+        Resets the cadence clock: a piggybacked tick counts."""
+        t0 = time.perf_counter()
+        with self._tick_lock:
+            self._last_tick = self._clock()
+            return self._apply_locked(
+                frozenset(int(d) for d in failed), dict(ewmas),
+                probes, failures, t0,
+            )
+
+    def _apply_locked(self, failed, ewmas, probes, failures, t0) -> TickReport:
         for dev_id, ewma in ewmas.items():
             self.ledger[dev_id].ewma_ms = ewma
         ok_ewmas = [e for d, e in ewmas.items() if d not in failed]
@@ -340,7 +384,7 @@ class HealthMonitor:
                              dev_id in stragglers, report)
         report.probe_ms = (time.perf_counter() - t0) * 1e3
         _hooks.observe(
-            "health.tick", probes=probes, failures=len(local_fail),
+            "health.tick", probes=probes, failures=failures,
             ms=report.probe_ms,
         )
         return report
@@ -369,24 +413,13 @@ class HealthMonitor:
             raise ValueError(
                 f"health frame: {len(local)} local devices exceed {cap} slots"
             )
-
-        def impl() -> Dict[int, float]:
-            from jax.experimental import multihost_utils
-
-            _hooks.fault_point(
-                "collective.health_frame", shape=(cap, 2), dtype="int64"
-            )
-            frame = np.full((cap, 2), -1, dtype=np.int64)
-            for i, (dev_id, ms) in enumerate(sorted(local.items())):
-                frame[i] = (dev_id, int(round(ms * 1000.0)))
-            gathered = np.asarray(
-                multihost_utils.process_allgather(frame)
-            ).reshape(-1, 2)
-            return {
-                int(d): float(us) / 1000.0 for d, us in gathered if d >= 0
-            }
-
-        return _hooks.guarded_call("collective.health_frame", impl)
+        frame = np.full((cap, 2), -1, dtype=np.int64)
+        for i, (dev_id, ms) in enumerate(sorted(local.items())):
+            frame[i] = (dev_id, int(round(ms * 1000.0)))
+        gathered = replicated_frame(
+            frame, label="collective.health_frame"
+        ).reshape(-1, 2)
+        return {int(d): float(us) / 1000.0 for d, us in gathered if d >= 0}
 
     # --------------------------------------------------------- transitions
     def _transition(self, entry: DeviceHealth, failed: bool,

@@ -28,6 +28,7 @@ from .autoscale import Autoscaler
 from .batching import BucketPolicy, PendingBatch
 from .service import DEFAULT_DISPATCH_POLICY, Request, ServeService
 from .session import ModelRegistry
+from .tick import TickPlan, plan_dispatch
 
 __all__ = [
     "SERVE_STATS",
@@ -36,6 +37,8 @@ __all__ = [
     "Autoscaler",
     "BucketPolicy",
     "PendingBatch",
+    "TickPlan",
+    "plan_dispatch",
     "Request",
     "ServeService",
     "ModelRegistry",

@@ -37,7 +37,13 @@ The service emits passive ``serve.*`` events (see
   expired in the queue; it was answered with ``ServeDeadlineError``
   before padding a batch;
 - ``serve.rejected`` (``depth``) — admission control fast-rejected a
-  submit past the high-water queue depth (``ServeOverloadError``).
+  submit past the high-water queue depth (``ServeOverloadError``);
+- ``serve.tick`` (``batches``, ``shed``, ``call``, ``monitor``) — one
+  AGREED replicated dispatch tick was applied (every rank counts the
+  same ticks — the rank-local due checks and declined rendezvous are
+  not events): ``batches``/``shed`` say what the tick's plan dispatched
+  and expired, ``call``/``monitor`` whether it released a control call
+  or carried a piggybacked health-monitor tick.
 
 One module-level observer folds them into :data:`SERVE_STATS`; the
 percentile gauges are recomputed from a bounded latency ring on
@@ -71,6 +77,9 @@ SERVE_STATS = {
     "redispatched": 0,      # requests re-dispatched after a recovery
     "shed": 0,              # requests shed on an expired deadline
     "rejected": 0,          # submits fast-rejected by admission control
+    "ticks": 0,             # agreed replicated dispatch ticks applied
+    "tick_batches": 0,      # batches dispatched by tick plans
+    "tick_sheds": 0,        # deadline sheds decided by tick plans
     "queue_depth": 0,       # gauge: depth at the last enqueue OR dispatch
     "max_queue_depth": 0,
     "p50_latency_ms": 0.0,  # gauges: refreshed from the latency ring
@@ -147,6 +156,10 @@ def _observer(event: str, ctx: dict) -> None:
             SERVE_STATS["shed"] += 1
         elif event == "serve.rejected":
             SERVE_STATS["rejected"] += 1
+        elif event == "serve.tick":
+            SERVE_STATS["ticks"] += 1
+            SERVE_STATS["tick_batches"] += int(ctx.get("batches", 0))
+            SERVE_STATS["tick_sheds"] += int(ctx.get("shed", 0))
 
 
 _hooks.add_observer(_observer)

@@ -119,37 +119,71 @@ class Autoscaler:
         if report is None:
             return None
         if report.degraded:
-            # safety first: reset pressure so the post-shrink queue
-            # build-up must re-arm the band from scratch
-            self._pressure = 0
-            return "shrink"
-
-        if queue_depth > self.high_depth:
-            self._pressure += 1
-        elif queue_depth <= self.low_depth:
-            self._pressure = 0
-
-        want_capacity = self._capacity_below_base()
-        cooled = (
-            self._last_grow < 0
-            or (self._clock() - self._last_grow) >= self.cooldown_s
-        )
-        want_grow = want_capacity and cooled and (
-            bool(report.healed)
-            or self._deferred_heal
-            or self._pressure >= self.hysteresis
-        )
+            # replicated fact: every rank shrinks with no extra rendezvous
+            return self.resolve(False, report)
+        want_grow = self.vote(queue_depth, report)
         # ONE symmetric rendezvous per tick: pressure streaks and
         # cooldown clocks are rank-local, the executed action must not be
         want_grow = replicated_decision(
             want_grow, active=jax.process_count() > 1
         )
+        return self.resolve(want_grow, report)
+
+    def vote(self, queue_depth: int, report) -> bool:
+        """The rank-local half of a tick consultation: fold this tick's
+        queue depth into the pressure streak and return this rank's grow
+        vote — NO collective. ``consult`` composes this with one
+        ``replicated_decision`` and :meth:`resolve`."""
+        if report.degraded:
+            return False  # resolve() shrinks regardless of votes
+        pressure, ready = self.pre_vote(queue_depth)
+        return pressure or (bool(report.healed) and ready)
+
+    def pre_vote(self, queue_depth: int) -> tuple:
+        """The report-FREE rank-local half, for piggybacking on a frame
+        exchanged before this tick's health report exists (the serve
+        dispatch tick). Folds ``queue_depth`` into the pressure streak
+        and returns ``(pressure_vote, capacity_ready)``:
+
+        - ``pressure_vote`` — this rank wants a grow on its own merits
+          (pressure streak armed, or a deferred heal pending), capacity
+          and cooldown permitting;
+        - ``capacity_ready`` — capacity is below base and cooldown has
+          elapsed, so a *heal* reported by the gathered frames should
+          grow.
+
+        The gathered verdict ``OR(pressure_vote) or (healed and
+        OR(capacity_ready))`` equals ``OR`` over ranks of :meth:`vote`
+        because heal/degrade facts are rank-uniform."""
+        if queue_depth > self.high_depth:
+            self._pressure += 1
+        elif queue_depth <= self.low_depth:
+            self._pressure = 0
+        cooled = (
+            self._last_grow < 0
+            or (self._clock() - self._last_grow) >= self.cooldown_s
+        )
+        ready = self._capacity_below_base() and cooled
+        pressure = ready and (
+            self._deferred_heal or self._pressure >= self.hysteresis
+        )
+        return (pressure, ready)
+
+    def resolve(self, want_grow: bool, report) -> Optional[str]:
+        """The replicated half: apply an already-rendezvoused grow
+        verdict (identical on every rank by the caller's contract) plus
+        the tick report's degrade/heal facts, and return the action."""
+        if report.degraded:
+            # safety first: reset pressure so the post-shrink queue
+            # build-up must re-arm the band from scratch
+            self._pressure = 0
+            return "shrink"
         if want_grow:
             self._pressure = 0
             self._deferred_heal = False
             self._last_grow = self._clock()
             return "grow"
-        if report.healed and want_capacity:
+        if report.healed and self._capacity_below_base():
             self._deferred_heal = True  # cooldown blocked it; retry later
         return None
 

@@ -25,7 +25,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-__all__ = ["BucketPolicy", "PendingBatch"]
+__all__ = ["BucketPolicy", "PendingBatch", "form_plan_batches"]
 
 # power-of-two menu: small enough that a handful of cold dispatches
 # covers all of it, dense enough that padding waste stays under 2x
@@ -45,11 +45,14 @@ class BucketPolicy:
         Flush a pending group as soon as it holds this many real rows.
     max_latency_ms : float
         Flush a non-full group once its oldest request has waited this
-        long. Both the timer and the count trigger fire at
-        rank-divergent moments, so the service arms them with a single
-        controller only; multi-process serving dispatches exclusively at
-        explicit ``flush()``/``drain()``/``submit_call`` barriers (see
-        docs/SERVING.md).
+        long. Both the timer and the count trigger consult rank-local
+        state (a wall clock; this rank's queue view), so with multiple
+        controllers they are never evaluated directly — the replicated
+        dispatch tick (:mod:`heat_tpu.serve.tick`) exchanges the
+        underlying numbers in a fixed-width frame and re-derives both
+        triggers from the gathered, rank-identical view (max-over-ranks
+        age, min-over-ranks rows). ``max_latency_ms`` also sets the
+        default tick cadence (see docs/SERVING.md).
     """
 
     def __init__(
@@ -120,3 +123,24 @@ class PendingBatch:
         request order."""
         stacked = np.concatenate([r.payload for r in self.requests], axis=0)
         return policy.pad(stacked)
+
+
+def form_plan_batches(key, requests, max_batch: int) -> List[PendingBatch]:
+    """Split a tick plan's request prefix for one bucket key into
+    dispatchable batches, capped at ``max_batch`` real rows each — a
+    burst becomes several batches in the SAME warm bucket rather than
+    one batch in a novel (cold) oversized bucket; a single over-large
+    request still dispatches alone. Pure request-order arithmetic over
+    plan-selected inputs, so every rank forms the identical batch
+    sequence."""
+    batches: List[PendingBatch] = []
+    current: Optional[PendingBatch] = None
+    for request in requests:
+        if (
+            current is None
+            or (current.rows and current.rows + request.rows > max_batch)
+        ):
+            current = PendingBatch(key)
+            batches.append(current)
+        current.add(request)
+    return batches

@@ -12,8 +12,8 @@ rendezvous — and every batch is pinned under ``collective_lockstep``
 before the next one launches, so multi-controller execution keeps one
 total order of collective-bearing programs.
 
-Flush triggers, and the multi-controller contract
--------------------------------------------------
+Dispatch triggers, and the multi-controller contract
+----------------------------------------------------
 A pending batch dispatches when (a) it reaches ``policy.max_batch``
 rows, (b) its oldest request has waited ``policy.max_latency_ms``, or
 (c) a barrier forces it: ``flush()``, ``drain()``, ``close()``, or any
@@ -23,19 +23,30 @@ the barrier has a deterministic POSITION in the queue: exactly the
 requests submitted before it are forced, never a racing later submit
 the dispatcher happened to observe.
 
-Triggers (a) and (b) are armed with a single controller only. Both are
-rank-divergent under multiple controllers: wall clocks drift, and the
-count trigger fires at whatever queue prefix each rank's dispatcher
-happens to observe — with two pending endpoint groups, rank A can see
-only the younger group full (dispatching it first) while rank B sees
-both (dispatching the older first), and the collective-bearing batch
-programs then interleave in different orders across ranks, which is
-exactly the deadlock ``collective_lockstep`` exists to prevent. So at
-``jax.process_count() > 1`` the service is barrier-driven SPMD like
-everything else in this tree: every process submits the same requests
-in the same order and calls the same barriers; batches between barriers
-form from identical queue segments by identical rules, and lockstep
-pinning keeps one total order of programs. See docs/SERVING.md.
+As LOCAL checks, (a) and (b) are rank-divergent under multiple
+controllers: wall clocks drift, and the count trigger fires at whatever
+queue prefix each rank's dispatcher happens to observe — with two
+pending endpoint groups, rank A can see only the younger group full
+(dispatching it first) while rank B sees both (dispatching the older
+first), and the collective-bearing batch programs then interleave in
+different orders across ranks, which is exactly the deadlock
+``collective_lockstep`` exists to prevent (and why PR 13 disarmed them
+at ws>1). The REPLICATED DISPATCH TICK (:mod:`heat_tpu.serve.tick`)
+re-arms both without that hazard: the dispatcher loop takes exactly one
+``replicated_decision`` per iteration on whether any rank is due, and
+on an agreed tick every rank exchanges one tiny fixed-width frame of
+queue metadata (accepted high-water, per-bucket pending prefix lengths
+and rows, µs-quantized oldest ages, expired deadlines) and runs the
+same PURE plan function over the gathered frames — so which buckets
+dispatch, at what prefix length, which requests shed, and when a
+control call runs are decided identically on every rank. Deadline
+shedding thereby rides the tick (promoted from its former ws1-only
+arming), and the same frame piggybacks the health monitor's probe
+exports and the autoscaler's grow votes: one heartbeat carries all
+three decisions instead of three allgathers. With ``tick_ms=0`` the
+service falls back to barrier-driven SPMD (the PR 13 contract): batches
+between barriers form from identical queue segments by identical rules.
+See docs/SERVING.md.
 
 The request-survival contract
 -----------------------------
@@ -69,10 +80,15 @@ submits past the high-water mark
 (:class:`~heat_tpu.resilience.ServeOverloadError`, raised in the client
 thread before enqueue), and per-request deadlines shed expired requests
 with :class:`~heat_tpu.resilience.ServeDeadlineError` before they pad a
-batch. Deadline shedding is wall-clock driven and therefore
-single-controller only (armed with the async triggers); overload
-rejection at ws>1 counts requests accepted since the last barrier — a
-rank-invariant number — instead of the racing instantaneous depth.
+batch (tick-decided at ws>1: a deadline any rank's clock saw expire is
+shed on every rank). Overload rejection is a client-thread decision and
+must be trace-invariant: with one controller the live queue depth is
+the yardstick; in barrier-driven multi-controller mode it counts
+requests accepted since the last barrier — a rank-invariant number —
+and with the tick armed at ws>1 there is no barrier to anchor a count
+to and the instantaneous depth races rank-divergently, so depth
+admission stands down and tick-decided deadline shedding is the
+overload mechanism.
 Recovery activity is counted in ``SERVE_STATS``
 (``retries/bisections/restores/shrinks/shed/rejected/redispatched``);
 the recovery-free warm path is byte-identical to PR 13's.
@@ -99,11 +115,13 @@ from ..resilience.retry import RetryPolicy
 from ..core.communication import (
     collective_lockstep,
     replicated_decision,
+    replicated_frame,
     replicated_ids,
     sanitize_comm,
 )
 from ..core.dndarray import DNDarray
-from .batching import BucketPolicy, PendingBatch
+from . import tick as _tick
+from .batching import BucketPolicy, PendingBatch, form_plan_batches
 from .session import ModelRegistry
 from ._stats import SERVE_STATS, refresh_latency_stats
 
@@ -146,7 +164,7 @@ class Request:
     ends at exactly 1, and the chaos soak asserts it.
     """
 
-    __slots__ = ("endpoint", "payload", "rows", "enqueue_t",
+    __slots__ = ("endpoint", "payload", "rows", "enqueue_t", "seq",
                  "deadline_ms", "deadline_t", "answers",
                  "_done", "_result", "_error")
 
@@ -156,6 +174,11 @@ class Request:
         self.payload = payload
         self.rows = int(payload.shape[0])
         self.enqueue_t = time.monotonic()
+        # admission order within the service (set under the queue lock
+        # at accept time): the trace-invariant identity the replicated
+        # tick plans speak in — identical for the same request on every
+        # rank, unlike id() or enqueue wall time
+        self.seq = -1
         self.deadline_ms = None if deadline_ms is None else float(deadline_ms)
         self.deadline_t = (
             None if deadline_ms is None
@@ -247,7 +270,19 @@ class ServeService:
         healed capacity available) rebuilds the default mesh,
         elastically relocates the resident registry, and invalidates
         the warm-bucket program cache — exactly the fault ladder's
-        shrink rung, but proactive.
+        shrink rung, but proactive. With the tick armed, the monitor's
+        probe exports and the grow votes ride the dispatch frame (one
+        heartbeat, not three allgathers).
+    tick_ms : float, optional
+        Replicated dispatch tick cadence (module docstring). ``None``
+        (default): armed at ``jax.process_count() > 1`` with the
+        ``policy.max_latency_ms`` cadence, while a single controller
+        keeps the direct async triggers. ``0``: ticks disabled — ws>1
+        falls back to barrier-driven dispatch (the PR 13 contract).
+        ``> 0``: explicit cadence; forces tick mode even at ws==1
+        (the replicated primitives pass through), which is how the
+        unit tests and the chaos soak drive the tick machinery in one
+        process.
     """
 
     def __init__(
@@ -259,11 +294,14 @@ class ServeService:
         max_queue_depth: Optional[int] = None,
         retry: Optional[RetryPolicy] = None,
         autoscaler=None,
+        tick_ms: Optional[float] = None,
     ):
         if max_queue_depth is not None and max_queue_depth < 1:
             raise ValueError(
                 f"max_queue_depth must be >= 1, got {max_queue_depth}"
             )
+        if tick_ms is not None and tick_ms < 0:
+            raise ValueError(f"tick_ms must be >= 0, got {tick_ms}")
         self.policy = policy or BucketPolicy()
         self.registry = registry or ModelRegistry()
         self.snapshot_dir = snapshot_dir
@@ -283,10 +321,25 @@ class ServeService:
         # instantaneous queue length races the dispatcher's pops at
         # rank-divergent moments)
         self._since_barrier = 0
-        # the latency timer and the max-batch count trigger both fire at
-        # rank-divergent moments (see the module docstring); arm them
-        # only when there is no other rank to diverge from
-        self._async_triggers = jax.process_count() == 1
+        self._single = jax.process_count() == 1
+        if tick_ms is None:
+            self._tick_armed = not self._single
+            self._tick_s = self.policy.max_latency_ms / 1e3
+        else:
+            self._tick_armed = tick_ms > 0
+            self._tick_s = float(tick_ms) / 1e3
+        # the DIRECT latency timer and max-batch count trigger consult
+        # rank-local state and fire at rank-divergent moments (see the
+        # module docstring); arm them only when there is no other rank
+        # to diverge from AND the replicated tick is not driving
+        self._async_triggers = self._single and not self._tick_armed
+        # trace-invariant admission order; plans identify requests by it
+        self._next_seq = 0
+        self._last_tick = -1.0
+        # the health monitor's local probe export, parked between the
+        # rank-local probe and the agreed tick that applies the gathered
+        # union: (fail_ids, ewma_export, probes, autoscale votes)
+        self._mon_stash = None
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="serve-dispatch"
         )
@@ -344,19 +397,31 @@ class ServeService:
             if self._closed:
                 raise RuntimeError("service is closed")
             if self.max_queue_depth is not None:
-                # ws>1 counts accepts since the last barrier (every rank
-                # submits the same trace, so the count is identical
-                # everywhere); ws==1 uses the live queue depth. Control
-                # calls (flush/drain sentinels, submit_call work) never
+                # the admission verdict must be trace-invariant (every
+                # rank accepts/rejects the same submits). ws==1: the
+                # live queue depth. Barrier-driven ws>1: accepts since
+                # the last barrier (every rank submits the same trace,
+                # so the count is identical everywhere). Tick-armed
+                # ws>1: neither works — no barrier to anchor a count
+                # to, and the live depth races the tick's pops at
+                # rank-divergent moments — so depth admission stands
+                # down and tick-decided deadline shedding bounds the
+                # queue instead (module docstring). Control calls
+                # (flush/drain sentinels, submit_call work) never
                 # consume admission budget — only requests do.
-                depth_now = (
-                    sum(1 for x in self._queue if not isinstance(x, _Call))
-                    if self._async_triggers
-                    else self._since_barrier
-                )
-                if depth_now >= self.max_queue_depth:
+                if self._single:
+                    depth_now = sum(
+                        1 for x in self._queue if not isinstance(x, _Call)
+                    )
+                elif not self._tick_armed:
+                    depth_now = self._since_barrier
+                else:
+                    depth_now = None
+                if depth_now is not None and depth_now >= self.max_queue_depth:
                     reject = depth_now
             if reject is None:
+                request.seq = self._next_seq
+                self._next_seq += 1
                 self._queue.append(request)
                 self._since_barrier += 1
                 depth = len(self._queue)
@@ -466,6 +531,9 @@ class ServeService:
 
     # ----------------------------------------------------------- dispatcher
     def _loop(self) -> None:
+        if self._tick_armed:
+            self._tick_loop()
+            return
         while True:
             with self._cond:
                 work = self._pick_work()
@@ -491,6 +559,274 @@ class ServeService:
             _hooks.observe("serve.depth", depth=depth)
             if self.autoscaler is not None:
                 self._autoscale(depth)
+
+    # ------------------------------------------------- replicated tick mode
+    def _tick_loop(self) -> None:
+        """The tick-armed dispatcher (module docstring). Collective
+        pairing invariant, the thing graftflow exists to check: every
+        iteration makes exactly ONE ``replicated_decision`` (am I — or
+        anyone — due?), and an agreed True is followed by exactly one
+        ``replicated_frame`` exchange; the plan derived from it is a
+        pure function of the gathered array, so the batch/shed/call
+        programs it triggers run in one total order on every rank. The
+        rank-local due check and the bounded waits never touch a
+        collective, so clock drift only costs latency (a rank blocks in
+        the rendezvous until the slowest peer's wait expires — at most
+        one cadence), never divergence."""
+        multi = not self._single
+        while True:
+            with self._cond:
+                if not self._tick_due_locked():
+                    self._cond.wait(self._tick_wait_locked())
+                due = self._tick_due_locked()
+            if not replicated_decision(due, active=multi):
+                continue
+            plan = self._tick_exchange()
+            if self._tick_apply(plan):
+                return
+
+    def _tick_due_locked(self) -> bool:
+        """Rank-local: is there a reason to ask for a tick? Caller holds
+        the lock. True on close (the drain/quit path needs frames), when
+        the heartbeat interval elapsed (keeps the piggybacked health
+        monitor ticking through idle traffic), or when locally
+        actionable work should hurry the rendezvous: a pending control
+        call, a full group, an over-age group, an expired deadline."""
+        if self._closed:
+            return True
+        now = time.monotonic()
+        if self._last_tick < 0 or (now - self._last_tick) >= self._tick_s:
+            return True
+        rows: Dict[tuple, int] = {}
+        oldest = None
+        for item in self._queue:
+            if isinstance(item, _Call):
+                return True
+            key = (item.endpoint, item.payload.shape[1:], item.payload.dtype.str)
+            rows[key] = rows.get(key, 0) + item.rows
+            if rows[key] >= self.policy.max_batch:
+                return True
+            if oldest is None:
+                oldest = item.enqueue_t
+            if item.deadline_t is not None and now >= item.deadline_t:
+                return True
+        if oldest is not None:
+            return (now - oldest) * 1e3 >= self.policy.max_latency_ms
+        return False
+
+    def _tick_wait_locked(self) -> float:
+        """Seconds until this rank next turns due (interval remainder,
+        oldest group's latency trigger, or nearest deadline — whichever
+        lands first). Always finite: every rank re-enters the due
+        rendezvous at least once per cadence, which bounds how long a
+        peer can block in it."""
+        now = time.monotonic()
+        if self._last_tick < 0:
+            return 1e-4
+        remaining = self._tick_s - (now - self._last_tick)
+        for item in self._queue:
+            if isinstance(item, _Call):
+                break
+            remaining = min(
+                remaining,
+                self.policy.max_latency_ms / 1e3 - (now - item.enqueue_t),
+            )
+            if item.deadline_t is not None:
+                remaining = min(remaining, item.deadline_t - now)
+        return max(1e-4, remaining)
+
+    def _tick_exchange(self) -> "_tick.TickPlan":
+        """One agreed tick: snapshot the local queue view under the
+        lock, bolt on the health monitor's probe export and the
+        autoscaler's grow votes, exchange ONE replicated frame, and
+        derive the pure plan every rank will apply identically."""
+        with self._cond:
+            self._last_tick = time.monotonic()
+            now = self._last_tick
+            call_at = len(self._queue)
+            for i, item in enumerate(self._queue):
+                if isinstance(item, _Call):
+                    call_at = i
+                    break
+            buckets: Dict[tuple, list] = {}
+            expired = []
+            for item in self._queue[:call_at]:
+                key = (
+                    item.endpoint, item.payload.shape[1:], item.payload.dtype.str
+                )
+                record = buckets.get(key)
+                if record is None:
+                    buckets[key] = record = [0, 0, int(item.seq)]
+                record[0] += 1
+                record[1] += item.rows
+                if item.deadline_t is not None and now >= item.deadline_t:
+                    expired.append(int(item.seq))
+            view = dict(
+                seq=self._next_seq,
+                closed=self._closed,
+                qlen=len(self._queue),
+                npending=call_at,
+                have_call=call_at < len(self._queue),
+                depth=sum(
+                    1 for x in self._queue if not isinstance(x, _Call)
+                ),
+            )
+            first_age_us: Dict[tuple, int] = {}
+            for item in self._queue[:call_at]:
+                key = (
+                    item.endpoint, item.payload.shape[1:], item.payload.dtype.str
+                )
+                if key not in first_age_us:
+                    first_age_us[key] = int((now - item.enqueue_t) * 1e6)
+            frame_buckets = [
+                (_tick.bucket_token(key), count, rows, first_age_us[key], first_seq)
+                for key, (count, rows, first_seq) in buckets.items()
+            ]
+        mon = getattr(self.autoscaler, "monitor", None)
+        mon_due = None
+        mon_failed: list = []
+        mon_ewmas_us: list = []
+        votes = None
+        if mon is not None:
+            mon_due = False
+            # advisory path (same contract as _autoscale): a failed
+            # probe must never take down the dispatcher — this rank
+            # just reports not-due and the piggybacked monitor tick
+            # waits for a cleaner heartbeat
+            try:
+                if self._mon_stash is None and mon.local_due():
+                    fail_ids, export, probes = mon.probe_local()
+                    self._mon_stash = (
+                        list(fail_ids), dict(export), int(probes),
+                        self.autoscaler.pre_vote(view["depth"]),
+                    )
+            # graftlint: G006 - advisory: probe/vote failures are
+            # absorbed; the reactive fault ladder owns hard faults
+            except Exception:  # noqa: BLE001
+                _hooks.observe("serve.error", endpoint="<autoscale>")
+            if self._mon_stash is not None:
+                fail_ids, export, _, votes = self._mon_stash
+                mon_due = True
+                mon_failed = [int(d) for d in fail_ids]
+                # µs·1000-free: quantization matches the monitor's own
+                # health frame, int(round(ms * 1000.0)) microseconds
+                mon_ewmas_us = [
+                    (int(d), int(round(ms * 1000.0)))
+                    for d, ms in export.items()
+                ]
+        frame = _tick.encode_frame(
+            seq=view["seq"],
+            closed=view["closed"],
+            qlen=view["qlen"],
+            npending=view["npending"],
+            have_call=view["have_call"],
+            buckets=frame_buckets,
+            shed=expired,
+            mon_due=mon_due,
+            mon_failed=mon_failed,
+            mon_ewmas_us=mon_ewmas_us,
+            votes=votes,
+        )
+        gathered = replicated_frame(
+            frame, label="collective.serve_tick", active=not self._single
+        )
+        return _tick.plan_dispatch(
+            gathered,
+            max_batch_rows=self.policy.max_batch,
+            max_latency_us=int(self.policy.max_latency_ms * 1000),
+        )
+
+    def _tick_apply(self, plan: "_tick.TickPlan") -> bool:
+        """Apply one replicated plan: pull the plan-selected requests
+        and call out of the queue under the lock, then shed / dispatch /
+        run them outside it, in the plan's (hence every rank's) order.
+        Returns True when the plan says quit (all ranks closed and
+        drained)."""
+        with self._cond:
+            call_at = len(self._queue)
+            for i, item in enumerate(self._queue):
+                if isinstance(item, _Call):
+                    call_at = i
+                    break
+            by_token: Dict[int, list] = {}
+            for item in self._queue[:call_at]:
+                key = (
+                    item.endpoint, item.payload.shape[1:], item.payload.dtype.str
+                )
+                by_token.setdefault(
+                    _tick.bucket_token(key), (key, [])
+                )[1].append(item)
+            taken = set()
+            shed_items: List[Request] = []
+            batches: List[PendingBatch] = []
+            for token, n in plan.dispatch:
+                entry = by_token.get(token)
+                if entry is None:
+                    continue
+                key, members = entry
+                prefix = members[:n]
+                taken.update(id(r) for r in prefix)
+                live = [r for r in prefix if r.seq not in plan.shed]
+                batches.extend(
+                    form_plan_batches(key, live, self.policy.max_batch)
+                )
+            for item in self._queue[:call_at]:
+                if item.seq in plan.shed:
+                    shed_items.append(item)
+                    taken.add(id(item))
+            if taken:
+                self._queue = [
+                    x for x in self._queue if id(x) not in taken
+                ]
+            call = None
+            if plan.run_call and self._queue and isinstance(
+                self._queue[0], _Call
+            ):
+                call = self._queue.pop(0)
+        # count the tick BEFORE its effects land: a client that has seen
+        # a result (or a stats reader racing the dispatcher) then always
+        # sees the tick that produced it already counted — the ordering
+        # tests and the bench rely on when comparing tick_batches to
+        # batches at quiescence points
+        _hooks.observe(
+            "serve.tick",
+            batches=len(batches),
+            shed=len(shed_items),
+            call=int(call is not None),
+            monitor=int(plan.monitor_tick),
+        )
+        if shed_items:
+            self._shed(shed_items)
+        for group in batches:
+            self._dispatch_batch(group)
+        if call is not None:
+            self._run_call(call)
+        if plan.monitor_tick and self._mon_stash is not None:
+            fail_ids, _, probes, _ = self._mon_stash
+            self._mon_stash = None
+            mon = self.autoscaler.monitor
+            # advisory, like _autoscale: a failed scale is absorbed
+            try:
+                report = mon.apply_gathered(
+                    plan.mon_failed,
+                    {int(d): us / 1000.0 for d, us in plan.mon_ewmas_us},
+                    probes=probes,
+                    failures=len(fail_ids),
+                )
+                want_grow = plan.grow_pressure or (
+                    bool(report.healed) and plan.grow_ready
+                )
+                action = self.autoscaler.resolve(bool(want_grow), report)
+                if action is not None:
+                    self._scale(action)
+            # graftlint: G006 - advisory path: a failed scale must never
+            # take down the dispatcher; the ladder owns hard faults
+            except Exception:  # noqa: BLE001
+                _hooks.observe("serve.error", endpoint="<autoscale>")
+        with self._cond:
+            depth = sum(1 for x in self._queue if not isinstance(x, _Call))
+        _hooks.observe("serve.depth", depth=depth)
+        return plan.quit
 
     def _pick_work(self):
         """Choose the next unit of work, FIFO by oldest member. Caller

@@ -168,6 +168,14 @@ def main(argv=None) -> int:
     parser.add_argument("pytest_args", nargs="*")
     args = parser.parse_args(argv)
 
+    # numpy resolves ``np.testing`` lazily, and its import probes CPU
+    # features through a subprocess; forking after jax.distributed has
+    # spawned its gRPC threads can wedge the child, and the per-test wall
+    # deadline then recycles the whole group as a crash. Import it NOW,
+    # while this process is still single-threaded, so every in-test
+    # ``np.testing`` access is a cached module lookup — never a fork.
+    import numpy.testing  # noqa: F401
+
     import jax
 
     jax.config.update("jax_platforms", "cpu")

@@ -23,6 +23,28 @@ from ...core.dndarray import DNDarray
 __all__ = ["DataLoader", "Dataset", "dataset_shuffle", "dataset_ishuffle"]
 
 
+# At ws>1 a per-batch op on a global sharded array is a trap: each rank
+# dispatches its own tiny cross-process program per batch, the ranks
+# drift apart over an epoch (one rank can be eight launches ahead), and
+# the collective rendezvous deadlocks maybe one run in three. Batching
+# must therefore cost ONE well-aligned collective per epoch — the same
+# shard-assembling allgather ``DNDarray.numpy()`` uses everywhere else —
+# and slice the replicated host snapshot locally after that.
+_TAKE_FNS: dict = {}
+
+
+def _sharded_take(arr, perm):
+    """Permute rows of a sharded array, keeping its sharding — one jitted
+    program shared by every rank instead of an eager per-rank gather."""
+    fn = _TAKE_FNS.get(arr.sharding)
+    if fn is None:
+        fn = _TAKE_FNS[arr.sharding] = jax.jit(
+            lambda a, p: jnp.take(a, p, axis=0),
+            out_shardings=arr.sharding,
+        )
+    return fn(arr, perm)
+
+
 class Dataset:
     """Dataset over one or more (sharded) DNDarrays (reference
     ``datatools.py:143``).
@@ -50,14 +72,29 @@ class Dataset:
         self.transforms = transforms if isinstance(transforms, (list, tuple)) else [transforms] * len(arrays)
         self.shuffle_flag = shuffle
         self.test_set = test_set
+        # per-array (larray, host snapshot) pairs for multi-process reads;
+        # a shuffle swaps larray, which invalidates the matching snapshot
+        self._snapshots: list = [None] * len(arrays)
 
     def __len__(self) -> int:
         return self.arrays[0].shape[0]
 
     def __getitem__(self, index):
         out = []
-        for a, t in zip(self.arrays, self.transforms):
-            item = a.larray[index]
+        for i, (a, t) in enumerate(zip(self.arrays, self.transforms)):
+            if a.larray.is_fully_addressable:
+                item = a.larray[index]
+            else:
+                # multi-process: slice a replicated host snapshot (one
+                # collective allgather per epoch, refreshed when a
+                # shuffle swaps the backing buffer) — every rank must
+                # reach this read in lockstep, which the SPMD batch loop
+                # guarantees
+                cached = self._snapshots[i]
+                if cached is None or cached[0] is not a.larray:
+                    cached = (a.larray, a.numpy())
+                    self._snapshots[i] = cached
+                item = jnp.asarray(cached[1][index])
             if t is not None:
                 item = t(item)
             out.append(item)
@@ -123,8 +160,10 @@ def dataset_shuffle(dataset: Dataset, attrs: Optional[List] = None) -> None:
     key = ht_random._next_key(n)
     perm = jax.random.permutation(key, n)
     for i, a in enumerate(dataset.arrays):
-        shuffled = jnp.take(a.larray, perm, axis=0)
-        a.larray = shuffled
+        if a.larray.is_fully_addressable:
+            a.larray = jnp.take(a.larray, perm, axis=0)
+        else:
+            a.larray = _sharded_take(a.larray, perm)
 
 
 def dataset_ishuffle(dataset: Dataset, attrs: Optional[List] = None) -> None:

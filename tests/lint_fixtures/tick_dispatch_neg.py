@@ -1,0 +1,42 @@
+# graftlint-fixture: G003=0
+# graftflow-fixture: F001=0 F003=0
+"""Near-miss negatives: the replicated-tick version of the dispatch
+triggers in ``tick_dispatch_pos.py`` — same timer/count semantics, but
+every decision is derived from GATHERED metadata, so it is identical on
+every rank and the collectives below fire everywhere or nowhere.
+
+Never executed — parsed by tests/test_graftflow.py. This is the shape
+``heat_tpu/serve/tick.py`` + ``ServeService._tick_loop`` implement: one
+``replicated_decision`` per loop iteration, one ``replicated_frame``
+per agreed tick, and a pure plan over the gathered frames.
+"""
+import numpy as np
+
+
+def timer_trigger_replicated_frame(batch, frame, max_latency_us):
+    # the frame carries each rank's µs-quantized oldest-request age;
+    # the MAX over the gathered rows is the same number everywhere, so
+    # the timer trigger re-arms without divergence
+    gathered = replicated_frame(frame)
+    if int(np.max(gathered[:, 0])) >= max_latency_us:
+        return process_allgather(batch)
+    return None
+
+
+def count_trigger_replicated_frame(batch, frame, max_batch):
+    # min-over-ranks pending rows: every rank compares the same value
+    # against the same bound — all dispatch together or wait together
+    gathered = replicated_frame(frame)
+    if int(np.min(gathered[:, 1])) >= max_batch:
+        return psum(batch)
+    return None
+
+
+def tick_loop_agreed_cadence(service, frame):
+    # the dispatcher loop shape: the loop condition is itself a
+    # replicating collective of the rank-local due bits, so every rank
+    # runs the SAME trip count through the collective-bearing body
+    while replicated_decision(service.local_due()):
+        gathered = replicated_frame(frame)
+        psum(gathered)
+    return None

@@ -1,0 +1,45 @@
+# graftlint-fixture: G003=0
+# graftflow-fixture: F001=2 F003=1
+"""True positives for the serve-dispatch hazard ISSUE 18 dodges: batch
+triggers evaluated against RANK-LOCAL state (a wall clock, this rank's
+queue view) gating collective-bearing dispatches.
+
+Never executed — parsed by tests/test_graftflow.py. This is exactly the
+shape that forced PR 13 to disarm the async triggers at ws>1: each
+rank's timer fires at its own moment and each rank sees its own queue
+prefix, so the collective-bearing batch programs launch on some ranks
+and not others (F001) or different numbers of times (F003) — the
+deadlock class ``heat_tpu/serve/tick.py`` exists to prevent. Every site
+is invisible to the syntactic G003 (no rank spelled in the test).
+"""
+import time
+
+
+def timer_trigger_local_clock(batch, born, max_latency_s):
+    # the ws1 latency trigger, naively kept at ws>1: wall clocks drift,
+    # so one rank's timer fires while another's has not — only some
+    # ranks reach the batch dispatch collective
+    waited = time.monotonic() - born
+    if waited >= max_latency_s:
+        return process_allgather(batch)
+    return None
+
+
+def count_trigger_local_queue(queue, batch, max_batch):
+    # the max-batch count trigger against THIS rank's queue view: each
+    # rank's dispatcher races its own clients, so the observed prefix
+    # length differs per rank and so does the dispatch decision
+    depth = sum(r.rows for r in queue.addressable_shards)
+    if depth >= max_batch:
+        return psum(batch)
+    return None
+
+
+def drain_until_local_deadline(batches, deadline_s):
+    # a drain loop bounded by the local clock: ranks run DIFFERENT trip
+    # counts through a collective-bearing body — divergent loop
+    t0 = time.monotonic()
+    out = []
+    while time.monotonic() - t0 < deadline_s and batches:
+        out.append(psum(batches.pop(0)))
+    return out

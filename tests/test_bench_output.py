@@ -236,6 +236,56 @@ class TestBenchCheck:
                 serve_out(health_probe_ms=-1.0), "d.json"
             )))
 
+    def test_rejects_ws2_tick_gate_violations(self):
+        # r18: the replicated dispatch tick must beat the barrier-per-
+        # request discipline >= 2x at world size 2, with zero lockstep
+        # divergences, zero warm compiles, and at least one agreed tick;
+        # absence (pre-r18 records / failed subprocess) is tolerated
+        def ws2_out(**over):
+            out = _synthetic_out()
+            out.update(
+                serve_ws2_speedup=3.1,
+                serve_ws2_requests_per_sec=190.0,
+                serve_ws2_p99_ms=340.0,
+                serve_ws2_warm_compiles=0,
+                serve_ws2_lockstep_divergences=0,
+                serve_ws2_ticks=2,
+            )
+            out.update(over)
+            return out
+
+        line = json.dumps(bench._compact_summary(ws2_out(), "d.json"))
+        obj = bench_check.check(line)
+        assert obj["serve_ws2_speedup"] == 3.1
+        assert obj["serve_ws2_ticks"] == 2
+        assert len(line) < bench_check.LINE_BUDGET
+        with pytest.raises(ValueError, match="bought nothing"):
+            bench_check.check(json.dumps(
+                bench._compact_summary(ws2_out(serve_ws2_speedup=1.6), "d.json")
+            ))
+        with pytest.raises(ValueError, match="out of lockstep across ranks"):
+            bench_check.check(json.dumps(bench._compact_summary(
+                ws2_out(serve_ws2_lockstep_divergences=1), "d.json"
+            )))
+        with pytest.raises(ValueError, match="traced or compiled at world"):
+            bench_check.check(json.dumps(bench._compact_summary(
+                ws2_out(serve_ws2_warm_compiles=3), "d.json"
+            )))
+        with pytest.raises(ValueError, match="never agreed on a dispatch tick"):
+            bench_check.check(json.dumps(bench._compact_summary(
+                ws2_out(serve_ws2_ticks=0), "d.json"
+            )))
+
+    def test_serve_ws2_error_degrades_gracefully(self):
+        # a failed 2-process run folds an error note instead of the
+        # gated numbers; the summary stays valid and under budget
+        out = _synthetic_out()
+        out["serve_ws2_error"] = "x" * 400
+        line = json.dumps(bench._compact_summary(out, "d.json"))
+        obj = bench_check.check(line)
+        assert "serve_ws2_error" in obj
+        assert len(line) < bench_check.LINE_BUDGET
+
     def test_rejects_stream_no_overlap(self):
         # prefetch-on barely different from synchronous means the double
         # buffer bought nothing — the pipeline feature is regressing

@@ -17,7 +17,10 @@ class TestChaosSoak(unittest.TestCase):
     def test_quick_serve_soak_passes(self):
         """The r16 serving soak: seeded faults on every dispatch rung
         (retry, bisect, restore, shrink, shed, reject) with the zero
-        lost / zero duplicated / oracle-equal survival proof."""
+        lost / zero duplicated / oracle-equal survival proof — plus the
+        r18 tick-armed leg (replicated dispatch tick forced on via
+        tick_ms > 0, device_flap + straggler_probe faults fired during
+        agreed ticks, every batch/shed tick-decided)."""
         import chaos_soak
 
         self.assertEqual(chaos_soak.main(["--serve", "--quick"]), 0)

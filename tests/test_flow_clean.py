@@ -151,6 +151,37 @@ def test_autoscaler_is_flow_clean():
     )
 
 
+def test_serve_tick_is_flow_clean():
+    """Explicit gate over the replicated dispatch tick plan module: it
+    must stay a PURE function of the gathered frames — any rank-local
+    source (a clock, a local queue view, rank identity) flowing into
+    the plan re-creates the exact divergent-dispatch hazard the tick
+    exists to dodge (see tests/lint_fixtures/tick_dispatch_pos.py for
+    the flagged shape)."""
+    findings, files_checked = gf.analyze_paths(
+        [os.path.join(REPO, "heat_tpu", "serve", "tick.py")]
+    )
+    assert files_checked == 1
+    assert not findings, "\n".join(
+        f"  {f.path}:{f.line}:{f.col}: {f.rule} {f.message}" for f in findings
+    )
+
+
+def test_serve_service_is_flow_clean():
+    """Explicit gate over the dispatcher: the tick loop's collective
+    pairing (one replicated_decision per iteration, one
+    replicated_frame per agreed tick) is exactly the discipline F001/
+    F003 police — a rank-local value gating either collective is the
+    disarmed-triggers deadlock come back."""
+    findings, files_checked = gf.analyze_paths(
+        [os.path.join(REPO, "heat_tpu", "serve", "service.py")]
+    )
+    assert files_checked == 1
+    assert not findings, "\n".join(
+        f"  {f.path}:{f.line}:{f.col}: {f.rule} {f.message}" for f in findings
+    )
+
+
 def test_collective_vocabulary_matches_graftlint():
     """graftflow keeps its own copy of the collective-name set (both
     halves must stay importable without the other); the copies must not

@@ -217,6 +217,85 @@ class TestWarmPathBudget:
         np.testing.assert_array_equal(z.numpy(), (xn - 1.0) * 0.5)
 
 
+# ---------------------------------------------- cross-chain prefix reuse
+class TestCrossChainCSE:
+    def test_shared_prefix_compiles_once(self):
+        """N chains sharing a serialized prefix compile it ONCE: the
+        second chain cuts at the shared prefix and caches it as its own
+        program, and every later chain reuses that executable
+        (``cse_hits``) while compiling only its own head — the serving
+        pattern where each endpoint standardizes identically before its
+        model-specific tail."""
+        xn = _data((24, 6), np.float64, seed=21)
+        x = ht.array(xn, split=0)
+
+        # distinctive constants so no other test's registered chain can
+        # shadow the prefix registry state this test asserts against
+        def prefix(a):
+            return ht.exp(-ht.abs(a)) * 2.125 + 1.375
+
+        heads = [
+            lambda t: t - 3.0,
+            lambda t: t * 0.25,
+            lambda t: t + 7.0,
+            lambda t: 0.5 * t,
+        ]
+        wants = [h(prefix(x)).numpy() for h in heads]  # eager oracle
+
+        def endpoint(head):
+            with ht.lazy():
+                return head(prefix(x))
+
+        reset_fuse_stats()
+        compiles = []
+        for h, want in zip(heads, wants):
+            r = Region("cse endpoint")
+            got = endpoint(h)
+            compiles.append(r.compiles)
+            # the cut preserves eager shardings at the boundary; only
+            # FMA-contraction ULPs separate differently-fused programs
+            # (same band as the f64 oracle sweep)
+            np.testing.assert_allclose(got.numpy(), want, rtol=1e-12, atol=1e-14)
+
+        # chain 1 discovers the shared prefix (compiles prefix + head);
+        # chains 2 and 3 reuse the prefix executable and compile ONLY
+        # their heads
+        assert compiles == [1, 2, 1, 1], compiles
+        assert FUSE_STATS["cse_hits"] == 2, FUSE_STATS
+        assert FUSE_STATS["graphs_captured"] == 4, FUSE_STATS
+        assert FUSE_STATS["fused_dispatches"] == 4, FUSE_STATS
+        assert FUSE_STATS["cache_hits"] == 0, FUSE_STATS
+        assert FUSE_STATS["eager_fallbacks"] == 0, FUSE_STATS
+
+    def test_composite_warm_replay_budget(self):
+        """A warm replay of a CSE-composite chain keeps the warm-path
+        contract: one cached lookup, one fused dispatch, zero compiles,
+        zero traces — the composite counts as ONE program."""
+        xn = _data((24, 6), np.float64, seed=21)
+        x = ht.array(xn, split=0)
+
+        def endpoint(head_scale):
+            with ht.lazy():
+                t = ht.exp(-ht.abs(x)) * 2.125 + 1.375
+                return t * head_scale
+
+        want = (ht.exp(-ht.abs(x)) * 2.125 + 1.375) * 11.5
+        endpoint(9.75)   # registers the chain shape
+        endpoint(11.5)   # composite: shared prefix + head
+        reset_fuse_stats()
+        r = Region("warm composite")
+        got = endpoint(11.5)
+        assert FUSE_STATS["fused_dispatches"] == 1, FUSE_STATS
+        assert FUSE_STATS["cache_hits"] == 1, FUSE_STATS
+        assert FUSE_STATS["graphs_captured"] == 0, FUSE_STATS
+        assert FUSE_STATS["cse_hits"] == 0, FUSE_STATS
+        r.assert_compiles(0)
+        assert r.traces == 0, r.stats()
+        np.testing.assert_allclose(
+            got.numpy(), want.numpy(), rtol=1e-12, atol=1e-14
+        )
+
+
 # ------------------------------------------------------------- escape hatches
 class TestEscapeHatches:
     def test_materialization_mid_scope_forces(self):

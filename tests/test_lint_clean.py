@@ -139,6 +139,32 @@ def test_health_monitor_is_lint_clean():
     )
 
 
+def test_serve_tick_is_lint_clean():
+    """Explicit gate over the replicated dispatch tick plan module:
+    the frame codec and the plan function are the replicated substrate
+    every ws>1 dispatch decision now stands on."""
+    findings, files_checked = gl.lint_paths(
+        [os.path.join(REPO, "heat_tpu", "serve", "tick.py")]
+    )
+    assert files_checked == 1
+    assert not findings, "\n".join(
+        f"  {f.path}:{f.line}:{f.col}: {f.rule} {f.message}" for f in findings
+    )
+
+
+def test_serve_service_is_lint_clean():
+    """Explicit gate over the dispatcher hosting the tick loop: its
+    G006 waivers (advisory scale/snapshot absorbs) are deliberate and
+    anything beyond them must be argued here, not silently added."""
+    findings, files_checked = gl.lint_paths(
+        [os.path.join(REPO, "heat_tpu", "serve", "service.py")]
+    )
+    assert files_checked == 1
+    assert not findings, "\n".join(
+        f"  {f.path}:{f.line}:{f.col}: {f.rule} {f.message}" for f in findings
+    )
+
+
 def test_autoscaler_is_lint_clean():
     """Explicit gate over the autoscale policy: its grow verdict is the
     single replicated decision standing between rank-divergent queue

@@ -222,10 +222,23 @@ class TestDASO(TestCase):
         daso.batches_to_wait = 0
         for _ in range(1, 5):  # steps 1..4, no sync (step 0 syncs)
             params, _ = daso.step(loss_and_grad, params, jnp.asarray(X), jnp.asarray(y))
-        reps = np.asarray(params["w"])
+
+        def read(arr):
+            # the stacked replicas span every process's devices at ws>1:
+            # replicate through one jitted identity (an SPMD all-gather
+            # every rank dispatches symmetrically) before the host read
+            rep = jax.jit(
+                lambda v: v,
+                out_shardings=jax.sharding.NamedSharding(
+                    mesh, jax.sharding.PartitionSpec()
+                ),
+            )(arr)
+            return np.asarray(rep)
+
+        reps = read(params["w"])
         assert not np.allclose(reps[0], reps[1])  # groups genuinely diverged
         synced = daso._avg_fn(params)
-        s = np.asarray(synced["w"])
+        s = read(synced["w"])
         np.testing.assert_allclose(s[0], s[1], rtol=1e-5)
 
     def test_detect_metric_plateau(self):
@@ -562,8 +575,10 @@ class TestDataTools(TestCase):
         y = X[:, 0].copy()
         ds = ht.utils.data.Dataset([ht.array(X, split=0), ht.array(y, split=0)])
         ht.utils.data.dataset_shuffle(ds)
-        Xs = np.asarray(ds.arrays[0].larray)
-        ys = np.asarray(ds.arrays[1].larray)
+        # .numpy() is the collective shard-assembling host read; the raw
+        # .larray buffer spans non-addressable devices at ws>1
+        Xs = ds.arrays[0].numpy()
+        ys = ds.arrays[1].numpy()
         np.testing.assert_array_equal(Xs[:, 0], ys)  # rows stayed paired
         assert not np.array_equal(Xs, X)  # actually shuffled
 
@@ -657,9 +672,15 @@ class TestTiling(TestCase):
             for r in range(comm.size):
                 take[split] = r
                 assert locs[tuple(take)] == r
-            # trimmed physical shard matches the tile extent
-            for r, shard in enumerate(a.local_shards):
-                _, lshape, _ = comm.chunk(shape, split, rank=r)
+            # trimmed physical shard matches the tile extent.
+            # local_shards holds only THIS process's shards (split-start
+            # order); each process owns a contiguous block of chunk ranks
+            import jax
+
+            per = comm.size // jax.process_count()
+            base = jax.process_index() * per
+            for i, shard in enumerate(a.local_shards):
+                _, lshape, _ = comm.chunk(shape, split, rank=base + i)
                 assert tuple(shard.shape) == tuple(lshape)
 
     def test_unfold(self):

@@ -211,6 +211,40 @@ def validate(line: str, obj: dict) -> None:
                 raise ValueError(
                     f"'health_probe_ms' must be a non-negative number, got {pms!r}"
                 )
+    # ws2 replicated-tick serving gates (r18). Absent when the
+    # 2-process subprocess failed (the driver folds a serve_ws2_error
+    # note instead) — absence is not a violation, a present-but-failing
+    # value is.
+    if "serve_ws2_speedup" in obj:
+        speedup = obj["serve_ws2_speedup"]
+        if not isinstance(speedup, (int, float)) or isinstance(speedup, bool):
+            raise ValueError(
+                f"'serve_ws2_speedup' must be numeric, got {speedup!r}"
+            )
+        if speedup < 2.0:
+            raise ValueError(
+                f"serve_ws2_speedup {speedup} < 2.0: tick-batched dispatch "
+                "is not beating the barrier-per-request discipline at world "
+                "size 2 — re-arming the timer/count triggers bought nothing"
+            )
+        if obj.get("serve_ws2_lockstep_divergences") != 0:
+            raise ValueError(
+                "serve_ws2_lockstep_divergences must be 0, got "
+                f"{obj.get('serve_ws2_lockstep_divergences')!r}: tick-decided "
+                "batches issued collectives out of lockstep across ranks"
+            )
+        if obj.get("serve_ws2_warm_compiles") != 0:
+            raise ValueError(
+                "serve_ws2_warm_compiles must be 0, got "
+                f"{obj.get('serve_ws2_warm_compiles')!r}: a warm tick-decided "
+                "batch traced or compiled at world size 2"
+            )
+        ticks = obj.get("serve_ws2_ticks")
+        if not isinstance(ticks, int) or isinstance(ticks, bool) or ticks <= 0:
+            raise ValueError(
+                f"'serve_ws2_ticks' must be a positive integer, got {ticks!r}: "
+                "the measured tick leg never agreed on a dispatch tick"
+            )
     # frame/shuffle gates (r14). Absent when the frame subprocess failed
     # (the driver folds a frame_error note instead) — absence is not a
     # violation, a present-but-failing value is.

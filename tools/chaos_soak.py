@@ -43,6 +43,19 @@ EXACTLY once — results oracle-equal to the pre-fault fitted model,
 failures carrying the typed error — no response lost, none duplicated,
 and the SERVE_STATS recovery counters match the schedule.
 
+``--serve`` additionally runs the TICK-ARMED soak (ISSUE 18): the same
+survival contract with the replicated dispatch tick forced on
+(``tick_ms > 0`` — the ws1 unit-test path where the replicated
+primitives pass through), the health monitor's probes riding the
+heartbeat frame, and ``device_flap`` + ``straggler_probe`` faults
+scheduled to fire DURING agreed ticks. The free-running tick cadence
+keeps probing through idle traffic, so this leg asserts monotone
+counter conditions (degraded/healed/damped streaks) rather than
+polling transient mesh sizes, plus the tick bookkeeping itself: every
+batch was tick-decided, the one expired-deadline request was
+tick-shed, and not one request was lost or duplicated through the
+tick-decided shrink -> heal -> re-grow cycles.
+
 ``--autoscale`` switches to the AUTOSCALE soak (PR 17): a resident
 service with a :class:`~heat_tpu.resilience.HealthMonitor` +
 :class:`~heat_tpu.serve.Autoscaler` is driven through two full
@@ -477,6 +490,249 @@ def run_serve_trial(seed: int, quick: bool) -> dict:
         rz.clear_unhealthy()
 
 
+def run_serve_tick_trial(seed: int, quick: bool) -> dict:
+    """Tick-armed serving soak (ISSUE 18): the replicated dispatch tick
+    forced on at ws==1 (``tick_ms > 0``; the replicated primitives pass
+    through, so one process drives the exact multi-controller code
+    path), a HealthMonitor + Autoscaler piggybacked on the heartbeat
+    frame, and ``device_flap`` + ``straggler_probe`` faults firing
+    DURING agreed ticks while request traffic flows. Proofs: zero lost,
+    zero duplicated, oracle-equal answers through the tick-decided
+    shrink -> heal -> re-grow cycles; every dispatched batch was
+    tick-decided (``tick_batches == batches``); the one expired-deadline
+    request was shed BY a tick plan and answered exactly once with the
+    typed error; the final mesh is back at full size.
+
+    Unlike :func:`run_autoscale_trial` (whose monitor only ticks at
+    traffic-driven dispatch consultations), the tick dispatcher
+    free-runs on its cadence — probe passes continue between pump
+    rounds, so intermediate mesh sizes are transient and the cycle
+    assertions poll MONOTONE health/serve counters instead."""
+    from heat_tpu import serve as serve_mod
+    from heat_tpu.resilience.errors import ServeDeadlineError
+    from heat_tpu.resilience.monitor import HEALTH_STATS
+    from heat_tpu.serve import SERVE_STATS
+
+    orig_comm = comm_mod.sanitize_comm(None)
+    ndev = orig_comm.size
+    t0 = time.monotonic()
+    rng = np.random.default_rng(5000 + seed)
+    k, f = 3, 4
+    blob = rng.normal(size=(k, f)) * 5.0
+    pts = blob[rng.integers(0, k, size=64)] + rng.normal(size=(64, f)) * 0.3
+    km = KMeans(n_clusters=k, init="random", max_iter=10, tol=0.0,
+                random_state=seed)
+    km.fit(ht.array(pts.astype(np.float32), split=0))
+
+    # host-side snapshot BEFORE the service starts: the oracle runs on
+    # the main thread while the tick loop may be mid-scale (see the
+    # autoscale trial for why km.predict here would race relocation)
+    centers = np.asarray(km.cluster_centers_.numpy(), dtype=np.float64)
+
+    def payload(rows=2):
+        return (blob[rng.integers(0, k, size=rows)]
+                + rng.normal(size=(rows, f)) * 0.3).astype(np.float32)
+
+    def oracle(p):
+        d = ((p[:, None, :].astype(np.float64) - centers[None]) ** 2).sum(-1)
+        return np.argmin(d, axis=1)
+
+    accepted = []  # (request, expected ndarray | exception class)
+    schedules = []
+    before = dict(SERVE_STATS)
+    health_before = dict(HEALTH_STATS)
+
+    def hdelta(key):
+        return HEALTH_STATS[key] - health_before[key]
+
+    try:
+        with tempfile.TemporaryDirectory() as d:
+            # interval 0: every agreed tick carries a probe pass, so the
+            # monitor heartbeats through idle traffic on the tick cadence
+            monitor = rz.HealthMonitor(
+                orig_comm, interval_s=0.0, heal_after=3, degrade_after=2,
+            )
+            scaler = serve_mod.Autoscaler(monitor, high_depth=8, low_depth=2)
+            svc = serve_mod.ServeService(
+                serve_mod.BucketPolicy(max_latency_ms=5.0, max_batch=64),
+                snapshot_dir=d, snapshot_every=1, autoscaler=scaler,
+                tick_ms=5.0,
+            )
+            assert svc._tick_armed, "tick_ms > 0 must force the tick dispatcher"
+            svc.registry.register("km", km)
+            svc.register_endpoint(
+                "classify", lambda x: svc.registry.get("km").predict(x)
+            )
+
+            def submit_one():
+                p = payload()
+                accepted.append((svc.submit("classify", p), oracle(p)))
+
+            def burst(n):
+                """Queue n requests WITHOUT draining: the next agreed
+                ticks dispatch them, so a fault scheduled on those
+                ticks' probe passes lands with requests in flight."""
+                for _ in range(n):
+                    submit_one()
+
+            def pump_until(cond, label, max_rounds=60):
+                """Keep one-batch traffic flowing until ``cond`` holds;
+                every answered batch is part of the survival proof.
+                ``cond`` must be MONOTONE (module docs): the tick loop
+                free-runs between rounds."""
+                for _ in range(max_rounds):
+                    submit_one()
+                    svc.drain(timeout=300)
+                    if cond():
+                        return
+                raise AssertionError(f"seed={seed}: {label} (after {max_rounds} rounds)")
+
+            def mesh_size():
+                return comm_mod.sanitize_comm(None).size
+
+            # warmup: first tick-decided batch + first snapshot
+            pump_until(lambda: True, "warmup")
+            assert mesh_size() == ndev
+            assert SERVE_STATS["ticks"] - before["ticks"] >= 1, (
+                "warmup batch answered without an agreed tick"
+            )
+
+            # tick-decided deadline shed (the ws1-only wall-clock shed
+            # was promoted onto the tick): an already-expired request
+            # must be answered exactly once with the typed error by a
+            # PLAN, never padded into a batch
+            shed_req = svc.submit("classify", payload(), deadline_ms=0.0)
+            accepted.append((shed_req, ServeDeadlineError))
+            pump_until(lambda: shed_req.done,
+                       "expired-deadline request never tick-shed")
+
+            # ---- cycle 1: a flapping device, flapped again mid-heal.
+            # Probe passes ride the ticks in base-mesh order, ndev hits
+            # per pass: device IDX's probe is hit idx+1+t*ndev of pass t
+            # inside the schedule. Flap at pass 0 (degrade -> proactive
+            # shrink), pass 1 probes clean (healing streak starts), flap
+            # AGAIN at pass 2 — inside the heal_after=3 window, so flap
+            # damping must reset the streak.
+            flap_dev = int(rng.integers(0, ndev))
+            sched = rz.FaultSchedule(
+                events=[
+                    ("monitor.probe", flap_dev + 1, "device_flap"),
+                    ("monitor.probe", flap_dev + 1 + 2 * ndev, "device_flap"),
+                ],
+                seed=seed,
+            )
+            schedules.append(sched)
+            with sched:
+                burst(4)
+                pump_until(lambda: hdelta("degraded") >= 1,
+                           "tick-borne flap never degraded the device")
+                pump_until(lambda: not sched.pending(),
+                           "mid-heal flap event never fired")
+                pump_until(lambda: hdelta("flaps_damped") >= 1,
+                           "flap damping never engaged")
+            pump_until(lambda: hdelta("healed") >= 1 and mesh_size() == ndev,
+                       "flapped device never healed back onto the mesh")
+
+            # ---- cycle 2: a straggling device. Two consecutive slow
+            # probes on adjacent tick passes lift its EWMA over the
+            # straggler cut; the verdict repeats degrade_after=2 times
+            # -> degrade -> shrink; clean tick probes then decay the
+            # EWMA -> heal -> re-grow. Nothing raises.
+            strag_dev = int((flap_dev + ndev // 2) % ndev)
+            sched = rz.FaultSchedule(
+                events=[
+                    ("monitor.probe", strag_dev + 1, "straggler_probe"),
+                    ("monitor.probe", strag_dev + 1 + ndev, "straggler_probe"),
+                ],
+                straggler_delay=0.2,
+                seed=seed,
+            )
+            schedules.append(sched)
+            with sched:
+                burst(4)
+                pump_until(lambda: not sched.pending(),
+                           "straggler probes never fired")
+            pump_until(lambda: hdelta("stragglers") >= 2,
+                       "straggler EWMA verdicts missing")
+            pump_until(lambda: hdelta("healed") >= 2 and mesh_size() == ndev,
+                       "recovered straggler never re-grew the mesh")
+
+            # steady state after the storm: traffic flows, no residue
+            pump_until(lambda: True, "cooldown traffic")
+            svc.drain(timeout=300)
+            svc.close(timeout=60)
+
+        # ---- the proof: nothing lost, nothing duplicated, oracle-equal
+        for request, want in accepted:
+            assert request.done, "LOST request: accepted but never answered"
+            assert request.answers == 1, (
+                f"request answered {request.answers} times (contract: exactly 1)"
+            )
+            if isinstance(want, np.ndarray):
+                np.testing.assert_array_equal(
+                    np.asarray(request.result(0)).ravel(), want.ravel(),
+                    err_msg=f"seed={seed}: answered rows drifted from oracle",
+                )
+            else:
+                try:
+                    request.result(0)
+                    raise AssertionError(f"expected {want.__name__}")
+                except want:
+                    pass
+        for sched in schedules:
+            assert sched.pending() == [], f"schedule incomplete:\n{sched.report()}"
+        assert mesh_size() == ndev, (
+            f"final mesh has {mesh_size()} devices, expected the full {ndev}"
+        )
+        delta = {
+            c: SERVE_STATS[c] - before[c]
+            for c in ("ticks", "tick_batches", "tick_sheds", "batches",
+                      "shed", "shrinks", "grows", "scale_events",
+                      "restores", "bucket_misses", "errors")
+        }
+        # tick bookkeeping: the async triggers are disarmed, so EVERY
+        # batch and the one shed must have been decided by a plan
+        assert delta["ticks"] >= 1, f"no agreed ticks counted: {delta}"
+        assert delta["batches"] >= 1 and delta["tick_batches"] == delta["batches"], (
+            f"a batch dispatched outside a tick plan: {delta}"
+        )
+        assert delta["shed"] == 1 and delta["tick_sheds"] == 1, (
+            f"expected exactly one tick-decided shed: {delta}"
+        )
+        assert delta["errors"] == 0, f"endpoint errors during the soak: {delta}"
+        assert delta["shrinks"] == 2, f"expected exactly two shrinks: {delta}"
+        assert delta["grows"] == 2, f"expected exactly two grows: {delta}"
+        assert delta["scale_events"] == 4, delta
+        assert delta["restores"] >= 4, (
+            f"registry was not relocated on every scale: {delta}"
+        )
+        assert delta["bucket_misses"] >= 5, (
+            f"bucket caches were not invalidated across scales: {delta}"
+        )
+        health = {k: hdelta(k) for k in
+                  ("ticks", "probes", "probe_failures", "stragglers",
+                   "degraded", "healed", "flaps_damped")}
+        assert health["degraded"] == 2 and health["healed"] == 2, health
+        assert health["probe_failures"] == 2, health  # the two flap events
+        assert health["flaps_damped"] >= 1, health
+        return {
+            "workload": "serve_tick",
+            "seed": seed,
+            "ok": True,
+            "faults": {f"{i.kind}@{i.site}": i.detail or True
+                       for s in schedules for i in s.injected},
+            "recoveries": delta,
+            "health": health,
+            "requests": len(accepted),
+            "answered_once": True,
+            "mesh": f"{ndev}->{ndev - 1}->{ndev} (x2, tick-decided)",
+            "wall_s": round(time.monotonic() - t0, 2),
+        }
+    finally:
+        comm_mod.use_comm(orig_comm)
+        rz.clear_unhealthy()
+
+
 def run_autoscale_trial(seed: int, quick: bool) -> dict:
     """One autoscale-soak trial: a live service with a HealthMonitor +
     Autoscaler driven through a full degrade -> shrink -> heal -> re-grow
@@ -722,7 +978,8 @@ def main(argv=None) -> int:
                         help="seeds per workload (default 3; quick forces 1)")
     parser.add_argument("--serve", action="store_true",
                         help="serving soak: the ServeService request-survival "
-                             "contract instead of the supervisor workloads")
+                             "contract instead of the supervisor workloads "
+                             "(barrier-driven AND tick-armed legs)")
     parser.add_argument("--autoscale", action="store_true",
                         help="autoscale soak: HealthMonitor + Autoscaler drive "
                              "a live service through degrade -> shrink -> heal "
@@ -734,7 +991,7 @@ def main(argv=None) -> int:
     if args.autoscale:
         workloads = (("autoscale", None),)
     elif args.serve:
-        workloads = (("serve", None),)
+        workloads = (("serve", None), ("serve_tick", None))
     else:
         workloads = WORKLOADS
     for name, fn in workloads:
@@ -742,6 +999,8 @@ def main(argv=None) -> int:
             try:
                 if name == "autoscale":
                     rec = run_autoscale_trial(seed, args.quick)
+                elif name == "serve_tick":
+                    rec = run_serve_tick_trial(seed, args.quick)
                 elif name == "serve":
                     rec = run_serve_trial(seed, args.quick)
                 else:
