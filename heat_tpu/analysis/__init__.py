@@ -1,15 +1,27 @@
 """Static + runtime enforcement of the SPMD/JAX invariants.
 
-Four pieces (full rule reference and failure stories: ``docs/ANALYSIS.md``):
+Five pieces (full rule reference and failure stories: ``docs/ANALYSIS.md``):
 
 - :mod:`heat_tpu.analysis.graftlint` — pure-stdlib AST checker (rules
-  G001–G006: retrace leaks, unbounded executable caches, divergent
+  G001–G007: retrace leaks, unbounded executable caches, divergent
   collectives, hot-path host syncs, unordered iteration, swallowed
-  ResilienceError).  CLI: ``python tools/graftlint.py heat_tpu/``.
+  ResilienceError, non-atomic durable writes).
+  CLI: ``python tools/graftlint.py heat_tpu/``.
 - :mod:`heat_tpu.analysis.graftflow` — flow-sensitive SPMD taint
-  analyzer (rules F001–F004: divergent collective schedules, tainted
-  cache keys, tainted loop bounds, divergent early exits) — the semantic
-  upgrade of G003/G005.  CLI: ``python tools/graftflow.py heat_tpu/``.
+  analyzer (rules F001–F009: divergent collective schedules, tainted
+  cache keys, tainted loop bounds, divergent early exits, hidden
+  ``device_put`` broadcasts, eager reads racing collectives in loops,
+  forks after distributed init, thread-discipline breaks,
+  clock/queue-steered dispatch) — the semantic upgrade of G003/G005.
+  CLI: ``python tools/graftflow.py heat_tpu/``.
+- :mod:`heat_tpu.analysis.summaries` — computed interprocedural
+  summaries (project-wide bare-name call graph; per-function collective
+  schedule, taint-out, and fork/init effects by fixpoint) feeding
+  graftflow; the hand table only seeds out-of-scope externals, and the
+  ``DRIFT`` diagnostic fires when a computed summary contradicts a hand
+  entry.  Unified gate for everything above:
+  ``python tools/graftcheck.py heat_tpu/`` (merged one-line JSON,
+  ``--format github``/``sarif``, combined bitmask exit code).
 - :mod:`heat_tpu.analysis.sanitizer` — runtime region accounting of
   compiles, host transfers, and collective dispatches
   (:data:`COMPILE_STATS`, :func:`sanitizer`).

@@ -14,16 +14,26 @@ opposite directions:
 graftflow closes both by doing real dataflow.  It taint-tracks
 *process-dependent* values — rank identity, ``.larray``/local-shard
 access, per-host I/O and filesystem probes, host clocks, un-seeded
-RNG — through assignments, calls (with a small interprocedural summary
-table for heat_tpu internals), and containers, flow-sensitively through
-``if``/``while``/``for``/``try``.  Values laundered through a
-replicating collective (``process_allgather``, ``psum``, …) become
-clean: every process holds the same result afterwards, so branching on
-it cannot diverge.
+RNG, rank-local queue state — through assignments, calls, and
+containers, flow-sensitively through ``if``/``while``/``for``/``try``.
+Values laundered through a replicating collective
+(``process_allgather``, ``psum``, …) become clean: every process holds
+the same result afterwards, so branching on it cannot diverge.
+
+Since PR 19, calls resolve through **computed interprocedural
+summaries** (``heat_tpu/analysis/summaries.py``): a project-wide call
+graph is built over the analyzed files and per-function summaries
+(flattened collective schedule, taint-out, fork effects, distributed
+init) are derived by fixpoint iteration.  The old hand table survives
+only as a *seed* for names defined outside the analyzed set (``jax.*``
+externals; cross-module helpers in single-file mode), and a ``DRIFT``
+diagnostic fires when a computed summary contradicts a hand entry — the
+table can no longer silently rot as the tree grows.
 
 On top of the taint facts it extracts per-function **collective
-schedules** (the ordered sequence of collective call sites) and flags
-only the shapes that actually hang a mesh:
+schedules** (the ordered sequence of collective call sites, seen
+*through* project helpers) and flags the shapes that actually hang a
+mesh:
 
 - **F001** ``divergent-collective`` — a process-dependent branch whose
   two arms dispatch *different* collective schedules (one-sided psum,
@@ -38,8 +48,32 @@ only the shapes that actually hang a mesh:
   process-dependent condition that skips collectives dispatched later
   in the function: the returning rank truncates its schedule.
 
+The PR 19 rule pack encodes the bug classes the ws-2 burn-down kept
+re-discovering by hand (stories: ``docs/ANALYSIS.md``):
+
+- **F005** ``hidden-broadcast`` — a host value ``device_put`` onto a
+  sharding expression.  At ws>1 a non-fully-addressable placement
+  issues a blocking cross-process equality broadcast (the PR 17
+  StreamingGroupBy flake); build with ``make_array_from_callback``.
+- **F006** ``eager-loop-gather`` — ``.numpy()``/``.item()``/
+  ``.tolist()``/``device_get`` inside a loop body that also dispatches
+  collectives (the PR 18 per-batch eager gather deadlock under rank
+  skew).  Reads pinned inside ``collective_lockstep(...)`` are exempt.
+- **F007** ``fork-after-init`` — a function-local import, or a
+  ``subprocess``/``os`` spawn (directly or through a callee's computed
+  summary), reachable after ``jax.distributed`` init in the same scope:
+  the child inherits wedged gRPC threads.
+- **F008** ``thread-discipline`` — in threaded modules (``serve/``,
+  ``stream/``, ``resilience/monitor.py``, or files carrying the
+  ``# graftflow: threaded`` pragma): a raw collective dispatched
+  outside ``collective_lockstep``, or a blocking queue ``get``/``put``/
+  ``join`` while holding a lock.
+- **F009** ``unreplicated-decision`` — wall-clock or queue-local state
+  steering a branch whose arms dispatch different collective schedules;
+  the fix is ``replicated_decision``.
+
 This module is **pure stdlib** (``ast`` only — no jax import, no
-imports from the rest of the package) so ``tools/graftflow.py`` can
+imports from the rest of the package) so ``tools/graftcheck.py`` can
 analyze without initializing a backend.  Finding IDs ride the same
 waiver grammar, bitmask exit codes, and one-line JSON report contract
 as graftlint; user-facing reference: ``docs/ANALYSIS.md``.
@@ -49,10 +83,11 @@ Waivers
 ``# graftflow: <token>`` (the ``# graftlint:`` spelling is honored too,
 so a mixed line can carry one comment) on the same line or in the
 contiguous comment block directly above, where ``<token>`` is a rule id
-(``F001``), a tag (``divergent-collective``), or ``all``.  File-level
-pragma ``# graftflow: skip-file`` disables the file.  The
-``# graftflow-fixture:`` header spelling used by the test corpus is
-deliberately not matched by the waiver grammar.
+(``F001``), a tag (``divergent-collective``), ``DRIFT``, or ``all``.
+File-level pragma ``# graftflow: skip-file`` disables the file;
+``# graftflow: threaded`` opts a file into the F008 threaded-module
+discipline.  The ``# graftflow-fixture:`` header spelling used by the
+test corpus is deliberately not matched by the waiver grammar.
 """
 from __future__ import annotations
 
@@ -66,6 +101,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 __all__ = [
     "RULES",
+    "DRIFT_RULE",
     "Finding",
     "analyze_source",
     "analyze_file",
@@ -76,7 +112,47 @@ __all__ = [
     "iter_python_files",
 ]
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
+
+
+def _load_summaries():
+    """Load the summaries module both as a package sibling and when this
+    file is exec'd standalone by path from tools/graftcheck.py."""
+    if __package__:
+        try:
+            from . import summaries  # type: ignore[no-redef]
+            return summaries
+        except ImportError:
+            pass
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "summaries.py")
+    spec = importlib.util.spec_from_file_location("_graftflow_summaries", path)
+    assert spec is not None and spec.loader is not None
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_S = _load_summaries()
+
+# Shared vocabulary lives in summaries.py (single source of truth for
+# the analyzer and the fixpoint); re-exported here because tests and
+# docs address it as graftflow's.
+Taint = _S.Taint
+COLLECTIVE_NAMES = _S.COLLECTIVE_NAMES
+TAINT_ATTRS = _S.TAINT_ATTRS
+REPLICATED_ATTRS = _S.REPLICATED_ATTRS
+TAINT_CALLS = _S.TAINT_CALLS
+CLOCK_CALLS = _S.CLOCK_CALLS
+FS_CALLS = _S.FS_CALLS
+RNG_FACTORIES = _S.RNG_FACTORIES
+RNG_DRAWS = _S.RNG_DRAWS
+RNG_MODULES = _S.RNG_MODULES
+QUEUE_CALLS = _S.QUEUE_CALLS
+LAUNDER_CALLS = _S.LAUNDER_CALLS
+COLLECTIVE_WRAPPERS = _S.COLLECTIVE_WRAPPERS
 
 
 @dataclass(frozen=True)
@@ -87,6 +163,9 @@ class Rule:
     summary: str
 
 
+# F001-F004 keep their historical bits; the PR 19 rule pack shares bit
+# 16 (exit codes are 8-bit and 128 is the syntax/internal bit — the
+# JSON report's per-rule counts carry the exact split).
 RULES: Dict[str, Rule] = {
     r.id: r
     for r in (
@@ -98,105 +177,37 @@ RULES: Dict[str, Rule] = {
              "loop with a process-dependent trip count dispatches collectives in its body"),
         Rule("F004", "divergent-exit", 8,
              "early return under a process-dependent condition skips later collectives"),
+        Rule("F005", "hidden-broadcast", 16,
+             "host value device_put onto a sharding: non-fully-addressable placement issues a hidden cross-process broadcast"),
+        Rule("F006", "eager-loop-gather", 16,
+             "per-iteration eager gather (.numpy()/.item()/device_get) inside a loop that also dispatches collectives"),
+        Rule("F007", "fork-after-init", 16,
+             "function-local import or process spawn reachable after jax.distributed init"),
+        Rule("F008", "thread-discipline", 16,
+             "collective outside collective_lockstep in a threaded module, or blocking queue op while holding a lock"),
+        Rule("F009", "unreplicated-decision", 16,
+             "wall-clock/queue-local state steers a schedule-changing branch without replicated_decision"),
     )
 }
 
+# Drift is a diagnostic about the analyzer's own model, not a program
+# bug class, so it lives outside RULES but rides the same report.
+DRIFT_RULE = Rule("DRIFT", "summary-drift", 32,
+                  "computed interprocedural summary contradicts a hand-table entry")
+
 TAG_TO_ID = {r.tag: r.id for r in RULES.values()}
-
-# Same collective vocabulary as graftlint (kept in sync by
-# tests/test_graftflow.py::test_collective_vocabulary_matches_graftlint).
-COLLECTIVE_NAMES = {
-    "ppermute", "psum", "pmax", "pmin", "pmean", "all_gather", "all_to_all",
-    "pshuffle", "process_allgather", "ragged_process_allgather",
-    "ragged_move", "reshape_via_flatmove", "strided_take",
-    "broadcast_one_to_all", "sync_global_devices", "assemble_local_shards",
-    "nonzero_scan", "unique_scan",
-}
-
-# ---------------------------------------------------------------- taint tables
-# Attribute access that is process-dependent regardless of the base:
-# rank identity and local-shard views.  (process_count / device counts
-# are replicated-uniform and deliberately absent — same policy as G003.
-# ``.process_index`` the *attribute* is also absent: in this tree it is
-# only ever read off device objects iterated from the replicated global
-# mesh (``d.process_index``) — replicated placement metadata, not the
-# caller's identity.  Self-identity is the ``process_index()`` call or
-# ``.rank``, which G003 cannot distinguish and flags both.)
-TAINT_ATTRS = {
-    "rank": "rank identity (.rank)",
-    "local_rank": "rank identity (.local_rank)",
-    "larray": "local shard (.larray)",
-    "lcounts": "per-shard layout (.lcounts)",
-    "lshape": "local shard shape (.lshape)",
-    "addressable_shards": "local shard view (.addressable_shards)",
-    "addressable_data": "local shard view (.addressable_data)",
-}
-
-# Replicated metadata of a distributed container: reading these off a
-# tainted base yields the same value on every process (a jax.Array's
-# ``.shape`` is the GLOBAL shape; addressability is a property of the
-# sharding, uniform across hosts), so they launder the base's taint.
-REPLICATED_ATTRS = {
-    "shape", "dtype", "ndim", "size", "sharding", "is_fully_addressable",
-    "gshape", "split", "device", "comm", "mesh",
-}
-
-# Calls whose *result* is process-dependent no matter the arguments.
-TAINT_CALLS = {
-    "process_index": "rank identity (process_index())",
-    "axis_index": "rank identity (axis_index())",
-    "local_devices": "per-host device list (local_devices())",
-    "local_device_count": "per-host device count (local_device_count())",
-    "getpid": "per-process pid (getpid())",
-    "gethostname": "per-host name (gethostname())",
-    "open": "per-host file I/O (open())",
-}
-
-# Host clocks: wall time differs across processes, so a time-based
-# decision is a divergence hazard exactly like a rank-based one.
-CLOCK_CALLS = {"time", "time_ns", "monotonic", "monotonic_ns",
-               "perf_counter", "perf_counter_ns"}
-
-# Per-host filesystem probes: each host sees its own disk.
-FS_CALLS = {"listdir", "scandir", "glob", "iglob", "exists", "isfile",
-            "isdir", "stat", "getmtime", "getsize", "walk"}
-
-# Un-seeded RNG: a no-argument constructor draws entropy per process.
-RNG_FACTORIES = {"default_rng", "Random", "RandomState"}
-# Module-level draws from the global (per-process) stream, e.g.
-# ``random.random()`` or ``np.random.randint(...)``.
-RNG_DRAWS = {"random", "randint", "randrange", "uniform", "normal",
-             "standard_normal", "rand", "randn", "choice", "shuffle",
-             "permutation", "sample", "getrandbits"}
-RNG_MODULES = {"random"}
-
-# Interprocedural summary table for heat_tpu internals — calls that
-# *launder* taint.  A replicating collective returns the same value on
-# every process, so its result is clean even when fed tainted input;
-# metadata helpers below return replicated layout facts by contract.
-LAUNDER_CALLS = {
-    "process_allgather", "ragged_process_allgather", "all_gather",
-    "psum", "pmax", "pmin", "pmean", "broadcast_one_to_all",
-    "sync_global_devices", "assemble_local_shards", "replicated_decision",
-    "replicated_frame",
-    "process_count", "device_count",
-    "lshape_map", "counts_displs_shape",
-}
-
-# heat_tpu internals that dispatch collectives *inside* (summary table):
-# they count as schedule events for F001/F003/F004 even though the
-# rendezvous itself is a call or two deeper.  save/load_checkpoint run
-# sync_global_devices + a ragged allgather; check_divergence reduces
-# per-shard digests; replicated_decision is a one-bool host allgather;
-# replicated_frame is the fixed-width metadata allgather under the
-# health monitor's EWMA frame and the serve dispatch tick.
-COLLECTIVE_WRAPPERS = {
-    "save_checkpoint", "load_checkpoint", "check_divergence",
-    "replicated_decision", "replicated_frame",
-}
+TAG_TO_ID[DRIFT_RULE.tag] = DRIFT_RULE.id
 
 CACHE_NAME_RE = re.compile(r"(?i)(^|_)caches?$")
 WAIVER_RE = re.compile(r"#\s*graft(?:flow|lint):\s*([A-Za-z0-9_,\s=-]+)")
+
+# F008 applies where collective dispatch crosses thread boundaries.
+_THREADED_PARTS = ("heat_tpu/serve/", "heat_tpu/stream/")
+_THREADED_FILES = ("heat_tpu/resilience/monitor.py",)
+
+# F006: eager host reads that force a device->host transfer (a hidden
+# sync point whose ordering interleaves with collectives under skew).
+EAGER_READS = {"numpy", "item", "tolist"}
 
 
 @dataclass
@@ -232,11 +243,11 @@ def _parse_waivers(source: str) -> Tuple[Dict[int, Set[str]], Set[str]]:
                 continue
             token = token.split("=", 1)[-1]
             low = token.lower()
-            if low == "skip-file":
+            if low in ("skip-file", "threaded"):
                 pragmas.add(low)
             elif low == "all":
                 ids.add("all")
-            elif token.upper() in RULES:
+            elif token.upper() in RULES or token.upper() == DRIFT_RULE.id:
                 ids.add(token.upper())
             elif low in TAG_TO_ID:
                 ids.add(TAG_TO_ID[low])
@@ -247,52 +258,61 @@ def _parse_waivers(source: str) -> Tuple[Dict[int, Set[str]], Set[str]]:
     return per_line, pragmas
 
 
+def _is_threaded(path: str) -> bool:
+    p = path.replace(os.sep, "/")
+    if any(part in p for part in _THREADED_PARTS):
+        return True
+    return any(p.endswith(f) for f in _THREADED_FILES)
+
+
 # --------------------------------------------------------------------- helpers
-def _call_name(func: ast.expr) -> Optional[str]:
-    if isinstance(func, ast.Attribute):
-        return func.attr
-    if isinstance(func, ast.Name):
-        return func.id
-    return None
+_call_name = _S._call_name
+_attr_base_name = _S._attr_base_name
+_SCOPE_NODES = _S._SCOPE_NODES
+_ordered_walk = _S._own_scope_walk
 
 
-def _attr_base_name(func: ast.expr) -> Optional[str]:
-    """For ``a.b.c`` return ``b`` (the immediate base of the attribute)."""
-    if isinstance(func, ast.Attribute):
-        v = func.value
-        if isinstance(v, ast.Name):
-            return v.id
-        if isinstance(v, ast.Attribute):
-            return v.attr
-    return None
+def _call_schedule_events(n: ast.Call, table) -> List[Tuple[str, int]]:
+    """Schedule events one call site contributes: a base collective is
+    itself an event; any other name resolves through the summary table
+    to its flattened schedule.  Function-valued arguments count too
+    (the ``guarded_call(label, impl, ...)`` higher-order idiom)."""
+    out: List[Tuple[str, int]] = []
+    name = _call_name(n.func)
+    if name in COLLECTIVE_NAMES:
+        out.append((name, n.lineno))
+    elif _attr_base_name(n.func) not in _S.EXTERNAL_BASES:
+        out.extend((s, n.lineno) for s in table.schedule_of(name))
+    for arg in [*n.args, *[kw.value for kw in n.keywords]]:
+        if isinstance(arg, ast.Name):
+            if arg.id in COLLECTIVE_NAMES:
+                out.append((arg.id, n.lineno))
+            else:
+                out.extend((s, n.lineno) for s in table.schedule_of(arg.id))
+    return out
 
 
-_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
-
-
-def _ordered_walk(node: ast.AST) -> Iterable[ast.AST]:
-    """Source-ordered walk that does not descend into nested scopes
-    (their code does not run at this program point)."""
-    for child in ast.iter_child_nodes(node):
-        yield child
-        if not isinstance(child, _SCOPE_NODES):
-            yield from _ordered_walk(child)
-
-
-def _schedule(stmts: Sequence[ast.stmt]) -> List[Tuple[str, int]]:
-    """Ordered collective call sites reachable in a statement list."""
+def _schedule(stmts: Sequence[ast.stmt], table) -> List[Tuple[str, int]]:
+    """Ordered collective call sites reachable in a statement list,
+    resolved through the interprocedural summary table."""
     out: List[Tuple[str, int]] = []
     for stmt in stmts:
         for n in [stmt, *_ordered_walk(stmt)]:
             if isinstance(n, ast.Call):
-                name = _call_name(n.func)
-                if name in COLLECTIVE_NAMES or name in COLLECTIVE_WRAPPERS:
-                    out.append((name, n.lineno))
+                out.extend(_call_schedule_events(n, table))
     return out
 
 
-def _schedule_names(stmts: Sequence[ast.stmt]) -> List[str]:
-    return [name for name, _ in _schedule(stmts)]
+def _schedule_names(stmts: Sequence[ast.stmt], table) -> List[str]:
+    return [name for name, _ in _schedule(stmts, table)]
+
+
+def _fmt_sched(names: List[str]) -> str:
+    if not names:
+        return "none"
+    if len(names) > 5:
+        return repr(names[:5])[:-1] + f", … +{len(names) - 5} more]"
+    return repr(names)
 
 
 def _first_difference(a: List[str], b: List[str]) -> str:
@@ -303,37 +323,144 @@ def _first_difference(a: List[str], b: List[str]) -> str:
     return longer[min(len(a), len(b))]
 
 
+def _ctx_name(expr: ast.expr) -> Optional[str]:
+    if isinstance(expr, ast.Call):
+        return _call_name(expr.func)
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _is_lock_ctx(expr: ast.expr) -> bool:
+    n = _ctx_name(expr)
+    return bool(n) and "lock" in n.lower() and "lockstep" not in n.lower()
+
+
+def _is_lockstep_ctx(expr: ast.expr) -> bool:
+    n = _ctx_name(expr)
+    return n == "collective_lockstep"
+
+
+def _is_sharding_expr(expr: ast.expr) -> bool:
+    """Placement argument that names a sharding (vs a single device).
+    SingleDeviceSharding is fully addressable by construction."""
+    name = None
+    if isinstance(expr, ast.Call):
+        name = _call_name(expr.func)
+    elif isinstance(expr, ast.Attribute):
+        name = expr.attr
+    elif isinstance(expr, ast.Name):
+        name = expr.id
+    if name is None or name == "SingleDeviceSharding":
+        return False
+    return "sharding" in name.lower()
+
+
+def _queueish(expr: ast.expr) -> bool:
+    name = None
+    if isinstance(expr, ast.Attribute):
+        name = expr.attr
+    elif isinstance(expr, ast.Name):
+        name = expr.id
+    if not name:
+        return False
+    low = name.lower().lstrip("_")
+    return "queue" in low or low == "q" or low.endswith("_q") or low.startswith("q_")
+
+
+def _eager_reads(stmts: Sequence[ast.stmt]) -> List[Tuple[str, ast.Call]]:
+    """(display name, call node) for F006 eager host reads in a loop
+    body.  Reads nested inside collective_lockstep(...) are pinned to
+    the dispatcher's schedule and exempt."""
+    out: List[Tuple[str, ast.Call]] = []
+
+    def visit(node: ast.AST, pinned: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _SCOPE_NODES):
+                continue
+            p = pinned
+            if isinstance(child, ast.Call):
+                n = _call_name(child.func)
+                if n == "collective_lockstep":
+                    p = True
+                elif not pinned:
+                    if (n in EAGER_READS and isinstance(child.func, ast.Attribute)
+                            and not child.args):
+                        out.append((f".{n}()", child))
+                    elif n == "device_get":
+                        out.append(("device_get()", child))
+            visit(child, p)
+
+    for s in stmts:
+        visit(s, False)
+    return out
+
+
 # ------------------------------------------------------------------ the engine
 class _FlowAnalyzer:
     """Flow-sensitive intraprocedural taint propagation for one scope.
 
-    State maps variable name -> human-readable taint reason.  A name
+    State maps variable name -> Taint (reason + source kind).  A name
     absent from the state is clean; assignment of a clean value kills
     taint; branch merge is the union of arm states (conservative)."""
 
     def __init__(self, checker: "_FileChecker"):
         self.checker = checker
+        self.table = checker.table
+        self._lockstep = 0       # depth inside collective_lockstep(...)
+        self._locks = 0          # depth inside `with <lock>:` blocks
+        self._post_init = False  # a distributed-init call has executed
+        self._module_scope = False
+        self._hostvals: Set[str] = set()  # names bound to host values (F005)
+
+    def sched(self, stmts: Sequence[ast.stmt]) -> List[Tuple[str, int]]:
+        return _schedule(stmts, self.table)
+
+    def sched_names(self, stmts: Sequence[ast.stmt]) -> List[str]:
+        return _schedule_names(stmts, self.table)
 
     # -- driver ---------------------------------------------------------------
-    def run(self, body: Sequence[ast.stmt], init_state: Dict[str, str]) -> None:
+    def run(self, body: Sequence[ast.stmt], init_state: Dict[str, Taint],
+            module_scope: bool = False) -> None:
+        self._module_scope = module_scope
         self.block(list(body), dict(init_state), rest=[])
 
-    def block(self, stmts: List[ast.stmt], state: Dict[str, str],
-              rest: List[str]) -> Dict[str, str]:
+    def block(self, stmts: List[ast.stmt], state: Dict[str, Taint],
+              rest: List[str]) -> Dict[str, Taint]:
         for i, stmt in enumerate(stmts):
-            rest_here = _schedule_names(stmts[i + 1:]) + rest
+            rest_here = self.sched_names(stmts[i + 1:]) + rest
             self.stmt(stmt, state, rest_here)
         return state
 
     # -- statements -----------------------------------------------------------
-    def stmt(self, node: ast.stmt, state: Dict[str, str], rest: List[str]) -> None:
+    def stmt(self, node: ast.stmt, state: Dict[str, Taint], rest: List[str]) -> None:
+        if self._post_init and isinstance(node, (ast.Import, ast.ImportFrom)) \
+                and not self._module_scope:
+            mod = (node.names[0].name if isinstance(node, ast.Import)
+                   else (node.module or "."))
+            self.checker.emit(
+                "F007", node,
+                f"function-local import of {mod!r} after distributed init — "
+                "importing here can spawn threads or subprocesses into a "
+                "process that already holds gRPC state (the PR 18 lazy-import "
+                "wedge); hoist the import to module scope",
+            )
         if isinstance(node, ast.Assign):
             t = self.expr(node.value, state)
+            host = self._is_host_value(node.value)
             for target in node.targets:
                 self.bind(target, t, state)
+                if isinstance(target, ast.Name):
+                    (self._hostvals.add if host else
+                     self._hostvals.discard)(target.id)
         elif isinstance(node, ast.AnnAssign):
             if node.value is not None:
                 self.bind(node.target, self.expr(node.value, state), state)
+                if isinstance(node.target, ast.Name):
+                    (self._hostvals.add if self._is_host_value(node.value) else
+                     self._hostvals.discard)(node.target.id)
         elif isinstance(node, ast.AugAssign):
             t = self.expr(node.value, state)
             if isinstance(node.target, ast.Name):
@@ -352,8 +479,9 @@ class _FlowAnalyzer:
             t_iter = self.expr(node.iter, state)
             body_state = dict(state)
             self.bind(node.target, t_iter, body_state)
-            if t_iter is not None and _schedule(node.body):
-                first = _schedule_names(node.body)[0]
+            body_sched = self.sched(node.body)
+            if t_iter is not None and body_sched:
+                first = body_sched[0][0]
                 self.checker.emit(
                     "F003", node,
                     f"for-loop over a process-dependent iterable [{t_iter}] "
@@ -361,17 +489,29 @@ class _FlowAnalyzer:
                     "different numbers of rendezvous rounds; iterate a "
                     "replicated quantity instead",
                 )
+            if body_sched:
+                self._check_eager_reads(node.body, body_sched)
             self._fixpoint_body(node.body, body_state, rest)
             for h in node.orelse:
                 self.stmt(h, body_state, rest)
             self._merge(state, body_state)
         elif isinstance(node, (ast.With, ast.AsyncWith)):
-            st = state
+            locks = steps = 0
             for item in node.items:
-                t = self.expr(item.context_expr, st)
+                t = self.expr(item.context_expr, state)
                 if item.optional_vars is not None:
-                    self.bind(item.optional_vars, t, st)
-            self.block(list(node.body), st, rest)
+                    self.bind(item.optional_vars, t, state)
+                if _is_lock_ctx(item.context_expr):
+                    locks += 1
+                if _is_lockstep_ctx(item.context_expr):
+                    steps += 1
+            self._locks += locks
+            self._lockstep += steps
+            try:
+                self.block(list(node.body), state, rest)
+            finally:
+                self._locks -= locks
+                self._lockstep -= steps
         elif isinstance(node, ast.Try):
             pre = dict(state)
             self.block(list(node.body), state, rest)
@@ -408,23 +548,69 @@ class _FlowAnalyzer:
             for n in ast.iter_child_nodes(node):
                 if isinstance(n, ast.expr):
                     self.expr(n, state)
+        if not self._post_init and self._stmt_does_init(node):
+            self._post_init = True
 
-    def _if(self, node: ast.If, state: Dict[str, str], rest: List[str]) -> None:
+    def _stmt_does_init(self, node: ast.stmt) -> bool:
+        for n in [node, *_ordered_walk(node)]:
+            if isinstance(n, ast.Call):
+                if _S._is_init_call(n):
+                    return True
+                s = self.table.resolve(_call_name(n.func))
+                if s is not None and s.does_init:
+                    return True
+        return False
+
+    def _check_eager_reads(self, body: Sequence[ast.stmt],
+                           body_sched: List[Tuple[str, int]]) -> None:
+        reads = _eager_reads(body)
+        if not reads:
+            return
+        # a loop whose ONLY collective events are the eager gathers
+        # themselves is a symmetric per-item read (every rank gathers the
+        # same items together) — the interleaving hazard needs another
+        # collective in the body for the transfer to skew against
+        read_lines = {call.lineno for _, call in reads}
+        if all(line in read_lines for _, line in body_sched):
+            return
+        for display, call in reads:
+            self.checker.emit(
+                "F006", call,
+                f"eager host gather {display} inside a loop that also "
+                "dispatches collectives — the device->host transfer is a "
+                "hidden sync point that interleaves with the loop's "
+                "rendezvous schedule under rank skew and deadlocks; hoist "
+                "the read out of the loop or pin it with "
+                "collective_lockstep(...)",
+            )
+
+    def _if(self, node: ast.If, state: Dict[str, Taint], rest: List[str]) -> None:
         t_test = self.expr(node.test, state)
         if t_test is not None:
-            body_sched = _schedule_names(node.body)
-            else_sched = _schedule_names(node.orelse)
+            body_sched = self.sched_names(node.body)
+            else_sched = self.sched_names(node.orelse)
             if body_sched != else_sched:
                 diff = _first_difference(body_sched, else_sched)
-                self.checker.emit(
-                    "F001", node,
-                    f"branch on a process-dependent value [{t_test}] dispatches "
-                    f"different collective schedules per arm "
-                    f"({body_sched or 'none'} vs {else_sched or 'none'}; first "
-                    f"divergent: {diff!r}) — ranks disagreeing on the test hang "
-                    "at the unmatched rendezvous; make the schedule symmetric "
-                    "or the predicate replicated",
-                )
+                if t_test.kind in ("clock", "queue"):
+                    self.checker.emit(
+                        "F009", node,
+                        f"branch steered by rank-local state [{t_test}] "
+                        f"dispatches different collective schedules per arm "
+                        f"({_fmt_sched(body_sched)} vs {_fmt_sched(else_sched)}; "
+                        f"first divergent: {diff!r}) — clocks and queue depth "
+                        "differ across ranks, so the schedule diverges; wrap "
+                        "the decision in replicated_decision(...)",
+                    )
+                else:
+                    self.checker.emit(
+                        "F001", node,
+                        f"branch on a process-dependent value [{t_test}] dispatches "
+                        f"different collective schedules per arm "
+                        f"({_fmt_sched(body_sched)} vs {_fmt_sched(else_sched)}; first "
+                        f"divergent: {diff!r}) — ranks disagreeing on the test hang "
+                        "at the unmatched rendezvous; make the schedule symmetric "
+                        "or the predicate replicated",
+                    )
             if rest:
                 for arm in (node.body, node.orelse):
                     for n in arm:
@@ -448,11 +634,12 @@ class _FlowAnalyzer:
         state.clear()
         state.update(merged)
 
-    def _loop(self, node: ast.While, test: ast.expr, state: Dict[str, str],
+    def _loop(self, node: ast.While, test: ast.expr, state: Dict[str, Taint],
               rest: List[str], kind: str) -> None:
         t_test = self.expr(test, state)
-        if t_test is not None and _schedule(node.body):
-            first = _schedule_names(node.body)[0]
+        body_sched = self.sched(node.body)
+        if t_test is not None and body_sched:
+            first = body_sched[0][0]
             self.checker.emit(
                 "F003", node,
                 f"{kind}-loop with a process-dependent trip count [{t_test}] "
@@ -460,6 +647,8 @@ class _FlowAnalyzer:
                 "different numbers of rendezvous rounds and the shorter ones "
                 "hang the rest; derive the bound from a replicated value",
             )
+        if body_sched:
+            self._check_eager_reads(node.body, body_sched)
         body_state = dict(state)
         self._fixpoint_body(node.body, body_state, rest)
         for h in node.orelse:
@@ -467,8 +656,8 @@ class _FlowAnalyzer:
         # re-evaluate the test after one body pass: loop-carried taint in
         # the condition still counts
         if t_test is None and self.expr(test, body_state) is not None \
-                and _schedule(node.body):
-            first = _schedule_names(node.body)[0]
+                and body_sched:
+            first = body_sched[0][0]
             self.checker.emit(
                 "F003", node,
                 f"{kind}-loop condition becomes process-dependent after the "
@@ -477,7 +666,7 @@ class _FlowAnalyzer:
             )
         self._merge(state, body_state)
 
-    def _fixpoint_body(self, body: Sequence[ast.stmt], state: Dict[str, str],
+    def _fixpoint_body(self, body: Sequence[ast.stmt], state: Dict[str, Taint],
                        rest: List[str]) -> None:
         # two passes reach a fixpoint for loop-carried taint because the
         # state lattice only grows and chains are short
@@ -490,13 +679,13 @@ class _FlowAnalyzer:
             before = snapshot
 
     @staticmethod
-    def _merge(into: Dict[str, str], other: Dict[str, str]) -> None:
+    def _merge(into: Dict[str, Taint], other: Dict[str, Taint]) -> None:
         for k, v in other.items():
             into.setdefault(k, v)
 
     # -- binding --------------------------------------------------------------
-    def bind(self, target: ast.expr, taint: Optional[str],
-             state: Dict[str, str]) -> None:
+    def bind(self, target: ast.expr, taint: Optional[Taint],
+             state: Dict[str, Taint]) -> None:
         if isinstance(target, ast.Name):
             if taint is None:
                 state.pop(target.id, None)
@@ -515,7 +704,7 @@ class _FlowAnalyzer:
         elif isinstance(target, ast.Attribute):
             self.expr(target.value, state)
 
-    def _container_mutation(self, node: ast.expr, state: Dict[str, str]) -> None:
+    def _container_mutation(self, node: ast.expr, state: Dict[str, Taint]) -> None:
         """``xs.append(tainted)`` / ``.add`` / ``.extend`` / ``.update``
         taints the container name."""
         if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
@@ -531,10 +720,36 @@ class _FlowAnalyzer:
                 state[base.id] = t
                 return
 
+    # -- F005 helpers ---------------------------------------------------------
+    def _is_host_value(self, expr: ast.expr) -> bool:
+        """Is this expression a host (numpy/python) value, as opposed to
+        an already-committed device array?"""
+        if isinstance(expr, (ast.Constant, ast.List, ast.Tuple, ast.Dict,
+                             ast.ListComp, ast.DictComp)):
+            return True
+        if isinstance(expr, ast.Name):
+            return expr.id in self._hostvals
+        if isinstance(expr, ast.BinOp):
+            return (self._is_host_value(expr.left)
+                    or self._is_host_value(expr.right))
+        if isinstance(expr, ast.Call):
+            fname = _call_name(expr.func)
+            base = _attr_base_name(expr.func)
+            if base in ("np", "numpy"):
+                return True
+            if fname in ("list", "tuple", "dict", "float", "int", "range"):
+                return True
+            if fname in EAGER_READS and isinstance(expr.func, ast.Attribute):
+                return True
+            if fname == "device_get":
+                return True
+        return False
+
     # -- expressions ----------------------------------------------------------
-    def expr(self, node: Optional[ast.expr], state: Dict[str, str]) -> Optional[str]:
-        """Taint reason of an expression (None = clean).  Also emits F002
-        findings for tainted cache keys encountered along the way."""
+    def expr(self, node: Optional[ast.expr],
+             state: Dict[str, Taint]) -> Optional[Taint]:
+        """Taint of an expression (None = clean).  Also emits F002/F005/
+        F008 findings for hazards encountered along the way."""
         if node is None:
             return None
         if isinstance(node, ast.Name):
@@ -630,17 +845,98 @@ class _FlowAnalyzer:
                 t_any = t_any or self.expr(child, state)
         return t_any
 
-    def _call(self, node: ast.Call, state: Dict[str, str]) -> Optional[str]:
+    def _call(self, node: ast.Call, state: Dict[str, Taint]) -> Optional[Taint]:
         fname = _call_name(node.func)
         base = _attr_base_name(node.func)
-        arg_taints = [self.expr(a, state) for a in node.args]
-        kw_taints = [self.expr(kw.value, state) for kw in node.keywords]
-        base_taint = (self.expr(node.func.value, state)
-                      if isinstance(node.func, ast.Attribute) else None)
+        summary = (None if base in _S.EXTERNAL_BASES
+                   else self.table.resolve(fname))
+
+        # F008a: raw collective dispatched outside collective_lockstep
+        # in a threaded module — the dispatcher thread owns the schedule
+        if (self.checker.threaded and self._lockstep == 0
+                and fname in COLLECTIVE_NAMES):
+            self.checker.emit(
+                "F008", node,
+                f"collective {fname!r} dispatched outside collective_lockstep "
+                "in a threaded module — a worker thread's dispatch interleaves "
+                "with the dispatcher's schedule and the rendezvous order "
+                "diverges across ranks; pin it with collective_lockstep(...)",
+            )
+        # F008b: blocking queue op while holding a lock — the consumer
+        # may need the same lock to drain the queue
+        if (self.checker.threaded and self._locks > 0
+                and isinstance(node.func, ast.Attribute)
+                and fname in ("get", "put", "join")
+                and _queueish(node.func.value)):
+            has_escape = any(kw.arg in ("timeout", "block")
+                             for kw in node.keywords)
+            positional_escape = len(node.args) >= (2 if fname == "put" else 1)
+            if not has_escape and not positional_escape:
+                self.checker.emit(
+                    "F008", node,
+                    f"blocking .{fname}() on a queue while holding a lock — "
+                    "the thread that would unblock it may need the same lock, "
+                    "deadlocking the pair; pass timeout=/block=False or "
+                    "release the lock first",
+                )
+        # F005: host value placed onto a sharding — at ws>1 a
+        # non-fully-addressable placement broadcasts under the hood
+        if fname == "device_put" and node.args:
+            placement = node.args[1] if len(node.args) > 1 else None
+            if placement is None:
+                placement = next((kw.value for kw in node.keywords
+                                  if kw.arg in ("device", "sharding")), None)
+            if placement is not None and _is_sharding_expr(placement) \
+                    and self._is_host_value(node.args[0]):
+                self.checker.emit(
+                    "F005", node,
+                    "host value placed onto a sharding via device_put — at "
+                    "ws>1 a non-fully-addressable placement issues a blocking "
+                    "cross-process equality broadcast (a hidden collective "
+                    "that deadlocks when ranks reach it asymmetrically); "
+                    "build the array with make_array_from_callback from the "
+                    "local shard instead",
+                )
+        # F007: spawn (direct or through a callee's computed summary)
+        # reachable after distributed init in this scope
+        if self._post_init:
+            spawn = _S._is_spawn_call(node)
+            if spawn:
+                self.checker.emit(
+                    "F007", node,
+                    f"{spawn} after distributed init — the child process "
+                    "inherits wedged gRPC threads from the initialized "
+                    "runtime; spawn before init_distributed() or from a "
+                    "dedicated launcher process",
+                )
+            elif summary is not None and summary.forks and not summary.does_init:
+                self.checker.emit(
+                    "F007", node,
+                    f"call to {fname}() after distributed init — its computed "
+                    f"summary has fork effects ({summary.forks}); spawn before "
+                    "init or from a dedicated launcher process",
+                )
+
+        bump = 1 if fname == "collective_lockstep" else 0
+        self._lockstep += bump
+        try:
+            arg_taints = [self.expr(a, state) for a in node.args]
+            kw_taints = [self.expr(kw.value, state) for kw in node.keywords]
+            base_taint = (self.expr(node.func.value, state)
+                          if isinstance(node.func, ast.Attribute) else None)
+        finally:
+            self._lockstep -= bump
         any_arg = next((t for t in [*arg_taints, *kw_taints] if t), None)
 
-        # replicating collectives / metadata helpers launder everything
+        # replicating collectives / laundering helpers (hand contract or
+        # computed summary) return the same value on every process
+        if summary is not None and summary.launders:
+            return None
         if fname in LAUNDER_CALLS:
+            return None
+        # type-shape probes: every process runs the same program over
+        # values of the same type, so isinstance(tainted, T) is replicated
+        if fname in _S.TYPE_PROBES:
             return None
         # unconditional process-dependent sources
         if fname in TAINT_CALLS:
@@ -655,14 +951,20 @@ class _FlowAnalyzer:
                 return None
             return arg_taints[0]
         if fname in CLOCK_CALLS and base in ("time",):
-            return f"host clock (time.{fname}())"
+            return Taint(f"host clock (time.{fname}())", "clock")
         if fname in FS_CALLS and base in ("os", "path", "glob", "shutil"):
-            return f"per-host filesystem ({base}.{fname}())"
+            return Taint(f"per-host filesystem ({base}.{fname}())", "fs")
+        # rank-local queue state: no-argument .qsize()/.empty()/.full()
+        # (np.empty((3,)) has arguments and a numpy base — never matches)
+        if fname in QUEUE_CALLS and not node.args and not node.keywords \
+                and isinstance(node.func, ast.Attribute) \
+                and base not in ("np", "numpy", "jnp", "jax"):
+            return Taint(f"rank-local queue state (.{fname}())", "queue")
         if fname in RNG_FACTORIES and not node.args and not any(
                 kw.arg in ("seed", "x") for kw in node.keywords):
-            return f"un-seeded RNG ({fname}())"
+            return Taint(f"un-seeded RNG ({fname}())", "rng")
         if fname in RNG_DRAWS and base in RNG_MODULES:
-            return f"per-process RNG stream ({base}.{fname}())"
+            return Taint(f"per-process RNG stream ({base}.{fname}())", "rng")
         # comm.chunk() defaults rank to *this* process; an explicit
         # untainted rank argument makes the result deterministic
         if fname == "chunk":
@@ -672,15 +974,21 @@ class _FlowAnalyzer:
                     rank_arg = kw.value
             if rank_arg is None or (
                     isinstance(rank_arg, ast.Constant) and rank_arg.value is None):
-                return "this process's chunk (chunk() with default rank)"
+                return Taint("this process's chunk (chunk() with default rank)",
+                             "shard")
             return self.expr(rank_arg, state)
+        # computed interprocedural summary: the callee's derived
+        # taint-out beats the conservative any-arg default
+        if summary is not None and summary.computed:
+            if summary.taint_out is not None:
+                return summary.taint_out
         # method on a tainted object (rng.random(), fh.read(), …)
         if base_taint is not None:
             return base_taint
         return any_arg
 
     # -- F002 -----------------------------------------------------------------
-    def _check_cache_key(self, node: ast.Subscript, state: Dict[str, str]) -> None:
+    def _check_cache_key(self, node: ast.Subscript, state: Dict[str, Taint]) -> None:
         name = (node.value.id if isinstance(node.value, ast.Name)
                 else _call_name(node.value))
         if not (name and CACHE_NAME_RE.search(name)):
@@ -699,8 +1007,10 @@ class _FlowAnalyzer:
 class _FileChecker:
     """Drives the flow analyzer over every scope of one file."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, table=None, threaded: bool = False):
         self.path = path
+        self.table = table if table is not None else _S.SummaryTable()
+        self.threaded = threaded
         self.findings: List[Finding] = []
         self._seen: Set[Tuple[str, int, int]] = set()
 
@@ -712,27 +1022,31 @@ class _FileChecker:
         self.findings.append(Finding(rule, self.path, key[1], key[2], message))
 
     def analyze_scope(self, body: Sequence[ast.stmt],
-                      init_state: Dict[str, str]) -> None:
-        _FlowAnalyzer(self).run(body, init_state)
+                      init_state: Dict[str, Taint],
+                      module_scope: bool = False) -> None:
+        _FlowAnalyzer(self).run(body, init_state, module_scope=module_scope)
 
     def check(self, tree: ast.Module) -> List[Finding]:
-        self.analyze_scope(tree.body, {})
+        self.analyze_scope(tree.body, {}, module_scope=True)
         return self.findings
 
 
 # -------------------------------------------------------- schedule extraction
 def collective_schedules(source: str) -> Dict[str, List[Tuple[str, int]]]:
     """Per-function collective schedules: qualified function name ->
-    ordered ``(collective, line)`` call sites.  The module's own
-    top-level schedule is keyed ``"<module>"``."""
+    ordered ``(collective, line)`` call sites, resolved through the
+    file's own computed summaries (calls into in-file helpers flatten
+    to the helpers' schedules).  The module's own top-level schedule is
+    keyed ``"<module>"``."""
     tree = ast.parse(source)
-    out: Dict[str, List[Tuple[str, int]]] = {"<module>": _schedule(tree.body)}
+    table = _S.compute_summaries({"<schedules>": tree})
+    out: Dict[str, List[Tuple[str, int]]] = {"<module>": _schedule(tree.body, table)}
 
     def visit(node: ast.AST, prefix: str) -> None:
         for child in ast.iter_child_nodes(node):
             if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 qual = f"{prefix}{child.name}"
-                out[qual] = _schedule(child.body)
+                out[qual] = _schedule(child.body, table)
                 visit(child, qual + ".")
             elif isinstance(child, ast.ClassDef):
                 visit(child, f"{prefix}{child.name}.")
@@ -744,18 +1058,14 @@ def collective_schedules(source: str) -> Dict[str, List[Tuple[str, int]]]:
 
 
 # ------------------------------------------------------------------ public API
-def analyze_source(
-    source: str, path: str = "<string>", select: Optional[Set[str]] = None
-) -> List[Finding]:
-    """Analyze one source string; returns unwaived findings."""
-    waivers, pragmas = _parse_waivers(source)
-    if "skip-file" in pragmas:
-        return []
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as e:
-        return [Finding("SYNTAX", path, e.lineno or 0, e.offset or 0, str(e.msg))]
-    findings = _FileChecker(path).check(tree)
+def _drift_findings(table) -> List[Finding]:
+    return [Finding(DRIFT_RULE.id, p, line, 0, msg)
+            for p, line, msg in _S.drift_records(table)]
+
+
+def _apply_waivers(findings: Iterable[Finding], source: str,
+                   waivers: Dict[int, Set[str]],
+                   select: Optional[Set[str]]) -> List[Finding]:
     lines = source.splitlines()
 
     def _waived(lineno: int) -> Set[str]:
@@ -778,9 +1088,37 @@ def analyze_source(
     return out
 
 
-def analyze_file(path: str, select: Optional[Set[str]] = None) -> List[Finding]:
+def analyze_source(
+    source: str, path: str = "<string>", select: Optional[Set[str]] = None,
+    table=None,
+) -> List[Finding]:
+    """Analyze one source string; returns unwaived findings.
+
+    With ``table=None`` the file's own computed summaries (plus the
+    hand seeds for externals) drive call resolution and the drift
+    diagnostic runs over in-file definitions; ``analyze_paths`` passes
+    a shared tree-wide table instead and handles drift itself."""
+    waivers, pragmas = _parse_waivers(source)
+    if "skip-file" in pragmas:
+        return []
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding("SYNTAX", path, e.lineno or 0, e.offset or 0, str(e.msg))]
+    own_table = table is None
+    if own_table:
+        table = _S.compute_summaries({path: tree})
+    threaded = _is_threaded(path) or "threaded" in pragmas
+    findings = _FileChecker(path, table=table, threaded=threaded).check(tree)
+    if own_table:
+        findings = findings + _drift_findings(table)
+    return _apply_waivers(findings, source, waivers, select)
+
+
+def analyze_file(path: str, select: Optional[Set[str]] = None,
+                 table=None) -> List[Finding]:
     with open(path, "r", encoding="utf-8") as fh:
-        return analyze_source(fh.read(), path=path, select=select)
+        return analyze_source(fh.read(), path=path, select=select, table=table)
 
 
 def iter_python_files(paths: Sequence[str]) -> List[str]:
@@ -800,27 +1138,60 @@ def iter_python_files(paths: Sequence[str]) -> List[str]:
 def analyze_paths(
     paths: Sequence[str], select: Optional[Set[str]] = None
 ) -> Tuple[List[Finding], int]:
-    """(findings, files_checked) over files and/or directory trees."""
+    """(findings, files_checked) over files and/or directory trees.
+
+    Summaries are computed once over the whole file set, so calls
+    resolve across module boundaries; the drift diagnostic runs against
+    every in-scope definition of a hand-table name."""
     files = iter_python_files(paths)
+    sources: Dict[str, str] = {}
+    trees: Dict[str, ast.Module] = {}
+    for f in files:
+        with open(f, "r", encoding="utf-8") as fh:
+            sources[f] = fh.read()
+    for f, src in sources.items():
+        _, pragmas = _parse_waivers(src)
+        if "skip-file" in pragmas:
+            continue
+        try:
+            trees[f] = ast.parse(src, filename=f)
+        except SyntaxError:
+            pass  # surfaced as a SYNTAX finding by the per-file pass
+    table = _S.compute_summaries(trees)
     findings: List[Finding] = []
     for f in files:
-        findings.extend(analyze_file(f, select=select))
+        findings.extend(analyze_source(sources[f], path=f, select=select,
+                                       table=table))
+    for fd in _drift_findings(table):
+        src = sources.get(fd.path)
+        if src is None:
+            continue
+        waivers, _ = _parse_waivers(src)
+        findings.extend(_apply_waivers([fd], src, waivers, select))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings, len(files)
 
 
 def exit_code_for(findings: Iterable[Finding]) -> int:
-    """Per-rule exit bitmask: F001=1, F002=2, F003=4, F004=8; syntax
-    errors / internal failures = 128 (same bit as graftlint)."""
+    """Per-rule exit bitmask: F001=1, F002=2, F003=4, F004=8, the
+    F005-F009 rule pack=16, DRIFT=32; syntax errors / internal failures
+    = 128 (same bit as graftlint)."""
     code = 0
     for f in findings:
-        code |= RULES[f.rule].bit if f.rule in RULES else 128
+        if f.rule in RULES:
+            code |= RULES[f.rule].bit
+        elif f.rule == DRIFT_RULE.id:
+            code |= DRIFT_RULE.bit
+        else:
+            code |= 128
     return code
 
 
 def build_report(paths: Sequence[str], findings: List[Finding], files_checked: int) -> dict:
     """Machine-readable output; same key contract as graftlint's report
     (pinned by tests/test_flow_clean.py::test_cli_json_contract)."""
-    counts = {rid: 0 for rid in RULES}
+    all_rules = list(RULES.values()) + [DRIFT_RULE]
+    counts = {r.id: 0 for r in all_rules}
     for f in findings:
         if f.rule in counts:
             counts[f.rule] += 1
@@ -831,7 +1202,7 @@ def build_report(paths: Sequence[str], findings: List[Finding], files_checked: i
         "files_checked": files_checked,
         "rules": [
             {"id": r.id, "tag": r.tag, "bit": r.bit, "summary": r.summary}
-            for r in RULES.values()
+            for r in all_rules
         ],
         "findings": [f.as_dict() for f in findings],
         "counts": counts,
@@ -864,10 +1235,9 @@ def render_github(report: dict) -> str:
 
 
 _EXIT_EPILOG = (
-    "exit code is a bitmask: "
-    + ", ".join(f"{r.bit}={r.id}" for r in RULES.values())
-    + ", 128=syntax/internal error; 0 means clean "
-    "(table: docs/ANALYSIS.md)"
+    "exit code is a bitmask: 1=F001, 2=F002, 4=F003, 8=F004, "
+    "16=F005-F009 (rule pack), 32=DRIFT, 128=syntax/internal error; "
+    "0 means clean (table: docs/ANALYSIS.md)"
 )
 
 
@@ -890,14 +1260,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = parser.parse_args(argv)
 
     if args.list_rules:
-        for r in RULES.values():
+        for r in list(RULES.values()) + [DRIFT_RULE]:
             print(f"{r.id}  [{r.tag}]  exit-bit {r.bit}: {r.summary}")
         return 0
 
     select = None
     if args.select:
         select = {s.strip().upper() for s in args.select.split(",") if s.strip()}
-        unknown = select - set(RULES)
+        unknown = select - set(RULES) - {DRIFT_RULE.id}
         if unknown:
             print(f"graftflow: unknown finding id(s): {sorted(unknown)}", file=sys.stderr)
             return 128

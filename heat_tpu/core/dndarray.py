@@ -1704,6 +1704,20 @@ def _place(
         # global array from per-device local shards instead — no collective;
         # the value-replicated-across-processes contract is documented at
         # the factories/chunked-reader host boundary.
+        if not target.addressable_devices:
+            # A mesh this process owns no slice of cannot hold data placed
+            # BY this process (jax dies with an opaque IndexError deep in
+            # make_array_from_callback — and only on the device-less ranks,
+            # so the group crashes divergently). Name the real mistake:
+            # sub-meshes must be drawn round-robin across processes, not as
+            # a jax.devices()[:k] prefix (tests/_mh_helpers.submesh).
+            raise ValueError(
+                f"sharding mesh owns no devices addressable by process "
+                f"{jax.process_index()}; every participating process must "
+                f"hold at least one mesh device — build sub-meshes spanning "
+                f"all processes (e.g. an equal share of each process's "
+                f"local devices), not as a global device-list prefix"
+            )
         host = np.asarray(array)
         return jax.make_array_from_callback(
             # np.array: own the shard memory (callback results may be aliased

@@ -330,6 +330,27 @@ def _compiled(key: Tuple, builder):
     return fn
 
 
+def _trim_cols(f, n: int):
+    """Compose ``f`` with its ``[:, :n]`` column trim so both compile as
+    ONE program.  An eager slice of the non-fully-addressable result
+    would dispatch an implicit cross-process gather program outside the
+    compiled lockstep schedule — nondeterministically racy under async
+    dispatch at ws>1 (rank aborts observed in the ws-2 burn-down)."""
+    def run(*args):
+        return f(*args)[:, :n]
+
+    return run
+
+
+def _first_col(f):
+    """Compose ``f`` with its ``[:, 0]`` vector trim — same single-program
+    rationale as :func:`_trim_cols` for the 1-D right-hand-side paths."""
+    def run(*args):
+        return f(*args)[:, 0]
+
+    return run
+
+
 def _dist2d(a: DNDarray) -> bool:
     return a.ndim == 2 and a.split is not None and a.comm.is_distributed()
 
@@ -432,9 +453,9 @@ def cholesky(a: DNDarray, tiles_per_proc: int = 1) -> DNDarray:
         p, mi, bs = _geometry(m, tiles_per_proc)
         fn = _compiled(
             ("chol", comm.mesh, p, mi, n, bs, jnp.dtype(ftype).name),
-            lambda: _build_cholesky(comm.mesh, p, mi, n, bs),
+            lambda: _trim_cols(_build_cholesky(comm.mesh, p, mi, n, bs), n),
         )
-        buf = fn(_prep(m, ftype))[:, :n]
+        buf = fn(_prep(m, ftype))
         return DNDarray._from_buffer(
             buf, (n, n), types.canonical_heat_type(buf.dtype), 0, a.device, comm
         )
@@ -466,14 +487,17 @@ def solve(a: DNDarray, b: DNDarray) -> DNDarray:
         A0 = a if a.split == 0 else a.resplit(0)
         p, mi, bs = _geometry(A0)
         k = 1 if b.ndim == 1 else b.gshape[1]
+        vec = b.ndim == 1
         fn = _compiled(
-            ("lu-solve", comm.mesh, p, mi, n, bs, k, jnp.dtype(ftype).name),
-            lambda: _build_lu(comm.mesh, p, mi, n, bs, "solve", k),
+            ("lu-solve", comm.mesh, p, mi, n, bs, k, vec, jnp.dtype(ftype).name),
+            lambda: (_first_col if vec else (lambda f: f))(
+                _build_lu(comm.mesh, p, mi, n, bs, "solve", k)
+            ),
         )
         X = fn(_prep(A0, ftype), _rhs_buffer(b, n, mi * p, ftype))
         ht = types.canonical_heat_type(X.dtype)
-        if b.ndim == 1:
-            return DNDarray._from_buffer(X[:, 0], (n,), ht, 0, a.device, comm)
+        if vec:
+            return DNDarray._from_buffer(X, (n,), ht, 0, a.device, comm)
         return DNDarray._from_buffer(X, (n, k), ht, 0, a.device, comm)
 
 
@@ -509,17 +533,20 @@ def solve_triangular(
         A0 = a if a.split == 0 else a.resplit(0)
         p, mi, bs = _geometry(A0)
         k = 1 if b.ndim == 1 else b.gshape[1]
+        vec = b.ndim == 1
         fn = _compiled(
-            ("trisolve", comm.mesh, p, mi, n, bs, k, bool(lower), bool(unit_diagonal),
-             jnp.dtype(ftype).name),
-            lambda: _build_trisolve(
-                comm.mesh, p, mi, n, bs, k, bool(lower), bool(unit_diagonal)
+            ("trisolve", comm.mesh, p, mi, n, bs, k, vec, bool(lower),
+             bool(unit_diagonal), jnp.dtype(ftype).name),
+            lambda: (_first_col if vec else (lambda f: f))(
+                _build_trisolve(
+                    comm.mesh, p, mi, n, bs, k, bool(lower), bool(unit_diagonal)
+                )
             ),
         )
         X = fn(_prep(A0, ftype), _rhs_buffer(b, n, mi * p, ftype))
         ht = types.canonical_heat_type(X.dtype)
-        if b.ndim == 1:
-            return DNDarray._from_buffer(X[:, 0], (n,), ht, 0, a.device, comm)
+        if vec:
+            return DNDarray._from_buffer(X, (n,), ht, 0, a.device, comm)
         return DNDarray._from_buffer(X, (n, k), ht, 0, a.device, comm)
 
 
@@ -571,9 +598,9 @@ def _inv_impl(a: DNDarray) -> DNDarray:
             p, mi, bs = _geometry(m)
             fn = _compiled(
                 ("lu-inv", comm.mesh, p, mi, n, bs, jnp.dtype(ftype).name),
-                lambda: _build_lu(comm.mesh, p, mi, n, bs, "inv", 0),
+                lambda: _trim_cols(_build_lu(comm.mesh, p, mi, n, bs, "inv", 0), n),
             )
-            buf = fn(_prep(m, ftype))[:, :n]
+            buf = fn(_prep(m, ftype))
             X = DNDarray._from_buffer(
                 buf, (n, n), types.canonical_heat_type(buf.dtype), 0, a.device, comm
             )

@@ -37,6 +37,9 @@ def _flatten_tree(prefix: str, tree) -> dict:
             from jax.experimental import multihost_utils
 
             leaf = multihost_utils.process_allgather(leaf, tiled=True)
+        # graftflow: F006 - every rank walks the SAME pytree (same leaf
+        # order), the allgather arm is gated on replicated sharding
+        # metadata, and each per-leaf host read is symmetric
         out[prefix + jax.tree_util.keystr(path)] = np.asarray(jax.device_get(leaf))
     return out
 

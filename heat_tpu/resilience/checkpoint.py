@@ -154,6 +154,10 @@ def save_checkpoint(
         # (offset, length, payload) for every shard THIS process must write
         local: List[Tuple[int, np.ndarray]] = []
         if x.split is None:
+            # graftflow: F001 - split=None means fully addressable on every
+            # process: .numpy() is a local device read here, no cross-rank
+            # rendezvous, and only rank 0 owning the single write is the
+            # checkpoint layout contract (everyone re-reads it on load)
             if jax.process_index() == 0:
                 local.append((0, x.numpy()))
         else:

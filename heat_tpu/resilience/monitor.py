@@ -290,6 +290,11 @@ class HealthMonitor:
         return False
 
     # ---------------------------------------------------------------- tick
+    # graftflow: DRIFT - the flow-insensitive derivation sees the probe
+    # timers feeding the report and calls the return process-dependent;
+    # the verdicts are replicated by contract (probe failures ride the
+    # cross-rank id union, EWMA adoption is µs-quantized on the gathered
+    # frame), which INTERNAL_LAUNDER asserts and ws-2 tick tests pin.
     def tick(self) -> TickReport:
         """One probe pass over every addressable base device, then
         replicated verdicts and ledger transitions (module docs)."""
@@ -349,6 +354,9 @@ class HealthMonitor:
         export = {d: self.ledger[d].ewma_ms for d in local_ms}
         return local_fail, export, probes
 
+    # graftflow: DRIFT - inputs are the already-gathered cross-rank union,
+    # so the report is rank-uniform by construction; the derivation only
+    # sees the rank-local EWMA ledger writes (contract in INTERNAL_LAUNDER)
     def apply_gathered(self, failed, ewmas, *, probes: int = 0,
                        failures: int = 0) -> TickReport:
         """The replicated half of a tick: adopt the gathered verdict

@@ -523,6 +523,10 @@ class Supervisor:
         if len(procs) < jax.process_count():  # pragma: no cover - multihost only
             self._checkpointing_on = False
             if jax.process_index() not in procs:
+                # graftflow: F004 - deliberate divergence: a process with
+                # no surviving devices DETACHES — it must leave the
+                # collective population, and checkpoint barriers were just
+                # disabled above so the survivors' schedule excludes it
                 return state, data, step, True
 
         if have_ckpt:
@@ -668,6 +672,8 @@ class Supervisor:
                         comm=self._comm,
                         retry=self.checkpoint_retry,
                     )
+                    # graftflow: F006 - same manifest on every rank, so the
+                    # per-entry gather is symmetric with the load sequence
                     state[name] = arr.numpy() if kind == "ndarray" else arr
                 return state, int(meta.get("step", ckpt_step))
             except ResilienceError:

@@ -176,6 +176,12 @@ def main(argv=None) -> int:
     # ``np.testing`` access is a cached module lookup — never a fork.
     import numpy.testing  # noqa: F401
 
+    # same discipline for pytest: its startup loads every installed
+    # entry-point plugin, and plugin imports are free to probe or fork
+    # (coverage starts a tracer, xdist probes CPUs). Pull it in before
+    # jax.distributed spawns its gRPC threads, not after (F007).
+    import pytest
+
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -191,8 +197,6 @@ def main(argv=None) -> int:
             num_processes=args.nproc,
             process_id=args.rank,
         )
-
-    import pytest
 
     plugin = PoolWorkerPlugin(
         args.rank, args.nproc, args.ctl_fd, args.res_fd, args.deadline

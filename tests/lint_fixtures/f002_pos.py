@@ -20,9 +20,9 @@ def cache_keyed_by_process_index(x, build):
     return _EXEC_CACHE[key]
 
 
-def plan_cache_keyed_by_local_counts(x, plan):
-    # lcounts is the per-process shard layout: a valid key only if every
-    # rank agrees on it, which nothing here establishes
-    counts = tuple(x.lcounts)
-    _PLAN_CACHE[counts] = plan
-    return _PLAN_CACHE[counts]
+def plan_cache_keyed_by_local_shape(x, plan):
+    # lshape is THIS process's shard extent (unlike .lcounts, the full
+    # replicated partition table): a key only this rank agrees with
+    shape = tuple(x.lshape)
+    _PLAN_CACHE[shape] = plan
+    return _PLAN_CACHE[shape]

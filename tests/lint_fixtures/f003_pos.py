@@ -20,8 +20,11 @@ def drain_local_directory(dirname, x):
     return x
 
 
-def while_over_local_shard_count(x):
-    n = len(x.lcounts)
+def while_over_local_shard_extent(x):
+    # .lshape is this rank's OWN shard extent (.lcounts, the replicated
+    # partition table, would be a fine bound — the drift audit proved it
+    # rank-uniform)
+    n = x.lshape[0]
     i = 0
     while i < n:
         x = process_allgather(x)

@@ -1,0 +1,34 @@
+# graftlint-fixture: G003=0
+# graftflow-fixture: F005=0
+"""Near-misses for F005: placements that look like the hazard but are
+collective-free.
+
+- an already-committed device array repartitioned onto a sharding (XLA
+  moves shards, no host broadcast);
+- a host value onto SingleDeviceSharding (fully addressable by
+  construction);
+- a host value onto a bare device;
+- the fix idiom itself: make_array_from_callback from the local shard.
+"""
+import jax
+import numpy as np
+
+
+def repartition_device_array(buf, comm):
+    return jax.device_put(buf, comm.array_sharding(buf.shape, 0))
+
+
+def place_single_device(dev):
+    host = np.arange(4)
+    return jax.device_put(host, jax.sharding.SingleDeviceSharding(dev))
+
+
+def place_on_device(dev):
+    host = np.arange(4)
+    return jax.device_put(host, dev)
+
+
+def assemble_instead(host, target_sharding):
+    return jax.make_array_from_callback(
+        host.shape, target_sharding, lambda idx: host[idx]
+    )

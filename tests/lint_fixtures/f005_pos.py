@@ -1,0 +1,27 @@
+# graftlint-fixture: G003=0
+# graftflow-fixture: F005=3
+"""True positives for F005: host values device_put onto shardings.
+
+At ws>1 placing a process-local host value onto a non-fully-addressable
+sharding makes jax issue a blocking cross-process equality broadcast —
+a hidden collective that deadlocks the group when ranks reach it
+asymmetrically (the PR 17 StreamingGroupBy flake; story:
+docs/ANALYSIS.md).  The fix idiom is make_array_from_callback from the
+local shard.
+"""
+import jax
+import numpy as np
+
+
+def stage_table(comm):
+    host = np.arange(16)
+    return jax.device_put(host, comm.array_sharding((16,), 0))
+
+
+def stage_literal(target_sharding):
+    return jax.device_put([0.0] * 8, target_sharding)
+
+
+def stage_keyword(mesh_sharding, n):
+    lut = list(range(n))
+    return jax.device_put(lut, device=mesh_sharding)

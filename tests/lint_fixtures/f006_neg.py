@@ -1,0 +1,29 @@
+# graftlint-fixture: G003=0
+# graftflow-fixture: F006=0
+"""Near-misses for F006.
+
+- a loop whose ONLY collective events are the per-item gathers
+  themselves: every rank reads the same item at the same point, so the
+  transfer cannot skew against another rendezvous;
+- a read pinned inside collective_lockstep(...): it rides the
+  dispatcher's schedule;
+- the hoisted fix: read once after the loop drains.
+"""
+
+
+def symmetric_per_item(batches, sink):
+    for b in batches:
+        sink(b.numpy())
+
+
+def pinned(batches, xs, sink):
+    for b in batches:
+        psum(xs)
+        sink(collective_lockstep(b.numpy()))
+
+
+def hoisted(batches, xs, sink):
+    acc = None
+    for _ in batches:
+        acc = psum(xs)
+    sink(acc.numpy())

@@ -1,0 +1,21 @@
+# graftlint-fixture: G003=0
+# graftflow-fixture: F006=2
+"""True positives for F006: eager host gathers inside loops that also
+dispatch collectives.
+
+The device->host transfer is a hidden sync point; under rank skew it
+interleaves with the loop's rendezvous schedule and deadlocks (the
+PR 18 per-batch eager gather; story: docs/ANALYSIS.md).
+"""
+
+
+def train(batches, xs, log):
+    for b in batches:
+        grads = psum(xs)
+        log(grads.numpy())
+
+
+def monitor(steps, xs, sink):
+    while steps:
+        norm = pmax(xs)
+        sink(norm.item())

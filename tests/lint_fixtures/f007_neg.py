@@ -1,0 +1,31 @@
+# graftlint-fixture: G003=0
+# graftflow-fixture: F007=0
+"""Near-misses for F007.
+
+- fork BEFORE init: the child predates gRPC's threads;
+- a module-scope import used after init (hoisting is the fix idiom);
+- a post-init call to a helper whose computed summary has no fork
+  effects.
+"""
+import pickle
+import subprocess
+
+
+def spawn_then_init(argv):
+    proc = subprocess.Popen(argv)
+    init_distributed()
+    return proc
+
+
+def hoisted_import(xs):
+    init_distributed()
+    return pickle.dumps(xs)
+
+
+def _pure_helper(x):
+    return x + 1
+
+
+def compute_after_init(x):
+    init_distributed()
+    return _pure_helper(x)

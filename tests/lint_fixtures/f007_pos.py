@@ -1,0 +1,33 @@
+# graftlint-fixture: G003=0
+# graftflow-fixture: F007=3
+"""True positives for F007: forks and lazy imports after distributed
+init.
+
+Once jax.distributed has spawned its gRPC threads, a forked child
+inherits them mid-state and wedges; a function-local import can spawn
+threads or subprocesses the same way via entry-point side effects (the
+PR 18 lazy-import wedge; story: docs/ANALYSIS.md).  The third positive
+reaches the spawn through a helper's computed summary — no hand-table
+entry involved.
+"""
+import subprocess
+
+
+def relaunch(argv):
+    init_distributed()
+    return subprocess.Popen(argv)
+
+
+def lazy_probe(xs):
+    init_distributed()
+    import pickle
+    return pickle.dumps(xs)
+
+
+def _spawn_worker(argv):
+    return subprocess.run(argv, check=True)
+
+
+def relaunch_via_helper(argv):
+    init_distributed()
+    return _spawn_worker(argv)

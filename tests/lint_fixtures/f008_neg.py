@@ -1,0 +1,23 @@
+# graftlint-fixture: G003=0
+# graftflow-fixture: F008=0
+# graftflow: threaded
+"""Near-misses for F008 (same threaded pragma as the positive).
+
+- the collective pinned inside collective_lockstep;
+- the queue op bounded with a timeout (it cannot deadlock the pair);
+- a blocking queue op with NO lock held.
+"""
+
+
+def pinned_flush(xs):
+    with collective_lockstep("flush"):
+        return psum(xs)
+
+
+def bounded_hand_off(state_lock, work_q, item):
+    with state_lock:
+        work_q.put(item, timeout=0.5)
+
+
+def unlocked_drain(work_q):
+    return work_q.get()

@@ -1,0 +1,23 @@
+# graftlint-fixture: G003=0
+# graftflow-fixture: F008=2
+# graftflow: threaded
+"""True positives for F008: thread-discipline violations in a threaded
+module (the ``# graftflow: threaded`` pragma above stands in for living
+under ``serve/``/``stream/``).
+
+- a raw collective dispatched outside collective_lockstep: a worker
+  thread's dispatch interleaves with the dispatcher's schedule and the
+  rendezvous order diverges across ranks (the PR 16 tick-dispatch
+  hazard; story: docs/ANALYSIS.md);
+- a blocking queue op while holding a lock: the consumer that would
+  unblock it may need the same lock.
+"""
+
+
+def flush(xs):
+    return psum(xs)
+
+
+def hand_off(state_lock, work_q, item):
+    with state_lock:
+        work_q.put(item)

@@ -1,0 +1,31 @@
+# graftlint-fixture: G003=0
+# graftflow-fixture: F009=0
+"""Near-misses for F009.
+
+- the decision rendezvoused through replicated_decision: every rank
+  branches the same way;
+- symmetric arms: whichever way a rank branches, the schedule matches;
+- the clock read used only for logging, never steering dispatch.
+"""
+import time
+
+
+def flush_replicated(xs, deadline):
+    if replicated_decision(time.monotonic() > deadline):
+        return psum(xs)
+    return xs
+
+
+def symmetric_arms(work_q, xs):
+    if work_q.qsize() > 4:
+        out = psum(xs)
+    else:
+        out = psum(xs)
+    return out
+
+
+def clock_for_logging(xs, log):
+    started = time.monotonic()
+    out = psum(xs)
+    log(time.monotonic() - started)
+    return out

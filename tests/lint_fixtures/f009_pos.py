@@ -1,0 +1,23 @@
+# graftlint-fixture: G003=0
+# graftflow-fixture: F009=2
+"""True positives for F009: rank-local clock / queue state steering
+branches whose arms dispatch different collective schedules.
+
+Wall clocks and queue depths differ across ranks (one dispatcher runs
+ahead of another), so the branch diverges and one rank hangs at the
+unmatched rendezvous — the PR 16/18 serve-autoscale deadlock shape; the
+fix is replicated_decision(...).  Story: docs/ANALYSIS.md.
+"""
+import time
+
+
+def flush_on_deadline(xs, deadline):
+    if time.monotonic() > deadline:
+        return psum(xs)
+    return xs
+
+
+def drain_when_backed_up(work_q, xs):
+    if work_q.qsize() > 4:
+        return process_allgather(xs)
+    return xs

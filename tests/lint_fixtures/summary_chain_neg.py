@@ -1,0 +1,25 @@
+# graftlint-fixture: G003=0
+# graftflow-fixture: F001=0
+"""Near-miss for the two-deep chain: both arms call different helpers
+whose COMPUTED schedules are identical ([psum]), so the branch is
+schedule-symmetric even though the collectives are two calls away and
+no hand-table entry describes either helper.
+"""
+import jax
+
+
+def _left(x):
+    return psum(x)
+
+
+def _right(x):
+    return psum(x) * 2
+
+
+def caller(x):
+    pid = jax.process_index()
+    if pid == 0:
+        out = _left(x)
+    else:
+        out = _right(x)
+    return out

@@ -1,0 +1,27 @@
+# graftlint-fixture: G003=0
+# graftflow-fixture: F001=1
+"""Two-deep helper chain: caller -> _mid -> _leaf -> psum.
+
+Neither helper has a hand-table entry; the collective schedule reaches
+the branch check purely through the computed fixpoint summaries (the
+PR 19 acceptance pin:
+test_graftflow.py::test_two_deep_chain_needs_no_hand_entry).  The
+rank test is assignment-hidden, so the syntactic G003 stays silent —
+only the flow engine sees it.
+"""
+import jax
+
+
+def _leaf(x):
+    return psum(x)
+
+
+def _mid(x):
+    return _leaf(x) + 1
+
+
+def caller(x):
+    pid = jax.process_index()
+    if pid == 0:
+        return _mid(x)
+    return x

@@ -1,5 +1,5 @@
 # graftlint-fixture: G003=0
-# graftflow-fixture: F001=2 F003=1
+# graftflow-fixture: F001=1 F003=1 F009=1
 """True positives for the serve-dispatch hazard ISSUE 18 dodges: batch
 triggers evaluated against RANK-LOCAL state (a wall clock, this rank's
 queue view) gating collective-bearing dispatches.
@@ -8,9 +8,11 @@ Never executed — parsed by tests/test_graftflow.py. This is exactly the
 shape that forced PR 13 to disarm the async triggers at ws>1: each
 rank's timer fires at its own moment and each rank sees its own queue
 prefix, so the collective-bearing batch programs launch on some ranks
-and not others (F001) or different numbers of times (F003) — the
-deadlock class ``heat_tpu/serve/tick.py`` exists to prevent. Every site
-is invisible to the syntactic G003 (no rank spelled in the test).
+and not others (the clock-steered branch now lands in the dedicated
+F009 bucket with its replicated_decision fix-it; the shard-view branch
+stays F001) or different numbers of times (F003) — the deadlock class
+``heat_tpu/serve/tick.py`` exists to prevent. Every site is invisible
+to the syntactic G003 (no rank spelled in the test).
 """
 import time
 

@@ -15,6 +15,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 from heat_tpu.analysis import graftflow as gf
 from heat_tpu.analysis import graftlint as gl
 
@@ -215,10 +217,11 @@ def test_cli_json_contract():
     assert obj["tool"] == "graftflow"
     assert obj["schema_version"] == gf.SCHEMA_VERSION
     assert obj["total"] == 0 and obj["exit_code"] == 0
-    assert sorted(obj["counts"]) == sorted(gf.RULES)
+    # PR 19: the DRIFT hand-table diagnostic reports alongside the rules
+    assert sorted(obj["counts"]) == sorted(list(gf.RULES) + ["DRIFT"])
     assert all(v == 0 for v in obj["counts"].values())
     assert isinstance(obj["files_checked"], int) and obj["files_checked"] > 90
-    assert {r["id"] for r in obj["rules"]} == set(gf.RULES)
+    assert {r["id"] for r in obj["rules"]} == set(gf.RULES) | {"DRIFT"}
     for r in obj["rules"]:
         assert set(r) == {"id", "tag", "bit", "summary"}
     # the round trip itself: re-serialization must be lossless
@@ -270,6 +273,204 @@ def test_cli_runs_without_jax():
             "rc = cli.main(['heat_tpu/analysis'])\n"
             "assert 'jax' not in sys.modules, 'flow analysis imported jax!'\n"
             "assert 'heat_tpu' not in sys.modules, 'flow analysis imported heat_tpu!'\n"
+            "sys.exit(rc)",
+        ],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ------------------------------------------------------ graftcheck (unified)
+def _run_graftcheck(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join("tools", "graftcheck.py"), *args],
+        capture_output=True, text=True, cwd=REPO,
+    )
+
+
+def test_graftcheck_clean_exit_zero():
+    """The PR 19 acceptance gate: one graftcheck invocation over the
+    gated surface is clean at head."""
+    proc = _run_graftcheck(*GATED_PATHS)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "clean" in proc.stdout
+
+
+def test_graftcheck_merged_json_contract():
+    """One process, one line, both analyzers: the merged report carries
+    the union rule table and counts, per-tool sub-reports with each
+    tool's own bitmask, and the combined exit code."""
+    proc = _run_graftcheck(*GATED_PATHS, "--format", "json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    assert len(lines) == 1, "JSON mode must emit exactly one line"
+    obj = json.loads(lines[0])
+    missing = [k for k in REQUIRED_KEYS if k not in obj]
+    assert not missing, f"report missing keys: {missing}"
+    assert obj["tool"] == "graftcheck"
+    assert obj["total"] == 0 and obj["exit_code"] == 0
+    union = set(gf.RULES) | set(gl.RULES) | {"DRIFT"}
+    assert sorted(obj["counts"]) == sorted(union)
+    assert all(v == 0 for v in obj["counts"].values())
+    assert {r["id"] for r in obj["rules"]} == union
+    assert set(obj["tools"]) == {"graftlint", "graftflow"}
+    for sub in obj["tools"].values():
+        assert sub["total"] == 0 and sub["exit_code"] == 0
+    assert json.loads(json.dumps(obj)) == obj
+
+
+def test_graftcheck_combined_bitmask_and_select():
+    """The fixture corpus trips both analyzers: bit 1 (graftlint) and
+    bit 2 (graftflow) combine to 3; selecting one tool's rules silences
+    the other entirely."""
+    fixtures = os.path.join("tests", "lint_fixtures")
+    proc = _run_graftcheck(fixtures, "--format", "json")
+    obj = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert proc.returncode == 3
+    assert obj["exit_code"] == 3
+    assert {f["tool"] for f in obj["findings"]} == {"graftlint", "graftflow"}
+    # findings arrive merged in (path, line, col, rule) order
+    keys = [(f["path"], f["line"], f["col"], f["rule"]) for f in obj["findings"]]
+    assert keys == sorted(keys)
+    lint_only = _run_graftcheck(fixtures, "--select", "G003", "--format", "json")
+    lint_obj = json.loads(lint_only.stdout.strip().splitlines()[-1])
+    assert lint_only.returncode == 1
+    assert {f["rule"] for f in lint_obj["findings"]} == {"G003"}
+    flow_only = _run_graftcheck(fixtures, "--select", "F001", "--format", "json")
+    flow_obj = json.loads(flow_only.stdout.strip().splitlines()[-1])
+    assert flow_only.returncode == 2
+    assert {f["rule"] for f in flow_obj["findings"]} == {"F001"}
+
+
+# The SARIF 2.1.0 members GitHub code scanning actually rejects uploads
+# over — a structural subset of the official schema, validated offline.
+_SARIF_SUBSET_SCHEMA = {
+    "type": "object",
+    "required": ["version", "runs"],
+    "properties": {
+        "version": {"const": "2.1.0"},
+        "runs": {
+            "type": "array",
+            "minItems": 1,
+            "maxItems": 1,
+            "items": {
+                "type": "object",
+                "required": ["tool", "results"],
+                "properties": {
+                    "tool": {
+                        "type": "object",
+                        "required": ["driver"],
+                        "properties": {
+                            "driver": {
+                                "type": "object",
+                                "required": ["name", "rules"],
+                                "properties": {
+                                    "rules": {
+                                        "type": "array",
+                                        "items": {
+                                            "type": "object",
+                                            "required": ["id"],
+                                        },
+                                    },
+                                },
+                            },
+                        },
+                    },
+                    "results": {
+                        "type": "array",
+                        "items": {
+                            "type": "object",
+                            "required": ["ruleId", "message", "locations"],
+                            "properties": {
+                                "message": {
+                                    "type": "object",
+                                    "required": ["text"],
+                                },
+                                "locations": {
+                                    "type": "array",
+                                    "minItems": 1,
+                                    "items": {
+                                        "type": "object",
+                                        "required": ["physicalLocation"],
+                                        "properties": {
+                                            "physicalLocation": {
+                                                "type": "object",
+                                                "required": ["artifactLocation",
+                                                             "region"],
+                                                "properties": {
+                                                    "region": {
+                                                        "type": "object",
+                                                        "required": ["startLine"],
+                                                        "properties": {
+                                                            "startLine": {
+                                                                "type": "integer",
+                                                                "minimum": 1,
+                                                            },
+                                                            "startColumn": {
+                                                                "type": "integer",
+                                                                "minimum": 1,
+                                                            },
+                                                        },
+                                                    },
+                                                },
+                                            },
+                                        },
+                                    },
+                                },
+                            },
+                        },
+                    },
+                },
+            },
+        },
+    },
+}
+
+
+def test_graftcheck_sarif_is_schema_valid():
+    """SARIF output validates against the structural schema subset, on
+    both a clean tree (empty results) and the fixture corpus (every rule
+    id resolvable against the driver's rule table)."""
+    jsonschema = pytest.importorskip("jsonschema")
+    for paths, want_rc in ((GATED_PATHS, 0), (["tests/lint_fixtures"], 3)):
+        proc = _run_graftcheck(*paths, "--format", "sarif")
+        assert proc.returncode == want_rc, proc.stdout + proc.stderr
+        sarif = json.loads(proc.stdout)
+        jsonschema.validate(sarif, _SARIF_SUBSET_SCHEMA)
+        driver = sarif["runs"][0]["tool"]["driver"]
+        assert driver["name"] == "graftcheck"
+        rule_ids = {r["id"] for r in driver["rules"]}
+        assert rule_ids == set(gf.RULES) | set(gl.RULES) | {"DRIFT"}
+        for res in sarif["runs"][0]["results"]:
+            assert res["ruleId"] in rule_ids
+        if want_rc == 0:
+            assert sarif["runs"][0]["results"] == []
+
+
+def test_graftcheck_github_format():
+    proc = _run_graftcheck("heat_tpu", "--format", "github")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "::error" not in proc.stdout
+    dirty = _run_graftcheck(os.path.join("tests", "lint_fixtures"),
+                            "--select", "F001", "--format", "github")
+    assert dirty.returncode == 2
+    for line in dirty.stdout.strip().splitlines():
+        assert line.startswith("::error file="), line
+        assert "title=graftflow F001" in line
+
+
+def test_graftcheck_runs_without_jax():
+    """The unified gate must be runnable on a machine with no
+    accelerator runtime at all: both analyzers load by file path, and
+    neither jax nor heat_tpu may appear in sys.modules afterwards."""
+    proc = subprocess.run(
+        [
+            sys.executable, "-c",
+            "import sys\n"
+            "import tools.graftcheck as cli\n"
+            "rc = cli.main(['heat_tpu/analysis', '--format', 'json'])\n"
+            "assert 'jax' not in sys.modules, 'graftcheck imported jax!'\n"
+            "assert 'heat_tpu' not in sys.modules, 'graftcheck imported heat_tpu!'\n"
             "sys.exit(rc)",
         ],
         capture_output=True, text=True, cwd=REPO,

@@ -300,6 +300,9 @@ T = (rng.random((16, 4)) - 0.5).astype("float32")
 for sp in (None, 0):
     def run_qr(sp=sp):
         q, r = ht.linalg.qr(ht.array(T, split=sp))
+        # graftflow: F006 - single-controller differential harness: the
+        # case list is fixed, so every gather sits at the same point of
+        # the (single-process) schedule
         cmp(f"qr recon sp={sp}", q @ ht.array(r.numpy() if isinstance(r, ht.DNDarray) else r), T, rtol=1e-3, atol=1e-3)
     check(f"linalg/qr sp={sp}", run_qr)
 sweep("linalg/vecdot", lambda x: ht.linalg.vecdot(x, x), lambda a: (a * a).sum(-1), shapes=((5, 7),))
@@ -460,8 +463,10 @@ def t_dsort_wave():
             v, i = ht.sort(ht.array(x, split=0), descending=desc)
             import jax.numpy as jnp
             ref_i = np.asarray(jnp.argsort(x, descending=desc, stable=True))
+            # graftflow: F006 - single-controller differential harness,
+            # fixed case list (see run_qr above)
             np.testing.assert_array_equal(v.numpy(), np.take_along_axis(x, ref_i, 0))
-            np.testing.assert_array_equal(i.numpy(), ref_i)
+            np.testing.assert_array_equal(i.numpy(), ref_i)  # graftflow: F006 - same harness
 check("dsort/values+indices", t_dsort_wave)
 
 def t_percentile_methods():

@@ -1,0 +1,277 @@
+"""graftcheck — the unified SPMD static-analysis gate.
+
+One process, one invocation, both analyzers:
+
+- **graftlint** (``heat_tpu/analysis/graftlint.py``) — syntactic
+  invariants G001-G007 (collective symmetry by shape, host-sync
+  hygiene, manifest ordering);
+- **graftflow** (``heat_tpu/analysis/graftflow.py``) — flow-sensitive
+  taint analysis F001-F009 over computed interprocedural summaries,
+  plus the DRIFT hand-table diagnostic.
+
+Usage::
+
+    python tools/graftcheck.py [paths...] [--format text|json|github|sarif]
+                               [--select G003,F001,DRIFT] [--list-rules]
+
+or, installed, as the ``graftcheck`` entry point (``pyproject.toml``).
+Default paths mirror the repo gate: ``heat_tpu tools bench.py examples``.
+
+Exit code is a coarse combined bitmask (the merged JSON report carries
+the per-rule split and each tool's own fine-grained bitmask):
+
+    1   graftlint findings (any G rule)
+    2   graftflow findings (any F rule)
+    4   summary drift (DRIFT)
+    128 syntax / internal error in either analyzer
+
+Both analyzers are pure stdlib; this wrapper loads their files directly
+so a gate run never imports ``heat_tpu`` (and therefore never
+initializes jax or a backend — it must be runnable on a machine with no
+accelerator runtime at all; pinned by tests/test_flow_clean.py).
+"""
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+
+SCHEMA_VERSION = 1
+DEFAULT_PATHS = ["heat_tpu", "tools", "bench.py", "examples"]
+
+_SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+
+def _load(modname: str, filename: str):
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "heat_tpu", "analysis", filename,
+    )
+    spec = importlib.util.spec_from_file_location(modname, path)
+    mod = importlib.util.module_from_spec(spec)
+    # dataclass processing resolves cls.__module__ through sys.modules, so
+    # the module must be registered before its body executes
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _load_analyzers():
+    return (_load("_graftlint_impl", "graftlint.py"),
+            _load("_graftflow_impl", "graftflow.py"))
+
+
+def _split_select(select, lint_rules, flow_rules):
+    """Partition a --select set between the two analyzers; unknown ids
+    raise ValueError (same contract as the standalone CLIs)."""
+    if select is None:
+        return None, None
+    lint_sel, flow_sel = set(), set()
+    for rid in select:
+        if rid in lint_rules:
+            lint_sel.add(rid)
+        elif rid in flow_rules:
+            flow_sel.add(rid)
+        else:
+            raise ValueError(rid)
+    # selecting only one tool's rules silences the other entirely
+    return (lint_sel or {"__none__"}), (flow_sel or {"__none__"})
+
+
+def run_check(paths, select=None):
+    """Run both analyzers over one file set; returns the merged report."""
+    lint, flow = _load_analyzers()
+    flow_ids = set(flow.RULES) | {flow.DRIFT_RULE.id}
+    lint_sel, flow_sel = _split_select(select, set(lint.RULES), flow_ids)
+
+    lint_findings, files_checked = lint.lint_paths(paths, select=lint_sel)
+    flow_findings, _ = flow.analyze_paths(paths, select=flow_sel)
+
+    lint_report = lint.build_report(paths, lint_findings, files_checked)
+    flow_report = flow.build_report(paths, flow_findings, files_checked)
+
+    findings = (
+        [dict(f, tool="graftlint") for f in lint_report["findings"]]
+        + [dict(f, tool="graftflow") for f in flow_report["findings"]]
+    )
+    findings.sort(key=lambda f: (f["path"], f["line"], f["col"], f["rule"]))
+
+    counts = dict(lint_report["counts"])
+    counts.update(flow_report["counts"])
+
+    exit_code = 0
+    for f in findings:
+        rid = f["rule"]
+        if rid == "DRIFT":
+            exit_code |= 4
+        elif rid.startswith("G") and rid in lint.RULES:
+            exit_code |= 1
+        elif rid in flow.RULES:
+            exit_code |= 2
+        else:  # SYNTAX or an internal error marker from either tool
+            exit_code |= 128
+
+    return {
+        "tool": "graftcheck",
+        "schema_version": SCHEMA_VERSION,
+        "paths": list(paths),
+        "files_checked": files_checked,
+        "rules": lint_report["rules"] + flow_report["rules"],
+        "findings": findings,
+        "counts": counts,
+        "total": len(findings),
+        "exit_code": exit_code,
+        "tools": {
+            "graftlint": {"total": lint_report["total"],
+                          "exit_code": lint_report["exit_code"],
+                          "schema_version": lint_report["schema_version"]},
+            "graftflow": {"total": flow_report["total"],
+                          "exit_code": flow_report["exit_code"],
+                          "schema_version": flow_report["schema_version"]},
+        },
+    }
+
+
+def render_text(report: dict) -> str:
+    lines = []
+    for f in report["findings"]:
+        lines.append(
+            f"{f['path']}:{f['line']}:{f['col']}: {f['rule']} [{f['tool']}] "
+            f"{f['message']}"
+        )
+    lines.append(
+        f"graftcheck: {report['total']} finding(s) in "
+        f"{report['files_checked']} file(s)"
+        + (" — clean" if report["total"] == 0 else "")
+    )
+    return "\n".join(lines)
+
+
+def render_github(report: dict) -> str:
+    lines = []
+    for f in report["findings"]:
+        msg = f["message"].replace("%", "%25").replace("\n", "%0A")
+        lines.append(
+            f"::error file={f['path']},line={f['line']},col={f['col']},"
+            f"title={f['tool']} {f['rule']}::{msg}"
+        )
+    return "\n".join(lines)
+
+
+def render_sarif(report: dict) -> str:
+    """SARIF 2.1.0 — one run carrying both drivers' rule metadata, so
+    the output uploads directly to code-scanning UIs."""
+    rules = [
+        {
+            "id": r["id"],
+            "name": r["tag"].replace("-", " ").title().replace(" ", ""),
+            "shortDescription": {"text": r["summary"]},
+            "helpUri": "https://example.invalid/heat_tpu/docs/ANALYSIS.md",
+            "properties": {"exitBit": r["bit"]},
+        }
+        for r in report["rules"]
+    ]
+    results = [
+        {
+            "ruleId": f["rule"],
+            "level": "error",
+            "message": {"text": f"[{f['tool']}] {f['message']}"},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": f["path"]},
+                        "region": {
+                            "startLine": max(int(f["line"]), 1),
+                            "startColumn": max(int(f["col"]), 0) + 1,
+                        },
+                    }
+                }
+            ],
+        }
+        for f in report["findings"]
+    ]
+    sarif = {
+        "$schema": _SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "graftcheck",
+                        "informationUri":
+                            "https://example.invalid/heat_tpu",
+                        "version": f"{SCHEMA_VERSION}",
+                        "rules": rules,
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
+    return json.dumps(sarif, sort_keys=True)
+
+
+_EXIT_EPILOG = (
+    "exit code is a combined bitmask: 1=graftlint findings, "
+    "2=graftflow findings, 4=summary drift, 128=syntax/internal error; "
+    "0 means clean. Per-rule bits live in the JSON report "
+    "(table: docs/ANALYSIS.md)"
+)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="graftcheck",
+        description="unified SPMD static-analysis gate "
+                    "(graftlint G-rules + graftflow F-rules + DRIFT)",
+        epilog=_EXIT_EPILOG,
+    )
+    parser.add_argument("paths", nargs="*", default=None,
+                        help=f"files/dirs (default: {' '.join(DEFAULT_PATHS)})")
+    parser.add_argument("--format", choices=("text", "json", "github", "sarif"),
+                        default="text")
+    parser.add_argument("--select", default=None,
+                        help="comma-separated rule ids (G003,F001,DRIFT,...)")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    lint, flow = _load_analyzers()
+    if args.list_rules:
+        for r in list(lint.RULES.values()):
+            print(f"graftlint {r.id} {r.tag}: {r.summary}")
+        for r in list(flow.RULES.values()) + [flow.DRIFT_RULE]:
+            print(f"graftflow {r.id} {r.tag}: {r.summary}")
+        return 0
+
+    select = None
+    if args.select:
+        select = {s.strip() for s in args.select.split(",") if s.strip()}
+        known = set(lint.RULES) | set(flow.RULES) | {flow.DRIFT_RULE.id}
+        unknown = select - known
+        if unknown:
+            parser.error(f"unknown rule id(s): {', '.join(sorted(unknown))}")
+
+    paths = args.paths or [p for p in DEFAULT_PATHS if os.path.exists(p)]
+    report = run_check(paths, select=select)
+
+    if args.format == "json":
+        print(json.dumps(report, sort_keys=True))
+    elif args.format == "github":
+        out = render_github(report)
+        if out:
+            print(out)
+    elif args.format == "sarif":
+        print(render_sarif(report))
+    else:
+        print(render_text(report))
+    return report["exit_code"]
+
+
+if __name__ == "__main__":
+    sys.exit(main())

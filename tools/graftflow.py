@@ -6,9 +6,12 @@ Usage::
     python tools/graftflow.py --list-rules
 
 or, installed, as the ``graftflow`` entry point (``pyproject.toml``).
-Exit code is a per-finding bitmask (F001=1 ... F004=8, errors=128), so a
-CI step can tell *which* divergence class regressed from the status
-alone; ``--format github`` emits workflow annotations for PR review.
+Exit code is a per-finding bitmask (F001=1 ... F004=8, the F005–F009
+pack=16, DRIFT=32, errors=128), so a CI step can tell *which*
+divergence class regressed from the status alone; ``--format github``
+emits workflow annotations for PR review.  Prefer
+``tools/graftcheck.py`` for the combined graftlint+graftflow gate; this
+shim stays for single-analyzer runs.
 
 The analyzer itself lives in ``heat_tpu/analysis/graftflow.py`` and is
 pure stdlib; this wrapper loads that file directly so analysis never

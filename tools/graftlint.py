@@ -8,7 +8,9 @@ Usage::
 or, installed, as the ``graftlint`` entry point (``pyproject.toml``).
 Exit code is a per-rule bitmask (G001=1 ... G007=64, errors=128), so a CI
 step can tell *which* invariant class regressed from the status alone;
-``--format github`` emits workflow annotations for PR review.
+``--format github`` emits workflow annotations for PR review.  Prefer
+``tools/graftcheck.py`` for the combined graftlint+graftflow gate; this
+shim stays for single-analyzer runs.
 
 The checker itself lives in ``heat_tpu/analysis/graftlint.py`` and is
 pure stdlib; this wrapper loads that file directly so linting never
