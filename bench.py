@@ -96,6 +96,15 @@ force on an interactive client. Gated: tick-batched throughput >= 2x
 barrier-driven, 0 lockstep divergences, 0 warm compiles/traces, and at
 least one tick actually fired.
 
+An eleventh, ``sketch_pipeline`` (``bench.py --sketch-worker``, same
+8-virtual-device subprocess pattern), folds the three fixed-size
+sketches (KLL quantiles + HyperLogLog distinct + Count-Min top-k) in a
+SINGLE pass over the same gzip HDF5 chunk stream, against the exact
+in-memory comparator row (np.percentile + np.unique + full-count top-k
+on identical rows). Every error column is paired with the sketch's own
+promised bound and checked in-worker (``sketch_divergences``, gated
+== 0), and the warm pass is counter-asserted to 0 compiles/0 traces.
+
 Protocol r7 additionally bounds the two DMA-overlap-banded kernel
 diagnostics (``OVERLAP_BAND``): their best/best_median can never ratchet
 beyond 1.2x the trailing clean median, retiring the stale single-run
@@ -632,6 +641,7 @@ def main():
     out.update(ragged_bench())
     out.update(fused_bench())
     out.update(stream_bench())
+    out.update(sketch_bench())
     out.update(serve_bench())
     out.update(serve_ws2_bench())
     out.update(frame_bench())
@@ -1046,6 +1056,164 @@ def stream_worker():
                 "1.0x, not the prefetcher)"
             )
         print(json.dumps(result))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+SKETCH_ROWS = 1 << 18
+SKETCH_COLS = 16
+SKETCH_CHUNK = 1 << 15
+SKETCH_TOPK = 8
+
+
+def sketch_worker():
+    """Subprocess body for the ``sketch_pipeline`` workload: the three
+    fixed-size sketches (KLL quantiles + HyperLogLog distinct + Count-Min
+    top-k) folded in a SINGLE pass over the same gzip HDF5 chunk stream
+    the streaming estimators use, against the exact in-memory comparator
+    row (``np.percentile`` + ``np.unique`` + full-count top-k on the
+    identical rows).
+
+    The stream is a capped Zipf draw — discrete heavy-tailed data so all
+    three sketches are exercised by ONE source: big atoms for the
+    heavy-hitter sketch, a few thousand distinct values for the
+    cardinality sketch, and a stepped CDF that makes the KLL rank-error
+    check honest (rank error of an estimate against an atom is its
+    distance to the whole rank INTERVAL the atom occupies, not to one
+    arbitrary side of it).
+
+    Counters asserted, not assumed: the warm pass runs 0 XLA compiles
+    and 0 traces (``Region`` over COMPILE_STATS — one cached fold
+    program per sketch, replayed per chunk), and every reported error
+    column is paired with the sketch's own promised bound, checked
+    in-worker: KLL rank error <= ``eps``, HLL relative error <= the 4
+    sigma band of ``rel_error``, top-k recall == 1.0 over true heavy
+    hitters that clear the Count-Min noise floor. Misses count into
+    ``sketch_divergences`` (gated == 0 by tools/bench_check.py) — the
+    observed-vs-promised contract is the product here; the GB/s column
+    is the price tag."""
+    import shutil
+    import tempfile
+
+    import h5py
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from heat_tpu.analysis.sanitizer import Region
+    from heat_tpu.stream import (
+        ChunkIterator,
+        CountMinTopK,
+        HyperLogLog,
+        KLLSketch,
+    )
+
+    rows, cols, chunk = SKETCH_ROWS, SKETCH_COLS, SKETCH_CHUNK
+    rng = np.random.default_rng(11)
+    # capped Zipf: heavy hitters for CM, ~10^4 distinct values for HLL,
+    # discrete stepped CDF for the KLL interval rank check
+    data = np.minimum(rng.zipf(1.3, size=(rows, cols)), 20000).astype(np.float32)
+    tmp = tempfile.mkdtemp(prefix="heat_tpu_sketch_bench_")
+    path = os.path.join(tmp, "sketch.h5")
+    try:
+        with h5py.File(path, "w") as fh:
+            fh.create_dataset(
+                "data",
+                data=data,
+                compression="gzip",
+                compression_opts=1,
+                chunks=(chunk, cols),
+            )
+
+        def one_pass():
+            kll = KLLSketch(k=256)
+            hll = HyperLogLog(p=12)
+            cm = CountMinTopK(width=2048, depth=4, k=64)
+            for ch in ChunkIterator(path, chunk, dataset="data"):
+                kll.update(ch)
+                hll.update(ch)
+                cm.update(ch)
+            # one host fence: the pass is measured stream-to-state, and
+            # the states are a few KB each — fetching one register drains
+            # the async dispatch queue without touching the chunk loop
+            jax.block_until_ready(hll._regs)
+            return kll, hll, cm
+
+        one_pass()  # cold pass: compiles the three fold programs
+
+        region = Region("warm sketch pass")
+        kll, hll, cm = one_pass()
+        warm_compiles = region.compiles + region.traces
+        assert warm_compiles == 0, region.stats()
+
+        # exact comparator row: the same answers computed in memory
+        flat = data.ravel()
+        t0 = time.perf_counter()
+        exact_q = np.percentile(flat, [50.0, 90.0, 99.0])
+        uniq, counts = np.unique(flat, return_counts=True)
+        order = np.argsort(counts)[::-1]
+        true_top = uniq[order[:SKETCH_TOPK]]
+        exact_seconds = time.perf_counter() - t0
+
+        # observed vs promised, checked in-worker. KLL rank error of an
+        # estimate vs an atom-heavy CDF is the distance from q to the
+        # rank interval [P(X < est), P(X <= est)] the estimate occupies.
+        srt = np.sort(flat)
+        kll_err = 0.0
+        for q in (50.0, 90.0, 99.0):
+            est = float(kll.percentile(q).numpy())
+            lo = np.searchsorted(srt, est, side="left") / flat.size
+            hi = np.searchsorted(srt, est, side="right") / flat.size
+            kll_err = max(kll_err, lo - q / 100.0, q / 100.0 - hi, 0.0)
+        hll_err = abs(hll.distinct() - uniq.size) / uniq.size
+        hll_bound = 4.0 * hll.rel_error
+        # recall over true heavy hitters that clear the CM noise floor
+        # (eps * items): below it a hitter is indistinguishable from
+        # collision noise by the sketch's own promise
+        floor = cm.eps * cm.items
+        promised = true_top[counts[order[:SKETCH_TOPK]] > floor]
+        got_top = cm.topk(SKETCH_TOPK)[0].numpy()
+        recall = float(np.isin(promised, got_top).mean()) if promised.size else 1.0
+        divergences = int(kll_err > kll.eps) + int(hll_err > hll_bound) + int(
+            recall < 1.0
+        )
+
+        gb = rows * cols * 4 / 1e9
+
+        # best-of-2 and 4 decimals: the virtual-CPU fold is sort-bound
+        # (XLA CPU comparator sort, replicated over 8 virtual devices
+        # sharing the cores), so the honest number here is single-digit
+        # MB/s — the gate is > 0 plus the error contract, not the rate
+        def rate(attempts=2):
+            best = float("inf")
+            for _ in range(attempts):
+                t0 = time.perf_counter()
+                one_pass()
+                best = min(best, time.perf_counter() - t0)
+            return gb / best
+
+        print(
+            json.dumps(
+                {
+                    "sketch_gbps": round(rate(), 4),
+                    "sketch_exact_gbps": round(gb / exact_seconds, 4),
+                    "sketch_warm_compiles": int(warm_compiles),
+                    "sketch_divergences": divergences,
+                    "sketch_kll_rank_err": round(float(kll_err), 5),
+                    "sketch_kll_eps": round(float(kll.eps), 5),
+                    "sketch_hll_rel_err": round(float(hll_err), 5),
+                    "sketch_hll_bound": round(float(hll_bound), 5),
+                    "sketch_topk_recall": round(recall, 3),
+                    "sketch_exact_quantiles": [round(float(v), 1) for v in exact_q],
+                    "sketch_distinct_true": int(uniq.size),
+                    "sketch_unit": (
+                        f"GB/s of gzip HDF5 rows through KLL+HLL+CountMin "
+                        f"folds in one pass, chunk={chunk} rows (n={rows}, "
+                        f"f={cols}, 8 virtual CPU devices; exact row = "
+                        f"np.percentile+np.unique+top-k on the same data)"
+                    ),
+                }
+            )
+        )
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
@@ -1625,6 +1793,32 @@ def stream_bench():
         return {"stream_error": repr(e)[:400]}
 
 
+def sketch_bench():
+    """Run the sketch_pipeline workload ONCE in a fresh 8-virtual-CPU-
+    device subprocess and fold its JSON line into the output; a failure
+    degrades to a ``sketch_error`` field, never kills the bench."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--sketch-worker"],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=900,
+        )
+        lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+        if proc.returncode != 0 or not lines:
+            return {"sketch_error": (proc.stderr or proc.stdout or "no output")[-400:]}
+        return json.loads(lines[-1])
+    except Exception as e:  # noqa: BLE001 - diagnostics ride in the output
+        return {"sketch_error": repr(e)[:400]}
+
+
 def fused_bench():
     """Run the fused_pipeline workload ONCE in a fresh 8-virtual-CPU-
     device subprocess and fold its JSON line into the output; a failure
@@ -1741,6 +1935,16 @@ def _compact_summary(out, detail_path):
         "stream_warm_compiles",
         "stream_divergences",
         "stream_error",
+        "sketch_gbps",
+        "sketch_exact_gbps",
+        "sketch_warm_compiles",
+        "sketch_divergences",
+        "sketch_kll_rank_err",
+        "sketch_kll_eps",
+        "sketch_hll_rel_err",
+        "sketch_hll_bound",
+        "sketch_topk_recall",
+        "sketch_error",
         "serve_batched_speedup",
         "serve_requests_per_sec",
         "serve_p50_ms",
@@ -2519,6 +2723,8 @@ if __name__ == "__main__":
         fused_worker()
     elif "--stream-worker" in sys.argv:
         stream_worker()
+    elif "--sketch-worker" in sys.argv:
+        sketch_worker()
     elif "--serve-worker" in sys.argv:
         serve_worker()
     elif "--frame-worker" in sys.argv:

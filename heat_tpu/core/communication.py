@@ -29,6 +29,7 @@ from contextlib import contextmanager
 from typing import Dict, List, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
@@ -50,6 +51,8 @@ __all__ = [
     "replicated_decision",
     "replicated_ids",
     "replicated_frame",
+    "tree_merge",
+    "tree_merge_rounds",
 ]
 
 # canonical mesh-axis name carrying the DNDarray ``split`` dimension
@@ -606,6 +609,190 @@ def collective_lockstep(tree):
     if jax.process_count() > 1:
         jax.block_until_ready(tree)
     return tree
+
+
+def tree_merge_rounds(nproc: int) -> int:
+    """Exchange rounds :func:`tree_merge` dispatches for ``nproc``
+    processes: ``ceil(log2 P)`` on the butterfly path, 0 when it falls
+    back (P == 1, or a non-power-of-two world). Host-pure — the counter
+    oracle the multihost tests assert against."""
+    nproc = int(nproc)
+    if nproc <= 1 or nproc & (nproc - 1):
+        return 0
+    return nproc.bit_length() - 1
+
+
+# one jitted butterfly program per (combine, state structure, mesh) —
+# every fold-then-merge epoch re-dispatches the same executable
+_TREE_PROGRAMS: Optional[object] = None
+_PROCESS_MESH: Optional[Mesh] = None
+
+
+def _process_mesh() -> Mesh:
+    """One-device-per-process mesh (split axis = process index): the
+    substrate for replicated-state collectives. Each process contributes
+    its first addressable device, ordered by process index, so rank ==
+    ``jax.process_index`` on every controller."""
+    global _PROCESS_MESH
+    if _PROCESS_MESH is not None and _PROCESS_MESH.devices.size == jax.process_count():
+        return _PROCESS_MESH
+    first: Dict[int, object] = {}
+    for d in jax.devices():
+        first.setdefault(d.process_index, d)
+    devs = [first[i] for i in range(jax.process_count())]
+    _PROCESS_MESH = Mesh(np.array(devs), axis_names=(SPLIT_AXIS,))
+    return _PROCESS_MESH
+
+
+def tree_merge(state, combine, *, label: str = "collective.tree_merge", active: bool = True):
+    """Merge one replicated-state pytree per process into the identical
+    global state on EVERY process in ``ceil(log2 P)`` ``ppermute`` rounds
+    — the log-depth alternative to allgathering all P states and folding
+    them serially.
+
+    ``state`` is a pytree of (host or device) arrays — one streaming
+    estimator's state as held by THIS process; every process must pass
+    the same tree structure, leaf shapes, and dtypes (a rank-dependent
+    shape would desync the exchange itself). ``combine`` is a pure,
+    jax-traceable, associative function ``(tree_a, tree_b) -> tree`` with
+    ``tree_a`` the lower-rank operand; it must preserve leaf shapes and
+    dtypes. The result on every process is the rank-ordered combination
+    ``s_0 ⊕ s_1 ⊕ ... ⊕ s_{P-1}`` with the SAME balanced-tree bracketing
+    everywhere, so the merged state is bit-identical across processes —
+    replicated-state discipline holds by construction.
+
+    Rounds: an XOR butterfly over a one-device-per-process mesh — round
+    ``d`` pairs rank ``r`` with ``r ^ d`` (one full-permutation
+    ``ppermute`` each), ``log2 P`` rounds total, counted in
+    ``MOVE_STATS["tree_merge_rounds"]``. A non-power-of-two world has no
+    single-permutation butterfly; it falls back to one flat
+    ``process_allgather`` + a rank-ordered serial fold (still identical
+    on every process, rounds counted as 0). ``active=False`` — or a
+    single-process world — returns ``state`` unchanged.
+
+    The dispatch runs under the collective watchdog (``label``) and is
+    pinned with :func:`collective_lockstep`, so independent merges of
+    several estimators stay rendezvous-ordered across controllers.
+    """
+    nproc = jax.process_count()
+    if not active or nproc == 1:
+        return state
+    from . import _hooks
+
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    np_leaves = [np.asarray(x) for x in leaves]
+
+    def impl():
+        _hooks.fault_point(
+            label,
+            leaves=len(np_leaves),
+            shapes=tuple(tuple(x.shape) for x in np_leaves),
+            dtypes=tuple(str(x.dtype) for x in np_leaves),
+        )
+        if nproc & (nproc - 1):  # no butterfly off powers of two
+            out = _flat_state_merge(np_leaves, treedef, combine, nproc)
+        else:
+            out = _butterfly_state_merge(np_leaves, treedef, combine, nproc)
+        from ..parallel.flatmove import MOVE_STATS
+
+        MOVE_STATS["tree_merges"] += 1
+        MOVE_STATS["tree_merge_rounds"] += tree_merge_rounds(nproc)
+        return out
+
+    merged = _hooks.guarded_call(label, impl)
+    return collective_lockstep(merged)
+
+
+def _flat_state_merge(np_leaves, treedef, combine, nproc):
+    """Fallback: allgather every process's leaves, fold in rank order.
+    Serial (P-1 combines) but structurally identical output on all
+    ranks; used off power-of-two worlds."""
+    from jax.experimental import multihost_utils
+
+    gathered = [
+        np.asarray(multihost_utils.process_allgather(x)).reshape((nproc,) + x.shape)
+        for x in np_leaves
+    ]
+    acc = jax.tree_util.tree_unflatten(
+        treedef, [jnp.asarray(g[0]) for g in gathered]
+    )
+    for r in range(1, nproc):
+        nxt = jax.tree_util.tree_unflatten(
+            treedef, [jnp.asarray(g[r]) for g in gathered]
+        )
+        acc = combine(acc, nxt)
+    return acc
+
+
+def _butterfly_state_merge(np_leaves, treedef, combine, nproc):
+    from jax import lax, shard_map
+
+    from ._cache import ExecutableCache
+
+    global _TREE_PROGRAMS
+    if _TREE_PROGRAMS is None:
+        _TREE_PROGRAMS = ExecutableCache(maxsize=32)
+    pmesh = _process_mesh()
+    pid = jax.process_index()
+    my_dev = pmesh.devices.ravel()[pid]
+
+    # each process donates its own row of the (P, *shape) stacked state
+    stacked = []
+    for x in np_leaves:
+        pshape = (nproc,) + x.shape
+        sharding = NamedSharding(
+            pmesh, PartitionSpec(SPLIT_AXIS, *([None] * x.ndim))
+        )
+        local = jax.device_put(x[None], my_dev)
+        stacked.append(
+            jax.make_array_from_single_device_arrays(pshape, sharding, [local])
+        )
+
+    key = (
+        "tree_merge",
+        combine,
+        treedef,
+        tuple((tuple(x.shape), str(x.dtype)) for x in np_leaves),
+        pmesh,
+    )
+    fn = _TREE_PROGRAMS.get(key)
+    if fn is None:
+
+        def kernel(*blocks):  # each (1, *shape): this rank's state
+            r = lax.axis_index(SPLIT_AXIS)
+            acc = [b[0] for b in blocks]
+            d = 1
+            while d < nproc:
+                perm = [(i, i ^ d) for i in range(nproc)]
+                recv = [lax.ppermute(a, SPLIT_AXIS, perm) for a in acc]
+                own_t = jax.tree_util.tree_unflatten(treedef, acc)
+                rec_t = jax.tree_util.tree_unflatten(treedef, recv)
+                # rank-ordered operands: the lower rank of each pair goes
+                # first, so every rank applies the same balanced tree
+                lo = jax.tree_util.tree_leaves(combine(own_t, rec_t))
+                hi = jax.tree_util.tree_leaves(combine(rec_t, own_t))
+                low_first = (r & d) == 0
+                acc = [jnp.where(low_first, a, b) for a, b in zip(lo, hi)]
+                d <<= 1
+            return tuple(a[None] for a in acc)
+
+        specs = tuple(
+            PartitionSpec(SPLIT_AXIS, *([None] * x.ndim)) for x in np_leaves
+        )
+        # every rank's block carries the identical merged state by
+        # construction, which the varying-mesh-axes analysis cannot infer
+        prog = shard_map(
+            kernel, mesh=pmesh, in_specs=specs, out_specs=specs, check_vma=False
+        )
+        fn = _TREE_PROGRAMS[key] = jax.jit(prog)
+    outs = fn(*stacked)
+    # read this process's (identical) copy back off the process mesh; host
+    # round-trip decommits the leaf so downstream arithmetic is free to
+    # place results wherever the estimator's other arrays live
+    merged_leaves = [
+        jnp.asarray(np.asarray(o.addressable_shards[0].data)[0]) for o in outs
+    ]
+    return jax.tree_util.tree_unflatten(treedef, merged_leaves)
 
 
 def _split_ranks(comm: MeshCommunication):

@@ -347,11 +347,47 @@ def nanmean(x: DNDarray, axis=None, out=None, keepdim=None, keepdims=None) -> DN
     return _reduce_op(jnp.nanmean, x, axis=axis, out=out, keepdims=bool(keepdim or keepdims), neutral=("nan", None))
 
 
+def _streaming_percentile(chunks, q_host, axis, kd) -> DNDarray:
+    """Single-pass approximate percentile over a ``ChunkIterator`` via a
+    KLL sketch (rank error <= the sketch's ``eps``, ~1.4% at defaults)."""
+    if axis is not None:
+        raise ValueError(
+            "streaming percentile/median folds all elements (axis=None "
+            f"semantics); per-axis reduction is not supported, got axis={axis}"
+        )
+    if kd:
+        raise ValueError("keepdim is not supported on the streaming path")
+    from ..stream.sketch import KLLSketch
+
+    sk = KLLSketch()
+    for chunk in chunks:
+        sk.update(chunk)
+    return sk.percentile(q_host.tolist())
+
+
+def _check_array_arg(x, name: str):
+    """Reject non-DNDarray inputs with a message that names the streaming
+    sketch path — a ``ChunkIterator`` is valid, anything else is not."""
+    if not isinstance(x, DNDarray):
+        raise TypeError(
+            f"{name} expects a DNDarray (exact, in-memory) or a "
+            "heat_tpu.stream.ChunkIterator (single-pass approximate KLL "
+            f"sketch path), got {type(x).__name__}"
+        )
+
+
 def median(x: DNDarray, axis=None, keepdim: bool = False, keepdims=None) -> DNDarray:
     """Median (reference ``statistics.py:1017``, gather-based; when the
     reduced axis is the split axis the distributed-sort percentile path
-    runs instead — O(n/P) memory, see :func:`percentile`)."""
+    runs instead — O(n/P) memory, see :func:`percentile`). A
+    ``ChunkIterator`` input streams through the KLL sketch instead
+    (approximate, see ``docs/STREAMING.md``)."""
     kd = bool(keepdim or keepdims)
+    from ..stream.chunked import ChunkIterator
+
+    if isinstance(x, ChunkIterator):
+        return _streaming_percentile(x, np.asarray(50.0), axis, kd)
+    _check_array_arg(x, "median")
     axis_s = sanitize_axis(x.shape, axis)
     if _use_sorted_percentile(x, axis_s):
         result = _sorted_percentile(x, jnp.asarray(50.0), axis_s, "linear", kd)
@@ -458,14 +494,28 @@ def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear
     When the reduced axis is the split axis, the computation routes
     through the distributed transposition sort + O(q) element takes
     (:mod:`heat_tpu.parallel.dsort`) instead of ``jnp.percentile`` on the
-    logical view, which would all-gather the full array to every device."""
+    logical view, which would all-gather the full array to every device.
+
+    A ``ChunkIterator`` input streams through the KLL sketch instead:
+    single-pass, fixed memory, approximate within the sketch's rank-error
+    bound (see ``docs/STREAMING.md``)."""
     kd = bool(keepdim or keepdims)
-    axis_s = sanitize_axis(x.shape, axis)
     q_arr = q._logical() if isinstance(q, DNDarray) else jnp.asarray(q)
     q_host = np.asarray(q_arr)  # graftlint: host-sync - O(q) scalars, validated eagerly
     # negated all-form so NaN q fails too, like numpy
     if q_host.size and not np.all((q_host >= 0) & (q_host <= 100)):
         raise ValueError("percentiles must be in the range [0, 100]")
+    from ..stream.chunked import ChunkIterator
+
+    if isinstance(x, ChunkIterator):
+        res = _streaming_percentile(x, q_host, axis, kd)
+        if out is not None:
+            from ._operations import _write_out
+
+            return _write_out(out, res)
+        return res
+    _check_array_arg(x, "percentile")
+    axis_s = sanitize_axis(x.shape, axis)
     method = {"lower": "lower", "higher": "higher", "midpoint": "midpoint", "nearest": "nearest", "linear": "linear"}[interpolation]
     if (axis_s is None or isinstance(axis_s, int)) and not types.issubdtype(
         x.dtype, types.complexfloating

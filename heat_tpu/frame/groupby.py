@@ -9,6 +9,16 @@ non-associative agg needs is *derived* afterwards from associative
 pieces with plain DNDarray arithmetic — which keeps the finalize step
 capturable by ``ht.lazy()``, so ``groupby → agg → filter`` chains fuse
 into one replayed program.
+
+``quantile`` is the one agg that is NOT associative in bounded memory,
+so it does not ride the shuffle at all: each process folds its local
+shard rows into one KLL sketch per (key, column) — a single vmapped
+device dispatch per column — and ONE log-depth
+:func:`~heat_tpu.core.communication.tree_merge` combines the per-key
+sketch states across processes (``bucket_moves`` stays 0; only the
+small key-union ragged allgather and the sketch-state butterfly move).
+The answer is approximate within the KLL rank-error bound,
+``(3 + ceil(log2 P)) / (2k)`` of each group's row count.
 """
 from __future__ import annotations
 
@@ -20,6 +30,15 @@ from ..core.dndarray import DNDarray
 from ._shuffle import groupby_reduce
 
 __all__ = ["FrameGroupBy", "AGGS"]
+
+
+def _grouped_kll_combine(a, b):
+    """Per-column dict of vmapped KLL combines — the ``tree_merge``
+    operand for :meth:`FrameGroupBy.quantile` (module-level: its identity
+    keys the butterfly program cache)."""
+    from ..stream.sketch.kll import grouped_merge_states
+
+    return {c: grouped_merge_states(a[c], b[c]) for c in a}
 
 AGGS = ("sum", "mean", "min", "max", "count", "std")
 
@@ -146,6 +165,85 @@ class FrameGroupBy:
         from .frame import Frame
 
         return Frame._wrap(out)
+
+    # ------------------------------------------------- approximate quantile
+    def quantile(self, q: float = 0.5, k: int = 256, levels: int = 8):
+        """Approximate per-group quantile of every value column WITHOUT a
+        shuffle (see the module docstring for the mechanism and bound).
+
+        ``q`` is a fraction in [0, 1] (pandas convention). ``k`` /
+        ``levels`` size the per-group KLL sketches. Returns a
+        :class:`Frame` keyed by the sorted distinct keys, one column per
+        value column, replicated-exact across processes.
+        """
+        q = float(q)
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"q must be a fraction in [0, 1], got {q}")
+        frame, key = self._frame, self._key
+        value_cols = [n for n in frame.columns if n != key]
+        if not value_cols:
+            raise ValueError("quantile needs at least one value column")
+        import jax.numpy as jnp
+
+        from ..core.communication import ragged_process_allgather, tree_merge
+        from ..stream.sketch import kll
+
+        # ---- host-local grouping: trimmed shard rows, bucketed by key
+        def host_rows(col: str) -> np.ndarray:
+            blocks = [
+                np.asarray(sh)  # graftlint: host-sync - local shard staging
+                for _, sh in frame[col]._iter_local_shards(dedup=True)
+            ]
+            dt = np.dtype(frame[col]._raw.dtype)
+            return np.concatenate(blocks) if blocks else np.empty((0,), dt)
+
+        keys_local = host_rows(key)
+        uniq_local = np.unique(keys_local)
+        union = np.unique(np.concatenate(ragged_process_allgather(uniq_local)))
+        G = union.size
+        order = np.argsort(keys_local, kind="stable")
+        sorted_keys = keys_local[order]
+        starts = np.searchsorted(sorted_keys, union, side="left")
+        ends = np.searchsorted(sorted_keys, union, side="right")
+        counts = (ends - starts).astype(np.int32)
+        lmax = max(int(counts.max(initial=0)), 1)
+
+        # ---- one vmapped KLL fold per column, one tree_merge for all
+        state: Dict[str, tuple] = {}
+        v0 = jnp.full((G, levels, k), jnp.inf, jnp.float32)
+        w0 = jnp.zeros((G, levels, k), jnp.float32)
+        prog = kll._grouped_fold_program(k, levels)
+        for c in value_cols:
+            rows = host_rows(c).astype(np.float32)[order]
+            padded = np.zeros((G, lmax, 1), np.float32)
+            for g in range(G):
+                padded[g, : counts[g], 0] = rows[starts[g] : ends[g]]
+            vals, wts = prog(jnp.asarray(padded), jnp.asarray(counts), v0, w0)
+            state[c] = (
+                jnp.asarray(counts),
+                jnp.ones((G,), jnp.int32),
+                vals,
+                wts,
+            )
+        merged = tree_merge(
+            state, _grouped_kll_combine, label="collective.groupby_quantile"
+        )
+
+        # ---- finalize: per-group quantile eval + replicated host columns
+        from ..core import factories
+
+        out: Dict[str, DNDarray] = {}
+        out[key] = union
+        qs = jnp.asarray([q], jnp.float32)
+        for c in value_cols:
+            _, _, vals, wts = merged[c]
+            res = kll._grouped_quantile(vals, wts, qs)[:, 0]
+            out[c] = np.asarray(res)  # graftlint: host-sync - O(G) finalize
+        from .frame import Frame
+
+        return Frame(
+            {name: factories.array(colv, split=0) for name, colv in out.items()}
+        )
 
     # -------------------------------------------------------- conveniences
     def sum(self):

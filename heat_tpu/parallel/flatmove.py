@@ -81,7 +81,15 @@ __all__ = [
 # e.g. redistribute→elementwise→redistribute must cost exactly ONE move.
 # ``bucket_moves`` sub-counts the shuffle engine's bucketed exchanges
 # (every bucket move is also a ragged move for budget purposes).
-MOVE_STATS = {"ragged_moves": 0, "bucket_moves": 0}
+# ``tree_merges``/``tree_merge_rounds`` count ``communication.tree_merge``
+# dispatches and their ppermute rounds — the rounds == ceil(log2 P)
+# contract the multihost tests assert.
+MOVE_STATS = {
+    "ragged_moves": 0,
+    "bucket_moves": 0,
+    "tree_merges": 0,
+    "tree_merge_rounds": 0,
+}
 
 
 class Edge(NamedTuple):

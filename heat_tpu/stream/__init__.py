@@ -14,6 +14,12 @@ Public surface (see ``docs/STREAMING.md`` for the walkthrough):
   :class:`~heat_tpu.stream.estimators.StreamingHistogram` — single-pass
   estimators via pairwise merge formulas, oracle-equal to the in-memory
   ``ht.mean/var/cov/histogram``;
+- :class:`~heat_tpu.stream.sketch.KLLSketch` /
+  :class:`~heat_tpu.stream.sketch.HyperLogLog` /
+  :class:`~heat_tpu.stream.sketch.CountMinTopK` — mergeable sketches for
+  the order/identity questions exact streaming can't bound: approximate
+  percentiles, distinct counts, heavy hitters (see
+  :mod:`heat_tpu.stream.sketch` for the state-size/error table);
 - :class:`~heat_tpu.stream.groupby.StreamingGroupBy` — bounded-memory
   per-key aggregation: chunks fold into a fixed-capacity replicated
   (key, statistics) table with the same associative contract as the
@@ -29,12 +35,13 @@ chunks ahead of the consumer (plus the chunk being consumed) no matter
 how large the dataset is; the warm chunk loop re-dispatches cached
 executables — 0 traces / 0 compiles per chunk.
 """
-from . import chunked, estimators, groupby, prefetch
+from . import chunked, estimators, groupby, prefetch, sketch
 from ._stats import STREAM_STATS, reset_stream_stats
 from .chunked import ChunkIterator
 from .estimators import StreamingCov, StreamingHistogram, StreamingMoments
 from .groupby import StreamingGroupBy
 from .prefetch import Prefetcher
+from .sketch import CountMinTopK, HyperLogLog, KLLSketch
 
 __all__ = [
     "ChunkIterator",
@@ -43,6 +50,9 @@ __all__ = [
     "StreamingCov",
     "StreamingHistogram",
     "StreamingGroupBy",
+    "KLLSketch",
+    "HyperLogLog",
+    "CountMinTopK",
     "STREAM_STATS",
     "reset_stream_stats",
 ]

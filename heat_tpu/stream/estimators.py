@@ -40,7 +40,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core._cache import ExecutableCache
-from ..core.communication import collective_lockstep
+from ..core.communication import collective_lockstep, tree_merge
 from ..core.dndarray import DNDarray
 
 __all__ = ["StreamingMoments", "StreamingCov", "StreamingHistogram"]
@@ -48,6 +48,38 @@ __all__ = ["StreamingMoments", "StreamingCov", "StreamingHistogram"]
 # one entry per estimator kind (histogram: per bin count) — the chunk
 # loop re-dispatches the same executable every chunk
 _PROGRAMS = ExecutableCache(maxsize=64)
+
+
+# -- pure cross-process state combines (the ``tree_merge`` operands) -------
+#
+# Module-level (stable identity keys the butterfly program cache) and
+# jax-traceable: counts travel as an int32 leaf so huge row totals stay
+# exact, and are cast to the statistic dtype only inside the arithmetic.
+
+def _combine_moments(a, b):
+    na, mean_a, m2a = a
+    nb, mean_b, m2b = b
+    naf, nbf = na.astype(mean_a.dtype), nb.astype(mean_a.dtype)
+    nf = jnp.maximum(naf + nbf, 1.0)
+    delta = mean_b - mean_a
+    m2 = m2a + m2b + delta * delta * (naf * nbf / nf)
+    mean = mean_a + delta * (nbf / nf)
+    return na + nb, mean, m2
+
+
+def _combine_cov(a, b):
+    na, mean_a, ca = a
+    nb, mean_b, cb = b
+    naf, nbf = na.astype(mean_a.dtype), nb.astype(mean_a.dtype)
+    nf = jnp.maximum(naf + nbf, 1.0)
+    delta = mean_b - mean_a
+    c = ca + cb + jnp.outer(delta, delta) * (naf * nbf / nf)
+    mean = mean_a + delta * (nbf / nf)
+    return na + nb, mean, c
+
+
+def _combine_hist(a, b):
+    return a[0] + b[0], a[1] + b[1]
 
 
 def _mask(xa: jnp.ndarray, n_valid):
@@ -192,6 +224,26 @@ class _StreamingBase:
     def _wrap(self, arr) -> DNDarray:
         return DNDarray(arr, split=None, device=self._device, comm=self._comm)
 
+    # -- cross-process merge (ROADMAP item 1 leftover) --------------------
+    _COMBINE = None  # subclass: pure (tree_a, tree_b) -> tree on _state()
+
+    def _state(self):  # subclass: pytree of jnp leaves (n travels int32)
+        raise NotImplementedError
+
+    def _set_state(self, state):  # subclass: inverse of _state()
+        raise NotImplementedError
+
+    def merge_processes(self):
+        """Fold every process's state into the identical global state on
+        every process via :func:`~heat_tpu.core.communication.tree_merge`
+        — ``ceil(log2 P)`` ppermute rounds instead of allgathering P
+        states. Collective: every process must call it after folding its
+        own chunks (each must have folded at least one chunk, so state
+        shapes agree). A single-process world is a no-op."""
+        self._require_data()
+        self._set_state(tree_merge(self._state(), type(self)._COMBINE))
+        return self
+
 
 class StreamingMoments(_StreamingBase):
     """Single-pass per-column mean/var/std (axis-0, like
@@ -231,6 +283,15 @@ class StreamingMoments(_StreamingBase):
         self._mean = self._mean + delta * (nb / n)
         self._n += other._n
         return self
+
+    _COMBINE = staticmethod(_combine_moments)
+
+    def _state(self):
+        return jnp.int32(self._n), self._mean, self._m2
+
+    def _set_state(self, state):
+        n, self._mean, self._m2 = state
+        self._n = int(n)
 
     @property
     def mean(self) -> DNDarray:
@@ -286,6 +347,15 @@ class StreamingCov(_StreamingBase):
         self._mean = self._mean + delta * (nb / n)
         self._n += other._n
         return self
+
+    _COMBINE = staticmethod(_combine_cov)
+
+    def _state(self):
+        return jnp.int32(self._n), self._mean, self._c
+
+    def _set_state(self, state):
+        n, self._mean, self._c = state
+        self._n = int(n)
 
     @property
     def mean(self) -> DNDarray:
@@ -343,6 +413,15 @@ class StreamingHistogram(_StreamingBase):
         self._counts = self._counts + other._counts
         self._n += other._n
         return self
+
+    _COMBINE = staticmethod(_combine_hist)
+
+    def _state(self):
+        return jnp.int32(self._n), self._counts
+
+    def _set_state(self, state):
+        n, self._counts = state
+        self._n = int(n)
 
     @property
     def hist(self) -> DNDarray:

@@ -1,0 +1,27 @@
+"""Mergeable sketches: fixed-size device-resident approximate analytics.
+
+Where the :mod:`heat_tpu.stream.estimators` answer *moment* questions
+(mean/var/cov/histogram) exactly up to float re-association, the
+sketches answer *order and identity* questions — quantiles, distinct
+counts, heavy hitters — that exact streaming cannot do in bounded
+memory. Each sketch is a tiny fixed-shape state folded by one cached
+jitted program per chunk (0-trace/0-compile warm, like the estimators)
+with a pure associative ``merge_states`` combine serving pairwise
+``merge()``, the vmapped per-group fold under
+``Frame.groupby(...).quantile``, and the cross-process log-depth
+:func:`~heat_tpu.core.communication.tree_merge` behind
+``merge_processes()``.
+
+=================  ======================  =========================
+sketch             state                   promised error
+=================  ======================  =========================
+``KLLSketch``      2 x levels x k values   rank error <= ``eps``
+``HyperLogLog``    2^p int32 registers     std err ``1.04/sqrt(2^p)``
+``CountMinTopK``   depth x width + k keys  overcount <= ``e*N/width``
+=================  ======================  =========================
+"""
+from .countmin import CountMinTopK
+from .hll import HyperLogLog
+from .kll import KLLSketch
+
+__all__ = ["KLLSketch", "HyperLogLog", "CountMinTopK"]

@@ -1,0 +1,156 @@
+"""HyperLogLog distinct-count sketch — fixed 2^p registers, merge = max.
+
+One device-resident ``(2^p,)`` int32 register file; each fold hashes
+every chunk element (float bits -> murmur3 finalizer on uint32),
+splits the hash into a ``p``-bit register index and a
+leading-zero-count rank (``lax.clz``), and scatter-maxes the rank into
+the registers — ONE jitted program per ``p``, so a warm
+``ChunkIterator`` pass is 0-trace/0-compile like every other streaming
+estimator. The estimate is the classic bias-corrected harmonic mean
+with the linear-counting small-range and 32-bit large-range
+corrections (Flajolet et al. '07); relative standard error is
+``1.04 / sqrt(2^p)``, exposed as :attr:`HyperLogLog.rel_error` and
+asserted (as a multiple-of-sigma band) by the oracle tests and bench.
+
+Registers combine by elementwise max — trivially associative and
+commutative, so :func:`merge_states` is the ``tree_merge`` operand for
+the cross-process path as well as the pairwise ``merge()``.
+
+Values are hashed at float32 precision (``-0.0`` canonicalized to
+``0.0``): distinct counting treats two f64 values that collide in f32
+as one, which is inside the sketch's own error for realistic streams.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core._cache import ExecutableCache
+from ...core.communication import collective_lockstep
+from ...core.dndarray import DNDarray
+from ..estimators import _StreamingBase
+
+__all__ = ["HyperLogLog", "merge_states"]
+
+_PROGRAMS = ExecutableCache(maxsize=64)
+
+
+def _hash_u32(x, seed: int = 0):
+    """murmur3 finalizer over float32 bit patterns (uint32 -> uint32)."""
+    h = lax.bitcast_convert_type(
+        jnp.where(x == 0.0, 0.0, x).astype(jnp.float32), jnp.uint32
+    )
+    h = h ^ jnp.uint32(seed)
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def merge_states(a, b):
+    """Pure associative combine of two HLL states ``(n:int32, regs)``."""
+    return a[0] + b[0], jnp.maximum(a[1], b[1])
+
+
+def _fold(xa, n_valid, regs, p):
+    m = regs.shape[0]
+    valid = jnp.broadcast_to(
+        (jnp.arange(xa.shape[0]) < n_valid)[:, None], xa.shape
+    ).ravel()
+    h = _hash_u32(xa.ravel())
+    idx = (h >> (32 - p)).astype(jnp.int32)
+    w = h << p  # low p bits vacate: suffix of 0 -> w == 0 -> max rank
+    rho = jnp.minimum(lax.clz(w.astype(jnp.int32)) + 1, 32 - p + 1)
+    rho = jnp.where(valid, rho, 0).astype(jnp.int32)
+    return regs.at[jnp.where(valid, idx, 0)].max(rho), m
+
+
+def _fold_program(p: int):
+    key = ("hll_fold", p)
+    prog = _PROGRAMS.get(key)
+    if prog is None:
+        from functools import partial
+
+        prog = _PROGRAMS[key] = jax.jit(partial(_fold, p=p))
+    return prog
+
+
+class HyperLogLog(_StreamingBase):
+    """Streaming approximate distinct-element count over chunk elements.
+
+    Parameters
+    ----------
+    p : int
+        Register-count exponent in [4, 16] (default 12 -> 4096 registers,
+        ~1.6% relative standard error, 16 KiB of state).
+    """
+
+    def __init__(self, p: int = 12):
+        super().__init__()
+        if not 4 <= p <= 16:
+            raise ValueError(f"p must be in [4, 16], got {p}")
+        self.p = int(p)
+        self.m = 1 << self.p
+        self._regs = None
+
+    def update(self, chunk: DNDarray) -> "HyperLogLog":
+        xa, nv = self._capture(chunk)
+        if self._regs is None:
+            self._regs = jnp.zeros((self.m,), jnp.int32)
+        regs, _ = collective_lockstep(_fold_program(self.p)(xa, nv, self._regs))
+        self._regs = regs
+        self._n += int(chunk.gshape[0])
+        return self
+
+    def merge(self, other: "HyperLogLog") -> "HyperLogLog":
+        """Fold ``other``'s registers into this one (pairwise max)."""
+        if self.p != other.p:
+            raise ValueError("cannot merge HyperLogLogs with different p")
+        self._require_data()
+        other._require_data()
+        self._set_state(
+            collective_lockstep(merge_states(self._state(), other._state()))
+        )
+        return self
+
+    _COMBINE = staticmethod(merge_states)
+
+    def _state(self):
+        return jnp.int32(self._n), self._regs
+
+    def _set_state(self, state):
+        n, self._regs = state
+        self._n = int(n)
+
+    @property
+    def rel_error(self) -> float:
+        """Relative standard error of the estimate: ``1.04 / sqrt(2^p)``."""
+        return 1.04 / math.sqrt(self.m)
+
+    def distinct(self) -> float:
+        """Bias-corrected cardinality estimate (small/large-range
+        corrected)."""
+        self._require_data()
+        m = float(self.m)
+        if m <= 16:
+            alpha = 0.673
+        elif m <= 32:
+            alpha = 0.697
+        elif m <= 64:
+            alpha = 0.709
+        else:
+            alpha = 0.7213 / (1.0 + 1.079 / m)
+        regs = jnp.asarray(self._regs, jnp.float32)
+        est = float(alpha * m * m / jnp.sum(jnp.exp2(-regs)))
+        zeros = float(jnp.sum(self._regs == 0))
+        if est <= 2.5 * m and zeros > 0:
+            return m * math.log(m / zeros)
+        two32 = float(1 << 32)
+        if est > two32 / 30.0:
+            return -two32 * math.log(1.0 - est / two32)
+        return est

@@ -40,6 +40,16 @@ def _synthetic_out():
         "stream_warm_compiles": 0,
         "stream_divergences": 0,
         "stream_unit": "u" * 60,
+        "sketch_gbps": 0.0004,
+        "sketch_exact_gbps": 0.018,
+        "sketch_warm_compiles": 0,
+        "sketch_divergences": 0,
+        "sketch_kll_rank_err": 0.0005,
+        "sketch_kll_eps": 0.0117,
+        "sketch_hll_rel_err": 0.0007,
+        "sketch_hll_bound": 0.065,
+        "sketch_topk_recall": 1.0,
+        "sketch_unit": "u" * 60,
         "lockstep_events": 42,
         "lockstep_divergences": 0,
         "kmeans_fused_ratio": 8.87,
@@ -331,6 +341,61 @@ class TestBenchCheck:
         line = json.dumps(bench._compact_summary(out, "d.json"))
         obj = bench_check.check(line)
         assert "stream_error" in obj
+        assert len(line) < bench_check.LINE_BUDGET
+
+    def test_sketch_keys_round_trip(self):
+        out = _synthetic_out()
+        obj = bench_check.check(json.dumps(bench._compact_summary(out, "d.json")))
+        assert obj["sketch_gbps"] == 0.0004
+        assert obj["sketch_warm_compiles"] == 0
+        assert obj["sketch_divergences"] == 0
+        assert obj["sketch_kll_rank_err"] == 0.0005
+        assert obj["sketch_topk_recall"] == 1.0
+
+    def test_rejects_sketch_divergence_recompile_and_no_data(self):
+        out = _synthetic_out()
+        out["sketch_divergences"] = 1
+        with pytest.raises(ValueError, match="promised bound"):
+            bench_check.check(json.dumps(bench._compact_summary(out, "d.json")))
+        out = _synthetic_out()
+        out["sketch_warm_compiles"] = 3
+        with pytest.raises(ValueError, match="warm sketch fold"):
+            bench_check.check(json.dumps(bench._compact_summary(out, "d.json")))
+        out = _synthetic_out()
+        out["sketch_gbps"] = 0.0
+        with pytest.raises(ValueError, match="moved no data"):
+            bench_check.check(json.dumps(bench._compact_summary(out, "d.json")))
+
+    def test_rejects_sketch_error_beyond_bound_and_orphan_column(self):
+        # an observed error larger than the sketch's own promise fails
+        # even if the worker's divergence counter missed it
+        out = _synthetic_out()
+        out["sketch_kll_rank_err"] = 0.05
+        with pytest.raises(ValueError, match="exceeds promised bound"):
+            bench_check.check(json.dumps(bench._compact_summary(out, "d.json")))
+        out = _synthetic_out()
+        out["sketch_hll_rel_err"] = 0.2
+        with pytest.raises(ValueError, match="exceeds promised bound"):
+            bench_check.check(json.dumps(bench._compact_summary(out, "d.json")))
+        out = _synthetic_out()
+        out["sketch_topk_recall"] = 0.875
+        with pytest.raises(ValueError, match="heavy hitter"):
+            bench_check.check(json.dumps(bench._compact_summary(out, "d.json")))
+        # error column without its bound is unjudgeable
+        out = _synthetic_out()
+        del out["sketch_hll_bound"]
+        with pytest.raises(ValueError, match="must appear together"):
+            bench_check.check(json.dumps(bench._compact_summary(out, "d.json")))
+
+    def test_sketch_error_degrades_gracefully(self):
+        out = _synthetic_out()
+        for k in list(out):
+            if k.startswith("sketch_"):
+                del out[k]
+        out["sketch_error"] = "x" * 400
+        line = json.dumps(bench._compact_summary(out, "d.json"))
+        obj = bench_check.check(line)
+        assert "sketch_error" in obj
         assert len(line) < bench_check.LINE_BUDGET
 
     def test_rejects_fused_kmeans_slower_than_components(self):

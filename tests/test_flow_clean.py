@@ -70,6 +70,20 @@ def test_stream_package_is_flow_clean():
     )
 
 
+def test_sketch_package_is_flow_clean():
+    """Explicit gate over the sketch layer: sketch states are merged over
+    the tree_merge butterfly, so every value feeding a fold or combine
+    must be replicated-identical across ranks — a rank-divergent count or
+    geometry here corrupts the merged estimate silently."""
+    findings, files_checked = gf.analyze_paths(
+        [os.path.join(REPO, "heat_tpu", "stream", "sketch")]
+    )
+    assert files_checked >= 4  # __init__, kll, hll, countmin
+    assert not findings, "\n".join(
+        f"  {f.path}:{f.line}:{f.col}: {f.rule} {f.message}" for f in findings
+    )
+
+
 def test_kernels_package_is_flow_clean():
     """Explicit gate over the fused-kernel layer: the sharded wrappers
     derive per-shard validity windows from axis_index inside shard_map —

@@ -45,15 +45,18 @@ def _np_lloyd_stats(x: np.ndarray, c: np.ndarray):
 
 
 def _submesh(world: int):
-    """A ws-``world`` mesh over the first ``world`` virtual CPU devices."""
+    """A ws-``world`` mesh over the first ``world`` LOCAL devices — under
+    a multi-process run every rank must build its mesh from devices it
+    can address (a global-ID submesh leaves rank 1 with no local devices
+    and XLA rejects the computation)."""
     import jax
 
     from heat_tpu.core.communication import SPLIT_AXIS
     from jax.sharding import Mesh
 
-    if len(jax.devices()) < world:
-        pytest.skip(f"needs {world} devices")
-    return Mesh(np.array(jax.devices()[:world]), axis_names=(SPLIT_AXIS,))
+    if len(jax.local_devices()) < world:
+        pytest.skip(f"needs {world} local devices")
+    return Mesh(np.array(jax.local_devices()[:world]), axis_names=(SPLIT_AXIS,))
 
 
 def _reference_knn(x: np.ndarray, y: np.ndarray, k: int):

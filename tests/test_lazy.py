@@ -274,12 +274,17 @@ class TestCrossChainCSE:
         xn = _data((24, 6), np.float64, seed=21)
         x = ht.array(xn, split=0)
 
+        # a prefix structurally disjoint from test_shared_prefix_compiles_once
+        # (no common leading op pair): the suite runner drives tests in
+        # sorted-id order, so this test runs BEFORE it and any shared
+        # registrable prefix would pre-register/pre-compile the chain whose
+        # fresh discovery that test counter-asserts
         def endpoint(head_scale):
             with ht.lazy():
-                t = ht.exp(-ht.abs(x)) * 2.125 + 1.375
+                t = ht.log(ht.abs(x) * 3.0625 + 2.4375)
                 return t * head_scale
 
-        want = (ht.exp(-ht.abs(x)) * 2.125 + 1.375) * 11.5
+        want = ht.log(ht.abs(x) * 3.0625 + 2.4375) * 11.5
         endpoint(9.75)   # registers the chain shape
         endpoint(11.5)   # composite: shared prefix + head
         reset_fuse_stats()

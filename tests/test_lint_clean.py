@@ -69,6 +69,20 @@ def test_stream_package_is_lint_clean():
     )
 
 
+def test_sketch_package_is_lint_clean():
+    """Explicit gate over the sketch layer: every fold is a cached jitted
+    program keyed by static geometry — a per-call jit closure or an
+    unbounded program cache here would turn the single-pass streaming
+    promise into a per-chunk recompile."""
+    findings, files_checked = gl.lint_paths(
+        [os.path.join(REPO, "heat_tpu", "stream", "sketch")]
+    )
+    assert files_checked >= 4  # __init__, kll, hll, countmin
+    assert not findings, "\n".join(
+        f"  {f.path}:{f.line}:{f.col}: {f.rule} {f.message}" for f in findings
+    )
+
+
 def test_kernels_package_is_lint_clean():
     """Explicit gate over the fused-kernel layer: the dispatch registry
     is HOT_CORE_MODULES-matched (host syncs are hard errors there) and

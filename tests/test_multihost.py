@@ -1448,3 +1448,160 @@ def test_multi_process_pytest_subset(tmp_path, nproc):
     assert len(passed) >= 50, f"only {len(passed)} multihost tests passed"
     assert not failed, f"multihost subset failures: {failed}"
     assert not uneven, f"rank-dependent outcomes: {uneven}"
+
+
+_TREE_MERGE_WORKER = r"""
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+pid = int(sys.argv[1]); nproc = int(sys.argv[2]); port = sys.argv[3]
+
+import heat_tpu as ht
+from heat_tpu.core import communication
+from heat_tpu.core.communication import tree_merge, tree_merge_rounds
+from heat_tpu.parallel.flatmove import MOVE_STATS
+from heat_tpu.stream import (
+    ChunkIterator, CountMinTopK, HyperLogLog, KLLSketch, StreamingMoments,
+)
+
+ht.init_distributed(
+    coordinator_address=f"localhost:{port}", num_processes=nproc, process_id=pid
+)
+assert jax.device_count() == 8 and jax.local_device_count() == 4
+
+rounds_expected = tree_merge_rounds(nproc)
+assert rounds_expected == 1, rounds_expected  # ceil(log2 2)
+
+# --- tree path vs flat path: bit-identical replicated result -------------
+import jax.numpy as jnp
+rng = np.random.default_rng(100 + pid)
+state = (jnp.int32(pid + 1), jnp.asarray(rng.normal(size=(5,)).astype(np.float32)))
+
+def comb(a, b):
+    return a[0] + b[0], a[1] + b[1] * 2.0  # deliberately non-commutative
+
+flat = communication._flat_state_merge(
+    [np.asarray(x) for x in state],
+    jax.tree_util.tree_structure(state), comb, nproc,
+)
+t0 = dict(MOVE_STATS)
+merged = tree_merge(state, comb)
+assert MOVE_STATS["tree_merges"] == t0["tree_merges"] + 1
+assert MOVE_STATS["tree_merge_rounds"] == t0["tree_merge_rounds"] + rounds_expected
+assert int(merged[0]) == int(flat[0]) == 3, (int(merged[0]), int(flat[0]))
+np.testing.assert_array_equal(np.asarray(merged[1]), np.asarray(flat[1]))
+
+# --- estimator retrofit: merge_processes == flat whole-data answer -------
+full = np.random.default_rng(7).normal(size=(240, 3)).astype(np.float32)
+local_rows = full[pid * 120 : (pid + 1) * 120]
+mom = StreamingMoments()
+for c in ChunkIterator(local_rows, 32, split=None):  # per-process pipeline
+    mom.update(c)
+t0 = dict(MOVE_STATS)
+mom.merge_processes()
+assert MOVE_STATS["tree_merges"] == t0["tree_merges"] + 1
+assert MOVE_STATS["tree_merge_rounds"] == t0["tree_merge_rounds"] + rounds_expected
+assert mom.n == 240, mom.n
+np.testing.assert_allclose(mom.mean.numpy(), full.mean(axis=0), rtol=1e-4, atol=1e-5)
+np.testing.assert_allclose(mom.var.numpy(), full.var(axis=0), rtol=1e-3, atol=1e-4)
+
+# --- sketches over the tree: oracle bounds hold at ws2 -------------------
+big = np.random.default_rng(9).normal(size=(8000, 2)).astype(np.float32)
+mine = big[pid * 4000 : (pid + 1) * 4000]
+sk = KLLSketch(k=256, levels=10)
+for c in ChunkIterator(mine, 512, split=None):
+    sk.update(c)
+sk.merge_processes()
+assert sk.n == big.shape[0], sk.n  # both halves merged back
+med = float(np.asarray(sk.median()._logical()))
+flat_sorted = np.sort(big.ravel())
+rank_err = abs(np.searchsorted(flat_sorted, med) / flat_sorted.size - 0.5)
+assert rank_err <= sk.eps + 1.0 / (2 * sk.k), (rank_err, sk.eps)
+
+ints = np.random.default_rng(11).integers(0, 3000, size=(6000, 1)).astype(np.float32)
+hll = HyperLogLog(p=12)
+for c in ChunkIterator(ints[pid * 3000 : (pid + 1) * 3000], 1024, split=None):
+    hll.update(c)
+hll.merge_processes()
+true_d = len(np.unique(ints))
+est = hll.distinct()
+assert abs(est - true_d) / true_d <= 4 * hll.rel_error, (est, true_d)
+
+zipf = np.minimum(np.random.default_rng(13).zipf(1.5, size=8000), 500).astype(
+    np.float32
+)[:, None]
+cm = CountMinTopK(width=1024, depth=4, k=16)
+for c in ChunkIterator(zipf[pid * 4000 : (pid + 1) * 4000], 1024, split=None):
+    cm.update(c)
+cm.merge_processes()
+tv, tc = cm.topk(5)
+tv = np.asarray(tv._logical())
+uniq, cnt = np.unique(zipf, return_counts=True)
+true_top3 = set(uniq[np.argsort(-cnt)[:3]].tolist())
+assert true_top3.issubset(set(tv.tolist())), (true_top3, tv)
+
+# --- groupby quantile: no shuffle, matches exact within the KLL bound ----
+keys = np.repeat(np.arange(4, dtype=np.int32), 500)
+vals = (np.random.default_rng(17).normal(size=2000) + keys).astype(np.float32)
+f = ht.Frame({"k": ht.array(keys, split=0), "v": ht.array(vals, split=0)})
+b0 = MOVE_STATS["bucket_moves"]
+res = f.groupby("k").quantile(0.5)
+assert MOVE_STATS["bucket_moves"] == b0, "groupby quantile shuffled"
+rk = np.asarray(res["k"]._logical()); rv = np.asarray(res["v"]._logical())
+for i, g in enumerate(rk):
+    grp = np.sort(vals[keys == g])
+    r_err = abs(np.searchsorted(grp, rv[i]) / grp.size - 0.5)
+    assert r_err <= (3 + 1) / (2 * 256) + 1e-3, (g, r_err)
+
+assert ht.LOCKSTEP_STATS["divergences"] == 0
+
+fp = float(np.sum(np.asarray(merged[1])))
+print(f"WORKER{pid} OK tree rounds={MOVE_STATS['tree_merge_rounds']} "
+      f"fp={fp:.6f} med={med:.6f} est={est:.1f}")
+"""
+
+
+@pytest.mark.skipif(
+    os.environ.get("HEAT_TPU_TEST_DEVICES", "8") != "8",
+    reason="one fixed 2x4 topology is enough for the matrix",
+)
+def test_two_process_tree_merge(tmp_path):
+    """Log-depth ``tree_merge`` under real 2-process execution (PR 20
+    tentpole): the butterfly path must (a) complete in exactly
+    ``ceil(log2 P)`` ppermute rounds (MOVE_STATS counter), (b) produce the
+    bit-identical replicated state the flat allgather+fold path produces,
+    (c) carry the retrofitted estimator ``merge_processes`` and every
+    sketch's cross-process merge within their oracle bounds, and (d) run
+    ``Frame.groupby(...).quantile`` with ``bucket_moves == 0`` while
+    matching the exact per-group quantile within the KLL rank bound —
+    all with ``LOCKSTEP_STATS['divergences'] == 0``."""
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+
+    worker = tmp_path / "tree_merge_worker.py"
+    worker.write_text(_TREE_MERGE_WORKER)
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env.pop("HEAT_TPU_TEST_DEVICES", None)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(i), "2", str(port)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = [p.communicate(timeout=600)[0] for p in procs]
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out}"
+        assert f"WORKER{i} OK" in out, out
+    # replicated results are identical across ranks: same merged payload,
+    # same sketch answers, same round counters
+    finals = [out.strip().splitlines()[-1].split()[2:] for out in outs]
+    assert finals[0] == finals[1], finals

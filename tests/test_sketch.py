@@ -1,0 +1,350 @@
+"""Sketch-based approximate analytics (PR 20 tentpole): single-pass
+quantiles / distinct-count / top-k at streaming bandwidth.
+
+Every sketch is oracle-checked against the exact in-memory answer on the
+same rows, with the observed error bounded by the sketch's OWN promise
+(``KLLSketch.eps``, ``HyperLogLog.rel_error``, ``CountMinTopK.eps``) —
+not a hand-tuned tolerance. The compile-once fold contract is
+counter-asserted (warm chunk folds run 0 XLA compiles / 0 traces), merge
+is exercised in both orders, and the float32/float64 sweep runs the same
+bounds at both precisions. The real 2-process merge path (tree_merge
+butterfly, rounds == ceil(log2 P)) lives in tests/test_multihost.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.analysis.sanitizer import sanitizer
+from heat_tpu.frame import Frame
+from heat_tpu.parallel.flatmove import MOVE_STATS
+from heat_tpu.stream import (
+    ChunkIterator,
+    CountMinTopK,
+    HyperLogLog,
+    KLLSketch,
+)
+
+DTYPES = (np.float32, np.float64)
+
+
+def _rank_err(flat: np.ndarray, est: float, q: float) -> float:
+    """Fractional-rank error of ``est`` against the exact data: distance
+    from ``q`` to the rank INTERVAL [P(X < est), P(X <= est)] the
+    estimate occupies (atoms occupy a whole interval, not a point)."""
+    srt = np.sort(flat.ravel())
+    lo = np.searchsorted(srt, est, side="left") / srt.size
+    hi = np.searchsorted(srt, est, side="right") / srt.size
+    return max(lo - q, q - hi, 0.0)
+
+
+@pytest.fixture(scope="module", params=DTYPES, ids=["f32", "f64"])
+def dtype(request):
+    return request.param
+
+
+class TestKLL:
+    def _data(self, dtype, rows=6000, cols=3, seed=3):
+        rng = np.random.default_rng(seed)
+        return rng.normal(size=(rows, cols)).astype(dtype)
+
+    def test_quantile_oracle_within_own_eps(self, dtype):
+        data = self._data(dtype)
+        sk = KLLSketch(k=256)
+        for ch in ChunkIterator(data, 512):
+            sk.update(ch)
+        assert sk.n == data.shape[0]
+        for q in (1.0, 25.0, 50.0, 75.0, 99.0):
+            est = float(sk.percentile(q).numpy())
+            assert _rank_err(data, est, q / 100.0) <= sk.eps
+        # median is percentile(50), same program
+        np.testing.assert_array_equal(
+            sk.median().numpy(), sk.percentile(50.0).numpy()
+        )
+        # vector q evaluates in one call
+        ests = sk.percentile([10.0, 90.0]).numpy()
+        assert ests.shape == (2,) and ests[0] < ests[1]
+
+    def test_state_dtype_follows_data(self, dtype):
+        sk = KLLSketch(k=64, levels=4)
+        sk.update(ht.array(self._data(dtype, rows=300), split=0))
+        assert sk._vals.dtype == np.dtype(dtype)
+
+    def test_warm_fold_zero_compile_zero_trace(self, dtype):
+        # a pass sees at most TWO chunk shapes (body + tail); one full
+        # cold pass compiles both programs, a second pass replays 0/0
+        data = self._data(dtype)
+        it = ChunkIterator(data, 512)
+        cold = KLLSketch(k=128)
+        for ch in it:
+            cold.update(ch)
+        sk = KLLSketch(k=128)
+        with sanitizer("kll warm folds") as region:
+            for ch in it:
+                sk.update(ch)
+        assert region.compiles == 0 and region.traces == 0, region.stats()
+
+    def test_merge_both_orders_stay_in_bound(self, dtype):
+        data = self._data(dtype, rows=9000)
+        thirds = np.array_split(data, 3)
+
+        def sketch(block):
+            sk = KLLSketch(k=256)
+            for ch in ChunkIterator(block, 512):
+                sk.update(ch)
+            return sk
+
+        left = sketch(thirds[0]).merge(sketch(thirds[1])).merge(sketch(thirds[2]))
+        right = sketch(thirds[2]).merge(sketch(thirds[1])).merge(sketch(thirds[0]))
+        for sk in (left, right):
+            assert sk.n == data.shape[0]
+            for q in (10.0, 50.0, 90.0):
+                est = float(sk.percentile(q).numpy())
+                assert _rank_err(data, est, q / 100.0) <= sk.eps
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="k must be"):
+            KLLSketch(k=4)
+        with pytest.raises(ValueError, match="levels must be"):
+            KLLSketch(levels=1)
+        with pytest.raises(RuntimeError, match="no chunks"):
+            KLLSketch().percentile(50.0)
+        a = KLLSketch(k=64, levels=4)
+        a.update(ht.array(np.ones((16, 1), np.float32), split=0))
+        b = KLLSketch(k=128, levels=4)
+        b.update(ht.array(np.ones((16, 1), np.float32), split=0))
+        with pytest.raises(ValueError, match="different geometry"):
+            a.merge(b)
+
+
+class TestHyperLogLog:
+    def _data(self, dtype, n=20000, card=5000, seed=5):
+        rng = np.random.default_rng(seed)
+        return rng.integers(0, card, size=(n,)).astype(dtype)
+
+    def test_distinct_oracle_within_4_sigma(self, dtype):
+        data = self._data(dtype)
+        sk = HyperLogLog(p=12)
+        for ch in ChunkIterator(data, 1 << 12):
+            sk.update(ch)
+        true = np.unique(data).size
+        assert abs(sk.distinct() - true) / true <= 4.0 * sk.rel_error
+
+    def test_merge_is_register_exact_union(self, dtype):
+        # max is associative and the hash is deterministic, so merging
+        # half-sketches must reproduce the full sketch REGISTER-exactly
+        data = self._data(dtype)
+        halves = np.array_split(data, 2)
+
+        def sketch(block):
+            sk = HyperLogLog(p=10)
+            for ch in ChunkIterator(block, 1 << 12):
+                sk.update(ch)
+            return sk
+
+        merged = sketch(halves[0]).merge(sketch(halves[1]))
+        full = sketch(data)
+        np.testing.assert_array_equal(
+            np.asarray(merged._regs), np.asarray(full._regs)
+        )
+        assert merged.n == full.n
+
+    def test_warm_fold_zero_compile_zero_trace(self, dtype):
+        data = self._data(dtype)
+        it = ChunkIterator(data, 1 << 12)
+        cold = HyperLogLog(p=10)
+        for ch in it:
+            cold.update(ch)
+        sk = HyperLogLog(p=10)
+        with sanitizer("hll warm folds") as region:
+            for ch in it:
+                sk.update(ch)
+        assert region.compiles == 0 and region.traces == 0, region.stats()
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="p must be"):
+            HyperLogLog(p=2)
+        with pytest.raises(RuntimeError, match="no chunks"):
+            HyperLogLog().distinct()
+        a = HyperLogLog(p=10)
+        a.update(ht.array(np.ones((8,), np.float32), split=0))
+        b = HyperLogLog(p=12)
+        b.update(ht.array(np.ones((8,), np.float32), split=0))
+        with pytest.raises(ValueError, match="different p"):
+            a.merge(b)
+
+
+class TestCountMinTopK:
+    def _data(self, dtype, n=40000, seed=7):
+        rng = np.random.default_rng(seed)
+        return np.minimum(rng.zipf(1.5, size=(n,)), 500).astype(dtype)
+
+    def test_topk_recovers_heavy_hitters(self, dtype):
+        data = self._data(dtype)
+        sk = CountMinTopK(width=2048, depth=4, k=32)
+        for ch in ChunkIterator(data, 1 << 12):
+            sk.update(ch)
+        uniq, counts = np.unique(data, return_counts=True)
+        order = np.argsort(counts)[::-1]
+        # every true hitter above the sketch's own noise floor must be in
+        # the candidate set, and its estimate conservative + within bound
+        floor = sk.eps * sk.items
+        top_vals = sk.topk(8)[0].numpy()
+        for v, c in zip(uniq[order[:8]], counts[order[:8]]):
+            if c <= floor:
+                continue
+            assert v in top_vals
+            est = sk.estimate(v)
+            assert est >= c  # never under-counts
+            assert est - c <= floor
+
+    def test_topk_counts_sorted_descending(self, dtype):
+        data = self._data(dtype)
+        sk = CountMinTopK(width=1024, depth=4, k=16)
+        for ch in ChunkIterator(data, 1 << 12):
+            sk.update(ch)
+        _, cnts = sk.topk()
+        c = cnts.numpy()
+        assert np.all(c[:-1] >= c[1:])
+
+    def test_merge_table_is_exact_sum(self, dtype):
+        # counts are small integers (exactly representable in f32), so
+        # merging half-sketch tables must equal the full sketch's table
+        data = self._data(dtype)
+        halves = np.array_split(data, 2)
+
+        def sketch(block):
+            sk = CountMinTopK(width=512, depth=4, k=16)
+            for ch in ChunkIterator(block, 1 << 12):
+                sk.update(ch)
+            return sk
+
+        merged = sketch(halves[0]).merge(sketch(halves[1]))
+        full = sketch(data)
+        np.testing.assert_array_equal(
+            np.asarray(merged._table), np.asarray(full._table)
+        )
+        assert merged.items == full.items
+
+    def test_warm_fold_zero_compile_zero_trace(self, dtype):
+        data = self._data(dtype)
+        it = ChunkIterator(data, 1 << 12)
+        cold = CountMinTopK(width=512, depth=4, k=16)
+        for ch in it:
+            cold.update(ch)
+        sk = CountMinTopK(width=512, depth=4, k=16)
+        with sanitizer("cm warm folds") as region:
+            for ch in it:
+                sk.update(ch)
+        assert region.compiles == 0 and region.traces == 0, region.stats()
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="width must be"):
+            CountMinTopK(width=8)
+        with pytest.raises(ValueError, match="depth must be"):
+            CountMinTopK(depth=0)
+        with pytest.raises(ValueError, match="k must be"):
+            CountMinTopK(k=0)
+        with pytest.raises(RuntimeError, match="no chunks"):
+            CountMinTopK().topk()
+        a = CountMinTopK(width=512, depth=4, k=8)
+        a.update(ht.array(np.ones((8,), np.float32), split=0))
+        b = CountMinTopK(width=1024, depth=4, k=8)
+        b.update(ht.array(np.ones((8,), np.float32), split=0))
+        with pytest.raises(ValueError, match="different geometry"):
+            a.merge(b)
+        with pytest.raises(ValueError, match="k must be in"):
+            a.topk(99)
+
+
+class TestStreamingPercentileAPI:
+    """``ht.percentile``/``ht.median`` route ChunkIterator input onto the
+    single-pass KLL path; exact DNDarray semantics are untouched."""
+
+    def _data(self, dtype, rows=5000):
+        rng = np.random.default_rng(9)
+        return rng.normal(size=(rows, 4)).astype(dtype)
+
+    def test_streaming_percentile_within_kll_bound(self, dtype):
+        data = self._data(dtype)
+        got = ht.percentile(ChunkIterator(data, 512), 75.0)
+        # the default sketch at this fold count promises eps <= 6/512
+        assert _rank_err(data, float(got.numpy()), 0.75) <= 6.0 / 512.0
+
+    def test_streaming_median_matches_percentile_50(self, dtype):
+        data = self._data(dtype)
+        med = float(ht.median(ChunkIterator(data, 512)).numpy())
+        p50 = float(ht.percentile(ChunkIterator(data, 512), 50.0).numpy())
+        assert med == p50
+
+    def test_streaming_rejects_axis_and_bad_q(self):
+        data = self._data(np.float32)
+        with pytest.raises(ValueError, match="streaming"):
+            ht.percentile(ChunkIterator(data, 512), 50.0, axis=0)
+        with pytest.raises(ValueError, match="percentiles must be"):
+            ht.percentile(ChunkIterator(data, 512), 150.0)
+
+    def test_type_error_names_sketch_path(self):
+        with pytest.raises(TypeError, match="KLL sketch path"):
+            ht.percentile([1.0, 2.0], 50.0)
+        with pytest.raises(TypeError, match="KLL sketch path"):
+            ht.median([1.0, 2.0])
+
+    def test_exact_dndarray_path_unchanged(self, dtype):
+        data = self._data(dtype)
+        x = ht.array(data, split=0)
+        np.testing.assert_allclose(
+            ht.percentile(x, 30.0).numpy(),
+            np.percentile(data, 30.0),
+            rtol=1e-6,
+        )
+
+
+class TestGroupbyQuantile:
+    """``Frame.groupby(key).quantile(q)`` — per-group KLL sketches merged
+    over the tree, NO shuffle (bucket_moves counter-asserted)."""
+
+    def _frame(self, rows=4000, keys=5, seed=13):
+        rng = np.random.default_rng(seed)
+        k = rng.integers(0, keys, size=(rows,)).astype(np.float32)
+        v = rng.normal(size=(rows,)).astype(np.float32) + 3.0 * k
+        w = rng.gamma(2.0, size=(rows,)).astype(np.float32)
+        return (
+            Frame({"k": ht.array(k, split=0), "v": ht.array(v, split=0),
+                   "w": ht.array(w, split=0)}),
+            k, {"v": v, "w": w},
+        )
+
+    def test_matches_exact_within_bound_without_shuffle(self):
+        frame, keys, cols = self._frame()
+        before = MOVE_STATS["bucket_moves"]
+        res = frame.groupby("k").quantile(0.5, k=256)
+        assert MOVE_STATS["bucket_moves"] == before  # no shuffle happened
+        union = res["k"].numpy()
+        np.testing.assert_array_equal(union, np.unique(keys))
+        # single fold per group, P=1: bound is (2 + 1 + 0) / (2k)
+        bound = 3.0 / (2.0 * 256)
+        for name, col in cols.items():
+            got = res[name].numpy()
+            for g, kv in enumerate(union):
+                grp = col[keys == kv]
+                assert _rank_err(grp, float(got[g]), 0.5) <= bound + 1e-6
+
+    def test_off_center_quantile(self):
+        frame, keys, cols = self._frame(rows=6000, keys=3)
+        res = frame.groupby("k").quantile(0.9, k=256)
+        union = res["k"].numpy()
+        for g, kv in enumerate(union):
+            grp = cols["v"][keys == kv]
+            err = _rank_err(grp, float(res["v"].numpy()[g]), 0.9)
+            assert err <= 3.0 / (2.0 * 256) + 1e-6
+
+    def test_validation(self):
+        frame, _, _ = self._frame(rows=64)
+        with pytest.raises(ValueError, match="fraction in"):
+            frame.groupby("k").quantile(50.0)
+        with pytest.raises(ValueError, match="value column"):
+            Frame({"k": ht.array(np.ones(8, np.float32), split=0)}).groupby(
+                "k"
+            ).quantile(0.5)

@@ -102,6 +102,45 @@ def validate(line: str, obj: dict) -> None:
                 f"stream_warm_compiles must be 0, got {obj.get('stream_warm_compiles')!r}: "
                 "the warm chunk loop recompiled/retraced per chunk"
             )
+    if "sketch_gbps" in obj:
+        gbps = obj["sketch_gbps"]
+        if not isinstance(gbps, (int, float)) or isinstance(gbps, bool) or gbps <= 0:
+            raise ValueError(
+                f"'sketch_gbps' must be a positive number, got {gbps!r}: the "
+                "sketch fold pipeline moved no data"
+            )
+        if obj.get("sketch_divergences") != 0:
+            raise ValueError(
+                f"sketch_divergences must be 0, got {obj.get('sketch_divergences')!r}: "
+                "a sketch's observed error broke its own promised bound — "
+                "the approximate answers cannot be trusted"
+            )
+        if obj.get("sketch_warm_compiles") != 0:
+            raise ValueError(
+                f"sketch_warm_compiles must be 0, got {obj.get('sketch_warm_compiles')!r}: "
+                "the warm sketch fold loop recompiled/retraced per chunk"
+            )
+        # observed-vs-promised columns must travel together: an error
+        # column without its bound (or vice versa) cannot be judged
+        for err_k, bound_k in (
+            ("sketch_kll_rank_err", "sketch_kll_eps"),
+            ("sketch_hll_rel_err", "sketch_hll_bound"),
+        ):
+            if (err_k in obj) != (bound_k in obj):
+                raise ValueError(
+                    f"'{err_k}' and '{bound_k}' must appear together: an "
+                    "observed error without its promised bound is unjudgeable"
+                )
+            if err_k in obj and obj[err_k] > obj[bound_k]:
+                raise ValueError(
+                    f"{err_k} {obj[err_k]} exceeds promised bound "
+                    f"{bound_k} {obj[bound_k]}"
+                )
+        if obj.get("sketch_topk_recall", 1.0) < 1.0:
+            raise ValueError(
+                f"sketch_topk_recall must be 1.0, got {obj.get('sketch_topk_recall')!r}: "
+                "a true heavy hitter above the Count-Min noise floor was missed"
+            )
     # fused-kernel layer gates (r8). Keys are absent when the bench ran
     # without the pallas path (e.g. CPU smoke) — absence is not a
     # violation, a present-but-failing value is.
